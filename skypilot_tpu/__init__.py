@@ -1,0 +1,48 @@
+"""skypilot_tpu: a TPU-native orchestration + training/serving framework.
+
+A from-scratch rebuild of the capabilities of SkyPilot (reference layer map in
+SURVEY.md §1) designed TPU-first: TPU pod slices are first-class schedulable
+units, the on-cluster runtime is Ray-free (per-host agents + jax.distributed
+rendezvous over ICI/DCN), and the compute path (models/, parallel/, ops/) is
+idiomatic JAX/XLA/Pallas.
+
+Public API mirrors the reference's surface (sky/__init__.py:83-222):
+``launch/exec/status/start/stop/down/autostop/queue/cancel/tail_logs/optimize``
+plus the ``Task``/``Resources``/``Dag`` object layer.
+"""
+from skypilot_tpu.accelerators import TpuSlice
+from skypilot_tpu.dag import Dag
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+__version__ = '0.1.0'
+
+__all__ = [
+    'Dag',
+    'Resources',
+    'Task',
+    'TpuSlice',
+    '__version__',
+]
+
+
+def __getattr__(name):  # lazy: heavy modules only on use
+    _lazy = {
+        'launch': ('skypilot_tpu.execution', 'launch'),
+        'exec': ('skypilot_tpu.execution', 'exec_'),
+        'optimize': ('skypilot_tpu.optimizer', 'optimize'),
+        'status': ('skypilot_tpu.core', 'status'),
+        'start': ('skypilot_tpu.core', 'start'),
+        'stop': ('skypilot_tpu.core', 'stop'),
+        'down': ('skypilot_tpu.core', 'down'),
+        'autostop': ('skypilot_tpu.core', 'autostop'),
+        'queue': ('skypilot_tpu.core', 'queue'),
+        'cancel': ('skypilot_tpu.core', 'cancel'),
+        'tail_logs': ('skypilot_tpu.core', 'tail_logs'),
+        'job_status': ('skypilot_tpu.core', 'job_status'),
+    }
+    if name in _lazy:
+        import importlib
+        module, attr = _lazy[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(f'module {__name__!r} has no attribute {name!r}')

@@ -1,0 +1,240 @@
+"""First-class TPU pod-slice model.
+
+The central design departure from the reference: SkyPilot models TPUs as
+"accelerators attached to a VM" and discovers the number of hosts of a pod
+slice only at runtime (``num_ips_per_node``, reference
+sky/backends/cloud_vm_ray_backend.py:2588-2596). Here the *slice* is the unit
+of scheduling: a ``TpuSlice`` knows its generation, chip count, ICI topology,
+hosts (derived), per-chip FLOPs/HBM, and the runtime version — everything the
+optimizer, provisioner, and mesh builder need, statically.
+
+Naming follows the public accelerator-type convention the reference also uses
+(e.g. ``tpu-v6e-8``; reference sky/resources.py:565-641 infers cloud=GCP from
+the ``tpu-`` prefix): for v2/v3/v4/v5p the trailing number counts TensorCores,
+for v5e (v5litepod) and v6e it counts chips.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuGeneration:
+    """Static hardware description of one TPU generation."""
+    name: str                  # 'v5e'
+    gcp_prefix: str            # accelerator-type prefix, e.g. 'v5litepod'
+    cores_per_chip: int        # name counts cores for gens where this is 2
+    chips_per_host: int        # chips on one host (full-host slices)
+    bf16_tflops_per_chip: float
+    hbm_gb_per_chip: float
+    ici_axes: int              # 2 = 2D torus (v5e/v6e), 3 = 3D torus (v4/v5p)
+    ici_gbps_per_link: float   # unidirectional per-link bandwidth (GB/s)
+    default_runtime_version: str
+    name_counts_cores: bool    # True → 'v3-8' means 8 cores (4 chips)
+    max_chips: int
+
+    def hosts_for_chips(self, chips: int) -> int:
+        return max(1, math.ceil(chips / self.chips_per_host))
+
+
+# Peak-compute / HBM numbers are the public per-chip specs; ICI bandwidths are
+# the public per-link figures used for the optimizer's comm-time model.
+GENERATIONS: Dict[str, TpuGeneration] = {
+    g.name: g for g in [
+        TpuGeneration('v2', 'v2', 2, 4, 45.0, 16.0, 2, 62.5,
+                      'tpu-vm-base', True, 512),
+        TpuGeneration('v3', 'v3', 2, 4, 123.0, 32.0, 2, 81.25,
+                      'tpu-vm-base', True, 2048),
+        TpuGeneration('v4', 'v4', 2, 4, 275.0, 32.0, 3, 56.25,
+                      'tpu-vm-v4-base', True, 8192),
+        TpuGeneration('v5e', 'v5litepod', 1, 8, 197.0, 16.0, 2, 50.0,
+                      'v2-alpha-tpuv5-lite', False, 256),
+        TpuGeneration('v5p', 'v5p', 2, 4, 459.0, 95.0, 3, 100.0,
+                      'v2-alpha-tpuv5', True, 12288),
+        TpuGeneration('v6e', 'v6e', 1, 8, 918.0, 32.0, 2, 112.5,
+                      'v2-alpha-tpuv6e', False, 256),
+    ]
+}
+
+# Default 2D topologies for v5e/v6e slice sizes (chips → XxY), the shapes the
+# TPU API actually offers; 3D-torus gens derive a near-cubic topology.
+_2D_TOPOLOGIES: Dict[int, str] = {
+    1: '1x1', 2: '1x2', 4: '2x2', 8: '2x4', 16: '4x4', 32: '4x8',
+    64: '8x8', 128: '8x16', 256: '16x16',
+}
+
+_NAME_RE = re.compile(r'^(?:tpu-)?(v[0-9]+[a-z]*)-(\d+)$')
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuSlice:
+    """One schedulable TPU slice, e.g. ``tpu-v5p-64``."""
+    generation: str   # 'v5p'
+    count: int        # the number in the name (cores or chips per convention)
+
+    # ---- parsing ----------------------------------------------------------
+    @classmethod
+    def from_name(cls, name: str) -> 'TpuSlice':
+        m = _NAME_RE.match(name.strip().lower())
+        if not m:
+            raise exceptions.InvalidSliceError(
+                f'Unrecognized TPU slice name: {name!r} '
+                f"(expected e.g. 'tpu-v5e-8', 'v5p-64')")
+        gen_name, count = m.group(1), int(m.group(2))
+        if gen_name == 'v5litepod':
+            gen_name = 'v5e'
+        if gen_name not in GENERATIONS:
+            raise exceptions.InvalidSliceError(
+                f'Unknown TPU generation {gen_name!r} in {name!r}. '
+                f'Known: {sorted(GENERATIONS)}')
+        gen = GENERATIONS[gen_name]
+        if count <= 0 or count > gen.max_chips * gen.cores_per_chip:
+            raise exceptions.InvalidSliceError(
+                f'TPU slice {name!r}: count {count} out of range for '
+                f'{gen_name}')
+        slice_ = cls(gen_name, count)
+        # Force count validity (chips integral).
+        _ = slice_.chips
+        return slice_
+
+    @classmethod
+    def maybe_from_name(cls, name: str) -> Optional['TpuSlice']:
+        try:
+            return cls.from_name(name)
+        except exceptions.InvalidSliceError:
+            return None
+
+    # ---- derived hardware facts ------------------------------------------
+    @property
+    def gen(self) -> TpuGeneration:
+        return GENERATIONS[self.generation]
+
+    @property
+    def chips(self) -> int:
+        gen = self.gen
+        if gen.name_counts_cores:
+            if self.count % gen.cores_per_chip != 0:
+                raise exceptions.InvalidSliceError(
+                    f'{self.name}: core count {self.count} not a multiple of '
+                    f'{gen.cores_per_chip} cores/chip')
+            return self.count // gen.cores_per_chip
+        return self.count
+
+    @property
+    def num_hosts(self) -> int:
+        """Derived statically — the provisioner gang-launches exactly this many
+        TPU-VM workers, and rank assignment needs no runtime discovery."""
+        return self.gen.hosts_for_chips(self.chips)
+
+    @property
+    def chips_per_host(self) -> int:
+        return min(self.chips, self.gen.chips_per_host)
+
+    @property
+    def is_pod(self) -> bool:
+        return self.num_hosts > 1
+
+    @property
+    def name(self) -> str:
+        return f'tpu-{self.generation}-{self.count}'
+
+    @property
+    def gcp_accelerator_type(self) -> str:
+        return f'{self.gen.gcp_prefix}-{self.count}'
+
+    @property
+    def default_runtime_version(self) -> str:
+        return self.gen.default_runtime_version
+
+    @property
+    def topology(self) -> Tuple[int, ...]:
+        """ICI mesh shape in chips (2D or 3D torus)."""
+        chips = self.chips
+        gen = self.gen
+        if gen.ici_axes == 2:
+            if chips in _2D_TOPOLOGIES:
+                x, y = _2D_TOPOLOGIES[chips].split('x')
+                return (int(x), int(y))
+            # Fall back: most-square factorization.
+            x = int(math.sqrt(chips))
+            while x > 1 and chips % x:
+                x -= 1
+            return (x, chips // x)
+        # 3D torus: near-cubic factorization with axes sized 2^k*... (the real
+        # API offers shapes like 2x2x1, 2x2x2, 2x2x4, 4x4x4...).
+        best = (1, 1, chips)
+        for x in range(1, int(round(chips ** (1 / 3))) + 1):
+            if chips % x:
+                continue
+            rem = chips // x
+            for y in range(x, int(math.sqrt(rem)) + 1):
+                if rem % y:
+                    continue
+                cand = (x, y, rem // y)
+                if max(cand) - min(cand) < max(best) - min(best):
+                    best = cand
+        return best
+
+    @property
+    def topology_str(self) -> str:
+        return 'x'.join(str(d) for d in self.topology)
+
+    # ---- perf model (optimizer inputs) -----------------------------------
+    @property
+    def total_bf16_tflops(self) -> float:
+        return self.chips * self.gen.bf16_tflops_per_chip
+
+    @property
+    def total_hbm_gb(self) -> float:
+        return self.chips * self.gen.hbm_gb_per_chip
+
+    @property
+    def ici_bisection_gbps(self) -> float:
+        """Approximate bisection bandwidth across the slice (GB/s)."""
+        topo = self.topology
+        links_cut = self.chips // max(topo)  # cut across the longest axis
+        wrap = 2 if max(topo) > 2 else 1     # torus wraparound doubles links
+        return links_cut * wrap * self.gen.ici_gbps_per_link
+
+    def __str__(self) -> str:
+        return self.name
+
+
+def list_slice_names(generation: Optional[str] = None) -> List[str]:
+    """All standard slice names (used by catalog generation / `show-tpus`)."""
+    names = []
+    for gen in GENERATIONS.values():
+        if generation and gen.name != generation:
+            continue
+        if gen.ici_axes == 2:
+            sizes = [c for c in _2D_TOPOLOGIES if c <= gen.max_chips]
+        else:
+            # Standard offerings: powers-of-two full-host multiples.
+            sizes = []
+            n = gen.chips_per_host
+            while n <= gen.max_chips:
+                sizes.append(n)
+                n *= 2
+        for chips in sizes:
+            count = chips * (gen.cores_per_chip if gen.name_counts_cores else 1)
+            names.append(f'tpu-{gen.name}-{count}')
+    return names
+
+
+def canonicalize_accelerator_name(name: str) -> str:
+    """'TPU-V5E-8' / 'v5litepod-8' / 'tpu-v5e-8' → 'tpu-v5e-8'."""
+    s = TpuSlice.maybe_from_name(name)
+    if s is not None:
+        return s.name
+    return name
+
+
+def is_tpu(accelerator_name: Optional[str]) -> bool:
+    if accelerator_name is None:
+        return False
+    return TpuSlice.maybe_from_name(accelerator_name) is not None

@@ -1,0 +1,115 @@
+"""Layered configuration system.
+
+Mirrors the reference's config stack (sky/skypilot_config.py:119-208):
+``~/.skytpu/config.yaml`` (jsonschema-validated) ← env-var override file
+(``SKYTPU_CONFIG``) ← per-task ``config_overrides`` overlays. Values are
+addressed by key tuples: ``config.get_nested(('gcp', 'project_id'), None)``.
+"""
+from __future__ import annotations
+
+import contextlib
+import copy
+import os
+import threading
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+from skypilot_tpu.utils import common_utils
+
+ENV_VAR_CONFIG = 'SKYTPU_CONFIG'
+CONFIG_PATH = '~/.skytpu/config.yaml'
+
+_dict_lock = threading.Lock()
+_loaded: bool = False
+_config: Dict[str, Any] = {}
+_overlays: 'threading.local' = threading.local()
+
+
+def _load() -> None:
+    global _loaded, _config
+    with _dict_lock:
+        if _loaded:
+            return
+        path = os.environ.get(ENV_VAR_CONFIG,
+                              os.path.expanduser(CONFIG_PATH))
+        config: Dict[str, Any] = {}
+        if os.path.exists(path):
+            config = common_utils.read_yaml(path)
+            from skypilot_tpu import schemas  # lazy: avoid cycle
+            schemas.validate_config(config, source=path)
+        _config = config
+        _loaded = True
+
+
+def reload() -> None:
+    """Drop the cache (tests and `api start` use this)."""
+    global _loaded
+    with _dict_lock:
+        _loaded = False
+
+
+def _active_config() -> Dict[str, Any]:
+    _load()
+    overlay = getattr(_overlays, 'stack', None)
+    if overlay:
+        return overlay[-1]
+    return _config
+
+
+def get_nested(keys: Tuple[str, ...], default_value: Any = None) -> Any:
+    cur: Any = _active_config()
+    for k in keys:
+        if not isinstance(cur, dict) or k not in cur:
+            return default_value
+        cur = cur[k]
+    return cur
+
+
+def set_nested(keys: Tuple[str, ...], value: Any) -> Dict[str, Any]:
+    """Return a copy of the active config with keys set (does not persist)."""
+    config = copy.deepcopy(_active_config())
+    cur = config
+    for k in keys[:-1]:
+        cur = cur.setdefault(k, {})
+        if not isinstance(cur, dict):
+            raise ValueError(f'Config key path {keys} hits non-dict at {k!r}')
+    cur[keys[-1]] = value
+    return config
+
+
+def _deep_merge(base: Dict[str, Any], overlay: Dict[str, Any]) -> Dict[str, Any]:
+    out = copy.deepcopy(base)
+    for k, v in overlay.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = copy.deepcopy(v)
+    return out
+
+
+@contextlib.contextmanager
+def override(overrides: Optional[Dict[str, Any]]) -> Iterator[None]:
+    """Overlay per-task ``config_overrides`` for the duration of a block."""
+    if not overrides:
+        yield
+        return
+    from skypilot_tpu import schemas  # lazy
+    schemas.validate_config(overrides, source='config_overrides')
+    merged = _deep_merge(_active_config(), overrides)
+    stack = getattr(_overlays, 'stack', None)
+    if stack is None:
+        stack = []
+        _overlays.stack = stack
+    stack.append(merged)
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
+def loaded_config_path() -> Optional[str]:
+    path = os.environ.get(ENV_VAR_CONFIG, os.path.expanduser(CONFIG_PATH))
+    return path if os.path.exists(path) else None
+
+
+def to_dict() -> Dict[str, Any]:
+    return copy.deepcopy(_active_config())

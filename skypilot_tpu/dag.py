@@ -1,0 +1,108 @@
+"""Task DAGs (chains, for pipelines of tasks).
+
+Counterpart of reference ``sky/dag.py`` (networkx-backed Dag + ``with
+sky.Dag():`` context). Kept dependency-light: adjacency dicts instead of
+networkx — the optimizer only needs topological order and chain detection.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Set
+
+from skypilot_tpu import task as task_lib
+
+
+class Dag:
+    """A DAG of Tasks. Supports `with Dag() as dag: Task(...)` registration."""
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name
+        self.tasks: List[task_lib.Task] = []
+        self._edges: Dict[task_lib.Task, Set[task_lib.Task]] = {}
+        self._redges: Dict[task_lib.Task, Set[task_lib.Task]] = {}
+        # Managed-jobs metadata:
+        self.policy_applied: bool = False
+
+    def add(self, task: task_lib.Task) -> None:
+        if task in self.tasks:
+            return
+        self.tasks.append(task)
+        self._edges.setdefault(task, set())
+        self._redges.setdefault(task, set())
+
+    def remove(self, task: task_lib.Task) -> None:
+        self.tasks.remove(task)
+        for nbrs in self._edges.values():
+            nbrs.discard(task)
+        for nbrs in self._redges.values():
+            nbrs.discard(task)
+        self._edges.pop(task, None)
+        self._redges.pop(task, None)
+
+    def add_edge(self, op1: task_lib.Task, op2: task_lib.Task) -> None:
+        self.add(op1)
+        self.add(op2)
+        self._edges[op1].add(op2)
+        self._redges[op2].add(op1)
+
+    def __len__(self) -> int:
+        return len(self.tasks)
+
+    def __enter__(self) -> 'Dag':
+        push_dag(self)
+        return self
+
+    def __exit__(self, *args) -> None:
+        pop_dag()
+
+    def topological_order(self) -> List[task_lib.Task]:
+        indeg = {t: len(self._redges[t]) for t in self.tasks}
+        # Stable order: seed queue in insertion order.
+        queue = [t for t in self.tasks if indeg[t] == 0]
+        out: List[task_lib.Task] = []
+        while queue:
+            t = queue.pop(0)
+            out.append(t)
+            for nbr in sorted(self._edges[t], key=self.tasks.index):
+                indeg[nbr] -= 1
+                if indeg[nbr] == 0:
+                    queue.append(nbr)
+        if len(out) != len(self.tasks):
+            raise ValueError('DAG has a cycle')
+        return out
+
+    def is_chain(self) -> bool:
+        if len(self.tasks) <= 1:
+            return True
+        nonzero_out = [t for t in self.tasks if self._edges[t]]
+        return all(len(self._edges[t]) <= 1 for t in self.tasks) and all(
+            len(self._redges[t]) <= 1 for t in self.tasks) and (
+                len(nonzero_out) == len(self.tasks) - 1)
+
+    def __repr__(self) -> str:
+        return f'Dag({self.name!r}, tasks={[t.name for t in self.tasks]})'
+
+
+_dag_stack = threading.local()
+
+
+def push_dag(dag: Dag) -> None:
+    stack = getattr(_dag_stack, 'stack', None)
+    if stack is None:
+        stack = []
+        _dag_stack.stack = stack
+    stack.append(dag)
+
+
+def pop_dag() -> Optional[Dag]:
+    stack = getattr(_dag_stack, 'stack', None)
+    if stack:
+        return stack.pop()
+    return None
+
+
+def get_current_dag() -> Optional[Dag]:
+    stack = getattr(_dag_stack, 'stack', None)
+    if stack:
+        return stack[-1]
+    return None

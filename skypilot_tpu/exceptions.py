@@ -1,0 +1,197 @@
+"""Typed error taxonomy for skypilot_tpu.
+
+Mirrors the role of the reference's error taxonomy (``sky/exceptions.py``):
+a small set of exception types that carry enough structure for the failover
+engine (blocked resources, failover history) and for the CLI/SDK to render
+actionable messages.
+"""
+from __future__ import annotations
+
+import enum
+from typing import Any, Dict, List, Optional
+
+
+class SkyTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class InvalidTaskError(SkyTpuError):
+    """Task YAML / Task object is malformed."""
+
+
+class InvalidResourcesError(SkyTpuError):
+    """Resources spec is malformed or internally inconsistent."""
+
+
+class InvalidSliceError(InvalidResourcesError):
+    """Unknown TPU slice type / topology."""
+
+
+class InvalidYamlError(InvalidTaskError):
+    """YAML failed schema validation."""
+
+
+class ClusterNotUpError(SkyTpuError):
+    """Operation requires an UP cluster (e.g. exec / queue / logs)."""
+
+    def __init__(self, message: str, cluster_status: Optional[Any] = None):
+        super().__init__(message)
+        self.cluster_status = cluster_status
+
+
+class ClusterDoesNotExist(SkyTpuError):
+    """Named cluster has no record."""
+
+
+class ClusterOwnerIdentityMismatchError(SkyTpuError):
+    """Cluster was created under a different cloud identity."""
+
+
+class NotSupportedError(SkyTpuError):
+    """Feature unsupported by the requested cloud/backend."""
+
+
+class CloudUserIdentityError(SkyTpuError):
+    """Could not determine the active cloud identity."""
+
+
+class CloudError(SkyTpuError):
+    """An error returned by a cloud API call."""
+
+    def __init__(self, message: str, *, code: Optional[int] = None,
+                 reason: Optional[str] = None):
+        super().__init__(message)
+        self.code = code
+        self.reason = reason
+
+
+class ProvisionError(SkyTpuError):
+    """Provisioning a cluster failed (possibly after retries)."""
+
+
+class ResourcesUnavailableError(SkyTpuError):
+    """No feasible resources (capacity/quota/feasibility).
+
+    Carries ``failover_history`` so callers (managed jobs, CLI) can show why
+    each candidate was rejected — same contract as the reference's
+    ``ResourcesUnavailableError`` (sky/exceptions.py).
+    """
+
+    def __init__(self, message: str,
+                 failover_history: Optional[List[Exception]] = None,
+                 no_failover: bool = False):
+        super().__init__(message)
+        self.failover_history: List[Exception] = failover_history or []
+        self.no_failover = no_failover
+
+    def with_failover_history(
+            self, history: List[Exception]) -> 'ResourcesUnavailableError':
+        self.failover_history = history
+        return self
+
+
+class ResourcesMismatchError(SkyTpuError):
+    """Requested resources do not match the target cluster's resources."""
+
+
+class CommandError(SkyTpuError):
+    """A remote/local command returned non-zero."""
+
+    def __init__(self, returncode: int, command: str, error_msg: str = '',
+                 detailed_reason: Optional[str] = None):
+        self.returncode = returncode
+        self.command = command
+        self.error_msg = error_msg
+        self.detailed_reason = detailed_reason
+        cmd = command if len(command) < 100 else command[:100] + '...'
+        super().__init__(
+            f'Command {cmd} failed with return code {returncode}.\n'
+            f'{error_msg}')
+
+
+class JobNotFoundError(SkyTpuError):
+    """Job id not present in the cluster job queue."""
+
+
+class JobExitCode(enum.IntEnum):
+    """Exit codes for job-related CLI commands (mirrors reference mapping)."""
+    SUCCEEDED = 0
+    FAILED = 100
+    NOT_FINISHED = 101
+    NOT_FOUND = 102
+
+
+class StorageError(SkyTpuError):
+    """Storage (bucket) creation/sync/mount errors."""
+
+
+class StorageBucketCreateError(StorageError):
+    pass
+
+
+class StorageBucketGetError(StorageError):
+    pass
+
+
+class StorageUploadError(StorageError):
+    pass
+
+
+class ServeUserTerminatedError(SkyTpuError):
+    """Service was terminated by the user mid-operation."""
+
+
+class RequestCancelled(SkyTpuError):
+    """An API-server request was cancelled."""
+
+
+class RequestNotFoundError(SkyTpuError):
+    """Unknown API-server request id."""
+
+
+class ApiServerConnectionError(SkyTpuError):
+    """Could not reach the API server."""
+
+    def __init__(self, server_url: str):
+        super().__init__(
+            f'Could not connect to API server at {server_url}. '
+            f'Start one with `skytpu api start`.')
+        self.server_url = server_url
+
+
+class ManagedJobReachedMaxRetriesError(SkyTpuError):
+    """Managed job exhausted launch retries during recovery."""
+
+
+class ManagedJobStatusError(SkyTpuError):
+    """Managed job is in an unexpected state."""
+
+
+class NoClusterLaunchedError(SkyTpuError):
+    """Failover ran out of candidates before launching anything."""
+
+
+def serialize_exception(e: Exception) -> Dict[str, Any]:
+    """JSON-serializable form for shipping errors across the API server."""
+    return {
+        'type': type(e).__name__,
+        'message': str(e),
+        'attrs': {
+            k: v for k, v in getattr(e, '__dict__', {}).items()
+            if isinstance(v, (str, int, float, bool, type(None)))
+        },
+    }
+
+
+def deserialize_exception(d: Dict[str, Any]) -> Exception:
+    cls = globals().get(d.get('type', ''), SkyTpuError)
+    try:
+        e = cls(d.get('message', ''))  # type: ignore[call-arg]
+    except TypeError:
+        e = SkyTpuError(d.get('message', ''))
+    for k, v in d.get('attrs', {}).items():
+        try:
+            setattr(e, k, v)
+        except Exception:  # pylint: disable=broad-except
+            pass
+    return e

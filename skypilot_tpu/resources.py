@@ -1,0 +1,489 @@
+"""Resource request/filter model with TPU pod slices as first-class targets.
+
+Counterpart of the reference's ``sky/resources.py`` (Resources with
+'8+'-style cpus/memory, accelerators like 'tpu-v6e-8', spot, region/zone,
+image, disk, ports, labels; reference sky/resources.py:52-1291) — redesigned
+so a TPU *slice* (not "a VM with accelerators") is the schedulable unit:
+
+- ``resources.tpu`` is a :class:`~skypilot_tpu.accelerators.TpuSlice`; the
+  host count, per-host chip count, ICI topology, HBM, and peak FLOPs are all
+  static properties the optimizer and provisioner consume directly (the
+  reference discovers hosts-per-pod at runtime,
+  sky/backends/cloud_vm_ray_backend.py:2588-2596).
+- 'tpu-*' accelerator names imply ``cloud=gcp`` (same inference as reference
+  sky/resources.py:565-641) and a default per-generation runtime version.
+"""
+from __future__ import annotations
+
+import dataclasses
+import textwrap
+from typing import Any, Dict, List, Optional, Set, Tuple, Union
+
+from skypilot_tpu import accelerators as accel_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import schemas
+from skypilot_tpu.utils import common_utils
+
+_DEFAULT_DISK_SIZE_GB = 256
+
+
+@dataclasses.dataclass(frozen=True)
+class AutostopConfig:
+    enabled: bool = False
+    idle_minutes: int = 5
+    down: bool = False
+
+    @classmethod
+    def from_yaml_config(
+            cls, cfg: Union[bool, int, Dict[str, Any], None]
+    ) -> Optional['AutostopConfig']:
+        if cfg is None:
+            return None
+        if isinstance(cfg, bool):
+            return cls(enabled=cfg)
+        if isinstance(cfg, int):
+            return cls(enabled=True, idle_minutes=cfg)
+        return cls(enabled=True,
+                   idle_minutes=int(cfg.get('idle_minutes', 5)),
+                   down=bool(cfg.get('down', False)))
+
+    def to_yaml_config(self) -> Union[bool, Dict[str, Any]]:
+        if not self.enabled:
+            return False
+        return {'idle_minutes': self.idle_minutes, 'down': self.down}
+
+
+@dataclasses.dataclass(frozen=True)
+class JobRecoveryConfig:
+    strategy: Optional[str] = None  # 'failover' | 'eager_next_region'
+    max_restarts_on_errors: int = 0
+
+    @classmethod
+    def from_yaml_config(
+            cls, cfg: Union[str, Dict[str, Any], None]
+    ) -> Optional['JobRecoveryConfig']:
+        if cfg is None:
+            return None
+        if isinstance(cfg, str):
+            return cls(strategy=cfg.lower())
+        strategy = cfg.get('strategy')
+        return cls(strategy=strategy.lower() if strategy else None,
+                   max_restarts_on_errors=int(
+                       cfg.get('max_restarts_on_errors', 0)))
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        return {'strategy': self.strategy,
+                'max_restarts_on_errors': self.max_restarts_on_errors}
+
+
+def _parse_infra(infra: Optional[str]) -> Tuple[Optional[str], Optional[str],
+                                                Optional[str]]:
+    """'gcp/us-central2/us-central2-b' → (cloud, region, zone)."""
+    if not infra:
+        return None, None, None
+    parts = [p if p != '*' else None for p in infra.strip('/').split('/')]
+    parts += [None] * (3 - len(parts))
+    if len(parts) > 3:
+        raise exceptions.InvalidResourcesError(
+            f'Invalid infra spec {infra!r}: expected cloud[/region[/zone]]')
+    return parts[0], parts[1], parts[2]
+
+
+def _parse_ports(
+        ports: Union[int, str, List[Union[int, str]], None]
+) -> Optional[Tuple[str, ...]]:
+    if ports is None:
+        return None
+    if isinstance(ports, (int, str)):
+        ports = [ports]
+    out: List[str] = []
+    for p in ports:
+        s = str(p)
+        if '-' in s:
+            lo, hi = s.split('-')
+            lo_i, hi_i = int(lo), int(hi)
+            if not 1 <= lo_i <= hi_i <= 65535:
+                raise exceptions.InvalidResourcesError(
+                    f'Invalid port range: {s}')
+        else:
+            if not 1 <= int(s) <= 65535:
+                raise exceptions.InvalidResourcesError(f'Invalid port: {s}')
+        out.append(s)
+    return tuple(sorted(set(out))) or None
+
+
+class Resources:
+    """An (im)mutable-by-convention resource request or concrete choice.
+
+    A Resources is *launchable* when cloud and either an instance type or a
+    TPU slice are pinned; the optimizer turns user filters into launchable
+    candidates.
+    """
+
+    # Pickled into cluster records; bump on incompatible field changes.
+    _VERSION = 1
+
+    def __init__(
+        self,
+        *,
+        cloud: Optional[str] = None,
+        region: Optional[str] = None,
+        zone: Optional[str] = None,
+        infra: Optional[str] = None,
+        accelerators: Union[str, Dict[str, int], None] = None,
+        instance_type: Optional[str] = None,
+        cpus: Union[int, float, str, None] = None,
+        memory: Union[int, float, str, None] = None,
+        use_spot: Optional[bool] = None,
+        disk_size: Optional[int] = None,
+        disk_tier: Optional[str] = None,
+        ports: Union[int, str, List[Union[int, str]], None] = None,
+        labels: Optional[Dict[str, str]] = None,
+        image_id: Optional[str] = None,
+        runtime_version: Optional[str] = None,
+        reserved: bool = False,
+        autostop: Union[bool, int, Dict[str, Any], None] = None,
+        job_recovery: Union[str, Dict[str, Any], None] = None,
+    ):
+        if infra is not None:
+            if cloud is not None or region is not None or zone is not None:
+                raise exceptions.InvalidResourcesError(
+                    "Specify either 'infra' or cloud/region/zone, not both.")
+            cloud, region, zone = _parse_infra(infra)
+
+        self._cloud = cloud.lower() if cloud else None
+        self._region = region
+        self._zone = zone
+
+        self._tpu: Optional[accel_lib.TpuSlice] = None
+        self._set_accelerators(accelerators)
+
+        self._instance_type = instance_type
+        try:
+            self._cpus, self._cpus_plus = common_utils.parse_plus_number(
+                cpus, 'cpus')
+            self._memory, self._memory_plus = common_utils.parse_memory_gb(
+                memory)
+        except ValueError as e:
+            raise exceptions.InvalidResourcesError(str(e)) from e
+        self._use_spot_specified = use_spot is not None
+        self._use_spot = bool(use_spot) if use_spot is not None else False
+        self._disk_size = disk_size if disk_size is not None else (
+            _DEFAULT_DISK_SIZE_GB)
+        self._disk_tier = disk_tier
+        self._ports = _parse_ports(ports)
+        self._labels = dict(labels) if labels else None
+        self._image_id = image_id
+        self._runtime_version = runtime_version
+        self._reserved = reserved
+        self._autostop = AutostopConfig.from_yaml_config(autostop)
+        self._job_recovery = JobRecoveryConfig.from_yaml_config(job_recovery)
+        self._validate()
+
+    # ---- accelerator / TPU handling --------------------------------------
+    def _set_accelerators(
+            self, accelerators: Union[str, Dict[str, int], None]) -> None:
+        if accelerators is None:
+            return
+        if isinstance(accelerators, dict):
+            if len(accelerators) != 1:
+                raise exceptions.InvalidResourcesError(
+                    f'accelerators dict must have one entry: {accelerators}')
+            name, count = next(iter(accelerators.items()))
+            accelerators = f'{name}:{count}' if count else str(name)
+        name = str(accelerators).strip()
+        tpu = accel_lib.TpuSlice.maybe_from_name(name)
+        if tpu is None and ':' in name:
+            base, count = name.split(':', 1)
+            # 'tpu-v5e-8:1' / {'tpu-v5e-8': 1} means one such slice.
+            if count.strip() in ('', '1') and accel_lib.is_tpu(base):
+                tpu = accel_lib.TpuSlice.maybe_from_name(base)
+            else:
+                # 'tpu-v5e:8' sugar → 'tpu-v5e-8'
+                tpu = accel_lib.TpuSlice.maybe_from_name(f'{base}-{count}')
+        if tpu is None:
+            raise exceptions.InvalidResourcesError(
+                f'Unsupported accelerator {accelerators!r}: this framework '
+                "schedules TPU slices (e.g. 'tpu-v5e-8', 'tpu-v5p-64'). "
+                'For CPU-only tasks omit accelerators.')
+        self._tpu = tpu
+        # TPU implies GCP (reference sky/resources.py:565-641).
+        if self._cloud is None:
+            self._cloud = 'gcp'
+
+    def _validate(self) -> None:
+        if self._tpu is not None and self._cloud not in (None, 'gcp', 'local'):
+            raise exceptions.InvalidResourcesError(
+                f'TPU slices require cloud=gcp, got {self._cloud!r}')
+        if self._zone is not None and self._region is None:
+            # Infer region from zone name (GCP convention: strip '-x').
+            self._region = self._zone.rsplit('-', 1)[0]
+        if self._disk_tier is not None and self._disk_tier not in (
+                'low', 'medium', 'high', 'ultra', 'best'):
+            raise exceptions.InvalidResourcesError(
+                f'Invalid disk_tier: {self._disk_tier}')
+
+    # ---- properties -------------------------------------------------------
+    @property
+    def cloud(self) -> Optional[str]:
+        return self._cloud
+
+    @property
+    def region(self) -> Optional[str]:
+        return self._region
+
+    @property
+    def zone(self) -> Optional[str]:
+        return self._zone
+
+    @property
+    def infra(self) -> str:
+        parts = [self._cloud or '*', self._region or '*', self._zone or '*']
+        while parts and parts[-1] == '*':
+            parts.pop()
+        return '/'.join(parts) if parts else '*'
+
+    @property
+    def tpu(self) -> Optional[accel_lib.TpuSlice]:
+        return self._tpu
+
+    @property
+    def accelerators(self) -> Optional[str]:
+        return self._tpu.name if self._tpu else None
+
+    @property
+    def instance_type(self) -> Optional[str]:
+        return self._instance_type
+
+    @property
+    def cpus(self) -> Optional[str]:
+        if self._cpus is None:
+            return None
+        return common_utils.format_float(self._cpus) + (
+            '+' if self._cpus_plus else '')
+
+    @property
+    def memory(self) -> Optional[str]:
+        if self._memory is None:
+            return None
+        return common_utils.format_float(self._memory) + (
+            '+' if self._memory_plus else '')
+
+    @property
+    def use_spot(self) -> bool:
+        return self._use_spot
+
+    @property
+    def use_spot_specified(self) -> bool:
+        return self._use_spot_specified
+
+    @property
+    def disk_size(self) -> int:
+        return self._disk_size
+
+    @property
+    def disk_tier(self) -> Optional[str]:
+        return self._disk_tier
+
+    @property
+    def ports(self) -> Optional[Tuple[str, ...]]:
+        return self._ports
+
+    @property
+    def labels(self) -> Optional[Dict[str, str]]:
+        return self._labels
+
+    @property
+    def image_id(self) -> Optional[str]:
+        return self._image_id
+
+    @property
+    def runtime_version(self) -> Optional[str]:
+        """TPU software version; defaults per generation."""
+        if self._runtime_version is not None:
+            return self._runtime_version
+        if self._tpu is not None:
+            return self._tpu.default_runtime_version
+        return None
+
+    @property
+    def reserved(self) -> bool:
+        return self._reserved
+
+    @property
+    def autostop(self) -> Optional[AutostopConfig]:
+        return self._autostop
+
+    @property
+    def job_recovery(self) -> Optional[JobRecoveryConfig]:
+        return self._job_recovery
+
+    @property
+    def num_hosts(self) -> int:
+        """Hosts this resource spans — derived from the slice, statically."""
+        if self._tpu is not None:
+            return self._tpu.num_hosts
+        return 1
+
+    def is_launchable(self) -> bool:
+        return self._cloud is not None and (
+            self._instance_type is not None or self._tpu is not None)
+
+    # ---- comparison / filtering ------------------------------------------
+    def less_demanding_than(self, other: 'Resources') -> bool:
+        """True if `self` (a request) is satisfiable by `other` (a cluster).
+
+        Same contract as reference sky/resources.py:1152: used by `exec` to
+        check a task fits an existing cluster.
+        """
+        if self._cloud is not None and self._cloud != other._cloud:
+            return False
+        if self._region is not None and self._region != other._region:
+            return False
+        if self._zone is not None and self._zone != other._zone:
+            return False
+        if self._tpu is not None:
+            if other._tpu is None:
+                return False
+            if self._tpu.generation != other._tpu.generation:
+                return False
+            if self._tpu.chips > other._tpu.chips:
+                return False
+        if self._use_spot_specified and self._use_spot != other._use_spot:
+            return False
+        if self._instance_type is not None and (
+                self._instance_type != other._instance_type):
+            return False
+        # cpus/memory: comparable only when the cluster side declares them
+        # (a cluster with unknown shape conservatively passes; the catalog
+        # fills these in for launched clusters).
+        if self._cpus is not None and other._cpus is not None:
+            if other._cpus < self._cpus:
+                return False
+        if self._memory is not None and other._memory is not None:
+            if other._memory < self._memory:
+                return False
+        if self._ports:
+            other_ports = set(other._ports or ())
+            if not set(self._ports) <= other_ports:
+                return False
+        return True
+
+    def should_be_blocked_by(self, blocked: 'Resources') -> bool:
+        """Failover blocklist matching: does `blocked` (a possibly-partial
+        spec) cover `self`?"""
+        checks = [
+            blocked._cloud is None or blocked._cloud == self._cloud,
+            blocked._region is None or blocked._region == self._region,
+            blocked._zone is None or blocked._zone == self._zone,
+            blocked._tpu is None or blocked._tpu == self._tpu,
+            blocked._instance_type is None
+            or blocked._instance_type == self._instance_type,
+            (not blocked._use_spot_specified)
+            or blocked._use_spot == self._use_spot,
+        ]
+        return all(checks)
+
+    # ---- copy / serialization --------------------------------------------
+    def copy(self, **override: Any) -> 'Resources':
+        cfg = self.to_yaml_config()
+        # Normalize override names.
+        if 'accelerators' not in override and self._tpu is not None:
+            cfg['accelerators'] = self._tpu.name
+        cfg.update(override)
+        return Resources.from_yaml_config(cfg)  # type: ignore[return-value]
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        cfg: Dict[str, Any] = {}
+
+        def add(key: str, value: Any) -> None:
+            if value is not None:
+                cfg[key] = value
+
+        add('cloud', self._cloud)
+        add('region', self._region)
+        add('zone', self._zone)
+        add('accelerators', self._tpu.name if self._tpu else None)
+        add('instance_type', self._instance_type)
+        add('cpus', self.cpus)
+        add('memory', self.memory)
+        if self._use_spot_specified:
+            cfg['use_spot'] = self._use_spot
+        if self._disk_size != _DEFAULT_DISK_SIZE_GB:
+            cfg['disk_size'] = self._disk_size
+        add('disk_tier', self._disk_tier)
+        if self._ports:
+            cfg['ports'] = list(self._ports)
+        add('labels', self._labels)
+        add('image_id', self._image_id)
+        add('runtime_version', self._runtime_version)
+        if self._reserved:
+            cfg['reserved'] = True
+        if self._autostop is not None:
+            cfg['autostop'] = self._autostop.to_yaml_config()
+        if self._job_recovery is not None:
+            cfg['job_recovery'] = self._job_recovery.to_yaml_config()
+        return cfg
+
+    @classmethod
+    def from_yaml_config(
+        cls, config: Union[Dict[str, Any], None]
+    ) -> Union['Resources', List['Resources']]:
+        """Parse a `resources:` section; `any_of:`/`ordered:` yield a list."""
+        if config is None:
+            return cls()
+        schemas._validate(config, schemas.RESOURCES_SCHEMA, 'resources')
+        config = dict(config)
+        any_of = config.pop('any_of', None)
+        ordered = config.pop('ordered', None)
+        if any_of is not None and ordered is not None:
+            raise exceptions.InvalidResourcesError(
+                "Specify at most one of 'any_of' / 'ordered'.")
+        if 'spot' in config:
+            config['use_spot'] = config.pop('spot')
+        if any_of is not None or ordered is not None:
+            base = config
+            out: List[Resources] = []
+            for sub in (any_of or ordered):
+                merged = dict(base)
+                if 'spot' in sub:
+                    sub = dict(sub)
+                    sub['use_spot'] = sub.pop('spot')
+                merged.update(sub)
+                r = cls.from_yaml_config(merged)
+                assert isinstance(r, Resources)
+                out.append(r)
+            return out
+        return cls(**config)  # type: ignore[arg-type]
+
+    def __repr__(self) -> str:
+        parts: List[str] = []
+        if self._tpu is not None:
+            parts.append(self._tpu.name)
+            parts.append(f'[{self._tpu.num_hosts} host'
+                         f'{"s" if self._tpu.num_hosts > 1 else ""}, '
+                         f'{self._tpu.topology_str} ICI]')
+        if self._instance_type:
+            parts.append(self._instance_type)
+        if self.cpus:
+            parts.append(f'cpus={self.cpus}')
+        if self.memory:
+            parts.append(f'mem={self.memory}')
+        if self._use_spot:
+            parts.append('[Spot]')
+        infra = self.infra
+        if infra != '*':
+            parts.append(f'({infra})')
+        return ' '.join(parts) if parts else '<empty>'
+
+    def __eq__(self, other: Any) -> bool:
+        if not isinstance(other, Resources):
+            return NotImplemented
+        return self.to_yaml_config() == other.to_yaml_config()
+
+    def __hash__(self) -> int:
+        return hash(common_utils.dump_yaml_str(self.to_yaml_config()))
+
+    # ---- pretty table row -------------------------------------------------
+    def format_brief(self) -> str:
+        return repr(self)

@@ -1,0 +1,220 @@
+"""JSON schemas for task YAML, service spec, and user config.
+
+Role of the reference's sky/utils/schemas.py (1,037 LoC): every externally
+supplied document is validated before it reaches the object layer, so errors
+point at the YAML, not at a stack trace.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jsonschema
+
+from skypilot_tpu import exceptions
+
+_NUM_OR_PLUS = {
+    'anyOf': [{'type': 'number'}, {'type': 'string'}]
+}
+
+_RESOURCES_PROPERTIES: Dict[str, Any] = {
+    'cloud': {'type': ['string', 'null']},
+    'region': {'type': ['string', 'null']},
+    'zone': {'type': ['string', 'null']},
+    'infra': {'type': ['string', 'null']},  # 'gcp/us-central2/us-central2-b'
+    'accelerators': {
+        'anyOf': [{'type': 'string'}, {'type': 'null'}, {'type': 'object'}]
+    },
+    'instance_type': {'type': ['string', 'null']},
+    'cpus': _NUM_OR_PLUS,
+    'memory': _NUM_OR_PLUS,
+    'use_spot': {'type': 'boolean'},
+    'spot': {'type': 'boolean'},
+    'disk_size': {'type': 'integer'},
+    'disk_tier': {'enum': ['low', 'medium', 'high', 'ultra', 'best', None]},
+    'ports': {
+        'anyOf': [{'type': 'integer'}, {'type': 'string'}, {'type': 'null'},
+                  {'type': 'array',
+                   'items': {'anyOf': [{'type': 'integer'},
+                                       {'type': 'string'}]}}]
+    },
+    'labels': {'type': 'object',
+               'additionalProperties': {'type': 'string'}},
+    'image_id': {'type': ['string', 'null']},
+    'runtime_version': {'type': ['string', 'null']},
+    'reserved': {'type': 'boolean'},
+    'autostop': {
+        'anyOf': [{'type': 'boolean'}, {'type': 'integer'},
+                  {'type': 'object', 'properties': {
+                      'idle_minutes': {'type': 'integer'},
+                      'down': {'type': 'boolean'},
+                  }, 'additionalProperties': False}]
+    },
+    'job_recovery': {
+        'anyOf': [{'type': 'string'}, {'type': 'null'},
+                  {'type': 'object', 'properties': {
+                      'strategy': {'type': ['string', 'null']},
+                      'max_restarts_on_errors': {'type': 'integer'},
+                  }, 'additionalProperties': False}]
+    },
+    'any_of': {'type': 'array'},
+    'ordered': {'type': 'array'},
+}
+
+RESOURCES_SCHEMA: Dict[str, Any] = {
+    'type': 'object',
+    'properties': _RESOURCES_PROPERTIES,
+    'additionalProperties': False,
+}
+
+_STORAGE_SCHEMA: Dict[str, Any] = {
+    'type': 'object',
+    'properties': {
+        'name': {'type': ['string', 'null']},
+        'source': {'anyOf': [{'type': 'string'},
+                             {'type': 'array', 'items': {'type': 'string'}},
+                             {'type': 'null'}]},
+        'store': {'enum': ['gcs', 's3', None]},
+        'mode': {'enum': ['MOUNT', 'COPY', 'mount', 'copy', None]},
+        'persistent': {'type': 'boolean'},
+    },
+    'additionalProperties': False,
+}
+
+SERVICE_SCHEMA: Dict[str, Any] = {
+    'type': 'object',
+    'required': ['readiness_probe'],
+    'properties': {
+        'readiness_probe': {
+            'anyOf': [
+                {'type': 'string'},
+                {'type': 'object', 'properties': {
+                    'path': {'type': 'string'},
+                    'initial_delay_seconds': {'type': 'number'},
+                    'timeout_seconds': {'type': 'number'},
+                    'post_data': {'type': ['object', 'string']},
+                    'headers': {'type': 'object'},
+                }, 'required': ['path'], 'additionalProperties': False},
+            ]
+        },
+        'readiness_path': {'type': 'string'},
+        'replica_policy': {
+            'type': 'object',
+            'properties': {
+                'min_replicas': {'type': 'integer'},
+                'max_replicas': {'type': ['integer', 'null']},
+                'target_qps_per_replica': {'type': ['number', 'null']},
+                'qps_window_seconds': {'type': 'number'},
+                'upscale_delay_seconds': {'type': 'number'},
+                'downscale_delay_seconds': {'type': 'number'},
+                'base_ondemand_fallback_replicas': {'type': 'integer'},
+                'dynamic_ondemand_fallback': {'type': 'boolean'},
+            },
+            'additionalProperties': False,
+        },
+        'replicas': {'type': 'integer'},
+        'load_balancing_policy': {'type': ['string', 'null']},
+        'tls': {'type': 'object'},
+    },
+    'additionalProperties': False,
+}
+
+TASK_SCHEMA: Dict[str, Any] = {
+    'type': 'object',
+    'properties': {
+        'name': {'type': ['string', 'null']},
+        'workdir': {'type': ['string', 'null']},
+        'num_nodes': {'type': 'integer', 'minimum': 1},
+        'resources': RESOURCES_SCHEMA,
+        'envs': {'type': 'object',
+                 'additionalProperties': {
+                     'type': ['string', 'number', 'boolean', 'null']}},
+        'secrets': {'type': 'object',
+                    'additionalProperties': {
+                        'type': ['string', 'number', 'boolean', 'null']}},
+        'file_mounts': {'type': 'object'},
+        'storage_mounts': {'type': 'object'},
+        'setup': {'type': ['string', 'null']},
+        'run': {'type': ['string', 'null']},
+        'service': SERVICE_SCHEMA,
+        'config_overrides': {'type': 'object'},
+        'experimental': {'type': 'object'},
+    },
+    'additionalProperties': False,
+}
+
+CONFIG_SCHEMA: Dict[str, Any] = {
+    'type': 'object',
+    'properties': {
+        'gcp': {
+            'type': 'object',
+            'properties': {
+                'project_id': {'type': ['string', 'null']},
+                'service_account': {'type': ['string', 'null']},
+                'vpc_name': {'type': ['string', 'null']},
+                'use_internal_ips': {'type': 'boolean'},
+                'specific_reservations': {'type': 'array'},
+                'labels': {'type': 'object'},
+            },
+            'additionalProperties': True,
+        },
+        'local': {
+            'type': 'object',
+            'properties': {
+                'state_dir': {'type': 'string'},
+            },
+            'additionalProperties': True,
+        },
+        'jobs': {
+            'type': 'object',
+            'properties': {
+                'controller': {'type': 'object'},
+            },
+            'additionalProperties': True,
+        },
+        'serve': {'type': 'object'},
+        'api_server': {
+            'type': 'object',
+            'properties': {
+                'endpoint': {'type': ['string', 'null']},
+                'port': {'type': 'integer'},
+            },
+            'additionalProperties': True,
+        },
+        'allowed_clouds': {'type': 'array', 'items': {'type': 'string'}},
+        'optimizer': {
+            'type': 'object',
+            'properties': {
+                'objective': {'enum': ['cost', 'time', 'perf_per_dollar']},
+            },
+            'additionalProperties': True,
+        },
+        'nvidia_gpus': {'type': 'object'},  # reserved for non-TPU extensions
+    },
+    'additionalProperties': True,
+}
+
+
+def _validate(doc: Dict[str, Any], schema: Dict[str, Any], kind: str,
+              source: Optional[str] = None) -> None:
+    try:
+        jsonschema.validate(doc, schema)
+    except jsonschema.ValidationError as e:
+        where = f' (in {source})' if source else ''
+        path = '.'.join(str(p) for p in e.absolute_path) or '<root>'
+        raise exceptions.InvalidYamlError(
+            f'Invalid {kind}{where}: at {path}: {e.message}') from e
+
+
+def validate_task_config(config: Dict[str, Any],
+                         source: Optional[str] = None) -> None:
+    _validate(config, TASK_SCHEMA, 'task YAML', source)
+
+
+def validate_service_config(config: Dict[str, Any],
+                            source: Optional[str] = None) -> None:
+    _validate(config, SERVICE_SCHEMA, 'service spec', source)
+
+
+def validate_config(config: Dict[str, Any],
+                    source: Optional[str] = None) -> None:
+    _validate(config, CONFIG_SCHEMA, 'config', source)

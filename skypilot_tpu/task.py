@@ -1,0 +1,271 @@
+"""Task: the declarative unit of work.
+
+Counterpart of reference ``sky/task.py`` (Task with name/setup/run/num_nodes/
+envs/workdir/file mounts/resources/service; YAML round-trip at
+sky/task.py:196-1333). Differences for the TPU-native design:
+
+- ``num_nodes`` counts *slices* (almost always 1); the per-slice host count is
+  derived from the TPU slice type (see resources.Resources.num_hosts). The
+  runtime still exports per-host rank/count env vars for multi-host slices.
+- The env contract exported to ``run:`` is JAX-native (SKYTPU_COORDINATOR_ADDR
+  / SKYTPU_NUM_PROCESSES / SKYTPU_PROCESS_ID plus topology vars), with
+  SKYPILOT_NODE_* compatibility aliases (see agent/constants.py).
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import (Any, Callable, Dict, List, Optional, Set, Tuple, Union)
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import schemas
+from skypilot_tpu.utils import common_utils
+
+_VALID_NAME_REGEX = re.compile(r'^[a-zA-Z0-9]+(?:[._-]{1,2}[a-zA-Z0-9]+)*$')
+
+RunFn = Callable[[int, List[str]], Optional[str]]
+
+
+def _fill_in_env_vars(yaml_field: Any, env_vars: Dict[str, str]) -> Any:
+    """Substitute ``$VAR``/``${VAR}`` in string fields (file_mounts etc.)."""
+    if isinstance(yaml_field, str):
+        # Word-boundary-aware so $FOO never corrupts $FOOD.
+        def _sub(m: 're.Match') -> str:
+            name = m.group(1) or m.group(2)
+            return env_vars.get(name, m.group(0))
+
+        return re.sub(r'\$\{(\w+)\}|\$(\w+)', _sub, yaml_field)
+    if isinstance(yaml_field, dict):
+        return {k: _fill_in_env_vars(v, env_vars) for k, v in yaml_field.items()}
+    if isinstance(yaml_field, list):
+        return [_fill_in_env_vars(v, env_vars) for v in yaml_field]
+    return yaml_field
+
+
+class Task:
+    """A coarse-grained unit of execution: setup + run on some Resources."""
+
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        *,
+        setup: Optional[str] = None,
+        run: Optional[Union[str, RunFn]] = None,
+        envs: Optional[Dict[str, str]] = None,
+        secrets: Optional[Dict[str, str]] = None,
+        workdir: Optional[str] = None,
+        num_nodes: Optional[int] = None,
+        file_mounts: Optional[Dict[str, str]] = None,
+    ):
+        self.name = name
+        self.setup = setup
+        self.run = run
+        self.workdir = workdir
+        self._envs = dict(envs) if envs else {}
+        self._secrets = dict(secrets) if secrets else {}
+        self.num_nodes = num_nodes if num_nodes is not None else 1
+        self.file_mounts: Optional[Dict[str, str]] = (
+            dict(file_mounts) if file_mounts else None)
+        self.storage_mounts: Dict[str, Any] = {}
+        self._resources: Tuple[resources_lib.Resources, ...] = (
+            resources_lib.Resources(),)
+        self._resources_ordered = False
+        self.service: Optional[Any] = None  # serve.ServiceSpec
+        self.config_overrides: Optional[Dict[str, Any]] = None
+        # Set by the optimizer:
+        self.best_resources: Optional[resources_lib.Resources] = None
+        self.estimated_cost_per_hour: Optional[float] = None
+        self._validate()
+
+        from skypilot_tpu import dag as dag_lib  # avoid import cycle
+        current = dag_lib.get_current_dag()
+        if current is not None:
+            current.add(self)
+
+    # ---- validation -------------------------------------------------------
+    def _validate(self) -> None:
+        if self.name is not None and not _VALID_NAME_REGEX.match(self.name):
+            raise exceptions.InvalidTaskError(
+                f'Invalid task name {self.name!r}')
+        if self.num_nodes < 1:
+            raise exceptions.InvalidTaskError(
+                f'num_nodes must be >= 1, got {self.num_nodes}')
+        if self.run is not None and not isinstance(self.run, str) and (
+                not callable(self.run)):
+            raise exceptions.InvalidTaskError(
+                'run must be a shell-script string or a callable')
+        if self.workdir is not None:
+            expanded = os.path.expanduser(self.workdir)
+            if not os.path.isdir(expanded):
+                raise exceptions.InvalidTaskError(
+                    f'workdir {self.workdir!r} is not an existing directory')
+
+    # ---- resources --------------------------------------------------------
+    @property
+    def resources(self) -> Tuple[resources_lib.Resources, ...]:
+        return self._resources
+
+    @property
+    def resources_ordered(self) -> bool:
+        return self._resources_ordered
+
+    def set_resources(
+        self,
+        resources: Union[resources_lib.Resources,
+                         List[resources_lib.Resources],
+                         Set[resources_lib.Resources]],
+        ordered: bool = False,
+    ) -> 'Task':
+        if isinstance(resources, resources_lib.Resources):
+            resources = [resources]
+        resources = list(resources)
+        if not resources:
+            raise exceptions.InvalidTaskError('Empty resources')
+        self._resources = tuple(resources)
+        self._resources_ordered = ordered
+        return self
+
+    # ---- envs -------------------------------------------------------------
+    @property
+    def envs(self) -> Dict[str, str]:
+        return dict(self._envs)
+
+    @property
+    def secrets(self) -> Dict[str, str]:
+        return dict(self._secrets)
+
+    @property
+    def envs_and_secrets(self) -> Dict[str, str]:
+        out = dict(self._envs)
+        out.update(self._secrets)
+        return out
+
+    def update_envs(
+            self, envs: Union[None, Dict[str, str],
+                              List[Tuple[str, str]]]) -> 'Task':
+        if envs is None:
+            return self
+        if isinstance(envs, (list, tuple)):
+            envs = dict(envs)
+        for k, v in envs.items():
+            if not isinstance(k, str) or not k:
+                raise exceptions.InvalidTaskError(f'Invalid env name: {k!r}')
+            self._envs[k] = str(v)
+        return self
+
+    def update_secrets(self, secrets: Optional[Dict[str, str]]) -> 'Task':
+        if secrets:
+            for k, v in secrets.items():
+                self._secrets[k] = str(v)
+        return self
+
+    # ---- service ----------------------------------------------------------
+    def set_service(self, service: Optional[Any]) -> 'Task':
+        self.service = service
+        return self
+
+    # ---- YAML round-trip ---------------------------------------------------
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any],
+                         env_overrides: Optional[Dict[str, str]] = None,
+                         source: Optional[str] = None) -> 'Task':
+        schemas.validate_task_config(config, source=source)
+        config = dict(config)
+
+        # YAML null means "must be supplied"; explicit '' is a real value.
+        envs: Dict[str, Any] = {
+            str(k): None if v is None else str(v)
+            for k, v in (config.get('envs') or {}).items()
+        }
+        if env_overrides:
+            envs.update({k: str(v) for k, v in env_overrides.items()})
+        missing = [k for k, v in envs.items() if v is None]
+        if missing:
+            raise exceptions.InvalidTaskError(
+                f'Environment variable(s) {missing} have no value; pass '
+                "them via --env or fill in the 'envs:' section.")
+        # Env substitution applies to everything downstream of `envs:`.
+        config = _fill_in_env_vars(config, envs)
+
+        task = cls(
+            name=config.get('name'),
+            setup=config.get('setup'),
+            run=config.get('run'),
+            envs=envs,
+            secrets={str(k): str(v)
+                     for k, v in (config.get('secrets') or {}).items()},
+            workdir=config.get('workdir'),
+            num_nodes=config.get('num_nodes'),
+            file_mounts=config.get('file_mounts'),
+        )
+        res = resources_lib.Resources.from_yaml_config(
+            config.get('resources'))
+        ordered = bool((config.get('resources') or {}).get('ordered'))
+        task.set_resources(res if isinstance(res, list) else [res],
+                           ordered=ordered)
+        if config.get('storage_mounts'):
+            task.storage_mounts = dict(config['storage_mounts'])
+        if config.get('service'):
+            from skypilot_tpu.serve import service_spec  # lazy import
+            task.set_service(
+                service_spec.ServiceSpec.from_yaml_config(config['service']))
+        task.config_overrides = config.get('config_overrides')
+        return task
+
+    @classmethod
+    def from_yaml(cls, yaml_path: str,
+                  env_overrides: Optional[Dict[str, str]] = None) -> 'Task':
+        configs = common_utils.read_yaml_all(os.path.expanduser(yaml_path))
+        configs = [c for c in configs if c]
+        if not configs:
+            return cls()
+        if len(configs) > 1:
+            raise exceptions.InvalidTaskError(
+                f'{yaml_path} contains multiple documents; use '
+                'dag_utils.load_chain_dag_from_yaml for pipelines.')
+        return cls.from_yaml_config(configs[0], env_overrides,
+                                    source=yaml_path)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        cfg: Dict[str, Any] = {}
+
+        def add(key: str, value: Any) -> None:
+            if value is not None and value != {} and value != []:
+                cfg[key] = value
+
+        add('name', self.name)
+        if len(self._resources) == 1:
+            add('resources', self._resources[0].to_yaml_config())
+        else:
+            key = 'ordered' if self._resources_ordered else 'any_of'
+            cfg['resources'] = {
+                key: [r.to_yaml_config() for r in self._resources]
+            }
+        if self.num_nodes != 1:
+            cfg['num_nodes'] = self.num_nodes
+        add('envs', self._envs or None)
+        add('secrets', self._secrets or None)
+        add('workdir', self.workdir)
+        add('file_mounts', self.file_mounts)
+        add('storage_mounts', self.storage_mounts or None)
+        add('setup', self.setup)
+        add('run', self.run if isinstance(self.run, str) else None)
+        if self.service is not None:
+            cfg['service'] = self.service.to_yaml_config()
+        add('config_overrides', self.config_overrides)
+        return cfg
+
+    # ---- misc -------------------------------------------------------------
+    @property
+    def tpu(self) -> Optional[Any]:
+        """The TPU slice if every resource option agrees on one."""
+        slices = {r.tpu for r in self._resources}
+        if len(slices) == 1:
+            return next(iter(slices))
+        return None
+
+    def __repr__(self) -> str:
+        name = self.name or '<unnamed>'
+        res = ', '.join(str(r) for r in self._resources)
+        return f'Task({name!r}, num_nodes={self.num_nodes}, resources=[{res}])'

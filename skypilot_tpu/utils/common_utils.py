@@ -1,0 +1,195 @@
+"""Small shared helpers: ids, names, yaml, retries, parsing."""
+from __future__ import annotations
+
+import functools
+import getpass
+import hashlib
+import os
+import re
+import socket
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import yaml
+
+CLUSTER_NAME_VALID_REGEX = re.compile(r'^[a-zA-Z]([-_.a-zA-Z0-9]*[a-zA-Z0-9])?$')
+USER_HASH_LENGTH = 8
+
+
+def get_user_hash() -> str:
+    """Stable per-user hash, used to namespace generated cloud resources."""
+    env = os.environ.get('SKYTPU_USER_HASH')
+    if env:
+        return env[:USER_HASH_LENGTH]
+    key = f'{getpass.getuser()}-{socket.gethostname()}'
+    return hashlib.md5(key.encode()).hexdigest()[:USER_HASH_LENGTH]
+
+
+def get_user_name() -> str:
+    return os.environ.get('SKYTPU_USER', None) or getpass.getuser()
+
+
+def generate_run_id() -> str:
+    return f'skytpu-{time.strftime("%Y-%m-%d-%H-%M-%S")}-{uuid.uuid4().hex[:6]}'
+
+
+def check_cluster_name_is_valid(name: str) -> None:
+    if not CLUSTER_NAME_VALID_REGEX.match(name):
+        raise ValueError(
+            f'Cluster name {name!r} is invalid: must start with a letter and '
+            'contain only letters, digits, "-", "_", ".".')
+
+
+def make_cluster_name_on_cloud(display_name: str, max_length: int = 35) -> str:
+    """Append user hash; truncate+hash if too long (cloud name limits)."""
+    user_hash = get_user_hash()
+    name = f'{display_name}-{user_hash}'
+    name = name.replace('_', '-').replace('.', '-').lower()
+    if len(name) > max_length:
+        digest = hashlib.md5(name.encode()).hexdigest()[:6]
+        keep = max_length - len(user_hash) - len(digest) - 2
+        name = f'{name[:keep]}-{digest}-{user_hash}'
+    return name
+
+
+def read_yaml(path: str) -> Dict[str, Any]:
+    with open(path, 'r', encoding='utf-8') as f:
+        return yaml.safe_load(f) or {}
+
+
+def read_yaml_all(path: str) -> List[Dict[str, Any]]:
+    with open(path, 'r', encoding='utf-8') as f:
+        return [c or {} for c in yaml.safe_load_all(f)]
+
+
+def dump_yaml(path: str, config: Union[Dict[str, Any], List[Dict[str, Any]]]) -> None:
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, 'w', encoding='utf-8') as f:
+        f.write(dump_yaml_str(config))
+
+
+def dump_yaml_str(config: Union[Dict[str, Any], List[Dict[str, Any]]]) -> str:
+
+    class _Dumper(yaml.SafeDumper):
+        pass
+
+    _Dumper.add_representer(
+        tuple, lambda d, t: d.represent_list(list(t)))
+    if isinstance(config, list):
+        return yaml.dump_all(config, Dumper=_Dumper, sort_keys=False,
+                             default_flow_style=False)
+    return yaml.dump(config, Dumper=_Dumper, sort_keys=False,
+                     default_flow_style=False)
+
+
+def parse_plus_number(value: Union[int, float, str, None],
+                      field: str) -> Tuple[Optional[float], bool]:
+    """Parse '8', 8, '8+' → (8.0, plus?). None → (None, False)."""
+    if value is None:
+        return None, False
+    if isinstance(value, (int, float)):
+        return float(value), False
+    s = str(value).strip()
+    plus = s.endswith('+')
+    if plus:
+        s = s[:-1]
+    try:
+        return float(s), plus
+    except ValueError as e:
+        raise ValueError(f'Invalid {field}: {value!r}. '
+                         f"Expected a number or 'N+'.") from e
+
+
+def parse_memory_gb(value: Union[int, float, str, None],
+                    field: str = 'memory') -> Tuple[Optional[float], bool]:
+    """Like parse_plus_number but strips an optional GB/GiB unit.
+
+    Accepts '32', '32+', '32GB', '32GB+', '32+GB'.
+    """
+    if isinstance(value, str):
+        s = value.strip()
+        m = re.match(r'^([0-9.]+)\s*(\+)?\s*(gb|gib|g)?\s*(\+)?$', s,
+                     flags=re.IGNORECASE)
+        if m is None:
+            raise ValueError(f'Invalid {field}: {value!r}. '
+                             "Expected e.g. '32', '32+', '32GB', '32GB+'.")
+        value = m.group(1) + ('+' if (m.group(2) or m.group(4)) else '')
+    return parse_plus_number(value, field)
+
+
+def format_float(x: Optional[float], precision: int = 2) -> str:
+    if x is None:
+        return '-'
+    if abs(x - round(x)) < 1e-9:
+        return str(int(round(x)))
+    return f'{x:.{precision}f}'
+
+
+def retry(max_retries: int = 3, initial_backoff: float = 1.0,
+          exceptions_to_retry: Tuple = (Exception,)) -> Callable:
+    """Exponential-backoff retry decorator for flaky IO."""
+
+    def decorator(fn: Callable) -> Callable:
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            backoff = initial_backoff
+            for attempt in range(max_retries):
+                try:
+                    return fn(*args, **kwargs)
+                except exceptions_to_retry:
+                    if attempt == max_retries - 1:
+                        raise
+                    time.sleep(backoff)
+                    backoff *= 2
+            raise RuntimeError('unreachable')
+
+        return wrapper
+
+    return decorator
+
+
+def readable_time_duration(start: Optional[float], end: Optional[float] = None,
+                           absolute: bool = False) -> str:
+    """'3m 12s' style durations for status tables."""
+    if start is None:
+        return '-'
+    if end is None:
+        end = time.time()
+    seconds = int(end - start)
+    if seconds < 0:
+        seconds = 0
+    units = [('d', 86400), ('h', 3600), ('m', 60), ('s', 1)]
+    parts: List[str] = []
+    for suffix, size in units:
+        if seconds >= size or (suffix == 's' and not parts):
+            n, seconds = divmod(seconds, size)
+            parts.append(f'{n}{suffix}')
+        if len(parts) == 2:
+            break
+    text = ' '.join(parts)
+    if absolute:
+        return text
+    return f'{text} ago'
+
+
+def truncate_long_string(s: str, max_length: int = 35) -> str:
+    if len(s) <= max_length:
+        return s
+    return s[:max_length - 3] + '...'
+
+
+class Backoff:
+    """Capped exponential backoff with jitter-free determinism for tests."""
+
+    def __init__(self, initial: float = 1.0, cap: float = 30.0,
+                 factor: float = 2.0):
+        self._next = initial
+        self._cap = cap
+        self._factor = factor
+
+    def next_backoff(self) -> float:
+        value = self._next
+        self._next = min(self._next * self._factor, self._cap)
+        return value

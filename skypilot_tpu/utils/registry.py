@@ -1,0 +1,60 @@
+"""Name → class registries (clouds, backends, recovery strategies, LB policies).
+
+Same role as the reference's ``sky/utils/registry.py``: decorating a class
+registers it under a canonical name; lookups are case-insensitive and support
+aliases.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Generic, List, Optional, Type, TypeVar
+
+T = TypeVar('T')
+
+
+class Registry(Generic[T]):
+
+    def __init__(self, registry_name: str):
+        self._registry_name = registry_name
+        self._entries: Dict[str, T] = {}
+        self._aliases: Dict[str, str] = {}
+
+    def register(self, name: Optional[str] = None,
+                 aliases: Optional[List[str]] = None) -> Callable[[Type], Type]:
+
+        def decorator(cls: Type) -> Type:
+            key = (name or cls.__name__).lower()
+            if key in self._entries:
+                raise ValueError(
+                    f'{self._registry_name}: duplicate registration {key!r}')
+            self._entries[key] = cls
+            for alias in aliases or []:
+                self._aliases[alias.lower()] = key
+            return cls
+
+        return decorator
+
+    def canonical_name(self, name: str) -> str:
+        key = name.lower()
+        return self._aliases.get(key, key)
+
+    def get(self, name: str) -> Optional[T]:
+        return self._entries.get(self.canonical_name(name))
+
+    def from_str(self, name: Optional[str]) -> Optional[T]:
+        if name is None:
+            return None
+        entry = self.get(name)
+        if entry is None:
+            raise ValueError(
+                f'{self._registry_name}: unknown name {name!r}. '
+                f'Available: {sorted(self._entries)}')
+        return entry
+
+    def keys(self) -> List[str]:
+        return sorted(self._entries)
+
+    def values(self) -> List[T]:
+        return [self._entries[k] for k in sorted(self._entries)]
+
+    def __contains__(self, name: str) -> bool:
+        return self.canonical_name(name) in self._entries

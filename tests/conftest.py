@@ -1,0 +1,31 @@
+"""Test harness configuration.
+
+- JAX tests run on a virtual 8-device CPU mesh (multi-chip sharding is
+  validated without TPU hardware; the driver separately dry-runs
+  __graft_entry__.dryrun_multichip).
+- Orchestration tests get an isolated state dir per test (no ~/.skytpu
+  pollution).
+"""
+import os
+
+# Must be set before jax import anywhere in the test process.
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in flags:
+    os.environ['XLA_FLAGS'] = (
+        flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _isolated_state(tmp_path, monkeypatch):
+    """Point all persistent state at a per-test temp dir."""
+    state_dir = tmp_path / 'skytpu_state'
+    monkeypatch.setenv('SKYTPU_STATE_DIR', str(state_dir))
+    monkeypatch.setenv('SKYTPU_CONFIG', str(tmp_path / 'nonexistent.yaml'))
+    monkeypatch.setenv('SKYTPU_USER_HASH', 'testhash')
+    from skypilot_tpu import config as config_lib
+    config_lib.reload()
+    yield
+    config_lib.reload()

@@ -1,0 +1,77 @@
+"""TPU slice model: parsing, derived hosts/topology, perf facts."""
+import pytest
+
+from skypilot_tpu import accelerators as accel
+from skypilot_tpu import exceptions
+
+
+def test_parse_basic():
+    s = accel.TpuSlice.from_name('tpu-v5e-8')
+    assert s.generation == 'v5e'
+    assert s.chips == 8
+    assert s.num_hosts == 1
+    assert s.chips_per_host == 8
+    assert not s.is_pod
+    assert s.gcp_accelerator_type == 'v5litepod-8'
+
+
+def test_parse_variants():
+    for name in ['v5e-8', 'TPU-V5E-8', 'v5litepod-8', 'tpu-v5e-8']:
+        assert accel.TpuSlice.from_name(name).name == 'tpu-v5e-8'
+
+
+def test_cores_vs_chips_convention():
+    # v5p counts cores: v5p-64 = 32 chips = 8 hosts (4 chips/host).
+    s = accel.TpuSlice.from_name('tpu-v5p-64')
+    assert s.chips == 32
+    assert s.num_hosts == 8
+    # v6e counts chips: v6e-16 = 16 chips = 2 hosts (8 chips/host).
+    s = accel.TpuSlice.from_name('tpu-v6e-16')
+    assert s.chips == 16
+    assert s.num_hosts == 2
+    assert s.is_pod
+
+
+def test_topology():
+    assert accel.TpuSlice.from_name('tpu-v5e-16').topology == (4, 4)
+    assert accel.TpuSlice.from_name('tpu-v6e-256').topology == (16, 16)
+    # 3D torus gens get a 3-axis shape whose product is the chip count.
+    t = accel.TpuSlice.from_name('tpu-v5p-128').topology
+    assert len(t) == 3
+    assert t[0] * t[1] * t[2] == 64
+
+
+def test_perf_facts():
+    s = accel.TpuSlice.from_name('tpu-v6e-8')
+    assert s.total_bf16_tflops == pytest.approx(8 * 918.0)
+    assert s.total_hbm_gb == pytest.approx(8 * 32.0)
+    assert s.default_runtime_version == 'v2-alpha-tpuv6e'
+
+
+def test_invalid_names():
+    with pytest.raises(exceptions.InvalidSliceError):
+        accel.TpuSlice.from_name('tpu-v9-8')
+    with pytest.raises(exceptions.InvalidSliceError):
+        accel.TpuSlice.from_name('a100-8')
+    with pytest.raises(exceptions.InvalidSliceError):
+        # v5p counts cores; odd core counts are not valid slices.
+        _ = accel.TpuSlice.from_name('tpu-v5p-7').chips
+    assert accel.TpuSlice.maybe_from_name('h100') is None
+
+
+def test_list_slice_names():
+    names = accel.list_slice_names('v5e')
+    assert 'tpu-v5e-8' in names
+    assert 'tpu-v5e-256' in names
+    all_names = accel.list_slice_names()
+    assert 'tpu-v5p-8' in all_names
+    # Every listed name must round-trip through the parser.
+    for n in all_names:
+        s = accel.TpuSlice.from_name(n)
+        assert s.num_hosts >= 1
+
+
+def test_is_tpu():
+    assert accel.is_tpu('tpu-v5e-8')
+    assert not accel.is_tpu('h100:8')
+    assert not accel.is_tpu(None)

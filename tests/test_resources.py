@@ -1,0 +1,133 @@
+"""Resources: parsing, infra strings, TPU inference, filtering, round-trip."""
+import pytest
+
+from skypilot_tpu import Resources
+from skypilot_tpu import exceptions
+
+
+def test_default():
+    r = Resources()
+    assert r.cloud is None
+    assert r.tpu is None
+    assert not r.is_launchable()
+    assert r.num_hosts == 1
+
+
+def test_tpu_implies_gcp():
+    r = Resources(accelerators='tpu-v5e-8')
+    assert r.cloud == 'gcp'
+    assert r.tpu.chips == 8
+    assert r.is_launchable()
+    assert r.runtime_version == 'v2-alpha-tpuv5-lite'
+
+
+def test_tpu_dict_and_colon_sugar():
+    assert Resources(accelerators={'tpu-v5e': 8}).tpu.name == 'tpu-v5e-8'
+    assert Resources(accelerators='tpu-v5e:8').tpu.name == 'tpu-v5e-8'
+
+
+def test_pod_hosts_derived():
+    r = Resources(accelerators='tpu-v5p-64')
+    assert r.num_hosts == 8
+
+
+def test_infra_parsing():
+    r = Resources(infra='gcp/us-central2/us-central2-b')
+    assert (r.cloud, r.region, r.zone) == ('gcp', 'us-central2',
+                                           'us-central2-b')
+    assert r.infra == 'gcp/us-central2/us-central2-b'
+    r = Resources(infra='gcp')
+    assert r.cloud == 'gcp' and r.region is None
+    with pytest.raises(exceptions.InvalidResourcesError):
+        Resources(infra='gcp/us-central1', cloud='gcp')
+
+
+def test_zone_implies_region():
+    r = Resources(cloud='gcp', zone='us-central2-b')
+    assert r.region == 'us-central2'
+
+
+def test_cpus_memory_plus_syntax():
+    r = Resources(cpus='8+', memory='32+')
+    assert r.cpus == '8+'
+    assert r.memory == '32+'
+    r = Resources(cpus=4, memory='16GB')
+    assert r.cpus == '4'
+    assert r.memory == '16'
+
+
+def test_gpu_rejected():
+    with pytest.raises(exceptions.InvalidResourcesError):
+        Resources(accelerators='A100:8')
+
+
+def test_yaml_round_trip():
+    r = Resources(accelerators='tpu-v5e-16', use_spot=True,
+                  region='us-central2', ports=[8080, '9000-9010'],
+                  labels={'team': 'ml'}, autostop=10)
+    cfg = r.to_yaml_config()
+    r2 = Resources.from_yaml_config(cfg)
+    assert r == r2
+    assert r2.autostop.idle_minutes == 10
+    assert r2.ports == ('8080', '9000-9010')
+
+
+def test_any_of():
+    res = Resources.from_yaml_config({
+        'accelerators': 'tpu-v5e-8',
+        'any_of': [{'use_spot': True}, {'use_spot': False,
+                                        'region': 'us-central1'}],
+    })
+    assert isinstance(res, list) and len(res) == 2
+    assert res[0].use_spot and res[0].tpu.name == 'tpu-v5e-8'
+    assert not res[1].use_spot and res[1].region == 'us-central1'
+
+
+def test_less_demanding_than():
+    req = Resources(accelerators='tpu-v5e-8')
+    cluster = Resources(accelerators='tpu-v5e-16', cloud='gcp',
+                        region='us-central2')
+    assert req.less_demanding_than(cluster)
+    assert not cluster.less_demanding_than(req)
+    other_gen = Resources(accelerators='tpu-v6e-8')
+    assert not other_gen.less_demanding_than(cluster)
+
+
+def test_blocklist_matching():
+    r = Resources(accelerators='tpu-v5e-8', region='us-central2',
+                  zone='us-central2-b')
+    assert r.should_be_blocked_by(Resources(cloud='gcp'))
+    assert r.should_be_blocked_by(
+        Resources(cloud='gcp', region='us-central2'))
+    assert not r.should_be_blocked_by(
+        Resources(cloud='gcp', region='europe-west4'))
+
+
+def test_copy_override():
+    r = Resources(accelerators='tpu-v5e-8', use_spot=True)
+    r2 = r.copy(use_spot=False, zone='us-central2-b')
+    assert not r2.use_spot
+    assert r2.zone == 'us-central2-b'
+    assert r2.tpu == r.tpu
+
+
+def test_repr_mentions_topology():
+    r = Resources(accelerators='tpu-v5p-64')
+    s = repr(r)
+    assert '8 hosts' in s
+
+
+def test_review_fixes():
+    # Full-name-plus-count forms accepted.
+    assert Resources(accelerators={'tpu-v5e-8': 1}).tpu.name == 'tpu-v5e-8'
+    assert Resources(accelerators='tpu-v5e-8:1').tpu.name == 'tpu-v5e-8'
+    # '32GB+' memory parses; bad memory raises typed error.
+    assert Resources(memory='32GB+').memory == '32+'
+    with pytest.raises(exceptions.InvalidResourcesError):
+        Resources(memory='lots')
+    # less_demanding_than respects cpus/memory/ports when both declare them.
+    req = Resources(cpus='64+', ports=[8080])
+    small = Resources(cloud='gcp', cpus=8, ports=[8080])
+    assert not req.less_demanding_than(small)
+    big = Resources(cloud='gcp', cpus=96, ports=[8080, 9090])
+    assert req.less_demanding_than(big)

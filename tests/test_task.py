@@ -1,0 +1,111 @@
+"""Task: construction, env handling, YAML round-trip, DAG."""
+import textwrap
+
+import pytest
+
+from skypilot_tpu import Dag, Resources, Task
+from skypilot_tpu import exceptions
+
+
+def test_basic_task():
+    t = Task('train', run='echo hello', setup='pip list')
+    assert t.num_nodes == 1
+    assert t.resources[0].cloud is None
+
+
+def test_invalid_name():
+    with pytest.raises(exceptions.InvalidTaskError):
+        Task('bad name!')
+
+
+def test_yaml_round_trip(tmp_path):
+    yaml_str = textwrap.dedent("""\
+        name: llama3-pretrain
+        resources:
+          accelerators: tpu-v5p-64
+          use_spot: true
+        envs:
+          MODEL_SIZE: 8b
+        setup: |
+          echo setup
+        run: |
+          python train.py --model $MODEL_SIZE
+        """)
+    p = tmp_path / 'task.yaml'
+    p.write_text(yaml_str)
+    t = Task.from_yaml(str(p))
+    assert t.name == 'llama3-pretrain'
+    assert t.resources[0].tpu.name == 'tpu-v5p-64'
+    assert t.resources[0].use_spot
+    assert t.envs == {'MODEL_SIZE': '8b'}
+    # Env substitution into run:
+    assert '--model 8b' in t.run
+    cfg = t.to_yaml_config()
+    t2 = Task.from_yaml_config(cfg)
+    assert t2.name == t.name
+    assert t2.resources[0] == t.resources[0]
+
+
+def test_env_required(tmp_path):
+    p = tmp_path / 'task.yaml'
+    p.write_text('envs:\n  NEEDED:\nrun: echo $NEEDED\n')
+    with pytest.raises(exceptions.InvalidTaskError):
+        Task.from_yaml(str(p))
+    t = Task.from_yaml(str(p), env_overrides={'NEEDED': 'x'})
+    assert t.envs['NEEDED'] == 'x'
+
+
+def test_schema_rejects_unknown_field(tmp_path):
+    p = tmp_path / 'task.yaml'
+    p.write_text('nmae: typo\nrun: echo hi\n')
+    with pytest.raises(exceptions.InvalidYamlError):
+        Task.from_yaml(str(p))
+
+
+def test_workdir_must_exist():
+    with pytest.raises(exceptions.InvalidTaskError):
+        Task(workdir='/nonexistent/path/xyz')
+
+
+def test_dag_chain():
+    with Dag('pipe') as dag:
+        a = Task('a', run='echo a')
+        b = Task('b', run='echo b')
+        dag.add(a)
+        dag.add(b)
+        dag.add_edge(a, b)
+    assert dag.is_chain()
+    assert dag.topological_order() == [a, b]
+
+
+def test_dag_cycle_detection():
+    dag = Dag()
+    a, b = Task('a'), Task('b')
+    dag.add_edge(a, b)
+    dag.add_edge(b, a)
+    with pytest.raises(ValueError):
+        dag.topological_order()
+
+
+def test_multi_resources():
+    t = Task('t')
+    t.set_resources([
+        Resources(accelerators='tpu-v5e-8', use_spot=True),
+        Resources(accelerators='tpu-v6e-8'),
+    ])
+    assert len(t.resources) == 2
+    assert t.tpu is None  # mixed slices -> no single slice
+
+
+def test_review_fixes():
+    # Env prefix does not corrupt longer names.
+    t = Task.from_yaml_config({'envs': {'FOO': 'a', 'FOOD': 'b'},
+                               'run': 'echo $FOOD ${FOO}'})
+    assert t.run == 'echo b a'
+    # Empty-string env is a real value, not "missing".
+    t = Task.from_yaml_config({'envs': {'DEBUG': ''}, 'run': 'echo ok'})
+    assert t.envs['DEBUG'] == ''
+    # Dag context auto-registers tasks.
+    with Dag('auto') as dag:
+        a = Task('a', run='echo a')
+    assert dag.tasks == [a]

@@ -1,0 +1,174 @@
+"""TPU/GCE service catalog: slice availability, pricing, perf facts.
+
+Counterpart of the reference's ``sky/clouds/service_catalog`` (lazy pandas
+CSVs with TTL refresh, sky/clouds/service_catalog/common.py:130-238; GCP TPU
+pseudo-instance handling, gcp_catalog.py:232-254). TPU-native changes:
+
+- The row unit is a *slice in a zone*, not an instance type: price, chips,
+  hosts, and ICI topology are columns, so the optimizer can rank by
+  **perf/$ per chip** directly (chips * gen TFLOPs / price).
+- Catalogs are baked into the wheel (no hosted fetch in the offline build);
+  ``fetchers/fetch_gcp.py`` regenerates them.
+"""
+from __future__ import annotations
+
+import functools
+import os
+from typing import Dict, List, Optional, Tuple
+
+import pandas as pd
+
+from skypilot_tpu import accelerators as accel_lib
+from skypilot_tpu import exceptions
+
+_DATA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), 'data')
+
+
+@functools.lru_cache(maxsize=None)
+def _read(name: str) -> pd.DataFrame:
+    path = os.path.join(_DATA_DIR, name)
+    if not os.path.exists(path):
+        # Regenerate on first use (e.g. fresh checkout).
+        from skypilot_tpu.catalog.fetchers import fetch_gcp
+        fetch_gcp.main()
+    return pd.read_csv(path)
+
+
+def _tpus() -> pd.DataFrame:
+    return _read('gcp_tpus.csv')
+
+
+def _vms() -> pd.DataFrame:
+    return _read('gcp_vms.csv')
+
+
+# ---- TPU slice queries -----------------------------------------------------
+def get_slice_zones(slice_: accel_lib.TpuSlice,
+                    region: Optional[str] = None) -> List[str]:
+    df = _tpus()
+    df = df[df['slice'] == slice_.name]
+    if region is not None:
+        df = df[df['region'] == region]
+    return sorted(df['zone'].unique())
+
+
+def get_slice_regions(slice_: accel_lib.TpuSlice) -> List[str]:
+    df = _tpus()
+    return sorted(df[df['slice'] == slice_.name]['region'].unique())
+
+
+def get_slice_hourly_cost(slice_: accel_lib.TpuSlice, use_spot: bool,
+                          region: Optional[str] = None,
+                          zone: Optional[str] = None) -> float:
+    df = _tpus()
+    df = df[df['slice'] == slice_.name]
+    if zone is not None:
+        df = df[df['zone'] == zone]
+    elif region is not None:
+        df = df[df['region'] == region]
+    if df.empty:
+        where = zone or region or 'any region'
+        raise exceptions.ResourcesUnavailableError(
+            f'{slice_.name} is not available in {where}.')
+    col = 'spot_price' if use_spot else 'price'
+    return float(df[col].min())
+
+
+def list_tpu_slices(
+        generation: Optional[str] = None,
+        region: Optional[str] = None) -> pd.DataFrame:
+    """One row per (slice, zone): used by `skytpu show-tpus`."""
+    df = _tpus()
+    if generation is not None:
+        df = df[df['generation'] == generation]
+    if region is not None:
+        df = df[df['region'] == region]
+    return df.reset_index(drop=True)
+
+
+def perf_per_dollar(slice_: accel_lib.TpuSlice, use_spot: bool,
+                    region: Optional[str] = None) -> float:
+    """bf16 TFLOPs per $/hour — the TPU-native ranking metric."""
+    cost = get_slice_hourly_cost(slice_, use_spot, region=region)
+    if cost <= 0:
+        return float('inf')
+    return slice_.total_bf16_tflops / cost
+
+
+# ---- GCE VM queries --------------------------------------------------------
+def get_instance_hourly_cost(instance_type: str, use_spot: bool,
+                             region: Optional[str] = None) -> float:
+    df = _vms()
+    df = df[df['instance_type'] == instance_type]
+    if region is not None:
+        df = df[df['region'] == region]
+    if df.empty:
+        raise exceptions.ResourcesUnavailableError(
+            f'Instance type {instance_type} not found'
+            f'{" in " + region if region else ""}.')
+    col = 'spot_price' if use_spot else 'price'
+    return float(df[col].min())
+
+
+def get_instance_info(instance_type: str) -> Tuple[int, float]:
+    """(vcpus, memory_gb) for an instance type."""
+    df = _vms()
+    df = df[df['instance_type'] == instance_type]
+    if df.empty:
+        raise exceptions.ResourcesUnavailableError(
+            f'Unknown instance type {instance_type}.')
+    row = df.iloc[0]
+    return int(row['vcpus']), float(row['memory_gb'])
+
+
+def get_default_instance_type(cpus: Optional[float] = None,
+                              cpus_plus: bool = True,
+                              memory: Optional[float] = None,
+                              memory_plus: bool = True,
+                              region: Optional[str] = None) -> Optional[str]:
+    """Cheapest instance satisfying the cpu/memory constraints."""
+    df = _vms()
+    if region is not None:
+        df = df[df['region'] == region]
+    if cpus is None and memory is None:
+        cpus, cpus_plus = 4, True  # sensible default, ref uses 4 vCPU too
+    if cpus is not None:
+        df = df[df['vcpus'] >= cpus] if cpus_plus else df[df['vcpus'] == cpus]
+    if memory is not None:
+        df = (df[df['memory_gb'] >= memory]
+              if memory_plus else df[df['memory_gb'] == memory])
+    if df.empty:
+        return None
+    # Cheapest (then smallest) first.
+    df = df.sort_values(['price', 'vcpus'])
+    return str(df.iloc[0]['instance_type'])
+
+
+def get_vm_regions(instance_type: str) -> List[str]:
+    df = _vms()
+    return sorted(df[df['instance_type'] == instance_type]['region'].unique())
+
+
+def get_tpu_host_shape(generation: str) -> Tuple[int, float]:
+    """(vcpus, memory_gb) on each TPU-VM host of a generation."""
+    from skypilot_tpu.catalog.fetchers import fetch_gcp
+    return fetch_gcp.TPU_HOST_SHAPES[generation]
+
+
+def validate_region_zone(
+        region: Optional[str],
+        zone: Optional[str]) -> None:
+    """Cheap sanity check that a region/zone exists in the catalog."""
+    if region is None and zone is None:
+        return
+    tpus, vms = _tpus(), _vms()
+    regions = set(tpus['region']).union(vms['region'])
+    zones = set(tpus['zone'])
+    if zone is not None and zone not in zones:
+        # GCE zones are region+suffix; accept unknown-but-wellformed.
+        if zone.rsplit('-', 1)[0] not in regions:
+            raise exceptions.InvalidResourcesError(
+                f'Unknown zone {zone!r} (known TPU zones: {sorted(zones)})')
+    elif region is not None and region not in regions:
+        raise exceptions.InvalidResourcesError(
+            f'Unknown region {region!r} (known: {sorted(regions)})')

@@ -1,0 +1,148 @@
+"""Generate the GCP TPU + GCE catalog CSVs.
+
+Counterpart of the reference's catalog data fetchers
+(sky/clouds/service_catalog/data_fetchers/fetch_gcp.py:34-66, which scrapes
+the GCP pricing SKU API and gap-fills TPU zones by hand). In production this
+module would hit ``cloudbilling.googleapis.com``; offline it regenerates the
+baked-in CSVs from the static tables below, which mirror public on-demand
+per-chip-hour pricing and published TPU zone availability.
+
+Run:  python -m skypilot_tpu.catalog.fetchers.fetch_gcp
+"""
+from __future__ import annotations
+
+import csv
+import os
+from typing import Dict, List, Tuple
+
+from skypilot_tpu import accelerators as accel_lib
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+DATA_DIR = os.path.join(_HERE, '..', 'data')
+
+# Public on-demand $/chip-hour in US regions; spot is the public preemptible
+# discount (~0.35-0.45x depending on generation).
+_TPU_PRICE_PER_CHIP_HOUR: Dict[str, Tuple[float, float]] = {
+    'v2': (1.125, 0.45),
+    'v3': (2.00, 0.80),
+    'v4': (3.22, 1.13),
+    'v5e': (1.20, 0.42),
+    'v5p': (4.20, 1.47),
+    'v6e': (2.70, 0.945),
+}
+
+# Zone availability per generation (published TPU region/zone matrix; the
+# reference hand-maintains the same kind of table, fetch_gcp.py:34-66).
+_TPU_ZONES: Dict[str, List[str]] = {
+    'v2': ['us-central1-b', 'us-central1-c', 'europe-west4-a',
+           'asia-east1-c'],
+    'v3': ['europe-west4-a', 'us-central1-a'],
+    'v4': ['us-central2-b'],
+    'v5e': ['us-central1-a', 'us-west4-a', 'us-east1-c', 'us-east5-b',
+            'europe-west4-b', 'asia-southeast1-b'],
+    'v5p': ['us-east5-a', 'us-central2-b', 'europe-west4-b'],
+    'v6e': ['us-east5-b', 'us-east1-d', 'us-central2-b', 'europe-west4-a',
+            'asia-northeast1-b'],
+}
+
+# Regional price multiplier vs US.
+_REGION_MULTIPLIER = [('europe-', 1.08), ('asia-', 1.10)]
+
+# GCE shapes for CPU tasks and controllers: (vcpus, memory_gb, $/h US).
+_GCE_INSTANCES: Dict[str, Tuple[int, float, float]] = {
+    'n2-standard-2': (2, 8, 0.0971),
+    'n2-standard-4': (4, 16, 0.1942),
+    'n2-standard-8': (8, 32, 0.3885),
+    'n2-standard-16': (16, 64, 0.7769),
+    'n2-standard-32': (32, 128, 1.5539),
+    'n2-highmem-8': (8, 64, 0.5241),
+    'n2-highmem-16': (16, 128, 1.0482),
+    'e2-standard-2': (2, 8, 0.0670),
+    'e2-standard-4': (4, 16, 0.1341),
+    'e2-standard-8': (8, 32, 0.2681),
+}
+_GCE_SPOT_FACTOR = 0.30
+
+# TPU-VM host shapes: CPU/RAM available on each TPU host for the user's
+# processes (reference forces the analogous shapes, sky/clouds/gcp.py:614-665).
+TPU_HOST_SHAPES: Dict[str, Tuple[int, float]] = {
+    'v2': (96, 334.0),
+    'v3': (96, 334.0),
+    'v4': (240, 400.0),
+    'v5e': (112, 192.0),
+    'v5p': (208, 448.0),
+    'v6e': (180, 720.0),
+}
+
+
+def _region_of(zone: str) -> str:
+    return zone.rsplit('-', 1)[0]
+
+
+def _multiplier(region: str) -> float:
+    for prefix, mult in _REGION_MULTIPLIER:
+        if region.startswith(prefix):
+            return mult
+    return 1.0
+
+
+def generate_tpu_rows() -> List[Dict[str, object]]:
+    rows: List[Dict[str, object]] = []
+    for name in accel_lib.list_slice_names():
+        s = accel_lib.TpuSlice.from_name(name)
+        base, base_spot = _TPU_PRICE_PER_CHIP_HOUR[s.generation]
+        for zone in _TPU_ZONES[s.generation]:
+            region = _region_of(zone)
+            mult = _multiplier(region)
+            rows.append({
+                'slice': s.name,
+                'generation': s.generation,
+                'chips': s.chips,
+                'num_hosts': s.num_hosts,
+                'topology': s.topology_str,
+                'region': region,
+                'zone': zone,
+                'price': round(base * s.chips * mult, 4),
+                'spot_price': round(base_spot * s.chips * mult, 4),
+            })
+    return rows
+
+
+def generate_vm_rows() -> List[Dict[str, object]]:
+    regions = sorted({_region_of(z)
+                      for zones in _TPU_ZONES.values()
+                      for z in zones} | {'us-central1'})
+    rows = []
+    for itype, (vcpus, mem, price) in _GCE_INSTANCES.items():
+        for region in regions:
+            mult = _multiplier(region)
+            rows.append({
+                'instance_type': itype,
+                'vcpus': vcpus,
+                'memory_gb': mem,
+                'region': region,
+                'price': round(price * mult, 4),
+                'spot_price': round(price * _GCE_SPOT_FACTOR * mult, 4),
+            })
+    return rows
+
+
+def write_csv(path: str, rows: List[Dict[str, object]]) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', newline='', encoding='utf-8') as f:
+        writer = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
+        writer.writeheader()
+        writer.writerows(rows)
+
+
+def main() -> None:
+    tpu_rows = generate_tpu_rows()
+    vm_rows = generate_vm_rows()
+    write_csv(os.path.join(DATA_DIR, 'gcp_tpus.csv'), tpu_rows)
+    write_csv(os.path.join(DATA_DIR, 'gcp_vms.csv'), vm_rows)
+    print(f'Wrote {len(tpu_rows)} TPU rows, {len(vm_rows)} VM rows '
+          f'to {os.path.normpath(DATA_DIR)}')
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,52 @@
+"""Credential probing: which clouds are enabled for this user.
+
+Counterpart of reference ``sky/check.py`` (check_capabilities:25,
+get_cached_enabled_clouds_or_refresh:208). Results are cached in the sqlite
+user state; `skytpu check` refreshes.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu import clouds as clouds_lib
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import exceptions
+
+
+def check_capabilities(
+        quiet: bool = False) -> Dict[str, Tuple[bool, Optional[str]]]:
+    """Probe every registered cloud; returns {name: (enabled, reason)}."""
+    allowed = config_lib.get_nested(('allowed_clouds',), None)
+    results: Dict[str, Tuple[bool, Optional[str]]] = {}
+    for name in clouds_lib.CLOUD_REGISTRY.keys():
+        if allowed is not None and name not in allowed:
+            results[name] = (False, 'disabled by config allowed_clouds')
+            continue
+        cloud_cls = clouds_lib.CLOUD_REGISTRY.get(name)
+        ok, reason = cloud_cls.check_credentials()
+        results[name] = (ok, reason)
+    if not quiet:
+        for name, (ok, reason) in sorted(results.items()):
+            mark = '✓' if ok else '✗'
+            line = f'  {mark} {name}'
+            if not ok and reason:
+                line += f': {reason}'
+            print(line)
+    # Persist for the optimizer.
+    from skypilot_tpu import global_user_state
+    global_user_state.set_enabled_clouds(
+        [n for n, (ok, _) in results.items() if ok])
+    return results
+
+
+def get_cached_enabled_clouds_or_refresh(
+        raise_if_no_cloud_access: bool = False) -> List[str]:
+    from skypilot_tpu import global_user_state
+    enabled = global_user_state.get_enabled_clouds()
+    if enabled is None:
+        results = check_capabilities(quiet=True)
+        enabled = [n for n, (ok, _) in results.items() if ok]
+    if raise_if_no_cloud_access and not enabled:
+        raise exceptions.CloudUserIdentityError(
+            'No cloud is enabled. Run `skytpu check` for details.')
+    return enabled

@@ -1,0 +1,20 @@
+"""Cloud abstraction: Cloud ABC + registry.
+
+Counterpart of reference ``sky/clouds/cloud.py`` (Cloud ABC with capability
+enum, feasibility, pricing, deploy vars, credentials;
+sky/clouds/cloud.py:131-887). GCP/TPU-first but the same functional shape so
+more providers can be added.
+"""
+from skypilot_tpu.clouds.cloud import (Cloud, CloudFeature, CLOUD_REGISTRY,
+                                       FeasibleResources)
+from skypilot_tpu.clouds import gcp as _gcp  # registers
+from skypilot_tpu.clouds import local as _local  # registers
+
+__all__ = ['Cloud', 'CloudFeature', 'CLOUD_REGISTRY', 'FeasibleResources',
+           'get_cloud']
+
+
+def get_cloud(name: str) -> Cloud:
+    cls = CLOUD_REGISTRY.from_str(name)
+    assert cls is not None
+    return cls()

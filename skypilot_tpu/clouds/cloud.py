@@ -1,0 +1,111 @@
+"""Cloud ABC: feasibility, pricing, deploy variables, credentials."""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Dict, List, Optional, TYPE_CHECKING, Tuple
+
+from skypilot_tpu.utils import registry
+
+if TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+CLOUD_REGISTRY: registry.Registry = registry.Registry('cloud')
+
+
+class CloudFeature(enum.Enum):
+    """Capabilities a task/operation may require of a cloud.
+
+    Same role as reference CloudImplementationFeatures (sky/clouds/cloud.py:31).
+    """
+    STOP = 'stop'
+    AUTOSTOP = 'autostop'
+    SPOT = 'spot'
+    MULTI_HOST = 'multi_host'
+    STORAGE_MOUNTS = 'storage_mounts'
+    OPEN_PORTS = 'open_ports'
+    CUSTOM_IMAGES = 'custom_images'
+
+
+@dataclasses.dataclass
+class FeasibleResources:
+    """Result of a feasibility query: concrete candidates + rejection notes."""
+    resources: List['resources_lib.Resources']
+    fuzzy_candidates: List[str] = dataclasses.field(default_factory=list)
+    hint: Optional[str] = None
+
+
+class Cloud:
+    """Abstract cloud provider."""
+
+    NAME = 'abstract'
+    _FEATURES: frozenset = frozenset()
+
+    # ---- identity / credentials ------------------------------------------
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        """(ok, reason-if-not)."""
+        raise NotImplementedError
+
+    @classmethod
+    def get_active_user_identity(cls) -> Optional[List[str]]:
+        return None
+
+    # ---- capabilities -----------------------------------------------------
+    @classmethod
+    def supports(cls, feature: CloudFeature) -> bool:
+        return feature in cls._FEATURES
+
+    @classmethod
+    def check_features_are_supported(
+            cls, features: set) -> None:
+        unsupported = {f for f in features if not cls.supports(f)}
+        if unsupported:
+            from skypilot_tpu import exceptions
+            raise exceptions.NotSupportedError(
+                f'{cls.NAME} does not support: '
+                f'{sorted(f.value for f in unsupported)}')
+
+    # ---- topology ---------------------------------------------------------
+    def regions_for(
+            self, resources: 'resources_lib.Resources') -> List[str]:
+        raise NotImplementedError
+
+    def zones_for(self, resources: 'resources_lib.Resources',
+                  region: str) -> List[Optional[str]]:
+        """Zones to iterate for failover within a region (None = regional)."""
+        raise NotImplementedError
+
+    # ---- pricing ----------------------------------------------------------
+    def hourly_cost(self, resources: 'resources_lib.Resources',
+                    region: Optional[str] = None,
+                    zone: Optional[str] = None) -> float:
+        raise NotImplementedError
+
+    def egress_cost_per_gb(self, dst_cloud: str, dst_region: str,
+                           src_region: Optional[str]) -> float:
+        return 0.0
+
+    # ---- feasibility ------------------------------------------------------
+    def get_feasible_resources(
+            self, resources: 'resources_lib.Resources'
+    ) -> FeasibleResources:
+        """Turn a (possibly partial) filter into launchable candidates."""
+        raise NotImplementedError
+
+    # ---- deployment -------------------------------------------------------
+    def make_deploy_variables(
+            self, resources: 'resources_lib.Resources',
+            cluster_name_on_cloud: str, region: str,
+            zone: Optional[str]) -> Dict[str, Any]:
+        """Variables consumed by the provisioner for this cloud."""
+        raise NotImplementedError
+
+    def __repr__(self) -> str:
+        return self.NAME
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, Cloud) and self.NAME == other.NAME
+
+    def __hash__(self) -> int:
+        return hash(self.NAME)

@@ -1,0 +1,73 @@
+"""Local "cloud": subprocess-backed hosts on this machine.
+
+The permanent unit-test backend (SURVEY.md §7 phase 1): every orchestration
+path — provision, setup, exec, job queue, logs, autostop, recovery — runs for
+real against local processes, no cloud credentials needed. The reference has
+no equivalent (its tests mock boto3 objects or need real clouds;
+tests/common_test_fixtures.py:356); this is a deliberate testability upgrade.
+
+A "cluster" is a directory under the state dir; "hosts" are entries that the
+local provisioner materializes; jobs run as real subprocesses through the
+same agent/job-queue code path used on TPU hosts. Multi-host slices are
+emulated with N worker entries on one machine (rank env vars still exported),
+which is exactly what `jax.distributed` + virtual CPU devices need for tests.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud as cloud_lib
+
+LOCAL_REGION = 'local'
+LOCAL_ZONE = 'local-a'
+
+
+@cloud_lib.CLOUD_REGISTRY.register(name='local')
+class Local(cloud_lib.Cloud):
+    NAME = 'local'
+    _FEATURES = frozenset({
+        cloud_lib.CloudFeature.AUTOSTOP,
+        cloud_lib.CloudFeature.STOP,
+        cloud_lib.CloudFeature.MULTI_HOST,
+        cloud_lib.CloudFeature.OPEN_PORTS,
+        # SPOT intentionally excluded; tests inject preemption directly.
+    })
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        return True, None
+
+    @classmethod
+    def get_active_user_identity(cls) -> Optional[List[str]]:
+        return ['local']
+
+    def regions_for(self, resources) -> List[str]:
+        if resources.region not in (None, LOCAL_REGION):
+            return []
+        return [LOCAL_REGION]
+
+    def zones_for(self, resources, region: str) -> List[Optional[str]]:
+        if resources.zone not in (None, LOCAL_ZONE):
+            return []
+        return [LOCAL_ZONE]
+
+    def hourly_cost(self, resources, region=None, zone=None) -> float:
+        return 0.0
+
+    def get_feasible_resources(self, resources) -> cloud_lib.FeasibleResources:
+        # Accept anything; a TPU resource is emulated with N host slots.
+        return cloud_lib.FeasibleResources(
+            [resources.copy(cloud=self.NAME)])
+
+    def make_deploy_variables(self, resources, cluster_name_on_cloud: str,
+                              region: str,
+                              zone: Optional[str]) -> Dict[str, Any]:
+        return {
+            'cloud': self.NAME,
+            'mode': 'local',
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region,
+            'zone': zone,
+            'num_hosts': resources.num_hosts,
+            'tpu_slice': resources.tpu.name if resources.tpu else None,
+        }

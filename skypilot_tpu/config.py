@@ -29,13 +29,16 @@ def _load() -> None:
     with _dict_lock:
         if _loaded:
             return
-        path = os.environ.get(ENV_VAR_CONFIG,
-                              os.path.expanduser(CONFIG_PATH))
+        explicit = os.environ.get(ENV_VAR_CONFIG)
+        path = os.path.expanduser(explicit or CONFIG_PATH)
         config: Dict[str, Any] = {}
         if os.path.exists(path):
             config = common_utils.read_yaml(path)
             from skypilot_tpu import schemas  # lazy: avoid cycle
             schemas.validate_config(config, source=path)
+        elif explicit:
+            raise FileNotFoundError(
+                f'{ENV_VAR_CONFIG}={explicit} does not exist.')
         _config = config
         _loaded = True
 
@@ -107,7 +110,8 @@ def override(overrides: Optional[Dict[str, Any]]) -> Iterator[None]:
 
 
 def loaded_config_path() -> Optional[str]:
-    path = os.environ.get(ENV_VAR_CONFIG, os.path.expanduser(CONFIG_PATH))
+    path = os.path.expanduser(
+        os.environ.get(ENV_VAR_CONFIG) or CONFIG_PATH)
     return path if os.path.exists(path) else None
 
 

@@ -185,10 +185,12 @@ def serialize_exception(e: Exception) -> Dict[str, Any]:
 
 def deserialize_exception(d: Dict[str, Any]) -> Exception:
     cls = globals().get(d.get('type', ''), SkyTpuError)
-    try:
-        e = cls(d.get('message', ''))  # type: ignore[call-arg]
-    except TypeError:
-        e = SkyTpuError(d.get('message', ''))
+    if not (isinstance(cls, type) and issubclass(cls, Exception)):
+        cls = SkyTpuError
+    # Bypass subclass __init__ (signatures vary and some rebuild the
+    # message); restore type, message, and flat attrs directly.
+    e = cls.__new__(cls)
+    Exception.__init__(e, d.get('message', ''))
     for k, v in d.get('attrs', {}).items():
         try:
             setattr(e, k, v)

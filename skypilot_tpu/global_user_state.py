@@ -1,0 +1,251 @@
+"""Persistent user state: clusters, history, enabled clouds.
+
+Counterpart of reference ``sky/global_user_state.py`` (sqlite `clusters` /
+`cluster_history` / kv tables, pickled handles; :40-111,548-606). The state
+dir is ``$SKYTPU_STATE_DIR`` (default ``~/.skytpu``) so tests fully isolate.
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import pickle
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import common_utils
+
+_DB_LOCK = threading.Lock()
+_CONNS: Dict[str, sqlite3.Connection] = {}
+
+
+def get_state_dir() -> str:
+    d = os.environ.get('SKYTPU_STATE_DIR', '~/.skytpu')
+    d = os.path.expanduser(d)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _db() -> sqlite3.Connection:
+    path = os.path.join(get_state_dir(), 'state.db')
+    with _DB_LOCK:
+        conn = _CONNS.get(path)
+        if conn is None:
+            conn = sqlite3.connect(path, check_same_thread=False)
+            conn.execute('PRAGMA journal_mode=WAL')
+            _create_tables(conn)
+            _CONNS[path] = conn
+        return conn
+
+
+def _create_tables(conn: sqlite3.Connection) -> None:
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS clusters (
+            name TEXT PRIMARY KEY,
+            launched_at INTEGER,
+            handle BLOB,
+            last_use TEXT,
+            status TEXT,
+            autostop_idle_minutes INTEGER DEFAULT -1,
+            autostop_down INTEGER DEFAULT 0,
+            owner TEXT,
+            config_hash TEXT,
+            metadata TEXT DEFAULT '{}'
+        )""")
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS cluster_history (
+            cluster_hash TEXT,
+            name TEXT,
+            num_hosts INTEGER,
+            resources BLOB,
+            launched_at INTEGER,
+            duration_s INTEGER,
+            usage_intervals BLOB,
+            PRIMARY KEY (cluster_hash, launched_at)
+        )""")
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS kv (
+            key TEXT PRIMARY KEY,
+            value TEXT
+        )""")
+    conn.commit()
+
+
+class ClusterStatus(enum.Enum):
+    """Reconciled cluster lifecycle state (reference sky/status_lib)."""
+    INIT = 'INIT'          # provisioning or unknown/dirty
+    UP = 'UP'              # provisioned + runtime healthy
+    STOPPED = 'STOPPED'    # hosts stopped, disk kept
+
+    def colored(self) -> str:
+        color = {'INIT': '\x1b[33m', 'UP': '\x1b[32m',
+                 'STOPPED': '\x1b[90m'}[self.value]
+        return f'{color}{self.value}\x1b[0m'
+
+
+# ---- clusters --------------------------------------------------------------
+def add_or_update_cluster(cluster_name: str, handle: Any,
+                          requested_resources: Optional[Any] = None,
+                          ready: bool = False,
+                          config_hash: Optional[str] = None) -> None:
+    status = ClusterStatus.UP if ready else ClusterStatus.INIT
+    now = int(time.time())
+    db = _db()
+    existing = get_cluster_from_name(cluster_name)
+    launched_at = existing['launched_at'] if existing else now
+    db.execute(
+        """INSERT INTO clusters
+           (name, launched_at, handle, last_use, status,
+            autostop_idle_minutes, autostop_down, owner, config_hash, metadata)
+           VALUES (?,?,?,?,?,
+                   COALESCE((SELECT autostop_idle_minutes FROM clusters
+                             WHERE name=?), -1),
+                   COALESCE((SELECT autostop_down FROM clusters
+                             WHERE name=?), 0),
+                   ?,?,COALESCE((SELECT metadata FROM clusters
+                                 WHERE name=?), '{}'))
+           ON CONFLICT(name) DO UPDATE SET
+               launched_at=excluded.launched_at, handle=excluded.handle,
+               last_use=excluded.last_use, status=excluded.status,
+               config_hash=COALESCE(excluded.config_hash, config_hash)
+        """,
+        (cluster_name, launched_at, pickle.dumps(handle),
+         common_utils.get_user_name(), status.value,
+         cluster_name, cluster_name,
+         common_utils.get_user_hash(), config_hash, cluster_name))
+    db.commit()
+    if requested_resources is not None:
+        _record_history(cluster_name, handle, requested_resources, launched_at)
+
+
+def _record_history(cluster_name: str, handle: Any, resources: Any,
+                    launched_at: int) -> None:
+    db = _db()
+    cluster_hash = f'{cluster_name}-{launched_at}'
+    num_hosts = getattr(resources, 'num_hosts', 1)
+    db.execute(
+        """INSERT OR REPLACE INTO cluster_history
+           (cluster_hash, name, num_hosts, resources, launched_at,
+            duration_s, usage_intervals)
+           VALUES (?,?,?,?,?,NULL,?)""",
+        (cluster_hash, cluster_name, num_hosts, pickle.dumps(resources),
+         launched_at, pickle.dumps([(launched_at, None)])))
+    db.commit()
+
+
+def update_cluster_status(cluster_name: str, status: ClusterStatus) -> None:
+    db = _db()
+    db.execute('UPDATE clusters SET status=? WHERE name=?',
+               (status.value, cluster_name))
+    db.commit()
+
+
+def update_last_use(cluster_name: str) -> None:
+    db = _db()
+    db.execute('UPDATE clusters SET last_use=? WHERE name=?',
+               (f'{common_utils.get_user_name()}@{int(time.time())}',
+                cluster_name))
+    db.commit()
+
+
+def set_cluster_autostop(cluster_name: str, idle_minutes: int,
+                         down: bool) -> None:
+    db = _db()
+    db.execute(
+        'UPDATE clusters SET autostop_idle_minutes=?, autostop_down=? '
+        'WHERE name=?', (idle_minutes, int(down), cluster_name))
+    db.commit()
+
+
+def remove_cluster(cluster_name: str, terminate: bool) -> None:
+    db = _db()
+    if terminate:
+        # Close the usage interval in history.
+        row = db.execute(
+            'SELECT launched_at FROM clusters WHERE name=?',
+            (cluster_name,)).fetchone()
+        if row:
+            db.execute(
+                'UPDATE cluster_history SET duration_s=? '
+                'WHERE cluster_hash=?',
+                (int(time.time()) - row[0], f'{cluster_name}-{row[0]}'))
+        db.execute('DELETE FROM clusters WHERE name=?', (cluster_name,))
+    else:
+        db.execute(
+            'UPDATE clusters SET status=? WHERE name=?',
+            (ClusterStatus.STOPPED.value, cluster_name))
+    db.commit()
+
+
+def _row_to_record(row) -> Dict[str, Any]:
+    (name, launched_at, handle, last_use, status, idle, down, owner,
+     config_hash, metadata) = row
+    return {
+        'name': name,
+        'launched_at': launched_at,
+        'handle': pickle.loads(handle) if handle else None,
+        'last_use': last_use,
+        'status': ClusterStatus(status),
+        'autostop': idle,
+        'to_down': bool(down),
+        'owner': owner,
+        'config_hash': config_hash,
+        'metadata': json.loads(metadata or '{}'),
+    }
+
+
+def get_cluster_from_name(
+        cluster_name: Optional[str]) -> Optional[Dict[str, Any]]:
+    if cluster_name is None:
+        return None
+    row = _db().execute('SELECT * FROM clusters WHERE name=?',
+                        (cluster_name,)).fetchone()
+    return _row_to_record(row) if row else None
+
+
+def get_clusters() -> List[Dict[str, Any]]:
+    rows = _db().execute(
+        'SELECT * FROM clusters ORDER BY launched_at DESC').fetchall()
+    return [_row_to_record(r) for r in rows]
+
+
+def get_cluster_history() -> List[Dict[str, Any]]:
+    rows = _db().execute(
+        'SELECT cluster_hash, name, num_hosts, resources, launched_at, '
+        'duration_s FROM cluster_history ORDER BY launched_at DESC').fetchall()
+    out = []
+    for (cluster_hash, name, num_hosts, resources, launched_at,
+         duration_s) in rows:
+        out.append({
+            'cluster_hash': cluster_hash,
+            'name': name,
+            'num_hosts': num_hosts,
+            'resources': pickle.loads(resources) if resources else None,
+            'launched_at': launched_at,
+            'duration_s': duration_s,
+        })
+    return out
+
+
+# ---- kv --------------------------------------------------------------------
+def set_kv(key: str, value: str) -> None:
+    db = _db()
+    db.execute('INSERT OR REPLACE INTO kv (key, value) VALUES (?,?)',
+               (key, value))
+    db.commit()
+
+
+def get_kv(key: str) -> Optional[str]:
+    row = _db().execute('SELECT value FROM kv WHERE key=?', (key,)).fetchone()
+    return row[0] if row else None
+
+
+def set_enabled_clouds(clouds: List[str]) -> None:
+    set_kv('enabled_clouds', json.dumps(sorted(clouds)))
+
+
+def get_enabled_clouds() -> Optional[List[str]]:
+    v = get_kv('enabled_clouds')
+    return json.loads(v) if v is not None else None

@@ -99,17 +99,41 @@ def _parse_ports(
     out: List[str] = []
     for p in ports:
         s = str(p)
-        if '-' in s:
-            lo, hi = s.split('-')
-            lo_i, hi_i = int(lo), int(hi)
-            if not 1 <= lo_i <= hi_i <= 65535:
-                raise exceptions.InvalidResourcesError(
-                    f'Invalid port range: {s}')
-        else:
-            if not 1 <= int(s) <= 65535:
-                raise exceptions.InvalidResourcesError(f'Invalid port: {s}')
+        try:
+            if '-' in s:
+                lo, hi = s.split('-')
+                lo_i, hi_i = int(lo), int(hi)
+                if not 1 <= lo_i <= hi_i <= 65535:
+                    raise ValueError(s)
+            else:
+                if not 1 <= int(s) <= 65535:
+                    raise ValueError(s)
+        except ValueError as e:
+            raise exceptions.InvalidResourcesError(
+                f'Invalid port or port range: {s!r}') from e
         out.append(s)
     return tuple(sorted(set(out))) or None
+
+
+def _port_ranges(ports: Tuple[str, ...]) -> List[Tuple[int, int]]:
+    out = []
+    for s in ports:
+        if '-' in s:
+            lo, hi = s.split('-')
+            out.append((int(lo), int(hi)))
+        else:
+            out.append((int(s), int(s)))
+    return out
+
+
+def _ports_covered(requested: Tuple[str, ...],
+                   available: Tuple[str, ...]) -> bool:
+    """Every requested port/range is inside some available range."""
+    avail = _port_ranges(available)
+    for lo, hi in _port_ranges(requested):
+        if not any(alo <= lo and hi <= ahi for alo, ahi in avail):
+            return False
+    return True
 
 
 class Resources:
@@ -190,7 +214,11 @@ class Resources:
                 raise exceptions.InvalidResourcesError(
                     f'accelerators dict must have one entry: {accelerators}')
             name, count = next(iter(accelerators.items()))
-            accelerators = f'{name}:{count}' if count else str(name)
+            if count is not None and int(count) == 0:
+                raise exceptions.InvalidResourcesError(
+                    f'accelerators count must be >= 1, got {accelerators}')
+            accelerators = (f'{name}:{count}'
+                            if count is not None else str(name))
         name = str(accelerators).strip()
         tpu = accel_lib.TpuSlice.maybe_from_name(name)
         if tpu is None and ':' in name:
@@ -364,9 +392,10 @@ class Resources:
             if other._memory < self._memory:
                 return False
         if self._ports:
-            other_ports = set(other._ports or ())
-            if not set(self._ports) <= other_ports:
+            if not _ports_covered(self._ports, other._ports or ()):
                 return False
+        if self._disk_size > other._disk_size:
+            return False
         return True
 
     def should_be_blocked_by(self, blocked: 'Resources') -> bool:
@@ -482,7 +511,8 @@ class Resources:
         return self.to_yaml_config() == other.to_yaml_config()
 
     def __hash__(self) -> int:
-        return hash(common_utils.dump_yaml_str(self.to_yaml_config()))
+        import json
+        return hash(json.dumps(self.to_yaml_config(), sort_keys=True))
 
     # ---- pretty table row -------------------------------------------------
     def format_brief(self) -> str:

@@ -23,7 +23,9 @@ def _isolated_state(tmp_path, monkeypatch):
     """Point all persistent state at a per-test temp dir."""
     state_dir = tmp_path / 'skytpu_state'
     monkeypatch.setenv('SKYTPU_STATE_DIR', str(state_dir))
-    monkeypatch.setenv('SKYTPU_CONFIG', str(tmp_path / 'nonexistent.yaml'))
+    empty_cfg = tmp_path / 'empty_config.yaml'
+    empty_cfg.write_text('{}\n')
+    monkeypatch.setenv('SKYTPU_CONFIG', str(empty_cfg))
     monkeypatch.setenv('SKYTPU_USER_HASH', 'testhash')
     from skypilot_tpu import config as config_lib
     config_lib.reload()

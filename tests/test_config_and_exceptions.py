@@ -1,0 +1,38 @@
+"""Config loading edge cases + exception serialization."""
+import pytest
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import exceptions
+
+
+def test_explicit_missing_config_errors(monkeypatch, tmp_path):
+    monkeypatch.setenv('SKYTPU_CONFIG', str(tmp_path / 'nope.yaml'))
+    config_lib.reload()
+    with pytest.raises(FileNotFoundError):
+        config_lib.get_nested(('gcp', 'project_id'))
+    config_lib.reload()
+
+
+def test_config_overlay(monkeypatch, tmp_path):
+    p = tmp_path / 'cfg.yaml'
+    p.write_text('gcp:\n  project_id: base-proj\n')
+    monkeypatch.setenv('SKYTPU_CONFIG', str(p))
+    config_lib.reload()
+    assert config_lib.get_nested(('gcp', 'project_id')) == 'base-proj'
+    with config_lib.override({'gcp': {'project_id': 'override-proj'}}):
+        assert config_lib.get_nested(('gcp', 'project_id')) == 'override-proj'
+    assert config_lib.get_nested(('gcp', 'project_id')) == 'base-proj'
+    config_lib.reload()
+
+
+def test_exception_round_trip():
+    e = exceptions.ApiServerConnectionError('http://x:46580')
+    d = exceptions.serialize_exception(e)
+    e2 = exceptions.deserialize_exception(d)
+    assert isinstance(e2, exceptions.ApiServerConnectionError)
+    assert str(e2) == str(e)
+    ce = exceptions.CommandError(42, 'long command', 'boom')
+    ce2 = exceptions.deserialize_exception(
+        exceptions.serialize_exception(ce))
+    assert isinstance(ce2, exceptions.CommandError)
+    assert ce2.returncode == 42

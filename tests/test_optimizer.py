@@ -1,0 +1,126 @@
+"""Optimizer: candidate enumeration, objectives, blocklists, chain DP."""
+import pytest
+
+from skypilot_tpu import Resources, Task, Dag
+from skypilot_tpu import exceptions
+from skypilot_tpu import optimizer
+from skypilot_tpu.optimizer import OptimizeTarget
+
+
+@pytest.fixture(autouse=True)
+def fake_gcp(monkeypatch):
+    monkeypatch.setenv('SKYTPU_FAKE_GCP_CREDENTIALS', '1')
+
+
+def _optimize(task, **kwargs):
+    return optimizer.optimize(task, quiet=True, **kwargs)
+
+
+def test_picks_cheapest_region():
+    t = Task('t', run='x')
+    t.set_resources(Resources(accelerators='tpu-v5e-8'))
+    _optimize(t)
+    best = t.best_resources
+    assert best.cloud == 'gcp'
+    assert best.region is not None
+    # US regions are cheapest in the catalog (1.0 multiplier).
+    assert best.region.startswith('us-')
+    assert t.estimated_cost_per_hour == pytest.approx(8 * 1.20)
+
+
+def test_spot_cheaper():
+    t1 = Task('od', run='x')
+    t1.set_resources(Resources(accelerators='tpu-v5e-8'))
+    t2 = Task('spot', run='x')
+    t2.set_resources(Resources(accelerators='tpu-v5e-8', use_spot=True))
+    _optimize(t1)
+    _optimize(t2)
+    assert t2.estimated_cost_per_hour < t1.estimated_cost_per_hour
+
+
+def test_perf_per_dollar_prefers_v6e():
+    t = Task('t', run='x')
+    t.set_resources([
+        Resources(accelerators='tpu-v5e-8'),
+        Resources(accelerators='tpu-v6e-8'),
+    ])
+    _optimize(t, minimize=OptimizeTarget.COST)
+    assert t.best_resources.tpu.generation == 'v5e'  # cheaper $/h
+    _optimize(t, minimize=OptimizeTarget.PERF_PER_DOLLAR)
+    assert t.best_resources.tpu.generation == 'v6e'  # more TFLOPs per $
+
+
+def test_blocklist_failover():
+    t = Task('t', run='x')
+    t.set_resources(Resources(accelerators='tpu-v5p-64'))
+    _optimize(t)
+    first_region = t.best_resources.region
+    # Block that region; the optimizer must move on.
+    blocked = [Resources(cloud='gcp', region=first_region)]
+    _optimize(t, blocked_resources=blocked)
+    assert t.best_resources.region != first_region
+
+
+def test_all_blocked_raises():
+    t = Task('t', run='x')
+    t.set_resources(Resources(accelerators='tpu-v4-8'))  # only us-central2
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        _optimize(t, blocked_resources=[Resources(cloud='gcp')])
+
+
+def test_infeasible_region_raises():
+    t = Task('t', run='x')
+    t.set_resources(Resources(accelerators='tpu-v4-8', region='europe-west4'))
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        _optimize(t)
+
+
+def test_cpu_task_picks_instance():
+    t = Task('cpu', run='x')
+    t.set_resources(Resources(cloud='gcp', cpus='8+'))
+    _optimize(t)
+    assert t.best_resources.instance_type is not None
+    # e2-standard-8 is the cheapest 8-vcpu shape in the catalog.
+    assert t.best_resources.instance_type == 'e2-standard-8'
+
+
+def test_ordered_resources_respected():
+    t = Task('t', run='x')
+    t.set_resources([
+        Resources(accelerators='tpu-v5p-8'),   # pricier
+        Resources(accelerators='tpu-v5e-8'),
+    ], ordered=True)
+    _optimize(t)
+    assert t.best_resources.tpu.generation == 'v5p'
+
+
+def test_candidate_list_for_failover():
+    t = Task('t', run='x')
+    t.set_resources(Resources(accelerators='tpu-v5e-8'))
+    _optimize(t)
+    cands = t.candidate_resources
+    assert len(cands) >= 2
+    assert cands[0] == t.best_resources
+    regions = [c.region for c in cands]
+    assert len(set(regions)) == len(regions)  # one per region
+
+
+def test_chain_dp_prefers_colocation():
+    with Dag('pipe') as dag:
+        a = Task('produce', run='x')
+        a.set_resources(Resources(accelerators='tpu-v5e-8'))
+        a.estimated_output_gb = 1000.0  # 1TB between stages
+        b = Task('consume', run='x')
+        b.set_resources(Resources(accelerators='tpu-v5e-8'))
+        dag.add_edge(a, b)
+    optimizer.optimize(dag, quiet=True)
+    # With heavy egress, both stages should land in the same region.
+    assert a.best_resources.region == b.best_resources.region
+
+
+def test_local_cloud_free():
+    t = Task('t', run='x')
+    t.set_resources(Resources(cloud='local'))
+    _optimize(t)
+    assert t.best_resources.cloud == 'local'
+    assert t.estimated_cost_per_hour == 0.0

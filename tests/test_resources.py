@@ -131,3 +131,25 @@ def test_review_fixes():
     assert not req.less_demanding_than(small)
     big = Resources(cloud='gcp', cpus=96, ports=[8080, 9090])
     assert req.less_demanding_than(big)
+
+
+def test_review_fixes_round2():
+    import json
+    # hash consistent with eq regardless of label insertion order
+    a = Resources(labels={'a': '1', 'b': '2'})
+    b = Resources(labels={'b': '2', 'a': '1'})
+    assert a == b and hash(a) == hash(b)
+    # malformed ports -> typed error
+    for bad in ['abc', '8080-', '-5']:
+        with pytest.raises(exceptions.InvalidResourcesError):
+            Resources(ports=bad)
+    # zero accelerator count -> error
+    with pytest.raises(exceptions.InvalidResourcesError):
+        Resources(accelerators={'tpu-v5e-8': 0})
+    # range-aware port coverage
+    req = Resources(ports=[80])
+    cluster = Resources(cloud='gcp', ports=['70-100'])
+    assert req.less_demanding_than(cluster)
+    # disk_size respected
+    big_disk = Resources(disk_size=1024)
+    assert not big_disk.less_demanding_than(Resources(cloud='gcp'))

@@ -169,6 +169,10 @@ def validate_region_zone(
         if zone.rsplit('-', 1)[0] not in regions:
             raise exceptions.InvalidResourcesError(
                 f'Unknown zone {zone!r} (known TPU zones: {sorted(zones)})')
-    elif region is not None and region not in regions:
-        raise exceptions.InvalidResourcesError(
-            f'Unknown region {region!r} (known: {sorted(regions)})')
+    if region is not None:
+        if region not in regions:
+            raise exceptions.InvalidResourcesError(
+                f'Unknown region {region!r} (known: {sorted(regions)})')
+        if zone is not None and zone.rsplit('-', 1)[0] != region:
+            raise exceptions.InvalidResourcesError(
+                f'Zone {zone!r} is not in region {region!r}')

@@ -18,7 +18,8 @@ from typing import Any, Dict, List, Optional
 from skypilot_tpu.utils import common_utils
 
 _DB_LOCK = threading.Lock()
-_CONNS: Dict[str, sqlite3.Connection] = {}
+_LOCAL = threading.local()
+_INITIALIZED_PATHS: set = set()
 
 
 def get_state_dir() -> str:
@@ -29,15 +30,23 @@ def get_state_dir() -> str:
 
 
 def _db() -> sqlite3.Connection:
+    """Thread-local connection: sharing one connection across threads lets
+    execute/commit pairs interleave (commit A's half-done transaction from
+    B). WAL + busy timeout make cross-connection writers serialize safely."""
     path = os.path.join(get_state_dir(), 'state.db')
-    with _DB_LOCK:
-        conn = _CONNS.get(path)
-        if conn is None:
-            conn = sqlite3.connect(path, check_same_thread=False)
-            conn.execute('PRAGMA journal_mode=WAL')
-            _create_tables(conn)
-            _CONNS[path] = conn
-        return conn
+    conns = getattr(_LOCAL, 'conns', None)
+    if conns is None:
+        conns = _LOCAL.conns = {}
+    conn = conns.get(path)
+    if conn is None:
+        conn = sqlite3.connect(path, timeout=10.0)
+        conn.execute('PRAGMA journal_mode=WAL')
+        with _DB_LOCK:
+            if path not in _INITIALIZED_PATHS:
+                _create_tables(conn)
+                _INITIALIZED_PATHS.add(path)
+        conns[path] = conn
+    return conn
 
 
 def _create_tables(conn: sqlite3.Connection) -> None:

@@ -1,0 +1,10 @@
+"""Flagship model family (TPU-native JAX).
+
+The reference ships models only as example YAMLs that invoke external
+frameworks (reference examples/tpu/v6e/train-llama3-8b.yaml, llm/llama-3);
+here the model layer is in-tree so benchmarks, serving, and parallelism are
+owned end-to-end by the framework.
+"""
+from skypilot_tpu.models.llama import (LlamaConfig, LlamaModel, PRESETS)
+
+__all__ = ['LlamaConfig', 'LlamaModel', 'PRESETS']

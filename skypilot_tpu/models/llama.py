@@ -1,0 +1,298 @@
+"""Llama-family decoder-only transformer, TPU-first.
+
+Design (vs the reference's torch-XLA example, reference
+examples/tpu/v6e/train-llama3-8b.yaml:43-50, which wraps HF Transformers):
+  - pure-JAX pytree params (dict-of-arrays), stacked per-layer weights with
+    a leading ``layers`` dim so the block stack is one ``lax.scan`` — O(1)
+    HLO size in depth, fast compiles, natural remat boundary;
+  - logical-axis shardings (parallel/sharding.py) so one model definition
+    serves DP, FSDP, FSDP×TP, and FSDP×TP×SP meshes unchanged;
+  - attention via ops.attention (Pallas flash on TPU) or
+    parallel.ring_attention under shard_map when the mesh has sp > 1;
+  - bf16 params/activations, f32 norms/softmax/logits.
+
+GQA, RoPE (configurable theta), SwiGLU, RMSNorm — the Llama-2/3
+architecture family; presets cover the baseline workloads in BASELINE.md
+(Llama-2-7B serving, Llama-3-8B training).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from skypilot_tpu.ops import attention as attention_ops
+from skypilot_tpu.ops.layers import apply_rotary, precompute_rotary, rms_norm
+from skypilot_tpu.parallel.ring_attention import ring_attention
+from skypilot_tpu.parallel.sharding import DEFAULT_RULES, LogicalRules
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    embed_dim: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    mlp_dim: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    tie_embeddings: bool = False
+    remat: bool = True
+
+    @property
+    def num_params(self) -> int:
+        e, l, v = self.embed_dim, self.num_layers, self.vocab_size
+        qkv = e * self.head_dim * (self.num_heads + 2 * self.num_kv_heads)
+        o = self.num_heads * self.head_dim * e
+        mlp = 3 * e * self.mlp_dim
+        per_layer = qkv + o + mlp + 2 * e
+        head = 0 if self.tie_embeddings else e * v
+        return v * e + l * per_layer + e + head
+
+
+PRESETS: Dict[str, LlamaConfig] = {
+    # Tiny config for unit tests / dryruns (dims stay multiples of 2 so tp/sp
+    # axes divide them).
+    'test-tiny': LlamaConfig(vocab_size=256, embed_dim=64, num_layers=2,
+                             num_heads=4, num_kv_heads=2, head_dim=16,
+                             mlp_dim=128, max_seq_len=512, dtype=jnp.float32,
+                             remat=False),
+    # ~1.3B with head_dim 128 (flash-kernel friendly); single-chip bench size.
+    'llama-1b': LlamaConfig(vocab_size=32000, embed_dim=2048, num_layers=16,
+                            num_heads=16, num_kv_heads=8, head_dim=128,
+                            mlp_dim=5632, max_seq_len=8192,
+                            rope_theta=10000.0),
+    'llama2-7b': LlamaConfig(vocab_size=32000, embed_dim=4096, num_layers=32,
+                             num_heads=32, num_kv_heads=32, head_dim=128,
+                             mlp_dim=11008, max_seq_len=4096,
+                             rope_theta=10000.0),
+    'llama3-8b': LlamaConfig(),  # defaults are Llama-3-8B
+    'llama3-70b': LlamaConfig(embed_dim=8192, num_layers=80, num_heads=64,
+                              num_kv_heads=8, mlp_dim=28672),
+}
+
+
+def logical_axes(config: LlamaConfig) -> Params:
+    """Pytree of logical-axis tuples matching ``init`` output."""
+    axes = {
+        'embed': ('vocab', 'embed'),
+        'final_norm': (None,),
+        'layers': {
+            'wq': ('layers', 'embed', 'heads', None),
+            'wk': ('layers', 'embed', 'kv_heads', None),
+            'wv': ('layers', 'embed', 'kv_heads', None),
+            'wo': ('layers', 'heads', None, 'embed'),
+            'w_gate': ('layers', 'embed', 'mlp'),
+            'w_up': ('layers', 'embed', 'mlp'),
+            'w_down': ('layers', 'mlp', 'embed'),
+            'attn_norm': ('layers', None),
+            'mlp_norm': ('layers', None),
+        },
+    }
+    if not config.tie_embeddings:
+        axes['lm_head'] = ('embed', 'vocab')
+    return axes
+
+
+class LlamaModel:
+    """Stateless module: ``init`` makes params, ``apply`` runs the forward."""
+
+    def __init__(self, config: LlamaConfig,
+                 mesh: Optional[Mesh] = None,
+                 rules: LogicalRules = DEFAULT_RULES):
+        self.config = config
+        self.mesh = mesh
+        self.rules = rules
+
+    # -- init ---------------------------------------------------------------
+    def init(self, rng: jax.Array) -> Params:
+        c = self.config
+        dt = c.dtype
+        k_embed, k_layers, k_head = jax.random.split(rng, 3)
+
+        def dense(key, shape, fan_in):
+            return (jax.random.normal(key, shape, jnp.float32)
+                    * fan_in**-0.5).astype(dt)
+
+        lk = jax.random.split(k_layers, 7)
+        l, e, h, kvh, d, m = (c.num_layers, c.embed_dim, c.num_heads,
+                              c.num_kv_heads, c.head_dim, c.mlp_dim)
+        params: Params = {
+            'embed': dense(k_embed, (c.vocab_size, e), 1.0),
+            'final_norm': jnp.ones((e,), dt),
+            'layers': {
+                'wq': dense(lk[0], (l, e, h, d), e),
+                'wk': dense(lk[1], (l, e, kvh, d), e),
+                'wv': dense(lk[2], (l, e, kvh, d), e),
+                'wo': dense(lk[3], (l, h, d, e), h * d),
+                'w_gate': dense(lk[4], (l, e, m), e),
+                'w_up': dense(lk[5], (l, e, m), e),
+                'w_down': dense(lk[6], (l, m, e), m),
+                'attn_norm': jnp.ones((l, e), dt),
+                'mlp_norm': jnp.ones((l, e), dt),
+            },
+        }
+        if not c.tie_embeddings:
+            params['lm_head'] = dense(k_head, (e, c.vocab_size), e)
+        return params
+
+    def param_shardings(self, mesh: Optional[Mesh] = None):
+        from skypilot_tpu.parallel.sharding import tree_shardings
+        mesh = mesh or self.mesh
+        assert mesh is not None
+        return tree_shardings(mesh, self.rules, logical_axes(self.config))
+
+    # -- helpers ------------------------------------------------------------
+    def _constrain(self, x, *axes):
+        if self.mesh is None:
+            return x
+        return lax.with_sharding_constraint(
+            x, jax.sharding.NamedSharding(self.mesh, self.rules.spec(*axes)))
+
+    def _sp_size(self) -> int:
+        if self.mesh is None:
+            return 1
+        return self.mesh.shape.get('sp', 1)
+
+    def _attend(self, q, k, v):
+        """Dispatch: ring attention under shard_map when sp > 1."""
+        if self._sp_size() > 1:
+            k, v = attention_ops._maybe_repeat_kv(q, k, v)
+            rules = self.rules
+            qkv_spec = rules.spec('batch', 'seq', 'act_heads', None)
+            fn = jax.shard_map(
+                functools.partial(ring_attention,
+                                  axis_name='sp', causal=True),
+                mesh=self.mesh,
+                in_specs=(qkv_spec, qkv_spec, qkv_spec),
+                out_specs=qkv_spec)
+            return fn(q, k, v)
+        return attention_ops.attention(q, k, v, causal=True)
+
+    # -- forward ------------------------------------------------------------
+    def apply(self, params: Params, tokens: jax.Array,
+              positions: Optional[jax.Array] = None) -> jax.Array:
+        """tokens [B, S] int32 -> logits [B, S, V] (f32)."""
+        c = self.config
+        if positions is None:
+            positions = jnp.arange(tokens.shape[1])
+        cos, sin = precompute_rotary(c.head_dim, c.max_seq_len, c.rope_theta)
+
+        x = params['embed'][tokens].astype(c.dtype)
+        x = self._constrain(x, 'batch', 'seq', 'act_embed')
+
+        def layer(x, lp):
+            h = rms_norm(x, lp['attn_norm'], c.norm_eps)
+            q = jnp.einsum('bse,ehd->bshd', h, lp['wq'])
+            k = jnp.einsum('bse,ehd->bshd', h, lp['wk'])
+            v = jnp.einsum('bse,ehd->bshd', h, lp['wv'])
+            q = apply_rotary(q, cos, sin, positions)
+            k = apply_rotary(k, cos, sin, positions)
+            q = self._constrain(q, 'batch', 'seq', 'act_heads', None)
+            k = self._constrain(k, 'batch', 'seq', 'act_kv_heads', None)
+            v = self._constrain(v, 'batch', 'seq', 'act_kv_heads', None)
+            attn = self._attend(q, k, v)
+            x = x + jnp.einsum('bshd,hde->bse', attn, lp['wo'])
+            x = self._constrain(x, 'batch', 'seq', 'act_embed')
+
+            h = rms_norm(x, lp['mlp_norm'], c.norm_eps)
+            gate = jnp.einsum('bse,em->bsm', h, lp['w_gate'])
+            up = jnp.einsum('bse,em->bsm', h, lp['w_up'])
+            gated = self._constrain(jax.nn.silu(gate) * up,
+                                    'batch', 'seq', 'act_mlp')
+            x = x + jnp.einsum('bsm,me->bse', gated, lp['w_down'])
+            x = self._constrain(x, 'batch', 'seq', 'act_embed')
+            return x, None
+
+        if c.remat:
+            layer = jax.checkpoint(layer)
+        x, _ = lax.scan(layer, x, params['layers'])
+
+        x = rms_norm(x, params['final_norm'], c.norm_eps)
+        head = (params['embed'].T if c.tie_embeddings else params['lm_head'])
+        logits = jnp.einsum('bse,ev->bsv', x.astype(jnp.float32),
+                            head.astype(jnp.float32))
+        return self._constrain(logits, 'batch', 'seq', 'act_vocab')
+
+    # -- decode (serving) ---------------------------------------------------
+    def init_cache(self, batch: int, max_len: int) -> Params:
+        c = self.config
+        shape = (c.num_layers, batch, max_len, c.num_kv_heads, c.head_dim)
+        return {
+            'k': jnp.zeros(shape, c.dtype),
+            'v': jnp.zeros(shape, c.dtype),
+            'length': jnp.zeros((), jnp.int32),
+        }
+
+    def decode_step(self, params: Params, cache: Params,
+                    tokens: jax.Array) -> Tuple[jax.Array, Params]:
+        """Append ``tokens`` [B, T] at cache.length, return last-token logits.
+
+        Covers both prefill (T = prompt length) and autoregressive decode
+        (T = 1) with one code path; static T per compiled variant.
+        """
+        c = self.config
+        start = cache['length']
+        positions = start + jnp.arange(tokens.shape[1])
+        cos, sin = precompute_rotary(c.head_dim, c.max_seq_len, c.rope_theta)
+        x = params['embed'][tokens].astype(c.dtype)
+        max_len = cache['k'].shape[2]
+
+        new_k, new_v = [], []
+        for i in range(c.num_layers):
+            lp = jax.tree.map(lambda p: p[i], params['layers'])
+            h = rms_norm(x, lp['attn_norm'], c.norm_eps)
+            q = jnp.einsum('bse,ehd->bshd', h, lp['wq'])
+            k = jnp.einsum('bse,ehd->bshd', h, lp['wk'])
+            v = jnp.einsum('bse,ehd->bshd', h, lp['wv'])
+            q = apply_rotary(q, cos, sin, positions)
+            k = apply_rotary(k, cos, sin, positions)
+            k_cache = lax.dynamic_update_slice(
+                cache['k'][i], k, (0, start, 0, 0))
+            v_cache = lax.dynamic_update_slice(
+                cache['v'][i], v, (0, start, 0, 0))
+            new_k.append(k_cache)
+            new_v.append(v_cache)
+            # Mask beyond current length via position comparison.
+            kv_pos = jnp.arange(max_len)
+            valid = kv_pos[None, :] <= positions[:, None]  # [T, max_len]
+            attn = _cached_attention(q, k_cache, v_cache, valid)
+            x = x + jnp.einsum('bshd,hde->bse', attn, lp['wo'])
+            h = rms_norm(x, lp['mlp_norm'], c.norm_eps)
+            gated = jax.nn.silu(jnp.einsum('bse,em->bsm', h, lp['w_gate'])) \
+                * jnp.einsum('bse,em->bsm', h, lp['w_up'])
+            x = x + jnp.einsum('bsm,me->bse', gated, lp['w_down'])
+
+        x = rms_norm(x, params['final_norm'], c.norm_eps)
+        head = (params['embed'].T if c.tie_embeddings else params['lm_head'])
+        logits = jnp.einsum('be,ev->bv', x[:, -1].astype(jnp.float32),
+                            head.astype(jnp.float32))
+        new_cache = {
+            'k': jnp.stack(new_k),
+            'v': jnp.stack(new_v),
+            'length': start + tokens.shape[1],
+        }
+        return logits, new_cache
+
+
+def _cached_attention(q, k, v, valid):
+    """Attention against a (padded) cache with an explicit validity mask."""
+    k, v = attention_ops._maybe_repeat_kv(q, k, v)
+    scale = q.shape[-1]**-0.5
+    s = jnp.einsum('bqhd,bkhd->bhqk', q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    s = jnp.where(valid[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum('bhqk,bkhd->bqhd', p,
+                      v.astype(jnp.float32)).astype(q.dtype)

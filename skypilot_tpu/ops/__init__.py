@@ -1,0 +1,24 @@
+"""TPU compute kernels (Pallas) with portable XLA fallbacks.
+
+Every op exposes one public entry point that dispatches:
+  - Pallas TPU kernel when running on TPU and shapes satisfy tiling
+    constraints (pallas_guide.md: last dim 128, sublane multiples by dtype);
+  - pure-XLA implementation otherwise (CPU tests, odd shapes).
+
+The reference has no kernel layer at all (it orchestrates; compute lives in
+user containers — SURVEY.md §2.8). Kernels here are the hot ops of the
+flagship model family: attention (flash), RMSNorm, rotary embeddings.
+
+``ops.attention`` is the attention *module* (``attention.attention`` is the
+dispatching entry point); layer helpers are re-exported at package level.
+"""
+from skypilot_tpu.ops import attention
+from skypilot_tpu.ops.layers import (apply_rotary, precompute_rotary,
+                                     rms_norm)
+
+__all__ = [
+    'attention',
+    'apply_rotary',
+    'precompute_rotary',
+    'rms_norm',
+]

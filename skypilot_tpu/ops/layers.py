@@ -1,0 +1,44 @@
+"""Small hot layers: RMSNorm and rotary position embeddings.
+
+Pure-jnp: XLA fuses these into the surrounding matmuls on TPU (the guidance
+in pallas_guide.md — don't hand-schedule what the compiler already fuses).
+Computation is f32 internally regardless of param dtype for stability.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-5) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    normed = x32 * jax.lax.rsqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def precompute_rotary(head_dim: int, max_seq: int,
+                      theta: float = 500000.0) -> Tuple[jax.Array, jax.Array]:
+    """Rotary cos/sin tables [max_seq, head_dim//2] (Llama-3 theta default)."""
+    inv_freq = 1.0 / (theta**(jnp.arange(0, head_dim, 2,
+                                         dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_seq, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rotary(x: jax.Array, cos: jax.Array, sin: jax.Array,
+                 positions: jax.Array) -> jax.Array:
+    """Rotate [B, S, H, D] by per-position tables; positions is [B, S] or [S]."""
+    cos_p = cos[positions].astype(jnp.float32)  # [..., S, D/2]
+    sin_p = sin[positions].astype(jnp.float32)
+    if cos_p.ndim == 2:  # [S, D/2] -> broadcast over batch
+        cos_p, sin_p = cos_p[None], sin_p[None]
+    cos_p, sin_p = cos_p[:, :, None, :], sin_p[:, :, None, :]
+    x32 = x.astype(jnp.float32)
+    x1, x2 = jnp.split(x32, 2, axis=-1)
+    out = jnp.concatenate(
+        [x1 * cos_p - x2 * sin_p, x2 * cos_p + x1 * sin_p], axis=-1)
+    return out.astype(x.dtype)

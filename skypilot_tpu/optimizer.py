@@ -34,6 +34,11 @@ class OptimizeTarget(enum.Enum):
     PERF_PER_DOLLAR = 'perf_per_dollar'
 
 
+# Assumed cross-cloud/cross-region transfer bandwidth for the TIME
+# objective's egress edge weights (conservative DCN-ish figure).
+_EGRESS_BANDWIDTH_GBPS = 8.0
+
+
 class Candidate:
     """A concrete launchable choice with its score breakdown."""
 
@@ -201,6 +206,16 @@ def _assign_chain_dp(dag: 'dag_lib.Dag',
     $/GB between (cloud, region) pairs with task.estimated_output_gb.
     """
     order = dag.topological_order()
+    if any(not per_task[t] for t in order):
+        # raise_error=False path: a task with zero candidates makes the chain
+        # unassignable — fall back to greedy per-task assignment for the
+        # tasks that do have candidates instead of crashing.
+        for task in order:
+            cands = per_task[task]
+            if cands:
+                task.best_resources = cands[0].resources
+                task.estimated_cost_per_hour = cands[0].cost_per_hour
+        return
     # dp[i][j] = (score, parent_index) for candidate j of task i.
     dp: List[List[Tuple[float, Optional[int]]]] = []
     for i, task in enumerate(order):
@@ -220,8 +235,18 @@ def _assign_chain_dp(dag: 'dag_lib.Dag',
                     src = prev_cand.resources
                     dst = cand.resources
                     cloud = clouds_lib.get_cloud(src.cloud)
-                    egress = out_gb * cloud.egress_cost_per_gb(
+                    egress_usd = out_gb * cloud.egress_cost_per_gb(
                         dst.cloud, dst.region or '', src.region)
+                    # Edge weight must share the objective's unit: dollars
+                    # for COST, seconds (transfer time) for TIME. For
+                    # PERF_PER_DOLLAR (an hourly ratio) a one-shot egress
+                    # fee has no coherent conversion without a run-duration
+                    # estimate, so edges are unweighted there.
+                    if target == OptimizeTarget.COST:
+                        egress = egress_usd
+                    elif target == OptimizeTarget.TIME:
+                        if egress_usd > 0:
+                            egress = out_gb * 8 / _EGRESS_BANDWIDTH_GBPS
                 total = dp[i - 1][pj][0] + own + egress
                 if total < best[0]:
                     best = (total, pj)

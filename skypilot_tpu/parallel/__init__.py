@@ -1,0 +1,33 @@
+"""TPU-native parallelism layer.
+
+The reference contains *no* parallelism implementation — it delegates
+DDP/FSDP/DeepSpeed to user task YAMLs via an env-var contract (reference
+sky/backends/cloud_vm_ray_backend.py:389-545, SURVEY.md §2.8). Here the
+framework owns the parallelism: a named-axis device mesh (``MeshSpec``),
+logical-axis sharding rules, and sequence/context parallelism (ring
+attention over ICI via ``shard_map`` + ``ppermute``).
+
+Axes (any subset may be size 1):
+  - ``dp``   data parallel (pure replication of params, sharded batch)
+  - ``fsdp`` fully-sharded data parallel (params/grads/opt sharded, batch too)
+  - ``tp``   tensor parallel (matmul column/row sharding over ICI)
+  - ``sp``   sequence/context parallel (ring attention over the seq axis)
+  - ``ep``   expert parallel (MoE experts spread over devices)
+  - ``pp``   pipeline parallel (stage-sharded layers)
+"""
+from skypilot_tpu.parallel.mesh import (MESH_AXES, MeshSpec, make_mesh)
+from skypilot_tpu.parallel.sharding import (LogicalRules, NamedSharding,
+                                            logical_sharding,
+                                            shard_constraint)
+from skypilot_tpu.parallel.ring_attention import ring_attention
+
+__all__ = [
+    'MESH_AXES',
+    'MeshSpec',
+    'make_mesh',
+    'LogicalRules',
+    'NamedSharding',
+    'logical_sharding',
+    'shard_constraint',
+    'ring_attention',
+]

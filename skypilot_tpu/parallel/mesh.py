@@ -1,0 +1,96 @@
+"""Named-axis device meshes for TPU slices.
+
+A ``MeshSpec`` maps the framework's canonical parallelism axes
+(dp/fsdp/tp/sp/ep/pp) onto a ``jax.sharding.Mesh``. On real TPU slices the
+device order from ``jax.devices()`` already follows the physical ICI torus
+(jax's mesh_utils further optimizes contiguity); on CPU test backends the
+devices are virtual so any order works.
+
+Design note vs reference: SkyPilot never builds meshes — parallel topology
+lives in user YAMLs (SURVEY.md §2.8). Here topology is derived from the
+``TpuSlice`` the optimizer picked, so the same `Resources` object that
+provisioned the slice also configures the compute mesh.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+MESH_AXES: Tuple[str, ...] = ('pp', 'dp', 'fsdp', 'ep', 'sp', 'tp')
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Sizes for each canonical mesh axis; unspecified axes default to 1.
+
+    Axis order is fixed (``MESH_AXES``) with ``tp`` innermost: tensor
+    parallelism has the highest communication volume per step so it must map
+    to the fastest (most-contiguous) ICI neighbors; ``pp`` is outermost since
+    pipeline stages communicate the least (activations at stage edges only).
+    """
+    pp: int = 1
+    dp: int = 1
+    fsdp: int = 1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    @property
+    def sizes(self) -> Dict[str, int]:
+        return {a: getattr(self, a) for a in MESH_AXES}
+
+    @property
+    def num_devices(self) -> int:
+        return math.prod(self.sizes.values())
+
+    def __post_init__(self):
+        for a in MESH_AXES:
+            if getattr(self, a) < 1:
+                raise ValueError(f'Mesh axis {a!r} must be >= 1, got '
+                                 f'{getattr(self, a)}')
+
+    @classmethod
+    def for_devices(cls,
+                    n: int,
+                    tp: int = 1,
+                    sp: int = 1,
+                    pp: int = 1,
+                    ep: int = 1,
+                    fsdp: Optional[int] = None) -> 'MeshSpec':
+        """Fill the leftover device factor into fsdp (or dp if fsdp given)."""
+        used = tp * sp * pp * ep
+        if n % used:
+            raise ValueError(f'{n} devices not divisible by tp*sp*pp*ep={used}')
+        rest = n // used
+        if fsdp is None:
+            return cls(pp=pp, fsdp=rest, ep=ep, sp=sp, tp=tp)
+        if rest % fsdp:
+            raise ValueError(f'residual {rest} not divisible by fsdp={fsdp}')
+        return cls(pp=pp, dp=rest // fsdp, fsdp=fsdp, ep=ep, sp=sp, tp=tp)
+
+
+def make_mesh(spec: MeshSpec,
+              devices: Optional[Sequence[jax.Device]] = None) -> jax.sharding.Mesh:
+    """Build a ``jax.sharding.Mesh`` with the canonical axis names.
+
+    Uses ``mesh_utils.create_device_mesh`` when the spec covers every device
+    of the default backend (it optimizes assignment for the physical ICI
+    topology); falls back to a plain reshape for explicit device subsets.
+    """
+    if devices is None:
+        devices = jax.devices()
+    shape = tuple(spec.sizes[a] for a in MESH_AXES)
+    if spec.num_devices != len(devices):
+        raise ValueError(
+            f'MeshSpec wants {spec.num_devices} devices '
+            f'({spec.sizes}), got {len(devices)}')
+    try:
+        from jax.experimental import mesh_utils
+        dev_array = mesh_utils.create_device_mesh(shape, devices=list(devices))
+    except Exception:  # virtual/CPU devices without topology info
+        dev_array = np.asarray(list(devices)).reshape(shape)
+    return jax.sharding.Mesh(dev_array, MESH_AXES)

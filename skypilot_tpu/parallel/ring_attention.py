@@ -1,0 +1,116 @@
+"""Ring attention: exact attention over a sequence-sharded ('sp') mesh axis.
+
+Long-context strategy (absent from the reference, which delegates sequence
+scaling to user YAMLs — reference examples/tpu/v6e/train-llama3-8b.yaml:43-50,
+SURVEY.md §5.7): Q/K/V are sharded along the sequence dimension over the
+``sp`` mesh axis; K/V shards rotate around the ICI ring with
+``lax.ppermute`` while each device accumulates its local Q block's attention
+with a numerically-stable online softmax (flash-attention style m/l/o
+accumulators). Compute and communication overlap naturally: XLA schedules the
+ppermute for step i+1 concurrently with the matmuls of step i.
+
+Call inside ``shard_map`` (or any context where ``axis_name`` is bound).
+Differentiable: the scan+ppermute structure transposes cleanly; the per-step
+body is rematerialized under ``jax.checkpoint`` so the backward pass never
+stores attention matrices.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, m, l, o, q_offset, kv_offset, causal, scale):
+    """One online-softmax accumulation step of q against one K/V block.
+
+    q: [B, Sq, H, D]; k/v: [B, Sk, H, D]; m/l: [B, H, Sq]; o: [B, Sq, H, D].
+    Offsets are the blocks' global sequence positions (for causal masking).
+    """
+    s = jnp.einsum('bqhd,bkhd->bhqk', q, k) * scale
+    if causal:
+        q_pos = q_offset + lax.iota(jnp.int32, q.shape[1])
+        kv_pos = kv_offset + lax.iota(jnp.int32, k.shape[1])
+        mask = q_pos[:, None] >= kv_pos[None, :]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # exp of fully-masked rows underflows to 0 (m_new stays -inf-ish): safe.
+    p = jnp.exp(s - m_new[..., None])
+    correction = jnp.exp(m - m_new)
+    l_new = l * correction + p.sum(axis=-1)
+    pv = jnp.einsum('bhqk,bkhd->bqhd', p, v)
+    o_new = o * correction.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, o_new
+
+
+def ring_attention(q: jax.Array,
+                   k: jax.Array,
+                   v: jax.Array,
+                   axis_name: str = 'sp',
+                   causal: bool = True,
+                   scale: Optional[float] = None) -> jax.Array:
+    """Exact attention with K/V ring-rotated over ``axis_name``.
+
+    Args:
+      q, k, v: [batch, seq_local, heads, head_dim] (KV heads must already be
+        repeated to match Q heads for GQA).
+      axis_name: bound mesh axis to ring over (size 1 degrades to local
+        flash-style attention, so the same code path runs unsharded).
+      causal: apply a causal mask using *global* positions.
+      scale: score scale; defaults to 1/sqrt(head_dim).
+
+    Returns: [batch, seq_local, heads, head_dim] attention output.
+    """
+    if scale is None:
+        scale = q.shape[-1]**-0.5
+    n = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, s_local, h, _ = q.shape
+    q_offset = my_idx * s_local
+
+    # Derive accumulators from q so they inherit its device-varying axes
+    # (shard_map vma typing): lax.cond requires both branches to agree.
+    zero_bhq = q[..., 0].transpose(0, 2, 1).astype(jnp.float32) * 0.0
+    m0 = zero_bhq + _NEG_INF
+    l0 = zero_bhq
+    o0 = q.astype(jnp.float32) * 0.0
+
+    step_fn = jax.checkpoint(functools.partial(_block_attend, causal=causal,
+                                               scale=scale))
+
+    def body(carry, step):
+        kv, (m, l, o) = carry
+        k_blk, v_blk = kv
+        # After `step` rotations device i holds the block that started on
+        # device (i - step) mod n.
+        src = (my_idx - step) % n
+        kv_offset = src * s_local
+
+        def attend(mlo):
+            return step_fn(q, k_blk, v_blk, *mlo, q_offset=q_offset,
+                           kv_offset=kv_offset)
+
+        if causal and n > 1:
+            # Skip blocks strictly in the future (fully masked).
+            m, l, o = lax.cond(src <= my_idx, attend, lambda mlo: mlo,
+                               (m, l, o))
+        else:
+            m, l, o = attend((m, l, o))
+
+        if n > 1:
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            kv = lax.ppermute((k_blk, v_blk), axis_name, perm)
+        return (kv, (m, l, o)), None
+
+    (_, (m, l, o)), _ = lax.scan(body, ((k, v), (m0, l0, o0)),
+                                 jnp.arange(n))
+    # Fully-masked rows (l == 0) can only occur for non-causal empty inputs;
+    # guard the divide anyway.
+    l = jnp.maximum(l, 1e-30)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)

@@ -1,0 +1,79 @@
+"""Logical-axis sharding rules.
+
+Model code annotates arrays with *logical* axis names ('batch', 'seq',
+'embed', 'mlp', 'heads', 'kv_heads', 'vocab', 'expert', 'layers'); a
+``LogicalRules`` table maps each logical name to zero or more mesh axes.
+This decouples model definitions from the mesh layout: the same Llama code
+runs pure-DP, FSDP, 2D FSDP×TP, or FSDP×TP×SP by swapping rule tables.
+
+(The reference has no analog — parallelism is user-space there, SURVEY.md
+§2.8; this is the GSPMD-native design jax/flax ecosystems converge on.)
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AxisVal = Union[None, str, Tuple[str, ...]]
+
+
+class LogicalRules:
+    """Mapping logical axis name -> mesh axis (or tuple of mesh axes)."""
+
+    def __init__(self, rules: Dict[str, AxisVal]):
+        self.rules = dict(rules)
+
+    def spec(self, *logical_axes: Optional[str]) -> P:
+        return P(*[self.rules.get(a) if a else None for a in logical_axes])
+
+    def with_overrides(self, **overrides: AxisVal) -> 'LogicalRules':
+        merged = dict(self.rules)
+        merged.update(overrides)
+        return LogicalRules(merged)
+
+
+# Default table: batch over (dp, fsdp); every weight's largest dim over fsdp;
+# head/mlp dims over tp; sequence over sp (activations only); experts over ep.
+# Activation dims get distinct logical names ('act_*') — batch already uses
+# fsdp, so activation feature dims shard only over tp (a mesh axis may appear
+# at most once in a PartitionSpec).
+DEFAULT_RULES = LogicalRules({
+    'batch': ('dp', 'fsdp'),
+    'seq': 'sp',
+    'embed': 'fsdp',
+    'mlp': 'tp',
+    'heads': 'tp',
+    'kv_heads': 'tp',
+    'qkv': 'tp',
+    'vocab': 'tp',
+    'expert': 'ep',
+    'layers': None,
+    'act_embed': None,
+    'act_mlp': 'tp',
+    'act_heads': 'tp',
+    'act_kv_heads': 'tp',
+    'act_vocab': 'tp',
+})
+
+
+def logical_sharding(mesh: Mesh, rules: LogicalRules,
+                     *logical_axes: Optional[str]) -> NamedSharding:
+    return NamedSharding(mesh, rules.spec(*logical_axes))
+
+
+def shard_constraint(x: jax.Array, mesh: Mesh, rules: LogicalRules,
+                     *logical_axes: Optional[str]) -> jax.Array:
+    """with_sharding_constraint by logical names (no-op outside jit/mesh)."""
+    return jax.lax.with_sharding_constraint(
+        x, logical_sharding(mesh, rules, *logical_axes))
+
+
+def tree_shardings(mesh: Mesh, rules: LogicalRules, logical_tree):
+    """Map a pytree of logical-axis tuples to a pytree of NamedShardings."""
+    return jax.tree.map(
+        lambda axes: logical_sharding(mesh, rules, *axes),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )

@@ -1,0 +1,11 @@
+"""Training loop layer: sharded train state + jitted step.
+
+The reference delegates training entirely to user containers (torch-XLA FSDP
+in reference examples/tpu/v6e/train-llama3-8b.yaml); here the framework owns
+an idiomatic-JAX trainer so the BASELINE.md throughput anchors are measured
+in-tree.
+"""
+from skypilot_tpu.train.step import (Trainer, TrainState,
+                                     cross_entropy_loss)
+
+__all__ = ['Trainer', 'TrainState', 'cross_entropy_loss']

@@ -8,14 +8,29 @@
 """
 import os
 
-# Must be set before jax import anywhere in the test process.
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+# Must be set before jax import anywhere in the test process. Forced (not
+# setdefault): the dev environment exports JAX_PLATFORMS pointing at the real
+# TPU tunnel, but unit tests always run on the virtual 8-device CPU mesh —
+# the single real chip can't back multi-device sharding tests.
+os.environ['JAX_PLATFORMS'] = 'cpu'
 flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in flags:
     os.environ['XLA_FLAGS'] = (
         flags + ' --xla_force_host_platform_device_count=8').strip()
 
 import pytest  # noqa: E402
+
+# The axon sitecustomize registers the TPU-tunnel backend and programmatically
+# sets jax_platforms='axon,cpu' (overriding the env var), so force CPU at the
+# config level too and drop any already-initialized backends.
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
+try:
+    from jax.extend.backend import clear_backends
+    clear_backends()
+except Exception:
+    pass
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,11 @@
+"""Backends: cluster lifecycle + job submission.
+
+One real backend (``SliceBackend``) covers every cloud through the
+provision router — including the local emulated cloud used in tests
+(contrast: reference needs CloudVmRayBackend + LocalDockerBackend +
+mocked-boto3 tests; sky/backends/).
+"""
+from skypilot_tpu.backends.backend import Backend, ResourceHandle
+from skypilot_tpu.backends.slice_backend import SliceBackend
+
+__all__ = ['Backend', 'ResourceHandle', 'SliceBackend']

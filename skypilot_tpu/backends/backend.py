@@ -1,0 +1,74 @@
+"""Backend ABC + pickleable cluster handle.
+
+Counterpart of reference ``sky/backends/backend.py`` (Backend ABC,
+ResourceHandle). The handle is stored pickled in the clusters table
+(global_user_state) and must contain everything needed to reconnect to a
+provisioned cluster from a fresh client process.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import task as task_lib
+
+
+class ResourceHandle:
+    """Pickleable pointer to a provisioned cluster.
+
+    Versioned like the reference's handle (_VERSION, pickle upgrade path in
+    reference cloud_vm_ray_backend.py:2187) so newer code can read state
+    written by older clients.
+    """
+    _VERSION = 1
+
+    def __init__(self, cluster_name: str, cloud: str, region: str,
+                 zone: Optional[str], num_hosts: int,
+                 launched_resources: resources_lib.Resources,
+                 deploy_vars: Optional[Dict[str, Any]] = None):
+        self._version = self._VERSION
+        self.cluster_name = cluster_name
+        self.cloud = cloud
+        self.region = region
+        self.zone = zone
+        self.num_hosts = num_hosts
+        self.launched_resources = launched_resources
+        self.deploy_vars = deploy_vars or {}
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        state.setdefault('_version', 0)
+        state.setdefault('deploy_vars', {})
+        self.__dict__.update(state)
+
+    def __repr__(self) -> str:
+        return (f'ResourceHandle({self.cluster_name!r}, {self.cloud}, '
+                f'{self.region}, hosts={self.num_hosts})')
+
+
+class Backend:
+    """Interface: provision/sync/setup/execute/teardown (reference
+    sky/backends/backend.py)."""
+
+    NAME = 'backend'
+
+    def provision(self, task: task_lib.Task, cluster_name: str,
+                  retry_until_up: bool = False,
+                  dryrun: bool = False) -> Optional[ResourceHandle]:
+        raise NotImplementedError
+
+    def sync_workdir(self, handle: ResourceHandle, workdir: str) -> None:
+        raise NotImplementedError
+
+    def sync_file_mounts(self, handle: ResourceHandle,
+                         file_mounts: Optional[Dict[str, str]]) -> None:
+        raise NotImplementedError
+
+    def setup(self, handle: ResourceHandle, task: task_lib.Task) -> None:
+        raise NotImplementedError
+
+    def execute(self, handle: ResourceHandle, task: task_lib.Task,
+                detach_run: bool = False) -> Optional[int]:
+        raise NotImplementedError
+
+    def teardown(self, handle: ResourceHandle, terminate: bool = True) -> None:
+        raise NotImplementedError

@@ -1,0 +1,405 @@
+"""SliceBackend: the one real backend (all clouds via the provision router).
+
+Counterpart of reference ``CloudVmRayBackend``
+(sky/backends/cloud_vm_ray_backend.py:2675) minus Ray: jobs are submitted to
+the head agent's sqlite queue through jobcli over a CommandRunner, and the
+agent fans out per-host processes with the rank env contract
+(runtime/agent.py). Failover lives in ``RetryingProvisioner`` (analog of
+RetryingVmProvisioner :1170 + FailoverCloudErrorHandler :763-1105).
+"""
+from __future__ import annotations
+
+import json
+import os
+import shlex
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import clouds as clouds_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import provision as provision_lib
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.backends import backend as backend_lib
+from skypilot_tpu.runtime import agent as agent_lib
+from skypilot_tpu.runtime import constants as rt_constants
+from skypilot_tpu.utils import common_utils
+
+_PROVISION_LOCK = threading.Lock()
+
+
+def _heredoc_write(path: str, content: str) -> str:
+    """Shell snippet writing `content` to `path` (no quoting pitfalls)."""
+    import base64
+    b64 = base64.b64encode(content.encode()).decode()
+    return (f'mkdir -p $(dirname {shlex.quote(path)}) && '
+            f'echo {b64} | base64 -d > {shlex.quote(path)}')
+
+
+class RetryingProvisioner:
+    """Walk the optimizer's ordered candidates across zones with
+    error-classified blocklisting (reference provision_with_retries
+    cloud_vm_ray_backend.py:2009-2184)."""
+
+    def __init__(self, retry_until_up: bool = False,
+                 max_rounds: int = 3, backoff_s: float = 5.0):
+        self.retry_until_up = retry_until_up
+        self.max_rounds = max_rounds
+        self.backoff_s = backoff_s
+
+    def provision(
+        self, task: task_lib.Task, cluster_name: str
+    ) -> Tuple[resources_lib.Resources, provision_lib.ClusterInfo]:
+        candidates = list(getattr(task, 'candidate_resources', None) or [])
+        if task.best_resources is not None and (
+                not candidates or candidates[0] != task.best_resources):
+            candidates.insert(0, task.best_resources)
+        if not candidates:
+            raise exceptions.ResourcesUnavailableError(
+                f'Task {task.name!r} has no launchable candidates; run the '
+                'optimizer first.')
+        history: List[Exception] = []
+        rounds = self.max_rounds if not self.retry_until_up else 10**9
+        for round_i in range(rounds):
+            for resources in candidates:
+                result = self._try_candidate(task, cluster_name, resources,
+                                             history)
+                if result is not None:
+                    return result
+            if not self.retry_until_up:
+                break
+            time.sleep(min(self.backoff_s * 2**round_i, 300))
+        msg = (f'Failed to provision {cluster_name!r} on any of '
+               f'{len(candidates)} candidate(s).')
+        if history:
+            msg += ' Failover history: ' + '; '.join(
+                f'{type(e).__name__}: {e}' for e in history[-8:])
+        raise exceptions.ResourcesUnavailableError(msg,
+                                                   failover_history=history)
+
+    def _try_candidate(
+        self, task: task_lib.Task, cluster_name: str,
+        resources: resources_lib.Resources, history: List[Exception]
+    ) -> Optional[Tuple[resources_lib.Resources, provision_lib.ClusterInfo]]:
+        cloud = clouds_lib.get_cloud(resources.cloud)
+        region = resources.region
+        assert region is not None, 'optimizer must region-resolve candidates'
+        name_on_cloud = common_utils.make_cluster_name_on_cloud(cluster_name)
+        zones = ([resources.zone] if resources.zone is not None
+                 else cloud.zones_for(resources, region))
+        for zone in zones:
+            deploy_vars = cloud.make_deploy_variables(
+                resources, name_on_cloud, region, zone)
+            try:
+                provision_lib.run_instances(
+                    cloud.NAME, cluster_name, region, zone,
+                    resources.num_hosts * max(1, task.num_nodes),
+                    deploy_vars)
+                provision_lib.wait_instances(cloud.NAME, cluster_name,
+                                             region)
+                info = provision_lib.get_cluster_info(cloud.NAME,
+                                                      cluster_name, region)
+                launched = resources.copy(region=region, zone=zone)
+                return launched, info
+            except exceptions.InsufficientCapacityError as e:
+                history.append(e)   # capacity: blocklist zone, try next
+                continue
+            except exceptions.CloudError as e:
+                history.append(e)   # config/quota-ish: skip region
+                break
+        return None
+
+
+class SliceBackend(backend_lib.Backend):
+
+    NAME = 'slice'
+
+    # ---- helpers -----------------------------------------------------------
+    def _cluster_info(self, handle: backend_lib.ResourceHandle
+                      ) -> provision_lib.ClusterInfo:
+        return provision_lib.get_cluster_info(handle.cloud,
+                                              handle.cluster_name,
+                                              handle.region)
+
+    def _runners(self, handle: backend_lib.ResourceHandle) -> List[Any]:
+        info = self._cluster_info(handle)
+        return provision_lib.get_command_runners(handle.cloud, info)
+
+    def _python(self, handle: backend_lib.ResourceHandle) -> Tuple[str, str]:
+        """(python executable, env-prefix) for running our code on hosts."""
+        if handle.cloud == 'local':
+            # parent of the skypilot_tpu package dir (e.g. the repo root)
+            pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(
+                __file__)))
+            pkg_parent = os.path.dirname(pkg_dir)
+            return sys.executable, f'PYTHONPATH={shlex.quote(pkg_parent)}'
+        return 'python3', 'PYTHONPATH=$HOME/.skytpu/code'
+
+    def _jobcli(self, handle: backend_lib.ResourceHandle, args_str: str,
+                stream_to: Optional[str] = None, timeout: float = 120
+                ) -> 'Any':
+        python, env_prefix = self._python(handle)
+        head = self._runners(handle)[0]
+        cmd = (f'{env_prefix} {python} -m skypilot_tpu.runtime.jobcli '
+               f'{args_str} --runtime-dir {rt_constants.RUNTIME_DIR}')
+        res = head.run(cmd, timeout=None if stream_to else timeout,
+                       stream_to=stream_to)
+        return res
+
+    # ---- provision ---------------------------------------------------------
+    def provision(self, task: task_lib.Task, cluster_name: str,
+                  retry_until_up: bool = False,
+                  dryrun: bool = False) -> Optional[backend_lib.ResourceHandle]:
+        if dryrun:
+            return None
+        provisioner = RetryingProvisioner(retry_until_up=retry_until_up)
+        with _PROVISION_LOCK:
+            global_user_state.add_or_update_cluster(
+                cluster_name, handle=None,
+                requested_resources=task.resources, ready=False)
+            try:
+                launched, info = provisioner.provision(task, cluster_name)
+            except exceptions.ResourcesUnavailableError:
+                global_user_state.remove_cluster(cluster_name,
+                                                 terminate=True)
+                raise
+            handle = backend_lib.ResourceHandle(
+                cluster_name=cluster_name, cloud=launched.cloud,
+                region=launched.region, zone=launched.zone,
+                num_hosts=info.num_hosts, launched_resources=launched,
+                deploy_vars=info.deploy_vars)
+            self._post_provision_setup(handle, info)
+            global_user_state.add_or_update_cluster(
+                cluster_name, handle=handle,
+                requested_resources=task.resources, ready=True)
+        # Autostop from the resources spec (reference execution.py autostop
+        # plumbing).
+        autostop = launched.autostop
+        if autostop is not None and autostop.idle_minutes >= 0:
+            self.set_autostop(handle, autostop.idle_minutes, autostop.down)
+        return handle
+
+    def _post_provision_setup(self, handle: backend_lib.ResourceHandle,
+                              info: provision_lib.ClusterInfo) -> None:
+        """Runtime bring-up on every host; agent on head (analog of
+        reference post_provision_runtime_setup, provision/provisioner.py:643
+        — minus Ray, so there is no head/worker runtime asymmetry beyond
+        which host runs the agent)."""
+        runners = provision_lib.get_command_runners(handle.cloud, info)
+        python, env_prefix = self._python(handle)
+        info_json = agent_lib.dump_cluster_info(info)
+        rtdir = rt_constants.RUNTIME_DIR
+
+        if handle.cloud != 'local':
+            self._sync_runtime_code(runners)
+
+        def bring_up(rank: int, runner) -> None:
+            cmds = [
+                f'mkdir -p {rtdir} {rt_constants.WORKDIR}',
+                _heredoc_write(f'{rtdir}/{rt_constants.CLUSTER_INFO_FILE}',
+                               info_json),
+            ]
+            res = runner.run(' && '.join(cmds), timeout=120)
+            if res.returncode != 0:
+                raise exceptions.ProvisionError(
+                    f'runtime dir setup failed on rank {rank}: '
+                    f'{res.stderr or res.stdout}')
+            if rank == 0:
+                tick = (rt_constants.AGENT_TICK_LOCAL
+                        if handle.cloud == 'local'
+                        else rt_constants.AGENT_TICK_CLOUD)
+                start = (
+                    f'test -f {rtdir}/{rt_constants.AGENT_PID_FILE} && '
+                    f'kill -0 $(cat {rtdir}/{rt_constants.AGENT_PID_FILE}) '
+                    f'2>/dev/null || '
+                    f'(nohup env {env_prefix} {python} -m '
+                    f'skypilot_tpu.runtime.agent --runtime-dir {rtdir} '
+                    f'--tick {tick} >> {rtdir}/{rt_constants.AGENT_LOG_FILE} '
+                    f'2>&1 < /dev/null &) ')
+                res = runner.run(start, timeout=60)
+                if res.returncode != 0:
+                    raise exceptions.ProvisionError(
+                        f'agent start failed: {res.stderr or res.stdout}')
+
+        threads = [threading.Thread(target=bring_up, args=(i, r))
+                   for i, r in enumerate(runners)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    def _sync_runtime_code(self, runners: List[Any]) -> None:
+        """Ship our package to non-local hosts (analog of reference wheel
+        shipping, sky/backends/wheel_utils.py)."""
+        pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        for runner in runners:
+            runner.run('mkdir -p .skytpu/code', timeout=60)
+            runner.rsync(pkg_dir, '.skytpu/code/', up=True)
+
+    # ---- sync / setup ------------------------------------------------------
+    def sync_workdir(self, handle: backend_lib.ResourceHandle,
+                     workdir: str) -> None:
+        workdir = os.path.expanduser(workdir)
+        if not workdir.endswith('/'):
+            workdir += '/'
+        for runner in self._runners(handle):
+            runner.run(f'mkdir -p {rt_constants.WORKDIR}', timeout=60)
+            runner.rsync(workdir, rt_constants.WORKDIR + '/', up=True)
+
+    def sync_file_mounts(self, handle: backend_lib.ResourceHandle,
+                         file_mounts: Optional[Dict[str, str]]) -> None:
+        if not file_mounts:
+            return
+        for runner in self._runners(handle):
+            for dst, src in file_mounts.items():
+                src = os.path.expanduser(src)
+                if src.endswith('/') and not dst.endswith('/'):
+                    dst += '/'
+                runner.run(f'mkdir -p $(dirname {shlex.quote(dst)})',
+                           timeout=60)
+                runner.rsync(src, dst, up=True)
+
+    def setup(self, handle: backend_lib.ResourceHandle,
+              task: task_lib.Task) -> None:
+        if not task.setup:
+            return
+        env = dict(task.envs_and_secrets)
+        errors: List[str] = []
+
+        def run_setup(rank: int, runner) -> None:
+            script = (f'cd {rt_constants.WORKDIR} 2>/dev/null || true; '
+                      + task.setup)
+            res = runner.run(script, env=env, timeout=3600)
+            if res.returncode != 0:
+                errors.append(
+                    f'rank {rank}: {res.stderr.strip() or res.stdout.strip()}')
+
+        threads = [threading.Thread(target=run_setup, args=(i, r))
+                   for i, r in enumerate(self._runners(handle))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise exceptions.CommandError(
+                1, 'setup', f'setup failed on {len(errors)} host(s): ' +
+                ' | '.join(errors[:4]))
+
+    # ---- execute -----------------------------------------------------------
+    def execute(self, handle: backend_lib.ResourceHandle,
+                task: task_lib.Task,
+                detach_run: bool = False) -> Optional[int]:
+        if task.run is None:
+            return None
+        spec = {
+            'run_script': task.run,
+            'env': dict(task.envs_and_secrets),
+            'num_hosts': handle.num_hosts,
+            'workdir': rt_constants.WORKDIR,
+        }
+        name = task.name or handle.cluster_name
+        args = (f'add --name {shlex.quote(name)} '
+                f'--username {shlex.quote(common_utils.get_user_name())} '
+                f'--spec-json {shlex.quote(json.dumps(spec))}')
+        res = self._jobcli(handle, args)
+        if res.returncode != 0:
+            raise exceptions.CommandError(
+                res.returncode, 'jobcli add', res.stderr or res.stdout)
+        job_id = int(json.loads(res.stdout.strip().splitlines()[-1])
+                     ['job_id'])
+        global_user_state.update_last_use(handle.cluster_name)
+        return job_id
+
+    # ---- job ops -----------------------------------------------------------
+    def queue(self, handle: backend_lib.ResourceHandle) -> List[Dict[str, Any]]:
+        res = self._jobcli(handle, 'queue')
+        if res.returncode != 0:
+            raise exceptions.CommandError(
+                res.returncode, 'jobcli queue', res.stderr or res.stdout)
+        return json.loads(res.stdout.strip().splitlines()[-1])['jobs']
+
+    def cancel_jobs(self, handle: backend_lib.ResourceHandle,
+                    job_ids: Optional[List[int]] = None,
+                    all_jobs: bool = False) -> List[int]:
+        if all_jobs:
+            args = 'cancel --all'
+        elif job_ids:
+            args = f'cancel --job-id {job_ids[0]}'
+        else:
+            raise ValueError('job_ids or all_jobs required')
+        res = self._jobcli(handle, args)
+        if res.returncode != 0:
+            raise exceptions.CommandError(
+                res.returncode, 'jobcli cancel', res.stderr or res.stdout)
+        return json.loads(res.stdout.strip().splitlines()[-1])['cancelled']
+
+    def tail_logs(self, handle: backend_lib.ResourceHandle,
+                  job_id: Optional[int] = None, follow: bool = True,
+                  stream_to=None) -> int:
+        if stream_to is None:
+            stream_to = sys.stdout
+        args = 'tail' + (f' --job-id {job_id}' if job_id else '')
+        if follow:
+            args += ' --follow'
+        res = self._jobcli(handle, args, stream_to=stream_to)
+        return res.returncode
+
+    def job_status(self, handle: backend_lib.ResourceHandle,
+                   job_id: int) -> Optional[str]:
+        res = self._jobcli(handle, f'status --job-id {job_id}')
+        if res.returncode != 0:
+            return None
+        return json.loads(res.stdout.strip().splitlines()[-1])['status']
+
+    # ---- lifecycle ---------------------------------------------------------
+    def set_autostop(self, handle: backend_lib.ResourceHandle,
+                     idle_minutes: int, down: bool = False) -> None:
+        python, env_prefix = self._python(handle)
+        hook = (f'{env_prefix} {python} -m skypilot_tpu.runtime.self_stop '
+                f'--cloud {handle.cloud} --cluster {handle.cluster_name} '
+                f'--region {handle.region}' + (' --down' if down else ''))
+        cfg = json.dumps({'idle_minutes': idle_minutes, 'down': down,
+                          'hook': hook})
+        head = self._runners(handle)[0]
+        res = head.run(_heredoc_write(
+            f'{rt_constants.RUNTIME_DIR}/{rt_constants.AUTOSTOP_FILE}', cfg),
+            timeout=60)
+        if res.returncode != 0:
+            raise exceptions.CommandError(
+                res.returncode, 'set_autostop', res.stderr or res.stdout)
+        global_user_state.set_cluster_autostop(handle.cluster_name,
+                                               idle_minutes, down)
+
+    def restart(self, handle: backend_lib.ResourceHandle) -> None:
+        """Bring a STOPPED cluster back UP (reference core.start:399)."""
+        provision_lib.run_instances(handle.cloud, handle.cluster_name,
+                                    handle.region, handle.zone,
+                                    handle.num_hosts, handle.deploy_vars)
+        provision_lib.wait_instances(handle.cloud, handle.cluster_name,
+                                     handle.region)
+        info = provision_lib.get_cluster_info(handle.cloud,
+                                              handle.cluster_name,
+                                              handle.region)
+        self._post_provision_setup(handle, info)
+        global_user_state.add_or_update_cluster(
+            handle.cluster_name, handle=handle, ready=True)
+
+    def teardown(self, handle: backend_lib.ResourceHandle,
+                 terminate: bool = True) -> None:
+        if terminate:
+            provision_lib.terminate_instances(handle.cloud,
+                                              handle.cluster_name,
+                                              handle.region)
+            global_user_state.remove_cluster(handle.cluster_name,
+                                             terminate=True)
+        else:
+            cloud = clouds_lib.get_cloud(handle.cloud)
+            cloud.check_features_are_supported(
+                {clouds_lib.CloudFeature.STOP})
+            provision_lib.stop_instances(handle.cloud, handle.cluster_name,
+                                         handle.region)
+            global_user_state.remove_cluster(handle.cluster_name,
+                                             terminate=False)

@@ -1,0 +1,291 @@
+"""skytpu CLI (click).
+
+Counterpart of reference ``sky/cli.py`` (groups at :1041; 5,856 LoC there —
+ours stays lean by delegating everything to core/execution). Entry point:
+``python -m skypilot_tpu.cli`` or the ``skytpu`` console script.
+"""
+from __future__ import annotations
+
+import json
+import sys
+from typing import List, Optional
+
+import click
+
+from skypilot_tpu.utils import common_utils
+
+
+def _task_from_args(entrypoint, name, workdir, cloud, accelerators,
+                    num_nodes, env, cmd):
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import task as task_lib
+
+    if entrypoint and entrypoint.endswith(('.yaml', '.yml')):
+        task = task_lib.Task.from_yaml(entrypoint)
+    else:
+        run_cmd = cmd or entrypoint
+        task = task_lib.Task(run=run_cmd)
+    if name:
+        task.name = name
+    if workdir:
+        task.workdir = workdir
+    if num_nodes:
+        task.num_nodes = num_nodes
+    if env:
+        task.update_envs(dict(kv.split('=', 1) for kv in env))
+    overrides = {}
+    if cloud:
+        overrides['cloud'] = cloud
+    if accelerators:
+        overrides['accelerators'] = accelerators
+    if overrides:
+        base = task.resources[0] if task.resources else \
+            resources_lib.Resources()
+        task.set_resources([base.copy(**overrides)])
+    return task
+
+
+@click.group()
+@click.version_option(message='%(version)s')
+def cli():
+    """skytpu: TPU-native multi-cloud orchestration."""
+
+
+@cli.command()
+@click.argument('entrypoint', required=False)
+@click.option('--cluster', '-c', default=None, help='Cluster name.')
+@click.option('--name', '-n', default=None)
+@click.option('--workdir', default=None)
+@click.option('--cloud', default=None)
+@click.option('--gpus', '--tpus', 'accelerators', default=None,
+              help='Accelerator spec, e.g. tpu-v5e-8.')
+@click.option('--num-nodes', type=int, default=None)
+@click.option('--env', multiple=True, help='KEY=VALUE (repeatable).')
+@click.option('--cmd', default=None, help='Inline run command.')
+@click.option('--detach-run', '-d', is_flag=True)
+@click.option('--retry-until-up', is_flag=True)
+@click.option('--idle-minutes-to-autostop', '-i', type=int, default=None)
+@click.option('--down', is_flag=True,
+              help='Autostop tears down instead of stopping.')
+@click.option('--dryrun', is_flag=True)
+def launch(entrypoint, cluster, name, workdir, cloud, accelerators,
+           num_nodes, env, cmd, detach_run, retry_until_up,
+           idle_minutes_to_autostop, down, dryrun):
+    """Launch a task (YAML file or inline command) on a new/existing
+    cluster."""
+    from skypilot_tpu import execution
+    task = _task_from_args(entrypoint, name, workdir, cloud, accelerators,
+                           num_nodes, env, cmd)
+    cluster = cluster or f'skytpu-{common_utils.get_user_name()}'
+    job_id, _ = execution.launch(
+        task, cluster_name=cluster, retry_until_up=retry_until_up,
+        idle_minutes_to_autostop=idle_minutes_to_autostop, down=down,
+        detach_run=detach_run, dryrun=dryrun)
+    if dryrun:
+        click.echo('Dry run complete (optimizer table above).')
+    elif job_id is not None and detach_run:
+        click.echo(f'Job {job_id} submitted on cluster {cluster!r}. '
+                   f'Logs: skytpu logs {cluster} {job_id}')
+
+
+@cli.command('exec')
+@click.argument('cluster')
+@click.argument('entrypoint', required=False)
+@click.option('--cmd', default=None)
+@click.option('--detach-run', '-d', is_flag=True)
+@click.option('--env', multiple=True)
+def exec_cmd(cluster, entrypoint, cmd, detach_run, env):
+    """Run a task on an existing cluster (skips provision/setup)."""
+    from skypilot_tpu import execution
+    task = _task_from_args(entrypoint, None, None, None, None, None, env,
+                           cmd)
+    job_id, _ = execution.exec_(task, cluster_name=cluster,
+                                detach_run=detach_run)
+    if job_id is not None and detach_run:
+        click.echo(f'Job {job_id} submitted on cluster {cluster!r}.')
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1)
+@click.option('--refresh/--no-refresh', default=True)
+def status(clusters, refresh):
+    """Show clusters (reconciled against cloud state)."""
+    from skypilot_tpu import core
+    records = core.status(list(clusters) or None, refresh=refresh)
+    if not records:
+        click.echo('No existing clusters.')
+        return
+    fmt = '{:<20} {:<10} {:<24} {:<8} {:<12}'
+    click.echo(fmt.format('NAME', 'STATUS', 'RESOURCES', 'HOSTS',
+                          'AUTOSTOP'))
+    for r in records:
+        handle = r['handle']
+        res = (str(handle.launched_resources) if handle else '-')
+        hosts = handle.num_hosts if handle else '-'
+        autostop = f"{r['autostop']}m" if r['autostop'] >= 0 else '-'
+        if r['to_down'] and r['autostop'] >= 0:
+            autostop += ' (down)'
+        click.echo(fmt.format(r['name'], r['status'].value, res[:24],
+                              str(hosts), autostop))
+
+
+@cli.command()
+@click.argument('cluster')
+def start(cluster):
+    """Restart a stopped cluster."""
+    from skypilot_tpu import core
+    core.start(cluster)
+    click.echo(f'Cluster {cluster!r} started.')
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1, required=True)
+def stop(clusters):
+    """Stop cluster(s), keeping disks."""
+    from skypilot_tpu import core
+    for c in clusters:
+        core.stop(c)
+        click.echo(f'Cluster {c!r} stopped.')
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1, required=True)
+@click.option('--yes', '-y', is_flag=True)
+def down(clusters, yes):
+    """Tear down cluster(s)."""
+    from skypilot_tpu import core
+    if not yes:
+        click.confirm(f'Tear down {", ".join(clusters)}?', abort=True)
+    for c in clusters:
+        core.down(c)
+        click.echo(f'Cluster {c!r} terminated.')
+
+
+@cli.command()
+@click.argument('cluster')
+@click.option('--idle-minutes', '-i', type=int, required=True,
+              help='-1 cancels autostop.')
+@click.option('--down', is_flag=True)
+def autostop(cluster, idle_minutes, down):
+    """Schedule autostop/autodown after idleness."""
+    from skypilot_tpu import core
+    core.autostop(cluster, idle_minutes, down)
+    if idle_minutes < 0:
+        click.echo(f'Autostop cancelled on {cluster!r}.')
+    else:
+        click.echo(f'Cluster {cluster!r} will '
+                   f'{"autodown" if down else "autostop"} after '
+                   f'{idle_minutes}m idle.')
+
+
+@cli.command()
+@click.argument('cluster')
+def queue(cluster):
+    """Show the cluster's job queue."""
+    from skypilot_tpu import core
+    jobs = core.queue(cluster)
+    if not jobs:
+        click.echo('No jobs.')
+        return
+    fmt = '{:<6} {:<16} {:<12} {:<12}'
+    click.echo(fmt.format('ID', 'NAME', 'STATUS', 'SUBMITTED'))
+    for j in jobs:
+        submitted = common_utils.readable_time_duration(j['submitted_at'])
+        click.echo(fmt.format(j['job_id'], (j['name'] or '-')[:16],
+                              j['status'], submitted))
+
+
+@cli.command()
+@click.argument('cluster')
+@click.argument('job_id', required=False, type=int)
+@click.option('--no-follow', is_flag=True)
+def logs(cluster, job_id, no_follow):
+    """Tail a job's logs."""
+    from skypilot_tpu import core
+    rc = core.tail_logs(cluster, job_id, follow=not no_follow)
+    sys.exit(rc)
+
+
+@cli.command()
+@click.argument('cluster')
+@click.argument('job_ids', nargs=-1, type=int)
+@click.option('--all', 'all_jobs', is_flag=True)
+def cancel(cluster, job_ids, all_jobs):
+    """Cancel job(s)."""
+    from skypilot_tpu import core
+    cancelled = core.cancel(cluster, list(job_ids) or None,
+                            all_jobs=all_jobs)
+    click.echo(f'Cancelled jobs: {cancelled}')
+
+
+@cli.command()
+def check():
+    """Probe cloud credentials and show enabled clouds."""
+    from skypilot_tpu import check as check_lib
+    results = check_lib.check_capabilities()
+    for cloud_name, (ok, reason) in results.items():
+        mark = '\x1b[32m✓\x1b[0m' if ok else '\x1b[31m✗\x1b[0m'
+        click.echo(f'  {mark} {cloud_name}'
+                   + (f': {reason}' if reason and not ok else ''))
+
+
+@cli.command('show-tpus')
+@click.option('--generation', default=None, help='e.g. v5e, v6e.')
+def show_tpus(generation):
+    """List TPU slice offerings with price and perf/$. (analog of
+    reference `sky show-gpus`)."""
+    from skypilot_tpu import accelerators as accel_lib
+    from skypilot_tpu import catalog
+    df = catalog.list_tpu_slices(generation=generation)
+    # Cheapest region per slice type.
+    df = df.loc[df.groupby('slice')['price'].idxmin()]
+    fmt = '{:<16} {:<6} {:<6} {:<10} {:<8} {:<10} {:<10} {:<16}'
+    click.echo(fmt.format('SLICE', 'CHIPS', 'HOSTS', 'TFLOPS', 'HBM_GB',
+                          '$/HR', 'SPOT$/HR', 'TFLOPS_PER_$HR'))
+    for _, r in df.sort_values(['generation', 'chips']).iterrows():
+        s = accel_lib.TpuSlice.from_name(r['slice'])
+        click.echo(fmt.format(
+            r['slice'], r['chips'], r['num_hosts'],
+            f'{s.total_bf16_tflops:,.0f}', f'{s.total_hbm_gb:,.0f}',
+            f"{r['price']:,.2f}", f"{r['spot_price']:,.2f}",
+            f"{s.total_bf16_tflops / r['price']:,.0f}"))
+
+
+@cli.command('cost-report')
+def cost_report():
+    """Show per-cluster accumulated cost."""
+    from skypilot_tpu import core
+    rows = core.cost_report()
+    if not rows:
+        click.echo('No cluster history.')
+        return
+    fmt = '{:<20} {:<10} {:<10} {:<10}'
+    click.echo(fmt.format('NAME', 'HOSTS', 'DURATION', 'COST($)'))
+    for r in rows:
+        click.echo(fmt.format(
+            r['name'], r['num_hosts'],
+            common_utils.readable_time_duration(0, r['duration_s'],
+                                                absolute=True),
+            f"{r['cost']:,.2f}"))
+
+
+@cli.command()
+@click.argument('entrypoint')
+@click.option('--minimize', type=click.Choice(['cost', 'time',
+                                               'perf_per_dollar']),
+              default='cost')
+def optimize(entrypoint, minimize):
+    """Show the optimizer's candidate table for a task YAML."""
+    from skypilot_tpu import optimizer as optimizer_lib
+    from skypilot_tpu import task as task_lib
+    task = task_lib.Task.from_yaml(entrypoint)
+    optimizer_lib.optimize(
+        task, minimize=optimizer_lib.OptimizeTarget(minimize))
+
+
+def main():
+    cli()
+
+
+if __name__ == '__main__':
+    main()

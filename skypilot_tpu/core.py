@@ -1,0 +1,156 @@
+"""Server-side core ops: status/start/stop/down/autostop/queue/cancel/logs.
+
+Counterpart of reference ``sky/core.py`` (status:92, start:399, down:471,
+stop:506, autostop:566, queue:670, cancel:733, tail_logs:828). The status
+refresh reconciles the sqlite record against cloud truth
+(reference backend_utils._update_cluster_status:1769 — "the subtlest code
+in the reference", SURVEY.md §7; ours is simpler because host groups are
+atomic: a TPU slice is all-up or not).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import backends
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import provision as provision_lib
+
+ClusterStatus = global_user_state.ClusterStatus
+
+
+def _refresh_record(record: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Reconcile one cluster record against the cloud; returns the updated
+    record, or None if the cluster no longer exists on the cloud."""
+    handle = record['handle']
+    name = record['name']
+    if handle is None:
+        return record  # mid-provision INIT record; leave as-is
+    try:
+        states = provision_lib.query_instances(handle.cloud, name,
+                                               handle.region)
+    except exceptions.SkyTpuError:
+        return record  # cloud unreachable: keep stale record
+    if not states:
+        # Terminated externally (or autostop --down): drop the record.
+        global_user_state.remove_cluster(name, terminate=True)
+        return None
+    values = set(states.values())
+    if values == {'running'}:
+        new_status = ClusterStatus.UP
+    elif values == {'stopped'}:
+        new_status = ClusterStatus.STOPPED
+    else:
+        new_status = ClusterStatus.INIT  # partial/transitional
+    if new_status != record['status']:
+        global_user_state.update_cluster_status(name, new_status)
+        record = dict(record, status=new_status)
+    return record
+
+
+def status(cluster_names: Optional[List[str]] = None,
+           refresh: bool = True) -> List[Dict[str, Any]]:
+    records = global_user_state.get_clusters()
+    if cluster_names:
+        records = [r for r in records if r['name'] in cluster_names]
+    if not refresh:
+        return records
+    out = []
+    for record in records:
+        refreshed = _refresh_record(record)
+        if refreshed is not None:
+            out.append(refreshed)
+    return out
+
+
+def _get_handle(cluster_name: str, need_up: bool = False
+                ) -> backends.ResourceHandle:
+    record = global_user_state.get_cluster_from_name(cluster_name)
+    if record is None or record['handle'] is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist.')
+    if need_up:
+        record = _refresh_record(record)
+        if record is None:
+            raise exceptions.ClusterDoesNotExist(
+                f'Cluster {cluster_name!r} no longer exists on the cloud.')
+        if record['status'] != ClusterStatus.UP:
+            raise exceptions.ClusterNotUpError(
+                f'Cluster {cluster_name!r} is {record["status"].value}.',
+                cluster_status=record['status'])
+    return record['handle']
+
+
+def start(cluster_name: str) -> None:
+    handle = _get_handle(cluster_name)
+    backends.SliceBackend().restart(handle)
+
+
+def stop(cluster_name: str) -> None:
+    handle = _get_handle(cluster_name)
+    backends.SliceBackend().teardown(handle, terminate=False)
+
+
+def down(cluster_name: str) -> None:
+    handle = _get_handle(cluster_name)
+    backends.SliceBackend().teardown(handle, terminate=True)
+
+
+def autostop(cluster_name: str, idle_minutes: int,
+             down_on_idle: bool = False) -> None:
+    handle = _get_handle(cluster_name, need_up=True)
+    backends.SliceBackend().set_autostop(handle, idle_minutes, down_on_idle)
+
+
+def queue(cluster_name: str) -> List[Dict[str, Any]]:
+    handle = _get_handle(cluster_name, need_up=True)
+    return backends.SliceBackend().queue(handle)
+
+
+def cancel(cluster_name: str, job_ids: Optional[List[int]] = None,
+           all_jobs: bool = False) -> List[int]:
+    handle = _get_handle(cluster_name, need_up=True)
+    return backends.SliceBackend().cancel_jobs(handle, job_ids=job_ids,
+                                               all_jobs=all_jobs)
+
+
+def tail_logs(cluster_name: str, job_id: Optional[int] = None,
+              follow: bool = True) -> int:
+    handle = _get_handle(cluster_name, need_up=True)
+    return backends.SliceBackend().tail_logs(handle, job_id, follow=follow)
+
+
+def job_status(cluster_name: str, job_id: int) -> Optional[str]:
+    handle = _get_handle(cluster_name, need_up=True)
+    return backends.SliceBackend().job_status(handle, job_id)
+
+
+def cost_report() -> List[Dict[str, Any]]:
+    """Per-cluster cost history (reference core.py cost_report)."""
+    import time as time_lib
+    out = []
+    for row in global_user_state.get_cluster_history():
+        resources = row.get('resources')
+        if isinstance(resources, (tuple, list)):
+            resources = resources[0] if resources else None
+        cost_per_hour = 0.0
+        try:
+            from skypilot_tpu import clouds as clouds_lib
+            if resources is not None and resources.cloud:
+                cloud = clouds_lib.get_cloud(resources.cloud)
+                cost_per_hour = cloud.hourly_cost(resources,
+                                                  resources.region,
+                                                  resources.zone)
+        except Exception:
+            pass
+        duration = row.get('duration_s')
+        if duration is None:
+            duration = int(time_lib.time()) - row['launched_at']
+        out.append({
+            'name': row['name'],
+            'launched_at': row['launched_at'],
+            'duration_s': duration,
+            'num_hosts': row['num_hosts'],
+            'cost': cost_per_hour * duration / 3600.0,
+        })
+    return out

@@ -69,6 +69,19 @@ class ProvisionError(SkyTpuError):
     """Provisioning a cluster failed (possibly after retries)."""
 
 
+class ClusterError(SkyTpuError):
+    """A cluster-level operation failed (bad state, missing cluster)."""
+
+
+class InsufficientCapacityError(CloudError):
+    """The cloud has no capacity for the request in this zone/region.
+
+    The failover engine treats this as 'blocklist the zone and move on'
+    (reference GCP handler for TPU capacity errors,
+    sky/backends/cloud_vm_ray_backend.py:1019-1050).
+    """
+
+
 class ResourcesUnavailableError(SkyTpuError):
     """No feasible resources (capacity/quota/feasibility).
 

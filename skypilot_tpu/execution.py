@@ -1,0 +1,154 @@
+"""Execution layer: the launch/exec life-cycle driver.
+
+Counterpart of reference ``sky/execution.py`` (Stage state machine :35-46,
+_execute :99-378, launch :383, exec :570-652). Drives:
+
+    OPTIMIZE -> PROVISION -> SYNC_WORKDIR -> SYNC_FILE_MOUNTS -> SETUP
+    -> EXEC -> (DOWN)
+
+against a ``SliceBackend``. ``exec_`` skips provision/setup for fast
+iteration on an UP cluster (reference :646-652).
+"""
+from __future__ import annotations
+
+import enum
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import backends
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu import task as task_lib
+
+
+class Stage(enum.Enum):
+    OPTIMIZE = 'OPTIMIZE'
+    PROVISION = 'PROVISION'
+    SYNC_WORKDIR = 'SYNC_WORKDIR'
+    SYNC_FILE_MOUNTS = 'SYNC_FILE_MOUNTS'
+    SETUP = 'SETUP'
+    EXEC = 'EXEC'
+    DOWN = 'DOWN'
+
+
+ALL_STAGES = [Stage.OPTIMIZE, Stage.PROVISION, Stage.SYNC_WORKDIR,
+              Stage.SYNC_FILE_MOUNTS, Stage.SETUP, Stage.EXEC]
+
+
+def _to_task(dag_or_task) -> task_lib.Task:
+    if isinstance(dag_or_task, dag_lib.Dag):
+        if len(dag_or_task.tasks) != 1:
+            raise exceptions.NotSupportedError(
+                'launch() takes a single task; use managed jobs for DAGs.')
+        return dag_or_task.tasks[0]
+    return dag_or_task
+
+
+def _existing_up_handle(cluster_name: str
+                        ) -> Optional[backends.ResourceHandle]:
+    record = global_user_state.get_cluster_from_name(cluster_name)
+    if record is None or record['handle'] is None:
+        return None
+    if record['status'] != global_user_state.ClusterStatus.UP:
+        return None
+    return record['handle']
+
+
+def _execute(task: task_lib.Task,
+             cluster_name: str,
+             stages: List[Stage],
+             backend: Optional[backends.Backend] = None,
+             detach_run: bool = False,
+             retry_until_up: bool = False,
+             optimize_target=None,
+             dryrun: bool = False,
+             stream_logs: bool = True) -> Tuple[Optional[int],
+                                                Optional[Any]]:
+    """Returns (job_id, handle)."""
+    backend = backend or backends.SliceBackend()
+    optimize_target = (optimize_target
+                       or optimizer_lib.OptimizeTarget.COST)
+
+    handle = _existing_up_handle(cluster_name)
+
+    if handle is None:
+        if Stage.OPTIMIZE in stages:
+            optimizer_lib.optimize(task, minimize=optimize_target,
+                                   quiet=dryrun)
+        if dryrun:
+            return None, None
+        if Stage.PROVISION in stages:
+            handle = backend.provision(task, cluster_name,
+                                       retry_until_up=retry_until_up)
+    else:
+        if dryrun:
+            return None, handle
+        # Reusing a live cluster: the requested resources must fit it
+        # (reference check_cluster_available + resources check).
+        launched = handle.launched_resources
+        for want in task.resources:
+            if want.less_demanding_than(launched):
+                break
+        else:
+            raise exceptions.ResourcesMismatchError(
+                f'Task requests {list(task.resources)} but cluster '
+                f'{cluster_name!r} has {launched}.')
+
+    assert handle is not None
+
+    if Stage.SYNC_WORKDIR in stages and task.workdir:
+        backend.sync_workdir(handle, task.workdir)
+    if Stage.SYNC_FILE_MOUNTS in stages:
+        backend.sync_file_mounts(handle, task.file_mounts)
+    if Stage.SETUP in stages and task.setup:
+        backend.setup(handle, task)
+
+    job_id = None
+    if Stage.EXEC in stages:
+        job_id = backend.execute(handle, task, detach_run=detach_run)
+        if job_id is not None and not detach_run and stream_logs:
+            backend.tail_logs(handle, job_id, follow=True)
+    if Stage.DOWN in stages:
+        backend.teardown(handle, terminate=True)
+    return job_id, handle
+
+
+def launch(task, cluster_name: str,
+           retry_until_up: bool = False,
+           idle_minutes_to_autostop: Optional[int] = None,
+           down: bool = False,
+           detach_run: bool = False,
+           backend: Optional[backends.Backend] = None,
+           optimize_target=None,
+           dryrun: bool = False,
+           stream_logs: bool = True) -> Tuple[Optional[int], Optional[Any]]:
+    """Provision (or reuse) a cluster and run the task on it."""
+    task = _to_task(task)
+    from skypilot_tpu.utils import common_utils
+    common_utils.check_cluster_name_is_valid(cluster_name)
+    job_id, handle = _execute(
+        task, cluster_name, ALL_STAGES, backend=backend,
+        detach_run=detach_run, retry_until_up=retry_until_up,
+        optimize_target=optimize_target, dryrun=dryrun,
+        stream_logs=stream_logs)
+    if handle is not None and idle_minutes_to_autostop is not None:
+        b = backend or backends.SliceBackend()
+        b.set_autostop(handle, idle_minutes_to_autostop, down)
+    return job_id, handle
+
+
+def exec_(task, cluster_name: str,
+          detach_run: bool = False,
+          backend: Optional[backends.Backend] = None,
+          stream_logs: bool = True) -> Tuple[Optional[int], Optional[Any]]:
+    """Run a task on an existing UP cluster (no provision, no setup)."""
+    task = _to_task(task)
+    handle = _existing_up_handle(cluster_name)
+    if handle is None:
+        raise exceptions.ClusterNotUpError(
+            f'Cluster {cluster_name!r} is not UP; use launch().')
+    return _execute(task, cluster_name,
+                    [Stage.SYNC_WORKDIR, Stage.EXEC],
+                    backend=backend, detach_run=detach_run,
+                    stream_logs=stream_logs)

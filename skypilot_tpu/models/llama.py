@@ -288,11 +288,4 @@ class LlamaModel:
 
 def _cached_attention(q, k, v, valid):
     """Attention against a (padded) cache with an explicit validity mask."""
-    k, v = attention_ops._maybe_repeat_kv(q, k, v)
-    scale = q.shape[-1]**-0.5
-    s = jnp.einsum('bqhd,bkhd->bhqk', q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
-    s = jnp.where(valid[None, None], s, -1e30)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum('bhqk,bkhd->bqhd', p,
-                      v.astype(jnp.float32)).astype(q.dtype)
+    return attention_ops.mha_reference(q, k, v, mask=valid)

@@ -35,18 +35,26 @@ def _maybe_repeat_kv(q, k, v):
 
 def mha_reference(q: jax.Array, k: jax.Array, v: jax.Array,
                   causal: bool = True,
-                  scale: Optional[float] = None) -> jax.Array:
-    """O(S^2)-memory reference attention (tests / tiny shapes)."""
+                  scale: Optional[float] = None,
+                  mask: Optional[jax.Array] = None) -> jax.Array:
+    """O(S^2)-memory reference attention (tests / tiny shapes / decode).
+
+    ``mask``: optional explicit [Sq, Sk] (or broadcastable) boolean mask of
+    *allowed* positions; overrides ``causal`` (used by the KV-cache decode
+    path, where validity depends on the cache fill level).
+    """
     if scale is None:
         scale = q.shape[-1]**-0.5
     k, v = _maybe_repeat_kv(q, k, v)
     s = jnp.einsum('bqhd,bkhd->bhqk', q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
-    if causal:
+    if mask is None and causal:
         sq, sk = q.shape[1], k.shape[1]
         mask = (sk - sq + lax.iota(jnp.int32, sq)[:, None]
                 >= lax.iota(jnp.int32, sk)[None, :])
-        s = jnp.where(mask[None, None], s, _NEG_INF)
+    if mask is not None:
+        s = jnp.where(mask[None, None] if mask.ndim == 2 else mask, s,
+                      _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum('bhqk,bkhd->bqhd', p, v.astype(jnp.float32)
                       ).astype(q.dtype)

@@ -222,7 +222,15 @@ def _assign_chain_dp(dag: 'dag_lib.Dag',
         cands = per_task[task]
         row: List[Tuple[float, Optional[int]]] = []
         for j, cand in enumerate(cands):
+            # Node weight, in the objective's own unit. For COST a one-shot
+            # egress fee ($) is only comparable to a *total* run cost, so
+            # when the task has a time estimate the node weight becomes
+            # est_hours * $/h (total $); otherwise egress edges are left
+            # unweighted rather than summing $/h with $.
             own = cand.sort_key(target)[0]
+            has_est = cand.est_time_s is not None
+            if target == OptimizeTarget.COST and has_est:
+                own = cand.cost_per_hour * cand.est_time_s / 3600.0
             if i == 0:
                 row.append((own, None))
                 continue
@@ -237,12 +245,12 @@ def _assign_chain_dp(dag: 'dag_lib.Dag',
                     cloud = clouds_lib.get_cloud(src.cloud)
                     egress_usd = out_gb * cloud.egress_cost_per_gb(
                         dst.cloud, dst.region or '', src.region)
-                    # Edge weight must share the objective's unit: dollars
-                    # for COST, seconds (transfer time) for TIME. For
-                    # PERF_PER_DOLLAR (an hourly ratio) a one-shot egress
-                    # fee has no coherent conversion without a run-duration
-                    # estimate, so edges are unweighted there.
-                    if target == OptimizeTarget.COST:
+                    # Edge weight in the objective's unit: total dollars for
+                    # COST (only when node weights are total dollars too),
+                    # transfer seconds for TIME. PERF_PER_DOLLAR (an hourly
+                    # ratio) admits no coherent one-shot conversion, so its
+                    # edges stay unweighted.
+                    if target == OptimizeTarget.COST and has_est:
                         egress = egress_usd
                     elif target == OptimizeTarget.TIME:
                         if egress_usd > 0:

@@ -1,0 +1,120 @@
+"""Cloud-agnostic provisioning API (functional, routed by cloud name).
+
+Counterpart of reference ``sky/provision/__init__.py`` (_route_to_cloud_impl
+:37, API surface :70-197). Each cloud module implements the same function
+names; the router dispatches ``provision.<fn>(cloud, ...)`` to
+``skypilot_tpu.provision.<cloud>.<fn>``.
+
+The unit of provisioning is a *host group*: for TPU slices, hosts are the
+slice's TPU-VM workers created atomically by one tpu.googleapis.com node
+(the gang is the slice — no placement groups needed, unlike reference
+RayCodeGen sky/backends/cloud_vm_ray_backend.py:389-545).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import importlib
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+
+_CLOUD_MODULES = {
+    'local': 'skypilot_tpu.provision.local_impl',
+    'gcp': 'skypilot_tpu.provision.gcp',
+}
+
+
+@dataclasses.dataclass
+class HostInfo:
+    """One reachable host (TPU-VM worker or VM)."""
+    host_id: str
+    rank: int
+    internal_ip: str
+    external_ip: Optional[str] = None
+    ssh_port: int = 22
+    # Cloud-specific bag (local: host_dir; gcp: instance metadata).
+    extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ClusterInfo:
+    """Everything the backend/runtime needs to reach a provisioned cluster."""
+    cluster_name: str
+    cloud: str
+    region: str
+    zone: Optional[str]
+    hosts: List[HostInfo]
+    deploy_vars: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def head(self) -> HostInfo:
+        return self.hosts[0]
+
+    @property
+    def num_hosts(self) -> int:
+        return len(self.hosts)
+
+
+def _route(fn_name: str, cloud: str):
+    module_path = _CLOUD_MODULES.get(cloud)
+    if module_path is None:
+        raise exceptions.CloudError(f'No provisioner for cloud {cloud!r}')
+    module = importlib.import_module(module_path)
+    impl = getattr(module, fn_name, None)
+    if impl is None:
+        raise exceptions.CloudError(
+            f'Provisioner for {cloud!r} does not implement {fn_name}')
+    return impl
+
+
+def _cloud_api(fn):
+    @functools.wraps(fn)
+    def wrapper(cloud: str, *args, **kwargs):
+        return _route(fn.__name__, cloud)(*args, **kwargs)
+    return wrapper
+
+
+# ---- routed API (signatures shown by the no-op bodies) ---------------------
+@_cloud_api
+def run_instances(cluster_name: str, region: str, zone: Optional[str],
+                  num_hosts: int, deploy_vars: Dict[str, Any]) -> None:
+    """Create (or restart) the host group; idempotent."""
+
+
+@_cloud_api
+def wait_instances(cluster_name: str, region: str,
+                   state: str = 'running', timeout: float = 1800) -> None:
+    """Block until every host reaches `state` ('running'|'stopped')."""
+
+
+@_cloud_api
+def stop_instances(cluster_name: str, region: str) -> None:
+    """Stop all hosts, keeping disks."""
+
+
+@_cloud_api
+def terminate_instances(cluster_name: str, region: str) -> None:
+    """Delete the host group entirely."""
+
+
+@_cloud_api
+def query_instances(cluster_name: str, region: str) -> Dict[str, str]:
+    """host_id -> raw cloud state ('running'/'stopped'/'terminated'/...)."""
+
+
+@_cloud_api
+def get_cluster_info(cluster_name: str, region: str) -> 'ClusterInfo':
+    """Describe a provisioned cluster (hosts in stable rank order)."""
+
+
+@_cloud_api
+def open_ports(cluster_name: str, region: str, ports: List[str]) -> None:
+    """Expose ports on the cluster's network."""
+
+
+@_cloud_api
+def get_command_runners(cluster_info: 'ClusterInfo',
+                        ssh_credentials: Optional[Dict[str, str]] = None
+                        ) -> List[Any]:
+    """One CommandRunner per host, rank order (head first)."""

@@ -1,0 +1,144 @@
+"""Local provisioner: emulated hosts as directories + real subprocesses.
+
+The permanent test backend (clouds/local.py docstring). Cluster state lives
+under ``$SKYTPU_STATE_DIR/local_clusters/<name>/``:
+
+    metadata.json     {status, num_hosts, deploy_vars}
+    host0/ host1/...  per-host working directories ("filesystems")
+
+Jobs later run as real subprocesses with cwd=hostN/, driven through the same
+agent/job-queue path used on TPU hosts — so provisioning, setup, exec, logs,
+autostop, and recovery are all testable hermetically (a deliberate upgrade
+over the reference, whose multi-node paths need real clouds or kind —
+SURVEY.md §4).
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import provision as provision_lib
+from skypilot_tpu.utils import command_runner as runner_lib
+
+
+def _clusters_root() -> str:
+    root = os.path.join(global_user_state.get_state_dir(), 'local_clusters')
+    os.makedirs(root, exist_ok=True)
+    return root
+
+
+def _cluster_dir(cluster_name: str) -> str:
+    return os.path.join(_clusters_root(), cluster_name)
+
+
+def _metadata_path(cluster_name: str) -> str:
+    return os.path.join(_cluster_dir(cluster_name), 'metadata.json')
+
+
+def _read_metadata(cluster_name: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(_metadata_path(cluster_name)) as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None
+
+
+def _write_metadata(cluster_name: str, meta: Dict[str, Any]) -> None:
+    os.makedirs(_cluster_dir(cluster_name), exist_ok=True)
+    tmp = _metadata_path(cluster_name) + '.tmp'
+    with open(tmp, 'w') as f:
+        json.dump(meta, f, indent=2)
+    os.replace(tmp, _metadata_path(cluster_name))
+
+
+# ---- provision API ---------------------------------------------------------
+def run_instances(cluster_name: str, region: str, zone: Optional[str],
+                  num_hosts: int, deploy_vars: Dict[str, Any]) -> None:
+    meta = _read_metadata(cluster_name)
+    if meta is not None and meta['num_hosts'] != num_hosts:
+        raise exceptions.ClusterError(
+            f'Cluster {cluster_name!r} exists with {meta["num_hosts"]} hosts;'
+            f' requested {num_hosts}. Tear it down first.')
+    # Simulated capacity errors for failover tests: deploy_vars may carry
+    # zones to reject (set via resources labels in tests).
+    fail_zones = (deploy_vars or {}).get('fail_in_zones', [])
+    if zone in fail_zones:
+        raise exceptions.InsufficientCapacityError(
+            f'local: no more capacity in zone {zone!r}')
+    for rank in range(num_hosts):
+        os.makedirs(os.path.join(_cluster_dir(cluster_name), f'host{rank}'),
+                    exist_ok=True)
+    _write_metadata(cluster_name, {
+        'status': 'running',
+        'num_hosts': num_hosts,
+        'region': region,
+        'zone': zone,
+        'deploy_vars': deploy_vars or {},
+        'launched_at': int(time.time()),
+    })
+
+
+def wait_instances(cluster_name: str, region: str, state: str = 'running',
+                   timeout: float = 1800) -> None:
+    meta = _read_metadata(cluster_name)
+    if meta is None or meta['status'] != state:
+        raise exceptions.ClusterError(
+            f'Local cluster {cluster_name!r} not in state {state!r} '
+            f'(meta={meta})')
+
+
+def stop_instances(cluster_name: str, region: str) -> None:
+    meta = _read_metadata(cluster_name)
+    if meta is None:
+        return
+    meta['status'] = 'stopped'
+    _write_metadata(cluster_name, meta)
+
+
+def terminate_instances(cluster_name: str, region: str) -> None:
+    shutil.rmtree(_cluster_dir(cluster_name), ignore_errors=True)
+
+
+def query_instances(cluster_name: str, region: str) -> Dict[str, str]:
+    meta = _read_metadata(cluster_name)
+    if meta is None:
+        return {}
+    return {f'host{r}': meta['status'] for r in range(meta['num_hosts'])}
+
+
+def get_cluster_info(cluster_name: str, region: str
+                     ) -> provision_lib.ClusterInfo:
+    meta = _read_metadata(cluster_name)
+    if meta is None:
+        raise exceptions.ClusterError(
+            f'Local cluster {cluster_name!r} does not exist')
+    hosts = [
+        provision_lib.HostInfo(
+            host_id=f'host{r}', rank=r, internal_ip='127.0.0.1',
+            external_ip='127.0.0.1',
+            extra={'host_dir': os.path.join(_cluster_dir(cluster_name),
+                                            f'host{r}')})
+        for r in range(meta['num_hosts'])
+    ]
+    return provision_lib.ClusterInfo(
+        cluster_name=cluster_name, cloud='local',
+        region=meta.get('region', 'local'), zone=meta.get('zone'),
+        hosts=hosts, deploy_vars=meta.get('deploy_vars', {}))
+
+
+def open_ports(cluster_name: str, region: str, ports: List[str]) -> None:
+    pass  # localhost: nothing to open
+
+
+def get_command_runners(cluster_info: provision_lib.ClusterInfo,
+                        ssh_credentials: Optional[Dict[str, str]] = None
+                        ) -> List[runner_lib.CommandRunner]:
+    return [
+        runner_lib.LocalProcessRunner(h.extra['host_dir'])
+        for h in cluster_info.hosts
+    ]

@@ -1,0 +1,18 @@
+"""On-cluster runtime: Ray-free head agent + job queue.
+
+Replaces the reference's Ray-based on-cluster stack (skylet daemon
+sky/skylet/skylet.py:17-35, job_lib sqlite queue :210-282, RayCodeGen gang
+scheduling sky/backends/cloud_vm_ray_backend.py:389-545) with:
+
+- a single asyncio **agent** on the head host (agent.py): schedules jobs
+  FIFO, fans each job out to every host over CommandRunners with the rank
+  env contract, monitors liveness, runs the autostop event;
+- a sqlite **job queue** in the head's runtime dir (job_lib.py);
+- **jobcli**, a tiny CLI the client invokes over SSH for queue/cancel/tail
+  (the codegen-free analog of reference JobLibCodeGen job_lib.py:936-1092).
+
+The gang is the TPU slice itself: all hosts of a slice exist atomically, so
+rank assignment is just the provisioner's stable host order — no placement
+groups, no rendezvous service. jax.distributed coordination uses host 0 as
+coordinator via SKYTPU_COORDINATOR_ADDR.
+"""

@@ -1,0 +1,239 @@
+"""Head-host agent: job scheduler + fan-out driver + autostop.
+
+The Ray-free replacement for reference skylet (sky/skylet/skylet.py:17-35)
+*and* the Ray driver program (RayCodeGen,
+sky/backends/cloud_vm_ray_backend.py:229-744): one daemon on host 0 that
+
+- pops PENDING jobs FIFO from the sqlite queue (one active job per cluster —
+  TPU chips are exclusively owned by one JAX process group);
+- fans the job's run script out to every host over CommandRunners, exporting
+  the SKYTPU_*/SKYPILOT_* rank env contract; per-rank output streams to
+  ``logs/<job_id>/rank<N>.log`` on the head;
+- cancels on marker files (kill the setsid'd process group on each host);
+- fails the whole job if any rank fails (gang semantics, analog of
+  reference ``get_or_fail`` cancel-on-first-failure);
+- runs the autostop event (idleness -> configured hook command).
+
+Launched detached by the backend at provision time:
+``python -m skypilot_tpu.runtime.agent --runtime-dir <dir> [--tick s]``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shlex
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import provision as provision_lib
+from skypilot_tpu.runtime import constants
+from skypilot_tpu.runtime import job_lib
+
+
+def load_cluster_info(runtime_dir: str) -> provision_lib.ClusterInfo:
+    with open(os.path.join(runtime_dir, constants.CLUSTER_INFO_FILE)) as f:
+        raw = json.load(f)
+    hosts = [provision_lib.HostInfo(**h) for h in raw['hosts']]
+    return provision_lib.ClusterInfo(
+        cluster_name=raw['cluster_name'], cloud=raw['cloud'],
+        region=raw['region'], zone=raw.get('zone'), hosts=hosts,
+        deploy_vars=raw.get('deploy_vars', {}))
+
+
+def dump_cluster_info(info: provision_lib.ClusterInfo) -> str:
+    return json.dumps({
+        'cluster_name': info.cluster_name,
+        'cloud': info.cloud,
+        'region': info.region,
+        'zone': info.zone,
+        'hosts': [h.__dict__ for h in info.hosts],
+        'deploy_vars': info.deploy_vars,
+    }, indent=2)
+
+
+def make_job_command(spec: Dict[str, Any], rank: int, env: Dict[str, str],
+                     pid_file: str) -> str:
+    """Build the per-host shell command for one rank of a job."""
+    workdir = spec.get('workdir') or constants.WORKDIR
+    exports = ' '.join(f'export {k}={shlex.quote(v)};'
+                       for k, v in env.items())
+    script = spec['run_script']
+    # setsid: new process group whose pgid == the leader pid written to the
+    # pidfile, so cancellation can kill the whole tree without touching the
+    # agent's own group (local runners share the agent's session).
+    inner = (f'echo $$ > {shlex.quote(pid_file)}; {exports} '
+             f'cd {shlex.quote(workdir)} 2>/dev/null || cd ~; '
+             + script)
+    return f'mkdir -p {shlex.quote(workdir)}; setsid bash -c {shlex.quote(inner)}'
+
+
+class JobDriver(threading.Thread):
+    """Runs one job across all hosts; one sub-thread per rank."""
+
+    def __init__(self, agent: 'Agent', job: Dict[str, Any]):
+        super().__init__(daemon=True, name=f'driver-{job["job_id"]}')
+        self.agent = agent
+        self.job = job
+        self.rcs: List[Optional[int]] = []
+
+    def _pid_file(self, rank: int) -> str:
+        return f'.skytpu_job_{self.job["job_id"]}_rank{rank}.pid'
+
+    def _run_rank(self, rank: int, runner, env: Dict[str, str],
+                  log_path: str, results: list) -> None:
+        cmd = make_job_command(self.job['spec'], rank, env,
+                               self._pid_file(rank))
+        try:
+            res = runner.run(cmd, stream_to=log_path)
+            results[rank] = res.returncode
+        except Exception as e:  # runner/transport failure = rank failure
+            with open(log_path, 'a') as f:
+                f.write(f'\n[skytpu] rank {rank} transport error: {e}\n')
+            results[rank] = 255
+
+    def run(self) -> None:
+        rtdir = self.agent.runtime_dir
+        job_id = self.job['job_id']
+        spec = self.job['spec']
+        info = self.agent.cluster_info
+        num_hosts = spec.get('num_hosts') or info.num_hosts
+        runners = self.agent.runners[:num_hosts]
+        ips = [h.internal_ip for h in info.hosts[:num_hosts]]
+        log_dir = job_lib.resolve_log_dir(rtdir, self.job)
+        os.makedirs(log_dir, exist_ok=True)
+
+        job_lib.set_status(rtdir, job_id, job_lib.JobStatus.RUNNING)
+        results: List[Optional[int]] = [None] * num_hosts
+        threads = []
+        for rank, runner in enumerate(runners):
+            env = constants.rank_env(
+                num_hosts, rank, ips, job_id, info.cluster_name,
+                chips_per_host=int(
+                    info.deploy_vars.get('chips_per_host') or 0))
+            env.update(spec.get('env') or {})
+            t = threading.Thread(
+                target=self._run_rank,
+                args=(rank, runner, env,
+                      os.path.join(log_dir, f'rank{rank}.log'), results),
+                daemon=True)
+            t.start()
+            threads.append(t)
+
+        # Wait for completion or cancellation.
+        while any(t.is_alive() for t in threads):
+            if job_lib.cancel_requested(rtdir, job_id):
+                self._kill_all(runners)
+                for t in threads:
+                    t.join(timeout=10)
+                job_lib.set_status(rtdir, job_id,
+                                   job_lib.JobStatus.CANCELLED)
+                return
+            time.sleep(self.agent.tick)
+        if job_lib.cancel_requested(rtdir, job_id):
+            job_lib.set_status(rtdir, job_id, job_lib.JobStatus.CANCELLED)
+            return
+        ok = all(rc == 0 for rc in results)
+        job_lib.set_status(
+            rtdir, job_id,
+            job_lib.JobStatus.SUCCEEDED if ok else job_lib.JobStatus.FAILED)
+        if not ok:
+            with open(os.path.join(log_dir, 'driver.log'), 'a') as f:
+                f.write(f'per-rank return codes: {results}\n')
+
+    def _kill_all(self, runners) -> None:
+        for rank, runner in enumerate(runners):
+            pid_file = self._pid_file(rank)
+            try:
+                runner.run(
+                    f'test -f {pid_file} && kill -TERM -- -$(cat {pid_file}) '
+                    f'2>/dev/null; sleep 1; '
+                    f'test -f {pid_file} && kill -KILL -- -$(cat {pid_file}) '
+                    f'2>/dev/null; rm -f {pid_file}; true',
+                    timeout=30)
+            except Exception:
+                pass
+
+
+class Agent:
+
+    def __init__(self, runtime_dir: str, tick: float = 1.0):
+        self.runtime_dir = os.path.abspath(runtime_dir)
+        self.tick = tick
+        self.cluster_info = load_cluster_info(self.runtime_dir)
+        self.runners = provision_lib.get_command_runners(
+            self.cluster_info.cloud, self.cluster_info)
+        self.drivers: Dict[int, JobDriver] = {}
+        self.started_at = time.time()
+        self._autostop_fired = False
+
+    # -- events --------------------------------------------------------------
+    def _schedule_jobs(self) -> None:
+        job = job_lib.next_pending_job(self.runtime_dir)
+        if job is None:
+            return
+        driver = JobDriver(self, job)
+        self.drivers[job['job_id']] = driver
+        driver.start()
+
+    def _autostop_check(self) -> None:
+        if self._autostop_fired:
+            return
+        path = os.path.join(self.runtime_dir, constants.AUTOSTOP_FILE)
+        try:
+            with open(path) as f:
+                cfg = json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return
+        idle_minutes = cfg.get('idle_minutes', -1)
+        if idle_minutes is None or idle_minutes < 0:
+            return
+        if job_lib.has_active_jobs(self.runtime_dir):
+            return
+        last = max(job_lib.last_activity_time(self.runtime_dir),
+                   self.started_at)
+        if time.time() - last < idle_minutes * 60:
+            return
+        hook = cfg.get('hook')
+        self._autostop_fired = True
+        if hook:
+            import subprocess
+            with open(os.path.join(self.runtime_dir,
+                                   constants.AGENT_LOG_FILE), 'a') as f:
+                f.write(f'[agent] autostop firing: {hook}\n')
+            subprocess.Popen(['bash', '-c', hook],
+                             start_new_session=True)
+
+    def _heartbeat(self) -> None:
+        path = os.path.join(self.runtime_dir, constants.HEARTBEAT_FILE)
+        with open(path, 'w') as f:
+            f.write(str(time.time()))
+
+    def run_forever(self) -> None:
+        with open(os.path.join(self.runtime_dir,
+                               constants.AGENT_PID_FILE), 'w') as f:
+            f.write(str(os.getpid()))
+        while True:
+            try:
+                self._schedule_jobs()
+                self._autostop_check()
+                self._heartbeat()
+            except Exception as e:
+                with open(os.path.join(self.runtime_dir,
+                                       constants.AGENT_LOG_FILE), 'a') as f:
+                    f.write(f'[agent] tick error: {e!r}\n')
+            time.sleep(self.tick)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--runtime-dir', required=True)
+    parser.add_argument('--tick', type=float, default=1.0)
+    args = parser.parse_args()
+    Agent(args.runtime_dir, tick=args.tick).run_forever()
+
+
+if __name__ == '__main__':
+    main()

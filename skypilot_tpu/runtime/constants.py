@@ -1,0 +1,67 @@
+"""Runtime env contract + on-host paths.
+
+JAX-native contract (SURVEY.md §7): SKYTPU_* variables wire
+``jax.distributed.initialize`` directly; SKYPILOT_* back-compat names let
+task YAMLs written for the reference run unchanged (reference
+sky/skylet/constants.py:320-323).
+"""
+from __future__ import annotations
+
+import os
+
+# -- env contract ------------------------------------------------------------
+ENV_NUM_HOSTS = 'SKYTPU_NUM_HOSTS'
+ENV_HOST_RANK = 'SKYTPU_HOST_RANK'
+ENV_HOST_IPS = 'SKYTPU_HOST_IPS'          # newline-separated, rank order
+ENV_COORDINATOR_ADDR = 'SKYTPU_COORDINATOR_ADDR'  # host0_ip:port
+ENV_NUM_PROCESSES = 'SKYTPU_NUM_PROCESSES'
+ENV_PROCESS_ID = 'SKYTPU_PROCESS_ID'
+ENV_JOB_ID = 'SKYTPU_JOB_ID'
+ENV_CLUSTER_NAME = 'SKYTPU_CLUSTER_NAME'
+
+# Back-compat with reference task YAMLs (sky/skylet/constants.py:320-323).
+ENV_COMPAT_NUM_NODES = 'SKYPILOT_NUM_NODES'
+ENV_COMPAT_NODE_RANK = 'SKYPILOT_NODE_RANK'
+ENV_COMPAT_NODE_IPS = 'SKYPILOT_NODE_IPS'
+ENV_COMPAT_NUM_GPUS = 'SKYPILOT_NUM_GPUS_PER_NODE'
+
+COORDINATOR_PORT = 8476
+
+# -- on-host layout ----------------------------------------------------------
+# Relative to the host's home/root dir (local cloud: the host directory).
+RUNTIME_DIR = '.skytpu-runtime'
+WORKDIR = 'skytpu_workdir'
+JOBS_DB = 'jobs.db'
+CLUSTER_INFO_FILE = 'cluster_info.json'
+AUTOSTOP_FILE = 'autostop.json'
+AGENT_PID_FILE = 'agent.pid'
+AGENT_LOG_FILE = 'agent.log'
+HEARTBEAT_FILE = 'heartbeat'
+LOG_DIR = 'logs'  # logs/<job_id>/rank<N>.log
+
+# Interval between agent event-loop ticks (seconds). Local clusters poll
+# fast so tests complete quickly; cloud hosts every few seconds.
+AGENT_TICK_LOCAL = 0.2
+AGENT_TICK_CLOUD = 5.0
+
+
+def rank_env(num_hosts: int, rank: int, ips: list, job_id: int,
+             cluster_name: str, chips_per_host: int = 0) -> dict:
+    """The per-host environment exported to every job process."""
+    coord = f'{ips[0]}:{COORDINATOR_PORT}'
+    env = {
+        ENV_NUM_HOSTS: str(num_hosts),
+        ENV_HOST_RANK: str(rank),
+        ENV_HOST_IPS: '\n'.join(ips),
+        ENV_COORDINATOR_ADDR: coord,
+        ENV_NUM_PROCESSES: str(num_hosts),
+        ENV_PROCESS_ID: str(rank),
+        ENV_JOB_ID: str(job_id),
+        ENV_CLUSTER_NAME: cluster_name,
+        ENV_COMPAT_NUM_NODES: str(num_hosts),
+        ENV_COMPAT_NODE_RANK: str(rank),
+        ENV_COMPAT_NODE_IPS: '\n'.join(ips),
+    }
+    if chips_per_host:
+        env[ENV_COMPAT_NUM_GPUS] = str(chips_per_host)
+    return env

@@ -1,0 +1,221 @@
+"""Job queue: sqlite table in the head host's runtime dir.
+
+Counterpart of reference ``sky/skylet/job_lib.py`` (JobStatus:127,
+FIFOScheduler:282, liveness check:544). All functions take the runtime dir
+explicitly so the same code runs inside the agent (on the head host) and in
+tests (pointed at a local cluster's host0 dir).
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+
+class JobStatus(enum.Enum):
+    INIT = 'INIT'
+    PENDING = 'PENDING'
+    SETTING_UP = 'SETTING_UP'
+    RUNNING = 'RUNNING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    FAILED_SETUP = 'FAILED_SETUP'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in _TERMINAL
+
+    def colored(self) -> str:
+        colors = {'SUCCEEDED': '\x1b[32m', 'FAILED': '\x1b[31m',
+                  'FAILED_SETUP': '\x1b[31m', 'CANCELLED': '\x1b[33m',
+                  'RUNNING': '\x1b[36m'}
+        c = colors.get(self.value, '')
+        return f'{c}{self.value}\x1b[0m' if c else self.value
+
+
+_TERMINAL = {JobStatus.SUCCEEDED, JobStatus.FAILED, JobStatus.FAILED_SETUP,
+             JobStatus.CANCELLED}
+
+
+def _db(runtime_dir: str) -> sqlite3.Connection:
+    os.makedirs(runtime_dir, exist_ok=True)
+    conn = sqlite3.connect(os.path.join(runtime_dir, 'jobs.db'),
+                           timeout=10.0)
+    conn.execute('PRAGMA journal_mode=WAL')
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS jobs (
+            job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+            name TEXT,
+            username TEXT,
+            submitted_at REAL,
+            started_at REAL,
+            ended_at REAL,
+            status TEXT NOT NULL,
+            spec TEXT NOT NULL,
+            log_dir TEXT
+        )""")
+    conn.commit()
+    return conn
+
+
+def add_job(runtime_dir: str, name: str, username: str,
+            spec: Dict[str, Any]) -> int:
+    """Enqueue a job; spec = {run_script, env, num_hosts, workdir}."""
+    conn = _db(runtime_dir)
+    try:
+        cur = conn.execute(
+            'INSERT INTO jobs (name, username, submitted_at, status, spec) '
+            'VALUES (?, ?, ?, ?, ?)',
+            (name, username, time.time(), JobStatus.PENDING.value,
+             json.dumps(spec)))
+        conn.commit()
+        job_id = int(cur.lastrowid)
+        # Stored relative to the runtime dir: clients may address the
+        # runtime dir by different paths (relative over SSH, absolute in
+        # the agent) — resolve_log_dir() joins at read time.
+        conn.execute('UPDATE jobs SET log_dir=? WHERE job_id=?',
+                     (os.path.join('logs', str(job_id)), job_id))
+        conn.commit()
+        return job_id
+    finally:
+        conn.close()
+
+
+def set_status(runtime_dir: str, job_id: int, status: JobStatus) -> None:
+    conn = _db(runtime_dir)
+    try:
+        now = time.time()
+        if status == JobStatus.RUNNING:
+            conn.execute(
+                'UPDATE jobs SET status=?, started_at=? WHERE job_id=?',
+                (status.value, now, job_id))
+        elif status.is_terminal():
+            conn.execute(
+                'UPDATE jobs SET status=?, ended_at=? WHERE job_id=? '
+                'AND status NOT IN (?, ?, ?, ?)',
+                (status.value, now, job_id,
+                 *[s.value for s in _TERMINAL]))
+        else:
+            conn.execute('UPDATE jobs SET status=? WHERE job_id=?',
+                         (status.value, job_id))
+        conn.commit()
+    finally:
+        conn.close()
+
+
+def get_status(runtime_dir: str, job_id: int) -> Optional[JobStatus]:
+    conn = _db(runtime_dir)
+    try:
+        row = conn.execute('SELECT status FROM jobs WHERE job_id=?',
+                           (job_id,)).fetchone()
+        return JobStatus(row[0]) if row else None
+    finally:
+        conn.close()
+
+
+def get_job(runtime_dir: str, job_id: int) -> Optional[Dict[str, Any]]:
+    jobs = list_jobs(runtime_dir, job_ids=[job_id])
+    return jobs[0] if jobs else None
+
+
+def list_jobs(runtime_dir: str,
+              job_ids: Optional[List[int]] = None,
+              statuses: Optional[List[JobStatus]] = None
+              ) -> List[Dict[str, Any]]:
+    conn = _db(runtime_dir)
+    try:
+        q = ('SELECT job_id, name, username, submitted_at, started_at, '
+             'ended_at, status, spec, log_dir FROM jobs')
+        clauses, args = [], []
+        if job_ids:
+            clauses.append(
+                f'job_id IN ({",".join("?" * len(job_ids))})')
+            args += job_ids
+        if statuses:
+            clauses.append(
+                f'status IN ({",".join("?" * len(statuses))})')
+            args += [s.value for s in statuses]
+        if clauses:
+            q += ' WHERE ' + ' AND '.join(clauses)
+        q += ' ORDER BY job_id DESC'
+        out = []
+        for row in conn.execute(q, args):
+            out.append({
+                'job_id': row[0], 'name': row[1], 'username': row[2],
+                'submitted_at': row[3], 'started_at': row[4],
+                'ended_at': row[5], 'status': row[6],
+                'spec': json.loads(row[7]), 'log_dir': row[8],
+            })
+        return out
+    finally:
+        conn.close()
+
+
+def next_pending_job(runtime_dir: str) -> Optional[Dict[str, Any]]:
+    """FIFO: oldest PENDING job, but only when nothing is active (one job at
+    a time per cluster keeps TPU chips exclusively owned, matching the
+    all-chips-visible JAX process model)."""
+    active = list_jobs(runtime_dir, statuses=[JobStatus.SETTING_UP,
+                                              JobStatus.RUNNING])
+    if active:
+        return None
+    pending = list_jobs(runtime_dir, statuses=[JobStatus.PENDING])
+    return pending[-1] if pending else None
+
+
+def cancel_jobs(runtime_dir: str,
+                job_ids: Optional[List[int]] = None,
+                all_jobs: bool = False) -> List[int]:
+    """Mark PENDING jobs cancelled; RUNNING ones are killed by the agent
+    (which watches for the cancel marker files this writes)."""
+    targets: List[Dict[str, Any]] = []
+    if all_jobs:
+        targets = list_jobs(runtime_dir, statuses=[JobStatus.PENDING,
+                                                   JobStatus.SETTING_UP,
+                                                   JobStatus.RUNNING])
+    elif job_ids:
+        targets = [j for j in list_jobs(runtime_dir, job_ids=job_ids)
+                   if not JobStatus(j['status']).is_terminal()]
+    cancelled = []
+    for job in targets:
+        if JobStatus(job['status']) == JobStatus.PENDING:
+            set_status(runtime_dir, job['job_id'], JobStatus.CANCELLED)
+        else:
+            # Signal the agent's driver thread.
+            marker = os.path.join(runtime_dir, f'cancel_{job["job_id"]}')
+            with open(marker, 'w') as f:
+                f.write(str(time.time()))
+        cancelled.append(job['job_id'])
+    return cancelled
+
+
+def resolve_log_dir(runtime_dir: str, job: Dict[str, Any]) -> str:
+    log_dir = job['log_dir'] or os.path.join('logs', str(job['job_id']))
+    if os.path.isabs(log_dir):
+        return log_dir
+    return os.path.join(runtime_dir, log_dir)
+
+
+def cancel_requested(runtime_dir: str, job_id: int) -> bool:
+    return os.path.exists(os.path.join(runtime_dir, f'cancel_{job_id}'))
+
+
+def last_activity_time(runtime_dir: str) -> float:
+    """Latest job submit/end time (autostop idleness source)."""
+    conn = _db(runtime_dir)
+    try:
+        row = conn.execute(
+            'SELECT MAX(COALESCE(ended_at, submitted_at)) FROM jobs'
+        ).fetchone()
+        return row[0] or 0.0
+    finally:
+        conn.close()
+
+
+def has_active_jobs(runtime_dir: str) -> bool:
+    return bool(list_jobs(runtime_dir, statuses=[JobStatus.PENDING,
+                                                 JobStatus.SETTING_UP,
+                                                 JobStatus.RUNNING]))

@@ -1,0 +1,57 @@
+"""jobcli: the client's on-cluster entry point, invoked over CommandRunners.
+
+The codegen-free analog of reference ``JobLibCodeGen`` / ``serve_utils``
+python-snippet codegen (sky/skylet/job_lib.py:936-1092): instead of shipping
+generated python source over SSH, the client runs this stable CLI on the
+head host. Output is JSON on stdout (single line) for machine consumption,
+except ``tail`` which streams raw log lines.
+
+Usage: python -m skypilot_tpu.runtime.jobcli <cmd> --runtime-dir D [...]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from skypilot_tpu.runtime import job_lib
+from skypilot_tpu.runtime import log_lib
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('cmd', choices=['add', 'queue', 'cancel', 'tail',
+                                        'status'])
+    parser.add_argument('--runtime-dir', required=True)
+    parser.add_argument('--job-id', type=int)
+    parser.add_argument('--name')
+    parser.add_argument('--username', default='unknown')
+    parser.add_argument('--spec-json')
+    parser.add_argument('--all', action='store_true')
+    parser.add_argument('--follow', action='store_true')
+    args = parser.parse_args()
+    rtdir = args.runtime_dir
+
+    if args.cmd == 'add':
+        spec = json.loads(args.spec_json)
+        job_id = job_lib.add_job(rtdir, args.name or 'job', args.username,
+                                 spec)
+        print(json.dumps({'job_id': job_id}))
+    elif args.cmd == 'queue':
+        print(json.dumps({'jobs': job_lib.list_jobs(rtdir)}))
+    elif args.cmd == 'status':
+        status = job_lib.get_status(rtdir, args.job_id)
+        print(json.dumps({'job_id': args.job_id,
+                          'status': status.value if status else None}))
+    elif args.cmd == 'cancel':
+        ids = None if args.all else [args.job_id]
+        cancelled = job_lib.cancel_jobs(rtdir, job_ids=ids,
+                                       all_jobs=args.all)
+        print(json.dumps({'cancelled': cancelled}))
+    elif args.cmd == 'tail':
+        rc = log_lib.tail_logs(rtdir, args.job_id, follow=args.follow)
+        sys.exit(rc)
+
+
+if __name__ == '__main__':
+    main()

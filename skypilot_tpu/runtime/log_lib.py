@@ -1,0 +1,75 @@
+"""Log streaming: follow per-rank job logs (reference sky/skylet/log_lib.py
+tail_logs:392, _follow_job_logs:308)."""
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Iterator, List, Optional
+
+from skypilot_tpu.runtime import job_lib
+
+
+def _iter_new_lines(f) -> Iterator[str]:
+    while True:
+        line = f.readline()
+        if not line:
+            return
+        yield line
+
+
+def tail_logs(runtime_dir: str, job_id: Optional[int] = None,
+              follow: bool = True, out=None, poll: float = 0.25,
+              timeout: Optional[float] = None) -> int:
+    """Stream a job's rank logs to ``out`` (default stdout).
+
+    Lines are prefixed ``(rankN)`` when the job spans multiple hosts.
+    Returns the job's exit-ish code: 0 SUCCEEDED, 100 FAILED, 101 CANCELLED,
+    102 unknown job.
+    """
+    out = out or sys.stdout
+    if job_id is None:
+        jobs = job_lib.list_jobs(runtime_dir)
+        if not jobs:
+            return 102
+        job_id = jobs[0]['job_id']
+    job = job_lib.get_job(runtime_dir, job_id)
+    if job is None:
+        return 102
+    log_dir = job_lib.resolve_log_dir(runtime_dir, job)
+    deadline = time.time() + timeout if timeout else None
+
+    handles = {}
+    multi = (job['spec'].get('num_hosts') or 1) > 1
+
+    def pump() -> None:
+        if not os.path.isdir(log_dir):
+            return
+        for name in sorted(os.listdir(log_dir)):
+            if not name.startswith('rank'):
+                continue
+            path = os.path.join(log_dir, name)
+            if path not in handles:
+                handles[path] = open(path, 'r', errors='replace')
+            f = handles[path]
+            prefix = f'({name[:-4]}) ' if multi else ''
+            for line in _iter_new_lines(f):
+                out.write(prefix + line)
+        out.flush()
+
+    try:
+        while True:
+            pump()
+            status = job_lib.get_status(runtime_dir, job_id)
+            if status is not None and status.is_terminal():
+                pump()
+                return {job_lib.JobStatus.SUCCEEDED: 0,
+                        job_lib.JobStatus.CANCELLED: 101}.get(status, 100)
+            if not follow:
+                return 0
+            if deadline and time.time() > deadline:
+                return 100
+            time.sleep(poll)
+    finally:
+        for f in handles.values():
+            f.close()

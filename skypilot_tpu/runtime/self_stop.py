@@ -1,0 +1,39 @@
+"""Autostop hook: stop/terminate the cluster this host belongs to.
+
+Invoked by the agent's autostop event (runtime/agent.py) — the analog of
+reference AutostopEvent re-invoking the provisioner on itself
+(sky/skylet/events.py:150-275). Needs cloud credentials on the head host
+(true for GCP TPU VMs via instance service accounts; trivially true for the
+local cloud).
+"""
+from __future__ import annotations
+
+import argparse
+
+from skypilot_tpu import global_user_state
+from skypilot_tpu import provision as provision_lib
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--cloud', required=True)
+    parser.add_argument('--cluster', required=True)
+    parser.add_argument('--region', required=True)
+    parser.add_argument('--down', action='store_true')
+    args = parser.parse_args()
+    if args.down:
+        provision_lib.terminate_instances(args.cloud, args.cluster,
+                                          args.region)
+    else:
+        provision_lib.stop_instances(args.cloud, args.cluster, args.region)
+    # Reconcile the user state db when reachable (local cloud: always; on
+    # cloud hosts the client's status refresh does this instead).
+    try:
+        global_user_state.remove_cluster(args.cluster,
+                                         terminate=args.down)
+    except Exception:
+        pass
+
+
+if __name__ == '__main__':
+    main()

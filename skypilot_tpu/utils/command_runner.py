@@ -1,0 +1,201 @@
+"""Command runners: uniform exec/rsync to cluster hosts.
+
+Counterpart of reference ``sky/utils/command_runner.py`` (CommandRunner:167,
+SSHCommandRunner:437). Two impls:
+
+- ``SSHCommandRunner``: ControlMaster-pooled ssh + rsync (TPU VM hosts).
+- ``LocalProcessRunner``: subprocess against a host *directory* (the local
+  cloud's emulated hosts) — the permanent test backend, so every
+  orchestration path exercises the same runner interface.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import shlex
+import subprocess
+import tempfile
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+_SSH_OPTIONS = [
+    '-o', 'StrictHostKeyChecking=no',
+    '-o', 'UserKnownHostsFile=/dev/null',
+    '-o', 'IdentitiesOnly=yes',
+    '-o', 'ConnectTimeout=30',
+    '-o', 'ServerAliveInterval=30',
+    '-o', 'ServerAliveCountMax=3',
+    '-o', 'LogLevel=ERROR',
+]
+
+
+def _control_path() -> str:
+    d = os.path.join(tempfile.gettempdir(), 'skytpu_ssh_ctrl')
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, '%C')
+
+
+@dataclasses.dataclass
+class CommandResult:
+    returncode: int
+    stdout: str
+    stderr: str
+
+
+class CommandRunner:
+    """Interface: run a command on a host; rsync files to/from it.
+
+    ``stream_to`` may be a filesystem path (appended to) or a writable
+    file-like object (lines are pumped to it as they arrive — works for
+    in-memory buffers without a real fd, e.g. under click's CliRunner).
+    """
+
+    def run(self,
+            cmd: Union[str, Sequence[str]],
+            env: Optional[Dict[str, str]] = None,
+            timeout: Optional[float] = None,
+            stream_to=None) -> CommandResult:
+        raise NotImplementedError
+
+    @staticmethod
+    def _run_with_stream(argv: Sequence[str], stream_to, cwd=None,
+                         env=None, timeout=None) -> CommandResult:
+        if isinstance(stream_to, str):
+            with open(stream_to, 'ab') as f:
+                proc = subprocess.run(argv, cwd=cwd, env=env, stdout=f,
+                                      stderr=subprocess.STDOUT,
+                                      timeout=timeout)
+            return CommandResult(proc.returncode, '', '')
+        proc = subprocess.Popen(argv, cwd=cwd, env=env,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True,
+                                errors='replace')
+        assert proc.stdout is not None
+        for line in proc.stdout:
+            stream_to.write(line)
+            stream_to.flush()
+        return CommandResult(proc.wait(), '', '')
+
+    def rsync(self, source: str, target: str, up: bool = True) -> None:
+        raise NotImplementedError
+
+    def check_connection(self) -> bool:
+        res = self.run('true', timeout=30)
+        return res.returncode == 0
+
+
+class LocalProcessRunner(CommandRunner):
+    """Runs commands as subprocesses with cwd = the emulated host's dir."""
+
+    def __init__(self, host_dir: str, base_env: Optional[Dict[str, str]] = None):
+        self.host_dir = host_dir
+        self.base_env = dict(base_env or {})
+
+    def run(self, cmd, env=None, timeout=None, stream_to=None):
+        if not isinstance(cmd, str):
+            cmd = ' '.join(shlex.quote(c) for c in cmd)
+        full_env = dict(os.environ)
+        full_env.update(self.base_env)
+        if env:
+            full_env.update(env)
+        os.makedirs(self.host_dir, exist_ok=True)
+        if stream_to is not None:
+            return self._run_with_stream(['bash', '-c', cmd], stream_to,
+                                         cwd=self.host_dir, env=full_env,
+                                         timeout=timeout)
+        proc = subprocess.run(['bash', '-c', cmd], cwd=self.host_dir,
+                              env=full_env, capture_output=True, text=True,
+                              timeout=timeout)
+        return CommandResult(proc.returncode, proc.stdout, proc.stderr)
+
+    def rsync(self, source: str, target: str, up: bool = True) -> None:
+        """rsync-semantics copy (pure Python: host dirs share a filesystem
+        and the image may lack an rsync binary)."""
+        import shutil
+        if up:
+            dst = os.path.join(self.host_dir, target.lstrip('/'))
+            src = source
+        else:
+            src = os.path.join(self.host_dir, source.lstrip('/'))
+            dst = target
+        src_slash = src.endswith('/')
+        src, dst = src.rstrip('/'), dst.rstrip('/')
+        if os.path.isdir(src):
+            if not src_slash:  # rsync: no trailing slash copies the dir itself
+                dst = os.path.join(dst, os.path.basename(src))
+            os.makedirs(dst, exist_ok=True)
+            shutil.copytree(src, dst, dirs_exist_ok=True, symlinks=True)
+        else:
+            os.makedirs(os.path.dirname(dst) or '.', exist_ok=True)
+            if os.path.isdir(dst):
+                dst = os.path.join(dst, os.path.basename(src))
+            shutil.copy2(src, dst)
+
+
+class SSHCommandRunner(CommandRunner):
+    """ssh/rsync with ControlMaster connection pooling."""
+
+    def __init__(self, ip: str, user: str, key_path: str, port: int = 22,
+                 proxy_command: Optional[str] = None):
+        self.ip = ip
+        self.user = user
+        self.key_path = os.path.expanduser(key_path)
+        self.port = port
+        self.proxy_command = proxy_command
+
+    def _ssh_base(self) -> List[str]:
+        opts = list(_SSH_OPTIONS)
+        opts += ['-o', 'ControlMaster=auto',
+                 '-o', f'ControlPath={_control_path()}',
+                 '-o', 'ControlPersist=120s']
+        if self.proxy_command:
+            opts += ['-o', f'ProxyCommand={self.proxy_command}']
+        return (['ssh'] + opts + ['-i', self.key_path, '-p', str(self.port),
+                                  f'{self.user}@{self.ip}'])
+
+    def run(self, cmd, env=None, timeout=None, stream_to=None):
+        if not isinstance(cmd, str):
+            cmd = ' '.join(shlex.quote(c) for c in cmd)
+        if env:
+            exports = ' '.join(f'export {k}={shlex.quote(v)};'
+                               for k, v in env.items())
+            cmd = exports + ' ' + cmd
+        argv = self._ssh_base() + [f'bash -lc {shlex.quote(cmd)}']
+        if stream_to is not None:
+            return self._run_with_stream(argv, stream_to, timeout=timeout)
+        proc = subprocess.run(argv, capture_output=True, text=True,
+                              timeout=timeout)
+        return CommandResult(proc.returncode, proc.stdout, proc.stderr)
+
+    def rsync(self, source: str, target: str, up: bool = True) -> None:
+        import shutil
+        if shutil.which('rsync'):
+            ssh_cmd = ' '.join(['ssh'] + _SSH_OPTIONS
+                               + ['-i', self.key_path, '-p', str(self.port)])
+            remote = f'{self.user}@{self.ip}:{target if up else source}'
+            pair = [source, remote] if up else [remote, target]
+            res = subprocess.run(
+                ['rsync', '-a', '--delete', '-e', ssh_cmd] + pair,
+                capture_output=True, text=True)
+            if res.returncode != 0:
+                raise RuntimeError(f'rsync failed: {res.stderr.strip()}')
+            return
+        # Fallback: tar over ssh (no rsync binary on the client).
+        if not up:
+            raise RuntimeError('rsync-down requires the rsync binary')
+        src = source.rstrip('/')
+        src_dir = os.path.isdir(src)
+        tar_src = f'-C {shlex.quote(src)} .' if src_dir else (
+            f'-C {shlex.quote(os.path.dirname(src) or ".")} '
+            f'{shlex.quote(os.path.basename(src))}')
+        if src_dir and not source.endswith('/'):
+            target = os.path.join(target, os.path.basename(src))
+        remote_cmd = (f'mkdir -p {shlex.quote(target)} && '
+                      f'tar -x -C {shlex.quote(target)}')
+        argv = self._ssh_base() + [f'bash -lc {shlex.quote(remote_cmd)}']
+        tar = subprocess.Popen(['bash', '-c', f'tar -c {tar_src}'],
+                               stdout=subprocess.PIPE)
+        res = subprocess.run(argv, stdin=tar.stdout, capture_output=True,
+                             text=True)
+        tar.wait()
+        if res.returncode != 0 or tar.returncode != 0:
+            raise RuntimeError(f'tar-over-ssh failed: {res.stderr.strip()}')

@@ -1,0 +1,71 @@
+"""CLI tests (click CliRunner) against the local cloud."""
+import time
+
+from click.testing import CliRunner
+
+from skypilot_tpu import cli
+from skypilot_tpu import core
+from skypilot_tpu.runtime import job_lib
+
+
+def _invoke(*args):
+    runner = CliRunner()
+    result = runner.invoke(cli.cli, list(args), catch_exceptions=False)
+    return result
+
+
+def _wait_job(cluster, job_id, timeout=30):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        status = core.job_status(cluster, job_id)
+        if status and job_lib.JobStatus(status).is_terminal():
+            return status
+        time.sleep(0.2)
+    raise TimeoutError
+
+
+class TestCli:
+
+    def test_launch_status_queue_logs_down(self):
+        res = _invoke('launch', '--cloud', 'local', '--cmd',
+                      'echo cli-run-output', '-c', 'cli-test', '-d')
+        assert res.exit_code == 0, res.output
+        assert 'Job 1 submitted' in res.output
+        _wait_job('cli-test', 1)
+
+        res = _invoke('status')
+        assert 'cli-test' in res.output and 'UP' in res.output
+
+        res = _invoke('queue', 'cli-test')
+        assert 'SUCCEEDED' in res.output
+
+        res = _invoke('logs', 'cli-test', '1', '--no-follow')
+        assert 'cli-run-output' in res.output
+
+        res = _invoke('down', 'cli-test', '--yes')
+        assert res.exit_code == 0
+        res = _invoke('status')
+        assert 'No existing clusters' in res.output
+
+    def test_launch_streams_logs_sync(self):
+        res = _invoke('launch', '--cloud', 'local', '--cmd',
+                      'echo streamed-$SKYTPU_JOB_ID', '-c', 'cli-sync')
+        assert res.exit_code == 0, res.output
+        assert 'streamed-1' in res.output
+        _invoke('down', 'cli-sync', '--yes')
+
+    def test_check_and_show_tpus(self):
+        res = _invoke('check')
+        assert 'local' in res.output
+        res = _invoke('show-tpus', '--generation', 'v5e')
+        assert res.exit_code == 0, res.output
+        assert 'tpu-v5e-8' in res.output
+        assert 'TFLOPS_PER_$HR' in res.output
+
+    def test_autostop_flag_on_launch(self):
+        res = _invoke('launch', '--cloud', 'local', '--cmd', 'echo x',
+                      '-c', 'cli-auto', '-d', '-i', '30')
+        assert res.exit_code == 0, res.output
+        res = _invoke('status')
+        assert '30m' in res.output
+        _invoke('down', 'cli-auto', '--yes')

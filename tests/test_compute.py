@@ -129,9 +129,10 @@ class TestLlama:
             state, metrics = step(state, batch)
             losses.append(float(metrics['loss']))
         assert losses[-1] < losses[0]
-        # params actually sharded (embed over fsdp)
+        # params actually sharded: embed table = ('vocab','embed') logical
+        # axes -> ('tp', 'fsdp') mesh axes under DEFAULT_RULES
         emb_sh = state.params['embed'].sharding
-        assert emb_sh.spec == P('vocab', 'embed') or not emb_sh.is_fully_replicated
+        assert emb_sh.spec == P('tp', 'fsdp')
 
     def test_sp_forward_matches_unsharded(self):
         cfg = PRESETS['test-tiny']

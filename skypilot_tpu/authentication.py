@@ -1,0 +1,48 @@
+"""SSH keypair management + per-cloud public key injection.
+
+Counterpart of reference ``sky/authentication.py`` (keypair generation :88,
+GCP metadata injection :176). The private key never leaves the client; the
+public key rides in TPU-VM/GCE instance metadata (``ssh-keys``), which GCP's
+guest agent installs for the login user.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import subprocess
+from typing import Tuple
+
+from skypilot_tpu import global_user_state
+
+SSH_USER = 'skytpu'
+
+
+def _key_dir() -> str:
+    d = os.path.join(global_user_state.get_state_dir(), 'ssh')
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+@functools.lru_cache(maxsize=None)
+def get_or_generate_keys() -> Tuple[str, str]:
+    """Returns (private_key_path, public_key_path), generating once."""
+    private = os.path.join(_key_dir(), 'skytpu-key')
+    public = private + '.pub'
+    if not os.path.exists(private):
+        subprocess.run(
+            ['ssh-keygen', '-t', 'ed25519', '-N', '', '-q', '-f', private,
+             '-C', 'skytpu'],
+            check=True, capture_output=True)
+        os.chmod(private, 0o600)
+    return private, public
+
+
+def public_key_openssh() -> str:
+    _, public = get_or_generate_keys()
+    with open(public) as f:
+        return f.read().strip()
+
+
+def gcp_ssh_keys_metadata() -> str:
+    """Value for the GCP `ssh-keys` metadata entry."""
+    return f'{SSH_USER}:{public_key_openssh()}'

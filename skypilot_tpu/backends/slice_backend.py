@@ -31,6 +31,14 @@ from skypilot_tpu.utils import common_utils
 _PROVISION_LOCK = threading.Lock()
 
 
+def _quote_path(path: str) -> str:
+    """shlex.quote that preserves a leading ~/ for remote home expansion."""
+    if path == '~' or path.startswith('~/'):
+        rest = path[2:]
+        return '~/' + shlex.quote(rest) if rest else '~'
+    return shlex.quote(path)
+
+
 def _heredoc_write(path: str, content: str) -> str:
     """Shell snippet writing `content` to `path` (no quoting pitfalls)."""
     import base64
@@ -171,6 +179,12 @@ class SliceBackend(backend_lib.Backend):
                 region=launched.region, zone=launched.zone,
                 num_hosts=info.num_hosts, launched_resources=launched,
                 deploy_vars=info.deploy_vars)
+            # Record the handle BEFORE runtime bring-up: if bring-up fails,
+            # instances exist and are billing — the user must still be able
+            # to `skytpu down` them (cluster stays INIT, not UP).
+            global_user_state.add_or_update_cluster(
+                cluster_name, handle=handle,
+                requested_resources=task.resources, ready=False)
             self._post_provision_setup(handle, info)
             global_user_state.add_or_update_cluster(
                 cluster_name, handle=handle,
@@ -195,6 +209,7 @@ class SliceBackend(backend_lib.Backend):
 
         if handle.cloud != 'local':
             self._sync_runtime_code(runners)
+        errors: List[str] = []
 
         def bring_up(rank: int, runner) -> None:
             cmds = [
@@ -224,12 +239,23 @@ class SliceBackend(backend_lib.Backend):
                     raise exceptions.ProvisionError(
                         f'agent start failed: {res.stderr or res.stdout}')
 
-        threads = [threading.Thread(target=bring_up, args=(i, r))
+        def bring_up_checked(rank: int, runner) -> None:
+            try:
+                bring_up(rank, runner)
+            except Exception as e:  # surface thread failures to the caller
+                errors.append(f'rank {rank}: {e}')
+
+        threads = [threading.Thread(target=bring_up_checked, args=(i, r))
                    for i, r in enumerate(runners)]
         for t in threads:
             t.start()
         for t in threads:
             t.join()
+        if errors:
+            raise exceptions.ProvisionError(
+                'runtime bring-up failed on '
+                f'{len(errors)}/{len(runners)} host(s): '
+                + ' | '.join(errors[:4]))
 
     def _sync_runtime_code(self, runners: List[Any]) -> None:
         """Ship our package to non-local hosts (analog of reference wheel
@@ -258,8 +284,8 @@ class SliceBackend(backend_lib.Backend):
                 src = os.path.expanduser(src)
                 if src.endswith('/') and not dst.endswith('/'):
                     dst += '/'
-                runner.run(f'mkdir -p $(dirname {shlex.quote(dst)})',
-                           timeout=60)
+                parent = os.path.dirname(dst.rstrip('/')) or '.'
+                runner.run(f'mkdir -p {_quote_path(parent)}', timeout=60)
                 runner.rsync(src, dst, up=True)
 
     def setup(self, handle: backend_lib.ResourceHandle,
@@ -325,16 +351,21 @@ class SliceBackend(backend_lib.Backend):
                     job_ids: Optional[List[int]] = None,
                     all_jobs: bool = False) -> List[int]:
         if all_jobs:
-            args = 'cancel --all'
+            arg_sets = ['cancel --all']
         elif job_ids:
-            args = f'cancel --job-id {job_ids[0]}'
+            arg_sets = [f'cancel --job-id {jid}' for jid in job_ids]
         else:
             raise ValueError('job_ids or all_jobs required')
-        res = self._jobcli(handle, args)
-        if res.returncode != 0:
-            raise exceptions.CommandError(
-                res.returncode, 'jobcli cancel', res.stderr or res.stdout)
-        return json.loads(res.stdout.strip().splitlines()[-1])['cancelled']
+        cancelled: List[int] = []
+        for args in arg_sets:
+            res = self._jobcli(handle, args)
+            if res.returncode != 0:
+                raise exceptions.CommandError(
+                    res.returncode, 'jobcli cancel',
+                    res.stderr or res.stdout)
+            cancelled.extend(
+                json.loads(res.stdout.strip().splitlines()[-1])['cancelled'])
+        return cancelled
 
     def tail_logs(self, handle: backend_lib.ResourceHandle,
                   job_id: Optional[int] = None, follow: bool = True,

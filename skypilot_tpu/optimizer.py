@@ -206,6 +206,12 @@ def _assign_chain_dp(dag: 'dag_lib.Dag',
     $/GB between (cloud, region) pairs with task.estimated_output_gb.
     """
     order = dag.topological_order()
+    # COST node weights can be total dollars (est_hours * $/h) only when
+    # EVERY candidate in the DP has a time estimate — mixing total-$ and
+    # $/h weights in one min() would favor whichever is numerically
+    # smaller, not cheaper.
+    use_total_cost = (target == OptimizeTarget.COST and all(
+        c.est_time_s is not None for t in order for c in per_task[t]))
     if any(not per_task[t] for t in order):
         # raise_error=False path: a task with zero candidates makes the chain
         # unassignable — fall back to greedy per-task assignment for the
@@ -228,8 +234,7 @@ def _assign_chain_dp(dag: 'dag_lib.Dag',
             # est_hours * $/h (total $); otherwise egress edges are left
             # unweighted rather than summing $/h with $.
             own = cand.sort_key(target)[0]
-            has_est = cand.est_time_s is not None
-            if target == OptimizeTarget.COST and has_est:
+            if use_total_cost:
                 own = cand.cost_per_hour * cand.est_time_s / 3600.0
             if i == 0:
                 row.append((own, None))
@@ -250,7 +255,7 @@ def _assign_chain_dp(dag: 'dag_lib.Dag',
                     # transfer seconds for TIME. PERF_PER_DOLLAR (an hourly
                     # ratio) admits no coherent one-shot conversion, so its
                     # edges stay unweighted.
-                    if target == OptimizeTarget.COST and has_est:
+                    if use_total_cost:
                         egress = egress_usd
                     elif target == OptimizeTarget.TIME:
                         if egress_usd > 0:

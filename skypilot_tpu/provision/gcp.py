@@ -1,0 +1,326 @@
+"""GCP provisioner: TPU-VM slices (first-class) + GCE VMs (controllers).
+
+TPU-native design vs reference (sky/provision/gcp/instance_utils.py:1191):
+a *slice* provisions as ONE tpu.googleapis.com node (all hosts atomic — the
+gang is the slice), via queued resources for v5e/v5p/v6e capacity; per-host
+IPs come from the node's ``networkEndpoints`` in stable worker order, which
+directly defines SKYTPU_HOST_RANK (no runtime discovery, contrast reference
+``num_ips_per_node`` cloud_vm_ray_backend.py:2588-2596).
+
+Cluster→(project, zone, node) bookkeeping lives in the client state kv
+(the reference persists the same in cluster YAML files).
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import authentication
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import provision as provision_lib
+from skypilot_tpu.provision import gcp_api
+from skypilot_tpu.utils import command_runner as runner_lib
+
+_LABEL = 'skytpu-cluster'
+
+_TPU_STATE_MAP = {
+    'CREATING': 'pending', 'STARTING': 'pending', 'RESTARTING': 'pending',
+    'REPAIRING': 'pending', 'READY': 'running', 'STOPPING': 'stopping',
+    'STOPPED': 'stopped', 'DELETING': 'terminating', 'PREEMPTED': 'preempted',
+    'TERMINATED': 'terminated',
+}
+_GCE_STATE_MAP = {
+    'PROVISIONING': 'pending', 'STAGING': 'pending', 'RUNNING': 'running',
+    'STOPPING': 'stopping', 'TERMINATED': 'stopped', 'SUSPENDED': 'stopped',
+}
+
+
+# ---- cluster record --------------------------------------------------------
+def _record_key(cluster_name: str) -> str:
+    return f'gcp_cluster/{cluster_name}'
+
+
+def _save_record(cluster_name: str, record: Dict[str, Any]) -> None:
+    global_user_state.set_kv(_record_key(cluster_name), json.dumps(record))
+
+
+def _load_record(cluster_name: str) -> Optional[Dict[str, Any]]:
+    raw = global_user_state.get_kv(_record_key(cluster_name))
+    return json.loads(raw) if raw else None
+
+
+def _delete_record(cluster_name: str) -> None:
+    global_user_state.set_kv(_record_key(cluster_name), '')
+
+
+# ---- provision API ---------------------------------------------------------
+def run_instances(cluster_name: str, region: str, zone: Optional[str],
+                  num_hosts: int, deploy_vars: Dict[str, Any]) -> None:
+    assert zone is not None, 'GCP provisioning is zonal'
+    project = deploy_vars['project_id']
+    mode = deploy_vars.get('mode', 'tpu_vm')
+    name = deploy_vars['cluster_name_on_cloud']
+    record = {'project': project, 'zone': zone, 'mode': mode,
+              'name_on_cloud': name, 'num_hosts': num_hosts,
+              'deploy_vars': deploy_vars}
+    if mode == 'tpu_vm':
+        _run_tpu_node(project, zone, name, deploy_vars)
+    else:
+        _run_gce_instances(project, zone, name, num_hosts, deploy_vars)
+    _save_record(cluster_name, record)
+
+
+def _tpu_node_body(name: str, deploy_vars: Dict[str, Any]) -> Dict[str, Any]:
+    labels = dict(deploy_vars.get('labels') or {})
+    labels[_LABEL] = name
+    body: Dict[str, Any] = {
+        'acceleratorType': deploy_vars['accelerator_type'],
+        'runtimeVersion': deploy_vars['runtime_version'],
+        'networkConfig': {'enableExternalIps': True},
+        'labels': labels,
+        'metadata': {'ssh-keys': authentication.gcp_ssh_keys_metadata()},
+        'schedulingConfig': {
+            'preemptible': bool(deploy_vars.get('use_spot')),
+            'reserved': bool(deploy_vars.get('reserved')),
+        },
+    }
+    return body
+
+
+def _run_tpu_node(project: str, zone: str, name: str,
+                  deploy_vars: Dict[str, Any]) -> None:
+    tpu = gcp_api.TpuClient(project)
+    node = tpu.get_node(zone, name)
+    if node is not None:
+        state = node.get('state')
+        if state in ('READY', 'CREATING', 'STARTING', 'RESTARTING'):
+            return  # idempotent
+        if state == 'STOPPED':
+            op = tpu.start_node(zone, name)
+            tpu.wait_operation(op)
+            return
+        raise exceptions.CloudError(
+            f'TPU node {name} in unexpected state {state}')
+    if deploy_vars.get('use_queued_resources'):
+        qr_body = {
+            'tpu': {'nodeSpec': [{
+                'parent': f'projects/{project}/locations/{zone}',
+                'nodeId': name,
+                'node': _tpu_node_body(name, deploy_vars),
+            }]},
+        }
+        if deploy_vars.get('use_spot'):
+            qr_body['spot'] = {}
+        elif not deploy_vars.get('reserved'):
+            qr_body['guaranteed'] = {}
+        tpu.create_queued_resource(zone, name, qr_body)
+        _wait_queued_resource(tpu, zone, name)
+    else:
+        op = tpu.create_node(zone, name, _tpu_node_body(name, deploy_vars))
+        tpu.wait_operation(op)
+
+
+def _wait_queued_resource(tpu: gcp_api.TpuClient, zone: str, qr_id: str,
+                          timeout: float = 1800) -> None:
+    """Queued resources either become ACTIVE (node exists) or fail; FAILED /
+    long-SUSPENDED is classified as capacity so failover moves on."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        qr = tpu.get_queued_resource(zone, qr_id)
+        if qr is None:
+            raise exceptions.CloudError(f'queued resource {qr_id} vanished')
+        state = (qr.get('state') or {}).get('state', 'UNKNOWN')
+        if state == 'ACTIVE':
+            return
+        if state in ('FAILED', 'SUSPENDED'):
+            tpu.delete_queued_resource(zone, qr_id)
+            raise exceptions.InsufficientCapacityError(
+                f'queued resource {qr_id} {state.lower()} in {zone} '
+                '(no TPU capacity)', reason='capacity')
+        time.sleep(10)
+    tpu.delete_queued_resource(zone, qr_id)
+    raise exceptions.InsufficientCapacityError(
+        f'queued resource {qr_id} not granted within {timeout}s',
+        reason='capacity')
+
+
+def _run_gce_instances(project: str, zone: str, name: str, num_hosts: int,
+                       deploy_vars: Dict[str, Any]) -> None:
+    gce = gcp_api.GceClient(project)
+    existing = {i['name']: i for i in gce.list_instances(
+        zone, label_filter=f'labels.{_LABEL}={name}')}
+    machine = deploy_vars.get('instance_type', 'n2-standard-8')
+    image = deploy_vars.get('image_family', 'ubuntu-2204-lts')
+    for rank in range(num_hosts):
+        iname = f'{name}-{rank}'
+        inst = existing.get(iname)
+        if inst is not None:
+            if inst.get('status') == 'TERMINATED':
+                op = gce.start(zone, iname)
+                gce.wait_zone_operation(zone, op)
+            continue
+        body = {
+            'name': iname,
+            'machineType': f'zones/{zone}/machineTypes/{machine}',
+            'labels': {_LABEL: name, 'skytpu-rank': str(rank)},
+            'disks': [{
+                'boot': True,
+                'initializeParams': {
+                    'sourceImage':
+                        f'projects/ubuntu-os-cloud/global/images/family/{image}',
+                    'diskSizeGb': deploy_vars.get('disk_size_gb', 256),
+                },
+                'autoDelete': True,
+            }],
+            'networkInterfaces': [{
+                'network': 'global/networks/default',
+                'accessConfigs': [{'type': 'ONE_TO_ONE_NAT'}],
+            }],
+            'metadata': {'items': [{
+                'key': 'ssh-keys',
+                'value': authentication.gcp_ssh_keys_metadata(),
+            }]},
+            'scheduling': {
+                'preemptible': bool(deploy_vars.get('use_spot'))},
+        }
+        op = gce.insert(zone, body)
+        gce.wait_zone_operation(zone, op)
+
+
+def _require_record(cluster_name: str) -> Dict[str, Any]:
+    record = _load_record(cluster_name)
+    if not record:
+        raise exceptions.ClusterError(
+            f'No GCP provisioning record for {cluster_name!r}')
+    return record
+
+
+def wait_instances(cluster_name: str, region: str, state: str = 'running',
+                   timeout: float = 1800) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        states = set(query_instances(cluster_name, region).values())
+        if states == {state}:
+            return
+        if 'preempted' in states or 'terminated' in states:
+            raise exceptions.InsufficientCapacityError(
+                f'{cluster_name}: host(s) preempted/terminated while '
+                f'waiting for {state}', reason='capacity')
+        time.sleep(10)
+    raise exceptions.ProvisionError(
+        f'{cluster_name} did not reach {state!r} within {timeout}s')
+
+
+def query_instances(cluster_name: str, region: str) -> Dict[str, str]:
+    record = _load_record(cluster_name)
+    if not record:
+        return {}
+    project, zone = record['project'], record['zone']
+    name = record['name_on_cloud']
+    if record['mode'] == 'tpu_vm':
+        node = gcp_api.TpuClient(project).get_node(zone, name)
+        if node is None:
+            return {}
+        mapped = _TPU_STATE_MAP.get(node.get('state', ''), 'unknown')
+        n = record['num_hosts']
+        return {f'{name}-w{r}': mapped for r in range(n)}
+    gce = gcp_api.GceClient(project)
+    out = {}
+    for inst in gce.list_instances(zone,
+                                   label_filter=f'labels.{_LABEL}={name}'):
+        out[inst['name']] = _GCE_STATE_MAP.get(inst.get('status', ''),
+                                               'unknown')
+    return out
+
+
+def stop_instances(cluster_name: str, region: str) -> None:
+    record = _require_record(cluster_name)
+    project, zone = record['project'], record['zone']
+    name = record['name_on_cloud']
+    if record['mode'] == 'tpu_vm':
+        tpu = gcp_api.TpuClient(project)
+        op = tpu.stop_node(zone, name)
+        tpu.wait_operation(op)
+    else:
+        gce = gcp_api.GceClient(project)
+        for rank in range(record['num_hosts']):
+            gce.wait_zone_operation(zone, gce.stop(zone, f'{name}-{rank}'))
+
+
+def terminate_instances(cluster_name: str, region: str) -> None:
+    record = _load_record(cluster_name)
+    if not record:
+        return
+    project, zone = record['project'], record['zone']
+    name = record['name_on_cloud']
+    if record['mode'] == 'tpu_vm':
+        tpu = gcp_api.TpuClient(project)
+        if record['deploy_vars'].get('use_queued_resources'):
+            tpu.delete_queued_resource(zone, name)
+        op = tpu.delete_node(zone, name)
+        tpu.wait_operation(op)
+    else:
+        gce = gcp_api.GceClient(project)
+        for rank in range(record['num_hosts']):
+            gce.wait_zone_operation(zone, gce.delete(zone, f'{name}-{rank}'))
+    _delete_record(cluster_name)
+
+
+def get_cluster_info(cluster_name: str, region: str
+                     ) -> provision_lib.ClusterInfo:
+    record = _require_record(cluster_name)
+    project, zone = record['project'], record['zone']
+    name = record['name_on_cloud']
+    hosts: List[provision_lib.HostInfo] = []
+    if record['mode'] == 'tpu_vm':
+        node = gcp_api.TpuClient(project).get_node(zone, name)
+        if node is None:
+            raise exceptions.ClusterError(f'TPU node {name} not found')
+        # networkEndpoints is in worker order: index == SKYTPU_HOST_RANK.
+        for rank, ep in enumerate(node.get('networkEndpoints', [])):
+            hosts.append(provision_lib.HostInfo(
+                host_id=f'{name}-w{rank}', rank=rank,
+                internal_ip=ep.get('ipAddress', ''),
+                external_ip=(ep.get('accessConfig') or {}).get(
+                    'externalIp'),
+                extra={'node': name}))
+    else:
+        insts = gcp_api.GceClient(project).list_instances(
+            zone, label_filter=f'labels.{_LABEL}={name}')
+        insts.sort(key=lambda i: int(
+            (i.get('labels') or {}).get('skytpu-rank', 0)))
+        for rank, inst in enumerate(insts):
+            nic = (inst.get('networkInterfaces') or [{}])[0]
+            access = (nic.get('accessConfigs') or [{}])[0]
+            hosts.append(provision_lib.HostInfo(
+                host_id=inst['name'], rank=rank,
+                internal_ip=nic.get('networkIP', ''),
+                external_ip=access.get('natIP'),
+                extra={}))
+    return provision_lib.ClusterInfo(
+        cluster_name=cluster_name, cloud='gcp', region=region, zone=zone,
+        hosts=hosts, deploy_vars=record['deploy_vars'])
+
+
+def open_ports(cluster_name: str, region: str, ports: List[str]) -> None:
+    # Firewall-rule management arrives with the serving layer; default VPC
+    # already allows SSH (reference provision/gcp/config.py handles full
+    # VPC bootstrap).
+    return
+
+
+def get_command_runners(cluster_info: provision_lib.ClusterInfo,
+                        ssh_credentials: Optional[Dict[str, str]] = None
+                        ) -> List[runner_lib.CommandRunner]:
+    creds = ssh_credentials or {}
+    key_path = creds.get('key_path')
+    if key_path is None:
+        key_path, _ = authentication.get_or_generate_keys()
+    user = creds.get('user', authentication.SSH_USER)
+    runners: List[runner_lib.CommandRunner] = []
+    for h in cluster_info.hosts:
+        ip = h.external_ip or h.internal_ip
+        runners.append(runner_lib.SSHCommandRunner(ip, user, key_path))
+    return runners

@@ -1,0 +1,247 @@
+"""Thin GCP REST clients: TPU API (tpu.googleapis.com v2) + GCE.
+
+The reference drives these through google-api-python-client discovery
+(reference sky/provision/gcp/instance_utils.py:1203-1209); here a direct
+``requests`` transport keeps the dependency surface tiny and — more
+importantly — gives tests a single seam (``set_transport``) to fake the
+whole cloud, including TPU state machines and capacity errors
+(reference's tests mock at the boto3/discovery level instead, SURVEY.md §4).
+
+Error classification (→ failover behavior) mirrors the reference's GCP
+handler (sky/backends/cloud_vm_ray_backend.py:950-1105):
+  - "no more capacity" / RESOURCE_EXHAUSTED / stockout → blocklist zone
+  - quota exceeded / permission → blocklist region/cloud
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+from skypilot_tpu import exceptions
+
+TPU_BASE = 'https://tpu.googleapis.com/v2'
+GCE_BASE = 'https://compute.googleapis.com/compute/v1'
+
+_CAPACITY_MARKERS = (
+    'no more capacity',                 # TPU stockout (reference :1019)
+    'out of capacity',
+    'resource_exhausted',
+    'stockout',
+    'does not have enough resources',
+    'zonal_resource_pool_exhausted',
+    'insufficient capacity',
+)
+_QUOTA_MARKERS = ('quota', 'rate limit')
+
+
+class HttpTransport:
+    """Real transport: requests + google-auth token."""
+
+    def __init__(self):
+        self._session = None
+        self._creds = None
+
+    def _ensure(self):
+        import google.auth
+        import google.auth.transport.requests
+        import requests
+        if self._session is None:
+            self._session = requests.Session()
+            self._creds, _ = google.auth.default(
+                scopes=['https://www.googleapis.com/auth/cloud-platform'])
+        if not self._creds.valid:
+            self._creds.refresh(
+                google.auth.transport.requests.Request(self._session))
+
+    def request(self, method: str, url: str,
+                json_body: Optional[Dict[str, Any]] = None,
+                params: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        self._ensure()
+        resp = self._session.request(
+            method, url, json=json_body, params=params,
+            headers={'Authorization': f'Bearer {self._creds.token}'},
+            timeout=60)
+        if resp.status_code >= 400:
+            try:
+                payload = resp.json().get('error', {})
+                message = payload.get('message', resp.text)
+            except Exception:
+                message = resp.text
+            raise classify_error(resp.status_code, message)
+        return resp.json() if resp.content else {}
+
+
+_transport: Any = None
+
+
+def get_transport() -> Any:
+    global _transport
+    if _transport is None:
+        _transport = HttpTransport()
+    return _transport
+
+
+def set_transport(transport: Any) -> None:
+    """Test seam: install a fake cloud."""
+    global _transport
+    _transport = transport
+
+
+def classify_error(code: int, message: str) -> exceptions.CloudError:
+    low = (message or '').lower()
+    if any(m in low for m in _CAPACITY_MARKERS) or code == 429:
+        return exceptions.InsufficientCapacityError(message, code=code,
+                                                    reason='capacity')
+    if any(m in low for m in _QUOTA_MARKERS) or code == 403:
+        return exceptions.CloudError(message, code=code, reason='quota')
+    return exceptions.CloudError(message, code=code)
+
+
+class TpuClient:
+    """projects.locations.nodes + queuedResources of tpu.googleapis.com."""
+
+    def __init__(self, project: str):
+        self.project = project
+
+    def _loc(self, zone: str) -> str:
+        return f'{TPU_BASE}/projects/{self.project}/locations/{zone}'
+
+    # -- nodes ---------------------------------------------------------------
+    def create_node(self, zone: str, node_id: str,
+                    body: Dict[str, Any]) -> Dict[str, Any]:
+        return get_transport().request(
+            'POST', f'{self._loc(zone)}/nodes', json_body=body,
+            params={'nodeId': node_id})
+
+    def get_node(self, zone: str, node_id: str) -> Optional[Dict[str, Any]]:
+        try:
+            return get_transport().request(
+                'GET', f'{self._loc(zone)}/nodes/{node_id}')
+        except exceptions.CloudError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def list_nodes(self, zone: str) -> List[Dict[str, Any]]:
+        out = get_transport().request('GET', f'{self._loc(zone)}/nodes')
+        return out.get('nodes', [])
+
+    def delete_node(self, zone: str, node_id: str) -> Dict[str, Any]:
+        try:
+            return get_transport().request(
+                'DELETE', f'{self._loc(zone)}/nodes/{node_id}')
+        except exceptions.CloudError as e:
+            if e.code == 404:
+                return {}
+            raise
+
+    def stop_node(self, zone: str, node_id: str) -> Dict[str, Any]:
+        return get_transport().request(
+            'POST', f'{self._loc(zone)}/nodes/{node_id}:stop')
+
+    def start_node(self, zone: str, node_id: str) -> Dict[str, Any]:
+        return get_transport().request(
+            'POST', f'{self._loc(zone)}/nodes/{node_id}:start')
+
+    # -- queued resources (v5p/v6e capacity; reference uses these for
+    # gang-atomic multi-host slices) -----------------------------------------
+    def create_queued_resource(self, zone: str, qr_id: str,
+                               body: Dict[str, Any]) -> Dict[str, Any]:
+        return get_transport().request(
+            'POST', f'{self._loc(zone)}/queuedResources', json_body=body,
+            params={'queuedResourceId': qr_id})
+
+    def get_queued_resource(self, zone: str,
+                            qr_id: str) -> Optional[Dict[str, Any]]:
+        try:
+            return get_transport().request(
+                'GET', f'{self._loc(zone)}/queuedResources/{qr_id}')
+        except exceptions.CloudError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def delete_queued_resource(self, zone: str, qr_id: str) -> None:
+        try:
+            get_transport().request(
+                'DELETE', f'{self._loc(zone)}/queuedResources/{qr_id}',
+                params={'force': 'true'})
+        except exceptions.CloudError as e:
+            if e.code != 404:
+                raise
+
+    # -- operations ----------------------------------------------------------
+    def wait_operation(self, op: Dict[str, Any],
+                       timeout: float = 1800) -> Dict[str, Any]:
+        if not op or op.get('done') or 'name' not in op:
+            return op
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            cur = get_transport().request('GET', f'{TPU_BASE}/{op["name"]}')
+            if cur.get('done'):
+                err = cur.get('error')
+                if err:
+                    raise classify_error(err.get('code', 500),
+                                         err.get('message', str(err)))
+                return cur
+            time.sleep(5)
+        raise exceptions.ProvisionError(
+            f'GCP operation {op.get("name")} timed out after {timeout}s')
+
+
+class GceClient:
+    """Minimal GCE instances API (controller/CPU VMs)."""
+
+    def __init__(self, project: str):
+        self.project = project
+
+    def _zone_url(self, zone: str) -> str:
+        return f'{GCE_BASE}/projects/{self.project}/zones/{zone}'
+
+    def insert(self, zone: str, body: Dict[str, Any]) -> Dict[str, Any]:
+        return get_transport().request(
+            'POST', f'{self._zone_url(zone)}/instances', json_body=body)
+
+    def list_instances(self, zone: str,
+                       label_filter: Optional[str] = None
+                       ) -> List[Dict[str, Any]]:
+        params = {}
+        if label_filter:
+            params['filter'] = label_filter
+        out = get_transport().request(
+            'GET', f'{self._zone_url(zone)}/instances', params=params)
+        return out.get('items', [])
+
+    def delete(self, zone: str, name: str) -> Dict[str, Any]:
+        try:
+            return get_transport().request(
+                'DELETE', f'{self._zone_url(zone)}/instances/{name}')
+        except exceptions.CloudError as e:
+            if e.code == 404:
+                return {}
+            raise
+
+    def stop(self, zone: str, name: str) -> Dict[str, Any]:
+        return get_transport().request(
+            'POST', f'{self._zone_url(zone)}/instances/{name}/stop')
+
+    def start(self, zone: str, name: str) -> Dict[str, Any]:
+        return get_transport().request(
+            'POST', f'{self._zone_url(zone)}/instances/{name}/start')
+
+    def wait_zone_operation(self, zone: str, op: Dict[str, Any],
+                            timeout: float = 600) -> None:
+        if not op or op.get('status') == 'DONE' or 'name' not in op:
+            return
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            cur = get_transport().request(
+                'GET', f'{self._zone_url(zone)}/operations/{op["name"]}')
+            if cur.get('status') == 'DONE':
+                if cur.get('error'):
+                    errs = cur['error'].get('errors', [])
+                    msg = '; '.join(e.get('message', '') for e in errs)
+                    raise classify_error(500, msg)
+                return
+            time.sleep(2)
+        raise exceptions.ProvisionError('GCE operation timed out')

@@ -172,8 +172,13 @@ class Agent:
     # -- events --------------------------------------------------------------
     def _schedule_jobs(self) -> None:
         job = job_lib.next_pending_job(self.runtime_dir)
-        if job is None:
+        if job is None or job['job_id'] in self.drivers:
             return
+        # Mark SETTING_UP synchronously BEFORE the driver thread starts:
+        # otherwise the next tick can re-pop the same PENDING job and run it
+        # twice (the driver's RUNNING update races the tick).
+        job_lib.set_status(self.runtime_dir, job['job_id'],
+                           job_lib.JobStatus.SETTING_UP)
         driver = JobDriver(self, job)
         self.drivers[job['job_id']] = driver
         driver.start()

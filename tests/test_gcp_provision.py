@@ -1,0 +1,323 @@
+"""GCP provisioner tests against an in-process fake of the TPU/GCE APIs.
+
+The fake implements the same REST surface the real transport hits
+(tpu.googleapis.com v2 nodes + queuedResources, compute.googleapis.com
+instances), including TPU state machines and per-zone capacity errors —
+so failover and lifecycle logic run for real with no cloud.
+"""
+import re
+from urllib.parse import urlparse
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import exceptions
+from skypilot_tpu.backends.slice_backend import RetryingProvisioner
+from skypilot_tpu.provision import gcp as gcp_provision
+from skypilot_tpu.provision import gcp_api
+
+
+class FakeGcpCloud:
+    """In-memory TPU + GCE control plane."""
+
+    def __init__(self):
+        self.tpu_nodes = {}       # (zone, id) -> node dict
+        self.queued = {}          # (zone, id) -> qr dict
+        self.gce = {}             # (zone, name) -> instance dict
+        self.fail_zones = set()   # zones with no TPU capacity
+        self.create_calls = []
+
+    # -- transport interface -------------------------------------------------
+    def request(self, method, url, json_body=None, params=None):
+        params = params or {}
+        path = urlparse(url).path
+        m = re.search(r'/locations/([^/]+)/nodes(?:/([^/:]+))?(?::(\w+))?$',
+                      path)
+        if m:
+            return self._nodes(method, m.group(1), m.group(2), m.group(3),
+                               json_body, params)
+        m = re.search(r'/locations/([^/]+)/queuedResources(?:/([^/]+))?$',
+                      path)
+        if m:
+            return self._queued(method, m.group(1), m.group(2), json_body,
+                                params)
+        m = re.search(r'/zones/([^/]+)/instances(?:/([^/]+))?(?:/(\w+))?$',
+                      path)
+        if m:
+            return self._gce(method, m.group(1), m.group(2), m.group(3),
+                             json_body, params)
+        raise AssertionError(f'fake: unhandled {method} {url}')
+
+    # -- TPU nodes -----------------------------------------------------------
+    def _make_node(self, zone, node_id, body):
+        n_hosts = {'v5litepod-16': 2, 'v5litepod-8': 1, 'v4-16': 2,
+                   'v5p-16': 2}.get(body['acceleratorType'], 1)
+        node = dict(body)
+        node.update({
+            'name': f'projects/p/locations/{zone}/nodes/{node_id}',
+            'state': 'READY',
+            'networkEndpoints': [
+                {'ipAddress': f'10.0.{len(self.tpu_nodes)}.{r}',
+                 'accessConfig': {'externalIp': f'34.1.{len(self.tpu_nodes)}.{r}'}}
+                for r in range(n_hosts)
+            ],
+        })
+        self.tpu_nodes[(zone, node_id)] = node
+        return node
+
+    def _nodes(self, method, zone, node_id, verb, body, params):
+        if method == 'POST' and node_id is None:
+            node_id = params['nodeId']
+            self.create_calls.append((zone, node_id))
+            if zone in self.fail_zones:
+                raise gcp_api.classify_error(
+                    429, f'There is no more capacity in the zone "{zone}"')
+            self._make_node(zone, node_id, body)
+            return {'name': f'projects/p/locations/{zone}/operations/op1',
+                    'done': True}
+        key = (zone, node_id)
+        if method == 'GET' and node_id:
+            node = self.tpu_nodes.get(key)
+            if node is None:
+                raise gcp_api.classify_error(404, 'not found')
+            return node
+        if method == 'GET':
+            return {'nodes': [n for (z, _), n in self.tpu_nodes.items()
+                              if z == zone]}
+        if method == 'DELETE':
+            if key not in self.tpu_nodes:
+                raise gcp_api.classify_error(404, 'not found')
+            del self.tpu_nodes[key]
+            return {'done': True}
+        if verb == 'stop':
+            self.tpu_nodes[key]['state'] = 'STOPPED'
+            return {'done': True}
+        if verb == 'start':
+            self.tpu_nodes[key]['state'] = 'READY'
+            return {'done': True}
+        raise AssertionError(f'fake nodes: {method} {verb}')
+
+    # -- queued resources ----------------------------------------------------
+    def _queued(self, method, zone, qr_id, body, params):
+        if method == 'POST':
+            qr_id = params['queuedResourceId']
+            if zone in self.fail_zones:
+                qr = {'state': {'state': 'FAILED'}}
+            else:
+                spec = body['tpu']['nodeSpec'][0]
+                self._make_node(zone, spec['nodeId'], spec['node'])
+                qr = {'state': {'state': 'ACTIVE'}}
+            self.queued[(zone, qr_id)] = qr
+            return qr
+        if method == 'GET':
+            qr = self.queued.get((zone, qr_id))
+            if qr is None:
+                raise gcp_api.classify_error(404, 'not found')
+            return qr
+        if method == 'DELETE':
+            self.queued.pop((zone, qr_id), None)
+            return {}
+        raise AssertionError('fake queued')
+
+    # -- GCE -----------------------------------------------------------------
+    def _gce(self, method, zone, name, verb, body, params):
+        if method == 'POST' and name is None:
+            inst = dict(body)
+            inst['status'] = 'RUNNING'
+            inst['networkInterfaces'] = [{
+                'networkIP': f'10.1.0.{len(self.gce)}',
+                'accessConfigs': [{'natIP': f'35.0.0.{len(self.gce)}'}],
+            }]
+            self.gce[(zone, body['name'])] = inst
+            return {'status': 'DONE'}
+        if method == 'GET' and name is None:
+            flt = params.get('filter', '')
+            m = re.match(r'labels\.([\w-]+)=([\w-]+)', flt)
+            items = []
+            for (z, _), inst in self.gce.items():
+                if z != zone:
+                    continue
+                if m and (inst.get('labels') or {}).get(m.group(1)) \
+                        != m.group(2):
+                    continue
+                items.append(inst)
+            return {'items': items}
+        if verb == 'stop':
+            self.gce[(zone, name)]['status'] = 'TERMINATED'
+            return {'status': 'DONE'}
+        if verb == 'start':
+            self.gce[(zone, name)]['status'] = 'RUNNING'
+            return {'status': 'DONE'}
+        if method == 'DELETE':
+            self.gce.pop((zone, name), None)
+            return {'status': 'DONE'}
+        raise AssertionError(f'fake gce: {method} {name} {verb}')
+
+
+@pytest.fixture
+def fake_gcp(monkeypatch):
+    fake = FakeGcpCloud()
+    gcp_api.set_transport(fake)
+    monkeypatch.setattr(
+        'skypilot_tpu.authentication.gcp_ssh_keys_metadata',
+        lambda: 'skytpu:ssh-ed25519 AAAA test')
+    from skypilot_tpu.clouds import gcp as gcp_cloud
+    monkeypatch.setattr(gcp_cloud.GCP, 'get_project_id',
+                        classmethod(lambda cls: 'test-proj'))
+    yield fake
+    gcp_api.set_transport(None)
+
+
+def _deploy_vars(slice_name='tpu-v5e-16', use_qr=False, **over):
+    from skypilot_tpu import accelerators as accel_lib
+    s = accel_lib.TpuSlice.from_name(slice_name)
+    base = {
+        'cloud': 'gcp', 'project_id': 'test-proj',
+        'cluster_name_on_cloud': 'c-abc123', 'mode': 'tpu_vm',
+        'tpu_slice': s.name, 'accelerator_type': s.gcp_accelerator_type,
+        'runtime_version': 'v2-alpha-tpuv5-lite', 'num_hosts': s.num_hosts,
+        'chips_per_host': s.chips_per_host, 'generation': s.generation,
+        'use_queued_resources': use_qr, 'use_spot': False, 'reserved': False,
+        'labels': {},
+    }
+    base.update(over)
+    return base
+
+
+class TestTpuLifecycle:
+
+    def test_create_query_info_stop_start_terminate(self, fake_gcp):
+        dv = _deploy_vars()
+        gcp_provision.run_instances('c1', 'us-west4', 'us-west4-a', 2, dv)
+        gcp_provision.wait_instances('c1', 'us-west4', timeout=5)
+        states = gcp_provision.query_instances('c1', 'us-west4')
+        assert set(states.values()) == {'running'} and len(states) == 2
+
+        info = gcp_provision.get_cluster_info('c1', 'us-west4')
+        assert info.num_hosts == 2
+        assert [h.rank for h in info.hosts] == [0, 1]
+        assert info.head.internal_ip.startswith('10.0.')
+
+        gcp_provision.stop_instances('c1', 'us-west4')
+        assert set(gcp_provision.query_instances(
+            'c1', 'us-west4').values()) == {'stopped'}
+
+        # restart path: run_instances on a STOPPED node starts it
+        gcp_provision.run_instances('c1', 'us-west4', 'us-west4-a', 2, dv)
+        assert set(gcp_provision.query_instances(
+            'c1', 'us-west4').values()) == {'running'}
+
+        gcp_provision.terminate_instances('c1', 'us-west4')
+        assert gcp_provision.query_instances('c1', 'us-west4') == {}
+
+    def test_queued_resource_path(self, fake_gcp):
+        dv = _deploy_vars(use_qr=True)
+        gcp_provision.run_instances('c2', 'us-west4', 'us-west4-a', 2, dv)
+        assert ('us-west4-a', 'c-abc123') in fake_gcp.queued
+        info = gcp_provision.get_cluster_info('c2', 'us-west4')
+        assert info.num_hosts == 2
+
+    def test_capacity_error_classified(self, fake_gcp):
+        fake_gcp.fail_zones.add('us-west4-a')
+        with pytest.raises(exceptions.InsufficientCapacityError):
+            gcp_provision.run_instances('c3', 'us-west4', 'us-west4-a', 2,
+                                        _deploy_vars())
+
+    def test_qr_capacity_error(self, fake_gcp):
+        fake_gcp.fail_zones.add('us-west4-a')
+        with pytest.raises(exceptions.InsufficientCapacityError):
+            gcp_provision.run_instances('c4', 'us-west4', 'us-west4-a', 2,
+                                        _deploy_vars(use_qr=True))
+        # failed QR cleaned up
+        assert ('us-west4-a', 'c-abc123') not in fake_gcp.queued
+
+    def test_gce_mode(self, fake_gcp):
+        dv = {'cloud': 'gcp', 'project_id': 'test-proj',
+              'cluster_name_on_cloud': 'ctrl-1', 'mode': 'gce',
+              'instance_type': 'n2-standard-8', 'disk_size_gb': 128,
+              'use_spot': False, 'labels': {}}
+        gcp_provision.run_instances('ctrl', 'us-central1', 'us-central1-a',
+                                    2, dv)
+        info = gcp_provision.get_cluster_info('ctrl', 'us-central1')
+        assert info.num_hosts == 2
+        assert info.hosts[0].external_ip.startswith('35.')
+        gcp_provision.terminate_instances('ctrl', 'us-central1')
+        assert gcp_provision.query_instances('ctrl', 'us-central1') == {}
+
+
+class TestFailover:
+
+    def test_zone_failover_within_region(self, fake_gcp):
+        """Capacity error in first zone -> provisioner lands in second."""
+        task = sky.Task(run='echo x')
+        res = sky.Resources(accelerators='tpu-v2-8', cloud='gcp',
+                            region='us-central1')
+        task.set_resources([res])
+        task.best_resources = res
+        task.candidate_resources = [res]
+
+        from skypilot_tpu import catalog
+        zones = catalog.get_slice_zones(res.tpu, region='us-central1')
+        assert len(zones) >= 2, f'need 2+ zones for the test, got {zones}'
+        fake_gcp.fail_zones.add(zones[0])
+
+        launched, info = RetryingProvisioner().provision(task, 'fo-test')
+        assert launched.zone == zones[1]
+        assert info.num_hosts == 1
+        # first zone was attempted and rejected
+        assert fake_gcp.create_calls[0][0] == zones[0]
+
+    def test_cross_region_failover(self, fake_gcp):
+        """All zones of the first candidate region fail -> next candidate
+        region wins (the optimizer emits region-level candidates)."""
+        task = sky.Task(run='echo x')
+        r1 = sky.Resources(accelerators='tpu-v5e-16', cloud='gcp',
+                           region='us-west4')
+        r2 = sky.Resources(accelerators='tpu-v5e-16', cloud='gcp',
+                           region='us-central1')
+        task.set_resources([r1])
+        task.best_resources = r1
+        task.candidate_resources = [r1, r2]
+        from skypilot_tpu import catalog
+        for z in catalog.get_slice_zones(r1.tpu, region='us-west4'):
+            fake_gcp.fail_zones.add(z)
+        launched, info = RetryingProvisioner().provision(task, 'fo-region')
+        assert launched.region == 'us-central1'
+        assert info.num_hosts == 2
+
+    def test_all_zones_exhausted_raises_with_history(self, fake_gcp):
+        task = sky.Task(run='echo x')
+        res = sky.Resources(accelerators='tpu-v5e-8', cloud='gcp',
+                            region='us-west4')
+        task.set_resources([res])
+        task.best_resources = res
+        task.candidate_resources = [res]
+        from skypilot_tpu import catalog
+        for z in catalog.get_slice_zones(res.tpu, region='us-west4'):
+            fake_gcp.fail_zones.add(z)
+        with pytest.raises(exceptions.ResourcesUnavailableError) as ei:
+            RetryingProvisioner().provision(task, 'fo-fail')
+        assert any(isinstance(e, exceptions.InsufficientCapacityError)
+                   for e in ei.value.failover_history)
+
+
+class TestErrorClassification:
+
+    @pytest.mark.parametrize('code,msg,expected', [
+        (429, 'There is no more capacity in the zone', 'capacity'),
+        (500, 'ZONAL_RESOURCE_POOL_EXHAUSTED', 'capacity'),
+        (403, 'Quota exceeded for TPUS_PER_PROJECT', 'quota'),
+        (400, 'Invalid runtime version', None),
+    ])
+    def test_classify(self, code, msg, expected):
+        err = gcp_api.classify_error(code, msg)
+        if expected == 'capacity':
+            assert isinstance(err, exceptions.InsufficientCapacityError)
+        elif expected == 'quota':
+            assert err.reason == 'quota'
+            assert not isinstance(err,
+                                  exceptions.InsufficientCapacityError)
+        else:
+            assert isinstance(err, exceptions.CloudError)
+            assert not isinstance(err,
+                                  exceptions.InsufficientCapacityError)

@@ -115,6 +115,18 @@ class RetryingProvisioner:
             except exceptions.InsufficientCapacityError as e:
                 history.append(e)   # capacity: blocklist zone, try next
                 continue
+            except exceptions.ProvisionError as e:
+                # Partial creation (operation timeout, half-created group):
+                # tear down the attempt so the next zone starts clean, then
+                # keep failing over (reference teardown-on-failure loop,
+                # provision/provisioner.py:145-201).
+                history.append(e)
+                try:
+                    provision_lib.terminate_instances(
+                        cloud.NAME, cluster_name, region)
+                except Exception:
+                    pass
+                continue
             except exceptions.CloudError as e:
                 history.append(e)   # config/quota-ish: skip region
                 break

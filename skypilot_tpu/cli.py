@@ -283,6 +283,44 @@ def optimize(entrypoint, minimize):
         task, minimize=optimizer_lib.OptimizeTarget(minimize))
 
 
+@cli.group()
+def api():
+    """Manage the local API server."""
+
+
+@api.command('start')
+@click.option('--port', type=int, default=None)
+def api_start(port):
+    from skypilot_tpu.client import sdk
+    sdk.api_start(port=port)
+    click.echo(f'API server healthy at {sdk.server_url()}')
+
+
+@api.command('stop')
+def api_stop():
+    from skypilot_tpu.client import sdk
+    stopped = sdk.api_stop()
+    click.echo('API server stopped.' if stopped
+               else 'No API server pid file found.')
+
+
+@api.command('status')
+def api_status():
+    from skypilot_tpu.client import sdk
+    info = sdk.api_status()
+    if info is None:
+        click.echo(f'API server at {sdk.server_url()} is NOT reachable.')
+        sys.exit(1)
+    click.echo(f'API server at {sdk.server_url()}: {info["status"]}')
+
+
+@api.command('logs')
+@click.argument('request_id')
+def api_logs(request_id):
+    from skypilot_tpu.client import sdk
+    sdk.stream(request_id)
+
+
 def main():
     cli()
 

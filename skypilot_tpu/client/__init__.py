@@ -1,0 +1,1 @@
+"""Client SDK for the skytpu API server (see server/ package docstring)."""

@@ -1,0 +1,226 @@
+"""Async request SDK over the API server.
+
+Counterpart of reference ``sky/client/sdk.py`` (every call POSTs a payload
+and returns a request id :300; ``get``/``stream_and_get`` fetch results
+:1456-1512; local server autostart :1676-1786). stdlib http.client only.
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+from urllib.parse import urlparse
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+
+DEFAULT_SERVER_URL = 'http://127.0.0.1:46580'
+
+
+def server_url() -> str:
+    return os.environ.get('SKYTPU_API_SERVER_URL', DEFAULT_SERVER_URL)
+
+
+def _conn() -> http.client.HTTPConnection:
+    parsed = urlparse(server_url())
+    return http.client.HTTPConnection(parsed.hostname,
+                                      parsed.port or 80, timeout=3700)
+
+
+def _call(method: str, path: str,
+          body: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    conn = _conn()
+    try:
+        payload = json.dumps(body).encode() if body is not None else None
+        headers = {'Content-Type': 'application/json'} if payload else {}
+        conn.request(method, path, body=payload, headers=headers)
+        resp = conn.getresponse()
+        data = json.loads(resp.read() or b'{}')
+        if resp.status >= 400:
+            raise exceptions.ApiServerConnectionError(
+                f'{method} {path}: {resp.status} {data.get("error")}')
+        return data
+    except (ConnectionRefusedError, OSError) as e:
+        raise exceptions.ApiServerConnectionError(
+            f'Cannot reach API server at {server_url()}: {e}. '
+            'Run `skytpu api start` (or python -m '
+            'skypilot_tpu.server.server).') from e
+    finally:
+        conn.close()
+
+
+# ---- async request API -----------------------------------------------------
+def submit(op: str, payload: Dict[str, Any]) -> str:
+    return _call('POST', f'/api/v1/{op}', payload)['request_id']
+
+
+def get(request_id: str, timeout_s: float = 3600) -> Any:
+    out = _call('GET',
+                f'/api/v1/get?request_id={request_id}'
+                f'&timeout_s={timeout_s}')
+    if out['status'] == 'FAILED':
+        raise exceptions.SkyTpuError(
+            f'Request {request_id} failed: {out.get("error")}')
+    if out['status'] == 'CANCELLED':
+        raise exceptions.RequestCancelled(f'Request {request_id} cancelled')
+    if out.get('error') == 'timeout':
+        raise TimeoutError(f'Request {request_id} still '
+                           f'{out["status"]} after {timeout_s}s')
+    return out['result']
+
+
+def stream(request_id: str, out=None) -> None:
+    """Stream the request's log to ``out`` until it finishes."""
+    out = out or sys.stdout
+    conn = _conn()
+    try:
+        conn.request('GET', f'/api/v1/stream?request_id={request_id}')
+        resp = conn.getresponse()
+        while True:
+            data = resp.read(4096)
+            if not data:
+                break
+            out.write(data.decode(errors='replace'))
+            out.flush()
+    finally:
+        conn.close()
+
+
+def stream_and_get(request_id: str, out=None) -> Any:
+    stream(request_id, out)
+    return get(request_id)
+
+
+def api_cancel(request_id: str) -> bool:
+    return _call('POST', '/api/v1/requests/cancel',
+                 {'request_id': request_id})['cancelled']
+
+
+def api_requests() -> List[Dict[str, Any]]:
+    return _call('GET', '/api/v1/requests')['requests']
+
+
+# ---- op wrappers (async: return request ids) -------------------------------
+def launch(task, cluster_name: str, **kwargs) -> str:
+    payload = {'task': task.to_yaml_config(), 'cluster_name': cluster_name}
+    payload.update(kwargs)
+    return submit('launch', payload)
+
+
+def exec_(task, cluster_name: str, **kwargs) -> str:
+    payload = {'task': task.to_yaml_config(), 'cluster_name': cluster_name}
+    payload.update(kwargs)
+    return submit('exec', payload)
+
+
+def status(cluster_names: Optional[List[str]] = None,
+           refresh: bool = True) -> str:
+    return submit('status', {'cluster_names': cluster_names,
+                             'refresh': refresh})
+
+
+def start(cluster_name: str) -> str:
+    return submit('start', {'cluster_name': cluster_name})
+
+
+def stop(cluster_name: str) -> str:
+    return submit('stop', {'cluster_name': cluster_name})
+
+
+def down(cluster_name: str) -> str:
+    return submit('down', {'cluster_name': cluster_name})
+
+
+def autostop(cluster_name: str, idle_minutes: int,
+             down_on_idle: bool = False) -> str:
+    return submit('autostop', {'cluster_name': cluster_name,
+                               'idle_minutes': idle_minutes,
+                               'down_on_idle': down_on_idle})
+
+
+def queue(cluster_name: str) -> str:
+    return submit('queue', {'cluster_name': cluster_name})
+
+
+def cancel(cluster_name: str, job_ids: Optional[List[int]] = None,
+           all_jobs: bool = False) -> str:
+    return submit('cancel', {'cluster_name': cluster_name,
+                             'job_ids': job_ids, 'all_jobs': all_jobs})
+
+
+def tail_logs(cluster_name: str, job_id: Optional[int] = None,
+              follow: bool = True) -> str:
+    return submit('tail_logs', {'cluster_name': cluster_name,
+                                'job_id': job_id, 'follow': follow})
+
+
+def check() -> str:
+    return submit('check', {})
+
+
+def cost_report() -> str:
+    return submit('cost_report', {})
+
+
+# ---- local server management ----------------------------------------------
+def _server_pid_file() -> str:
+    return os.path.join(global_user_state.get_state_dir(), 'server',
+                        'server.pid')
+
+
+def api_status() -> Optional[Dict[str, Any]]:
+    try:
+        return _call('GET', '/healthz')
+    except exceptions.ApiServerConnectionError:
+        return None
+
+
+def api_start(port: Optional[int] = None, wait: float = 10.0) -> None:
+    """Start a local API server in the background if not already up.
+
+    A non-default ``port`` retargets this process' server_url() too (via
+    SKYTPU_API_SERVER_URL) so the health check and subsequent SDK calls hit
+    the server actually started.
+    """
+    if port is not None:
+        os.environ['SKYTPU_API_SERVER_URL'] = f'http://127.0.0.1:{port}'
+    if api_status() is not None:
+        return
+    if port is None:
+        port = urlparse(server_url()).port or 46580
+    log_dir = os.path.join(global_user_state.get_state_dir(), 'server')
+    os.makedirs(log_dir, exist_ok=True)
+    with open(os.path.join(log_dir, 'server.log'), 'ab') as log:
+        proc = subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.server.server',
+             '--port', str(port)],
+            stdout=log, stderr=log, start_new_session=True)
+    os.makedirs(os.path.dirname(_server_pid_file()), exist_ok=True)
+    with open(_server_pid_file(), 'w') as f:
+        f.write(str(proc.pid))
+    deadline = time.time() + wait
+    while time.time() < deadline:
+        if api_status() is not None:
+            return
+        time.sleep(0.2)
+    raise exceptions.ApiServerConnectionError(
+        f'API server did not come up on port {port} within {wait}s')
+
+
+def api_stop() -> bool:
+    try:
+        with open(_server_pid_file()) as f:
+            pid = int(f.read().strip())
+    except (FileNotFoundError, ValueError):
+        return False
+    import signal
+    try:
+        os.killpg(os.getpgid(pid), signal.SIGTERM)
+    except ProcessLookupError:
+        return False
+    os.remove(_server_pid_file())
+    return True

@@ -1,0 +1,10 @@
+"""Client/server API layer.
+
+Counterpart of reference ``sky/server/`` (FastAPI app server.py:169-1100,
+request executor requests/executor.py). This environment bakes no
+FastAPI/uvicorn, so the server is stdlib: a ThreadingHTTPServer router over
+the same architecture — every op POSTs a payload, a sqlite-backed request
+table records it, a bounded worker pool executes each request in a separate
+*process* (isolation + parallel launches), and clients block on
+``/api/get`` or stream logs from ``/api/stream``.
+"""

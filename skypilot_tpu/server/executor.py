@@ -1,0 +1,241 @@
+"""Request executor: bounded worker pools running requests in processes.
+
+Counterpart of reference ``sky/server/requests/executor.py`` (per-type
+worker pools :84-111, _request_execution_wrapper :329). Each request runs
+in a forked process with stdout/stderr redirected to the request's log
+file; the process writes its own result row, so a crashed worker can't
+leave a RUNNING row behind unnoticed (the dispatcher reaps and marks
+FAILED on nonzero exit).
+"""
+from __future__ import annotations
+
+import json
+import multiprocessing
+import os
+import queue
+import signal
+import sys
+import threading
+import traceback
+from typing import Any, Callable, Dict, Optional
+
+from skypilot_tpu.server import requests_store as store
+
+# ---- entrypoints -----------------------------------------------------------
+
+
+def _serialize_record(r: Dict[str, Any]) -> Dict[str, Any]:
+    handle = r.get('handle')
+    return {
+        'name': r['name'],
+        'status': r['status'].value,
+        'launched_at': r['launched_at'],
+        'last_use': r.get('last_use'),
+        'autostop': r.get('autostop', -1),
+        'to_down': r.get('to_down', False),
+        'cloud': handle.cloud if handle else None,
+        'region': handle.region if handle else None,
+        'zone': handle.zone if handle else None,
+        'num_hosts': handle.num_hosts if handle else None,
+        'resources': (str(handle.launched_resources) if handle else None),
+    }
+
+
+def _task_from_payload(payload: Dict[str, Any]):
+    from skypilot_tpu import task as task_lib
+    return task_lib.Task.from_yaml_config(payload['task'])
+
+
+def _ep_launch(payload: Dict[str, Any]) -> Dict[str, Any]:
+    from skypilot_tpu import execution
+    task = _task_from_payload(payload)
+    job_id, handle = execution.launch(
+        task, cluster_name=payload['cluster_name'],
+        retry_until_up=payload.get('retry_until_up', False),
+        idle_minutes_to_autostop=payload.get('idle_minutes_to_autostop'),
+        down=payload.get('down', False),
+        detach_run=payload.get('detach_run', False),
+        dryrun=payload.get('dryrun', False))
+    return {'job_id': job_id,
+            'cluster_name': payload['cluster_name'],
+            'provisioned': handle is not None}
+
+
+def _ep_exec(payload: Dict[str, Any]) -> Dict[str, Any]:
+    from skypilot_tpu import execution
+    task = _task_from_payload(payload)
+    job_id, _ = execution.exec_(
+        task, cluster_name=payload['cluster_name'],
+        detach_run=payload.get('detach_run', False))
+    return {'job_id': job_id, 'cluster_name': payload['cluster_name']}
+
+
+def _ep_status(payload: Dict[str, Any]) -> Any:
+    from skypilot_tpu import core
+    records = core.status(payload.get('cluster_names'),
+                          refresh=payload.get('refresh', True))
+    return [_serialize_record(r) for r in records]
+
+
+def _ep_simple(fn_name: str) -> Callable[[Dict[str, Any]], Any]:
+    def run(payload: Dict[str, Any]) -> Any:
+        from skypilot_tpu import core
+        fn = getattr(core, fn_name)
+        return fn(**payload)
+    return run
+
+
+def _ep_tail_logs(payload: Dict[str, Any]) -> Any:
+    from skypilot_tpu import core
+    return core.tail_logs(payload['cluster_name'], payload.get('job_id'),
+                          follow=payload.get('follow', True))
+
+
+def _ep_check(payload: Dict[str, Any]) -> Any:
+    from skypilot_tpu import check as check_lib
+    results = check_lib.check_capabilities(quiet=True)
+    return {name: {'enabled': ok, 'reason': reason}
+            for name, (ok, reason) in results.items()}
+
+
+def _ep_optimize(payload: Dict[str, Any]) -> Any:
+    from skypilot_tpu import optimizer as optimizer_lib
+    task = _task_from_payload(payload)
+    optimizer_lib.optimize(
+        task,
+        minimize=optimizer_lib.OptimizeTarget(
+            payload.get('minimize', 'cost')))
+    return {
+        'best': str(task.best_resources),
+        'cost_per_hour': task.estimated_cost_per_hour,
+        'candidates': [str(c) for c in task.candidate_resources],
+    }
+
+
+ENTRYPOINTS: Dict[str, Callable[[Dict[str, Any]], Any]] = {
+    'launch': _ep_launch,
+    'exec': _ep_exec,
+    'status': _ep_status,
+    'start': _ep_simple('start'),
+    'stop': _ep_simple('stop'),
+    'down': _ep_simple('down'),
+    'autostop': _ep_simple('autostop'),
+    'queue': _ep_simple('queue'),
+    'cancel': _ep_simple('cancel'),
+    'job_status': _ep_simple('job_status'),
+    'cost_report': _ep_simple('cost_report'),
+    'tail_logs': _ep_tail_logs,
+    'check': _ep_check,
+    'optimize': _ep_optimize,
+}
+
+LONG_OPS = {'launch', 'exec', 'tail_logs'}
+
+
+def schedule_type_for(op: str) -> store.ScheduleType:
+    return (store.ScheduleType.LONG if op in LONG_OPS
+            else store.ScheduleType.SHORT)
+
+
+# ---- worker process --------------------------------------------------------
+def _run_in_process(request_id: str) -> None:
+    """Child process body: redirect output, execute, record result."""
+    log = open(store.log_path(request_id), 'a', buffering=1)
+    os.dup2(log.fileno(), sys.stdout.fileno())
+    os.dup2(log.fileno(), sys.stderr.fileno())
+    row = store.get(request_id)
+    assert row is not None
+    op = row['name']
+    try:
+        result = ENTRYPOINTS[op](row['payload'] or {})
+        store.finish(request_id, result=result)
+    except Exception as e:  # noqa: BLE001 — report any failure to client
+        traceback.print_exc()
+        store.finish(request_id, error=f'{type(e).__name__}: {e}')
+
+
+class Executor:
+    """Two dispatcher pools (LONG: processes are heavier, fewer; SHORT:
+    more parallelism)."""
+
+    def __init__(self, long_workers: int = 4, short_workers: int = 8):
+        self._queues = {
+            store.ScheduleType.LONG: queue.Queue(),
+            store.ScheduleType.SHORT: queue.Queue(),
+        }
+        self._procs: Dict[str, multiprocessing.Process] = {}
+        self._lock = threading.Lock()
+        self._threads = []
+        for stype, n in ((store.ScheduleType.LONG, long_workers),
+                         (store.ScheduleType.SHORT, short_workers)):
+            for i in range(n):
+                t = threading.Thread(target=self._dispatch_loop,
+                                     args=(stype,), daemon=True,
+                                     name=f'dispatch-{stype.value}-{i}')
+                t.start()
+                self._threads.append(t)
+
+    def submit(self, request_id: str, schedule_type: store.ScheduleType
+               ) -> None:
+        self._queues[schedule_type].put(request_id)
+
+    def cancel(self, request_id: str) -> bool:
+        row = store.get(request_id)
+        if row is None or row['status'].is_terminal():
+            return False
+        store.set_cancelled(request_id)
+        with self._lock:
+            proc = self._procs.get(request_id)
+        if proc is not None and proc.is_alive():
+            assert proc.pid is not None
+            try:
+                os.kill(proc.pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+        return True
+
+    def _dispatch_loop(self, stype: store.ScheduleType) -> None:
+        # LONG requests get their own process (isolation, cancellable via
+        # SIGTERM, parallel launches). spawn, not fork: this server process
+        # is multi-threaded and forking with live locks can deadlock the
+        # child; state flows to the child via env (SKYTPU_STATE_DIR).
+        # SHORT requests (status/queue/...) run inline in the dispatcher
+        # thread — a ~1s spawn per quick metadata op would dominate its
+        # latency (reference draws the same line with its SHORT pool,
+        # sky/server/requests/executor.py:84-111).
+        ctx = multiprocessing.get_context('spawn')
+        while True:
+            request_id = self._queues[stype].get()
+            row = store.get(request_id)
+            if row is None or row['status'].is_terminal():
+                continue  # cancelled while queued
+            if stype == store.ScheduleType.SHORT:
+                self._run_inline(request_id, row)
+                continue
+            proc = ctx.Process(target=_run_in_process, args=(request_id,))
+            proc.start()
+            assert proc.pid is not None
+            store.set_running(request_id, proc.pid)
+            with self._lock:
+                self._procs[request_id] = proc
+            proc.join()
+            with self._lock:
+                self._procs.pop(request_id, None)
+            final = store.get(request_id)
+            if final is not None and not final['status'].is_terminal():
+                # Worker died without writing a result (OOM-kill, SIGTERM).
+                store.finish(request_id,
+                             error=f'worker exited with code '
+                                   f'{proc.exitcode} before finishing')
+
+    @staticmethod
+    def _run_inline(request_id: str, row: Dict[str, Any]) -> None:
+        import contextlib
+        store.set_running(request_id, os.getpid())
+        try:
+            with open(store.log_path(request_id), 'a', buffering=1) as log, \
+                    contextlib.redirect_stdout(log):
+                result = ENTRYPOINTS[row['name']](row['payload'] or {})
+            store.finish(request_id, result=result)
+        except Exception as e:  # noqa: BLE001
+            store.finish(request_id, error=f'{type(e).__name__}: {e}')

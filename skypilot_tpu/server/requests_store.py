@@ -1,0 +1,142 @@
+"""Request table: sqlite rows tracking every API call's lifecycle.
+
+Counterpart of reference ``sky/server/requests/requests.py`` (Request row
+:415, RequestStatus :48, ScheduleType :91). Requests execute in worker
+processes; the row carries payload in, result/error out, plus the log file
+the process' stdout streams to.
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import sqlite3
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import global_user_state
+
+
+class RequestStatus(enum.Enum):
+    PENDING = 'PENDING'
+    RUNNING = 'RUNNING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in (RequestStatus.SUCCEEDED, RequestStatus.FAILED,
+                        RequestStatus.CANCELLED)
+
+
+class ScheduleType(enum.Enum):
+    LONG = 'long'    # launch/exec/jobs: worker processes, bounded pool
+    SHORT = 'short'  # status/queue/...: quick, higher parallelism
+
+
+_LOCAL = threading.local()
+
+
+def _server_dir() -> str:
+    d = os.path.join(global_user_state.get_state_dir(), 'server')
+    os.makedirs(os.path.join(d, 'logs'), exist_ok=True)
+    return d
+
+
+def _db() -> sqlite3.Connection:
+    path = os.path.join(_server_dir(), 'requests.db')
+    conns = getattr(_LOCAL, 'conns', None)
+    if conns is None:
+        conns = _LOCAL.conns = {}
+    conn = conns.get(path)
+    if conn is None:
+        conn = sqlite3.connect(path, timeout=10.0)
+        conn.execute('PRAGMA journal_mode=WAL')
+        conn.execute("""
+            CREATE TABLE IF NOT EXISTS requests (
+                request_id TEXT PRIMARY KEY,
+                name TEXT,
+                schedule_type TEXT,
+                status TEXT,
+                payload TEXT,
+                result TEXT,
+                error TEXT,
+                pid INTEGER,
+                created_at REAL,
+                finished_at REAL
+            )""")
+        conn.commit()
+        conns[path] = conn
+    return conn
+
+
+def log_path(request_id: str) -> str:
+    return os.path.join(_server_dir(), 'logs', f'{request_id}.log')
+
+
+def create(name: str, payload: Dict[str, Any],
+           schedule_type: ScheduleType) -> str:
+    request_id = uuid.uuid4().hex[:16]
+    conn = _db()
+    conn.execute(
+        'INSERT INTO requests (request_id, name, schedule_type, status, '
+        'payload, created_at) VALUES (?,?,?,?,?,?)',
+        (request_id, name, schedule_type.value, RequestStatus.PENDING.value,
+         json.dumps(payload), time.time()))
+    conn.commit()
+    return request_id
+
+
+def get(request_id: str) -> Optional[Dict[str, Any]]:
+    row = _db().execute(
+        'SELECT request_id, name, schedule_type, status, payload, result, '
+        'error, pid, created_at, finished_at FROM requests '
+        'WHERE request_id=?', (request_id,)).fetchone()
+    if row is None:
+        return None
+    return {
+        'request_id': row[0], 'name': row[1], 'schedule_type': row[2],
+        'status': RequestStatus(row[3]),
+        'payload': json.loads(row[4]) if row[4] else None,
+        'result': json.loads(row[5]) if row[5] else None,
+        'error': row[6], 'pid': row[7], 'created_at': row[8],
+        'finished_at': row[9],
+    }
+
+
+def list_requests(limit: int = 100) -> List[Dict[str, Any]]:
+    rows = _db().execute(
+        'SELECT request_id, name, status, created_at, finished_at '
+        'FROM requests ORDER BY created_at DESC LIMIT ?',
+        (limit,)).fetchall()
+    return [{'request_id': r[0], 'name': r[1], 'status': r[2],
+             'created_at': r[3], 'finished_at': r[4]} for r in rows]
+
+
+def set_running(request_id: str, pid: int) -> None:
+    conn = _db()
+    conn.execute('UPDATE requests SET status=?, pid=? WHERE request_id=?',
+                 (RequestStatus.RUNNING.value, pid, request_id))
+    conn.commit()
+
+
+def finish(request_id: str, result: Any = None,
+           error: Optional[str] = None) -> None:
+    conn = _db()
+    status = RequestStatus.FAILED if error else RequestStatus.SUCCEEDED
+    conn.execute(
+        'UPDATE requests SET status=?, result=?, error=?, finished_at=? '
+        'WHERE request_id=? AND status NOT IN (?)',
+        (status.value, json.dumps(result), error, time.time(), request_id,
+         RequestStatus.CANCELLED.value))
+    conn.commit()
+
+
+def set_cancelled(request_id: str) -> None:
+    conn = _db()
+    conn.execute(
+        'UPDATE requests SET status=?, finished_at=? WHERE request_id=?',
+        (RequestStatus.CANCELLED.value, time.time(), request_id))
+    conn.commit()

@@ -1,0 +1,189 @@
+"""stdlib HTTP API server.
+
+Counterpart of reference ``sky/server/server.py`` (FastAPI endpoints
+:169-1100; this image bakes no FastAPI — see package docstring). Routes:
+
+    POST /api/v1/<op>                 -> {"request_id"}   (async; op in
+                                         executor.ENTRYPOINTS)
+    GET  /api/v1/get?request_id=&timeout_s=   -> blocks until terminal
+    GET  /api/v1/stream?request_id=   -> chunked log stream until terminal
+    GET  /api/v1/requests             -> recent request rows
+    POST /api/v1/requests/cancel      -> {"cancelled": bool}
+    GET  /healthz                     -> {"status": "healthy"}
+
+Run: ``python -m skypilot_tpu.server.server [--host H] [--port P]``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from skypilot_tpu.server import executor as executor_lib
+from skypilot_tpu.server import requests_store as store
+
+DEFAULT_PORT = 46580
+API_PREFIX = '/api/v1'
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = 'skytpu-api'
+    executor: executor_lib.Executor = None  # type: ignore  # set by serve()
+
+    # quiet default request logging
+    def log_message(self, fmt, *args):  # noqa: A003
+        pass
+
+    # -- helpers -------------------------------------------------------------
+    def _json(self, code: int, payload: Any) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header('Content-Type', 'application/json')
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_body(self) -> Dict[str, Any]:
+        length = int(self.headers.get('Content-Length', 0))
+        if not length:
+            return {}
+        return json.loads(self.rfile.read(length) or b'{}')
+
+    def _query(self) -> Tuple[str, Dict[str, str]]:
+        parsed = urlparse(self.path)
+        return parsed.path, {k: v[0] for k, v in
+                             parse_qs(parsed.query).items()}
+
+    # -- routes --------------------------------------------------------------
+    def do_GET(self) -> None:  # noqa: N802
+        path, q = self._query()
+        if path == '/healthz':
+            self._json(200, {'status': 'healthy', 'version': 1})
+        elif path == f'{API_PREFIX}/get':
+            self._get_request(q)
+        elif path == f'{API_PREFIX}/stream':
+            self._stream_request(q)
+        elif path == f'{API_PREFIX}/requests':
+            self._json(200, {'requests': store.list_requests()})
+        else:
+            self._json(404, {'error': f'unknown path {path}'})
+
+    def do_POST(self) -> None:  # noqa: N802
+        path, _ = self._query()
+        if path == f'{API_PREFIX}/requests/cancel':
+            body = self._read_body()
+            ok = self.executor.cancel(body.get('request_id', ''))
+            self._json(200, {'cancelled': ok})
+            return
+        if not path.startswith(API_PREFIX + '/'):
+            self._json(404, {'error': f'unknown path {path}'})
+            return
+        op = path[len(API_PREFIX) + 1:]
+        if op not in executor_lib.ENTRYPOINTS:
+            self._json(404, {'error': f'unknown operation {op!r}'})
+            return
+        payload = self._read_body()
+        stype = executor_lib.schedule_type_for(op)
+        request_id = store.create(op, payload, stype)
+        open(store.log_path(request_id), 'a').close()
+        self.executor.submit(request_id, stype)
+        self._json(200, {'request_id': request_id})
+
+    # -- get/stream ----------------------------------------------------------
+    def _get_request(self, q: Dict[str, str]) -> None:
+        request_id = q.get('request_id', '')
+        timeout_s = float(q.get('timeout_s', 3600))
+        deadline = time.time() + timeout_s
+        while True:
+            row = store.get(request_id)
+            if row is None:
+                self._json(404, {'error': f'no request {request_id!r}'})
+                return
+            if row['status'].is_terminal():
+                self._json(200, {
+                    'request_id': request_id,
+                    'status': row['status'].value,
+                    'result': row['result'],
+                    'error': row['error'],
+                })
+                return
+            if time.time() > deadline:
+                self._json(200, {'request_id': request_id,
+                                 'status': row['status'].value,
+                                 'result': None, 'error': 'timeout'})
+                return
+            time.sleep(0.2)
+
+    def _stream_request(self, q: Dict[str, str]) -> None:
+        request_id = q.get('request_id', '')
+        row = store.get(request_id)
+        if row is None:
+            self._json(404, {'error': f'no request {request_id!r}'})
+            return
+        self.send_response(200)
+        self.send_header('Content-Type', 'text/plain; charset=utf-8')
+        self.send_header('Transfer-Encoding', 'chunked')
+        self.end_headers()
+
+        def chunk(data: bytes) -> None:
+            if not data:
+                return
+            self.wfile.write(f'{len(data):x}\r\n'.encode())
+            self.wfile.write(data + b'\r\n')
+            self.wfile.flush()
+
+        path = store.log_path(request_id)
+        pos = 0
+        try:
+            while True:
+                if os.path.exists(path):
+                    with open(path, 'rb') as f:
+                        f.seek(pos)
+                        data = f.read()
+                    if data:
+                        pos += len(data)
+                        chunk(data)
+                row = store.get(request_id)
+                if row is None or row['status'].is_terminal():
+                    # final drain
+                    if os.path.exists(path):
+                        with open(path, 'rb') as f:
+                            f.seek(pos)
+                            data = f.read()
+                        if data:
+                            chunk(data)
+                    break
+                time.sleep(0.2)
+            self.wfile.write(b'0\r\n\r\n')  # chunked terminator
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+
+def serve(host: str = '127.0.0.1', port: int = DEFAULT_PORT,
+          background: bool = False) -> ThreadingHTTPServer:
+    _Handler.executor = executor_lib.Executor()
+    httpd = ThreadingHTTPServer((host, port), _Handler)
+    if background:
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        return httpd
+    httpd.serve_forever()
+    return httpd
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--host', default='127.0.0.1')
+    parser.add_argument('--port', type=int, default=DEFAULT_PORT)
+    args = parser.parse_args()
+    serve(args.host, args.port)
+
+
+if __name__ == '__main__':
+    main()

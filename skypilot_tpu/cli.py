@@ -284,6 +284,73 @@ def optimize(entrypoint, minimize):
 
 
 @cli.group()
+def jobs():
+    """Managed jobs with auto-recovery."""
+
+
+@jobs.command('launch')
+@click.argument('entrypoint', required=False)
+@click.option('--name', '-n', default=None)
+@click.option('--cloud', default=None)
+@click.option('--gpus', '--tpus', 'accelerators', default=None)
+@click.option('--cmd', default=None)
+@click.option('--env', multiple=True)
+@click.option('--detach-run', '-d', is_flag=True)
+def jobs_launch(entrypoint, name, cloud, accelerators, cmd, env,
+                detach_run):
+    """Submit a managed job (controller recovers it on preemption)."""
+    from skypilot_tpu import jobs as jobs_lib
+    task = _task_from_args(entrypoint, name, None, cloud, accelerators,
+                           None, env, cmd)
+    job_id = jobs_lib.launch(task, name=name)
+    click.echo(f'Managed job {job_id} submitted.'
+               f' Logs: skytpu jobs logs {job_id}')
+    if not detach_run:
+        sys.exit(jobs_lib.tail_logs(job_id, follow=True))
+
+
+@jobs.command('queue')
+def jobs_queue():
+    """List managed jobs."""
+    from skypilot_tpu import jobs as jobs_lib
+    rows = jobs_lib.queue()
+    if not rows:
+        click.echo('No managed jobs.')
+        return
+    fmt = '{:<5} {:<16} {:<18} {:<10} {:<20}'
+    click.echo(fmt.format('ID', 'NAME', 'STATUS', 'RECOVERIES',
+                          'CLUSTER'))
+    for r in rows:
+        click.echo(fmt.format(r['job_id'], (r['name'] or '-')[:16],
+                              r['status'].value, r['recovery_count'],
+                              (r['cluster_name'] or '-')[:20]))
+
+
+@jobs.command('cancel')
+@click.argument('job_ids', nargs=-1, type=int)
+@click.option('--all', 'all_jobs', is_flag=True)
+def jobs_cancel(job_ids, all_jobs):
+    """Cancel managed job(s)."""
+    from skypilot_tpu import jobs as jobs_lib
+    cancelled = jobs_lib.cancel(list(job_ids) or None, all_jobs=all_jobs)
+    click.echo(f'Cancelling managed jobs: {cancelled}')
+
+
+@jobs.command('logs')
+@click.argument('job_id', type=int)
+@click.option('--no-follow', is_flag=True)
+@click.option('--controller', is_flag=True,
+              help='Show the controller process log instead.')
+def jobs_logs(job_id, no_follow, controller):
+    """Stream a managed job's logs."""
+    from skypilot_tpu.jobs import core as jobs_core
+    if controller:
+        click.echo(jobs_core.controller_logs(job_id))
+        return
+    sys.exit(jobs_core.tail_logs(job_id, follow=not no_follow))
+
+
+@cli.group()
 def api():
     """Manage the local API server."""
 

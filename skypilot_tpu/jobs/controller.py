@@ -1,0 +1,158 @@
+"""Per-job controller process: launch, watch, recover, clean up.
+
+Counterpart of reference ``sky/jobs/controller.py`` (_run_one_task :119,
+main loop :403, cleanup :508) + the preemption-vs-failure discrimination
+the reference does across jobs/controller.py:119-403:
+
+- cluster gone / not UP / job record missing  -> PREEMPTION -> recover()
+- job FAILED with cluster healthy             -> user failure ->
+  restart up to max_restarts_on_errors, else terminal FAILED
+- job FAILED_SETUP                            -> terminal (setup bugs
+  don't heal by relaunching)
+
+Entry: ``python -m skypilot_tpu.jobs.controller --job-id N`` (spawned
+detached by jobs.core.launch).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import time
+import traceback
+from typing import Optional
+
+from skypilot_tpu import core
+from skypilot_tpu import exceptions
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.jobs import recovery_strategy
+from skypilot_tpu.jobs import state
+from skypilot_tpu.runtime import job_lib as cluster_job_lib
+
+ManagedJobStatus = state.ManagedJobStatus
+
+
+def _poll_interval() -> float:
+    return float(os.environ.get('SKYTPU_JOBS_POLL_INTERVAL', '15'))
+
+
+class JobsController:
+
+    def __init__(self, job_id: int):
+        self.job_id = job_id
+        row = state.get(job_id)
+        assert row is not None, f'managed job {job_id} missing'
+        self.task = task_lib.Task.from_yaml_config(row['task_yaml'])
+        self.cluster_name = (row['cluster_name']
+                             or f'skytpu-jobs-{job_id}')
+        state.update(job_id, cluster_name=self.cluster_name,
+                     controller_pid=os.getpid())
+        self.strategy = recovery_strategy.StrategyExecutor.make(
+            self.task, self.cluster_name)
+
+    # -- helpers -------------------------------------------------------------
+    def _cluster_job_status(self, cluster_job_id: int
+                            ) -> Optional[cluster_job_lib.JobStatus]:
+        """None => the cluster (or its job record) is gone: preemption."""
+        try:
+            raw = core.job_status(self.cluster_name, cluster_job_id)
+        except (exceptions.ClusterNotUpError,
+                exceptions.ClusterDoesNotExist):
+            return None
+        except exceptions.SkyTpuError:
+            return None
+        if raw is None:
+            return None
+        return cluster_job_lib.JobStatus(raw)
+
+    def _down_cluster(self) -> None:
+        try:
+            core.down(self.cluster_name)
+        except exceptions.SkyTpuError:
+            pass
+
+    def _handle_cancel(self, cluster_job_id: Optional[int]) -> None:
+        if cluster_job_id is not None:
+            try:
+                core.cancel(self.cluster_name, [cluster_job_id])
+            except exceptions.SkyTpuError:
+                pass
+        self._down_cluster()
+        state.set_status(self.job_id, ManagedJobStatus.CANCELLED)
+
+    # -- main ----------------------------------------------------------------
+    def run(self) -> None:
+        job_id = self.job_id
+        state.set_status(job_id, ManagedJobStatus.STARTING)
+        try:
+            cluster_job_id = self.strategy.launch(retry_until_up=False)
+        except exceptions.ResourcesUnavailableError as e:
+            state.set_status(job_id, ManagedJobStatus.FAILED_NO_RESOURCE,
+                             failure_reason=str(e))
+            return
+        state.update(job_id, cluster_job_id=cluster_job_id)
+        state.set_status(job_id, ManagedJobStatus.RUNNING)
+
+        while True:
+            if state.cancel_requested(job_id):
+                self._handle_cancel(cluster_job_id)
+                return
+            status = self._cluster_job_status(cluster_job_id)
+            if status is None:
+                # Preemption (slice terminated / cluster unreachable).
+                state.set_status(job_id, ManagedJobStatus.RECOVERING)
+                state.bump_recovery(job_id)
+                self._down_cluster()
+                try:
+                    cluster_job_id = self.strategy.recover()
+                except exceptions.ResourcesUnavailableError as e:
+                    state.set_status(job_id,
+                                     ManagedJobStatus.FAILED_NO_RESOURCE,
+                                     failure_reason=str(e))
+                    return
+                state.update(job_id, cluster_job_id=cluster_job_id)
+                state.set_status(job_id, ManagedJobStatus.RUNNING)
+            elif status == cluster_job_lib.JobStatus.SUCCEEDED:
+                state.set_status(job_id, ManagedJobStatus.SUCCEEDED)
+                self._down_cluster()
+                return
+            elif status == cluster_job_lib.JobStatus.FAILED_SETUP:
+                state.set_status(job_id, ManagedJobStatus.FAILED_SETUP,
+                                 failure_reason='task setup failed')
+                self._down_cluster()
+                return
+            elif status == cluster_job_lib.JobStatus.FAILED:
+                # User-code failure on a healthy cluster.
+                if self.strategy.should_restart_on_failure():
+                    state.set_status(job_id, ManagedJobStatus.RECOVERING)
+                    state.bump_recovery(job_id)
+                    cluster_job_id = self.strategy.launch(
+                        retry_until_up=False)
+                    state.update(job_id, cluster_job_id=cluster_job_id)
+                    state.set_status(job_id, ManagedJobStatus.RUNNING)
+                else:
+                    state.set_status(
+                        job_id, ManagedJobStatus.FAILED,
+                        failure_reason='task run: non-zero exit')
+                    self._down_cluster()
+                    return
+            elif status == cluster_job_lib.JobStatus.CANCELLED:
+                state.set_status(job_id, ManagedJobStatus.CANCELLED)
+                self._down_cluster()
+                return
+            time.sleep(_poll_interval())
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--job-id', type=int, required=True)
+    args = parser.parse_args()
+    try:
+        JobsController(args.job_id).run()
+    except Exception as e:  # noqa: BLE001 — controller itself failed
+        traceback.print_exc()
+        state.set_status(args.job_id, ManagedJobStatus.FAILED_CONTROLLER,
+                         failure_reason=f'{type(e).__name__}: {e}')
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,123 @@
+"""Managed-jobs client ops: launch/queue/cancel/tail_logs.
+
+Counterpart of reference ``sky/jobs/server/core.py`` + ``client/sdk.py``.
+``launch`` records the job and spawns a detached controller process.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.jobs import state
+
+ManagedJobStatus = state.ManagedJobStatus
+
+
+def _controller_log(job_id: int) -> str:
+    d = os.path.join(global_user_state.get_state_dir(), 'jobs_controller')
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, f'{job_id}.log')
+
+
+def launch(task: task_lib.Task, name: Optional[str] = None) -> int:
+    """Submit a managed job; returns the managed job id immediately."""
+    job_name = name or task.name or 'managed-job'
+    job_id = state.create(job_name, task.to_yaml_config())
+    with open(_controller_log(job_id), 'ab') as log:
+        proc = subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
+             '--job-id', str(job_id)],
+            stdout=log, stderr=log, start_new_session=True)
+    state.update(job_id, controller_pid=proc.pid)
+    state.set_status(job_id, ManagedJobStatus.SUBMITTED)
+    return job_id
+
+
+def _controller_alive(pid: Optional[int]) -> bool:
+    if not pid:
+        return False
+    try:
+        os.kill(pid, 0)
+    except (ProcessLookupError, PermissionError):
+        return False
+    # A dead-but-unreaped child (launcher exited without wait()) still
+    # answers signal 0; check for zombie state.
+    try:
+        with open(f'/proc/{pid}/stat') as f:
+            return f.read().split(') ')[-1].split()[0] != 'Z'
+    except (FileNotFoundError, IndexError):
+        return False
+
+
+def queue(refresh_controller: bool = True) -> List[Dict[str, Any]]:
+    """All managed jobs; reconciles rows whose controller died."""
+    rows = state.list_jobs()
+    for row in rows:
+        if (refresh_controller and not row['status'].is_terminal()
+                and row['status'] != ManagedJobStatus.PENDING
+                and not _controller_alive(row['controller_pid'])):
+            state.set_status(row['job_id'],
+                             ManagedJobStatus.FAILED_CONTROLLER,
+                             failure_reason='controller process died')
+            row['status'] = ManagedJobStatus.FAILED_CONTROLLER
+    return rows
+
+
+def cancel(job_ids: Optional[List[int]] = None,
+           all_jobs: bool = False) -> List[int]:
+    targets = state.list_jobs(job_ids=None if all_jobs else job_ids)
+    cancelled = []
+    for row in targets:
+        if row['status'].is_terminal():
+            continue
+        state.set_status(row['job_id'], ManagedJobStatus.CANCELLING)
+        cancelled.append(row['job_id'])
+    return cancelled
+
+
+def tail_logs(job_id: int, follow: bool = True, out=None) -> int:
+    """Stream the managed job's task logs (through its current cluster)."""
+    out = out or sys.stdout
+    row = state.get(job_id)
+    if row is None:
+        raise exceptions.JobNotFoundError(f'No managed job {job_id}')
+    while True:
+        row = state.get(job_id)
+        assert row is not None
+        cluster = row['cluster_name']
+        cluster_job_id = row['cluster_job_id']
+        if cluster and cluster_job_id:
+            try:
+                from skypilot_tpu import backends
+                handle_record = \
+                    global_user_state.get_cluster_from_name(cluster)
+                if handle_record and handle_record['handle']:
+                    backends.SliceBackend().tail_logs(
+                        handle_record['handle'], cluster_job_id,
+                        follow=follow, stream_to=out)
+            except exceptions.SkyTpuError:
+                pass
+        row = state.get(job_id)
+        assert row is not None
+        if row['status'].is_terminal():
+            out.write(f'\n[managed job {job_id}] {row["status"].value}'
+                      + (f': {row["failure_reason"]}'
+                         if row['failure_reason'] else '') + '\n')
+            return 0 if row['status'] == ManagedJobStatus.SUCCEEDED else 100
+        if not follow:
+            return 0
+        time.sleep(1.0)  # RECOVERING: wait for the next cluster
+
+
+def controller_logs(job_id: int) -> str:
+    try:
+        with open(_controller_log(job_id)) as f:
+            return f.read()
+    except FileNotFoundError:
+        return ''

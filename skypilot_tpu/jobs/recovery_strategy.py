@@ -1,0 +1,143 @@
+"""Recovery strategies: where to relaunch after preemption/failure.
+
+Counterpart of reference ``sky/jobs/recovery_strategy.py`` (StrategyExecutor
+registry :71, FAILOVER :382, EAGER_NEXT_REGION :466,
+should_restart_on_failure :368). A strategy wraps ``execution.launch`` with
+a placement policy over the optimizer's candidate list:
+
+- FAILOVER: retry the last successful (region, zone) first, then the rest.
+- EAGER_NEXT_REGION (default): after a preemption, immediately move to the
+  next region — on TPU spot the zone that just preempted you is the
+  *least* likely to have capacity (same reasoning as the reference's
+  default-ish choice).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import execution
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.utils import registry
+
+RECOVERY_STRATEGIES = registry.Registry('recovery strategy')
+
+MAX_PROVISION_ROUNDS = 3
+
+
+class StrategyExecutor:
+    """Launch/recover a task onto an ephemeral cluster."""
+
+    NAME = 'base'
+
+    def __init__(self, task: task_lib.Task, cluster_name: str,
+                 max_restarts_on_errors: int = 0):
+        self.task = task
+        self.cluster_name = cluster_name
+        self.max_restarts_on_errors = max_restarts_on_errors
+        self.restart_count_on_errors = 0
+        self.last_launched: Optional[Any] = None  # Resources
+
+    @classmethod
+    def make(cls, task: task_lib.Task, cluster_name: str
+             ) -> 'StrategyExecutor':
+        recovery = None
+        for r in task.resources:
+            if r.job_recovery is not None:
+                recovery = r.job_recovery
+                break
+        name = (recovery.strategy if recovery else None) \
+            or 'EAGER_NEXT_REGION'
+        max_restarts = (recovery.max_restarts_on_errors if recovery else 0)
+        strategy_cls = RECOVERY_STRATEGIES.get(name)
+        if strategy_cls is None:
+            raise exceptions.InvalidTaskError(
+                f'Unknown job recovery strategy {name!r}; known: '
+                f'{RECOVERY_STRATEGIES.keys()}')
+        return strategy_cls(task, cluster_name,
+                            max_restarts_on_errors=max_restarts)
+
+    # -- launch --------------------------------------------------------------
+    def launch(self, retry_until_up: bool = True) -> Optional[int]:
+        """(Re)launch the cluster + job; returns the cluster job id."""
+        rounds = MAX_PROVISION_ROUNDS if not retry_until_up else 10**9
+        backoff = 10.0
+        for i in range(rounds):
+            try:
+                job_id, handle = execution.launch(
+                    self.task, cluster_name=self.cluster_name,
+                    detach_run=True, stream_logs=False)
+                if handle is not None:
+                    self.last_launched = handle.launched_resources
+                return job_id
+            except exceptions.ResourcesUnavailableError:
+                if i == rounds - 1:
+                    raise
+                time.sleep(min(backoff * 2**i, 300))
+        return None
+
+    def should_restart_on_failure(self) -> bool:
+        """User-code failure: restart up to max_restarts_on_errors times."""
+        if self.restart_count_on_errors >= self.max_restarts_on_errors:
+            return False
+        self.restart_count_on_errors += 1
+        return True
+
+    def recover(self) -> Optional[int]:
+        raise NotImplementedError
+
+
+@RECOVERY_STRATEGIES.register(name='FAILOVER')
+class FailoverStrategy(StrategyExecutor):
+    """Retry the same placement first (data locality, reserved capacity),
+    then fail over (reference :382)."""
+    NAME = 'FAILOVER'
+
+    def recover(self) -> Optional[int]:
+        # Pin to the last placement for the first attempt.
+        if self.last_launched is not None:
+            pinned = self.last_launched.copy()
+            original = self.task.resources
+            self.task.set_resources([pinned])
+            try:
+                return self.launch(retry_until_up=False)
+            except exceptions.ResourcesUnavailableError:
+                pass
+            finally:
+                self.task.set_resources(list(original))
+            # Pinned placement gone: clear stale optimizer assignment and
+            # let the full candidate set failover.
+            self.task.best_resources = None
+            self.task.candidate_resources = []
+        return self.launch(retry_until_up=True)
+
+
+@RECOVERY_STRATEGIES.register(name='EAGER_NEXT_REGION')
+class EagerNextRegionStrategy(StrategyExecutor):
+    """Skip the region that preempted us on the first recovery pass
+    (reference :466)."""
+    NAME = 'EAGER_NEXT_REGION'
+
+    def recover(self) -> Optional[int]:
+        preempted_region = (self.last_launched.region
+                            if self.last_launched is not None else None)
+        if preempted_region is not None:
+            candidates = [
+                c for c in (getattr(self.task, 'candidate_resources', None)
+                            or [])
+                if c.region != preempted_region
+            ]
+            if candidates:
+                original_best = self.task.best_resources
+                self.task.best_resources = candidates[0]
+                self.task.candidate_resources = candidates
+                try:
+                    return self.launch(retry_until_up=False)
+                except exceptions.ResourcesUnavailableError:
+                    self.task.best_resources = original_best
+        # Everything elsewhere failed (or no other region): full retry
+        # including the original region.
+        self.task.best_resources = None
+        self.task.candidate_resources = []
+        return self.launch(retry_until_up=True)

@@ -1,0 +1,162 @@
+"""Managed-job state: sqlite table + status machine.
+
+Counterpart of reference ``sky/jobs/state.py`` (ManagedJobStatus :196-254,
+schedule states :323). One row per managed job; the controller process owns
+transitions, clients read.
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import global_user_state
+
+
+class ManagedJobStatus(enum.Enum):
+    PENDING = 'PENDING'
+    SUBMITTED = 'SUBMITTED'
+    STARTING = 'STARTING'
+    RUNNING = 'RUNNING'
+    RECOVERING = 'RECOVERING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    FAILED_SETUP = 'FAILED_SETUP'
+    FAILED_NO_RESOURCE = 'FAILED_NO_RESOURCE'
+    FAILED_CONTROLLER = 'FAILED_CONTROLLER'
+    CANCELLING = 'CANCELLING'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in _TERMINAL
+
+    def is_failed(self) -> bool:
+        return self in (ManagedJobStatus.FAILED,
+                        ManagedJobStatus.FAILED_SETUP,
+                        ManagedJobStatus.FAILED_NO_RESOURCE,
+                        ManagedJobStatus.FAILED_CONTROLLER)
+
+
+_TERMINAL = {ManagedJobStatus.SUCCEEDED, ManagedJobStatus.FAILED,
+             ManagedJobStatus.FAILED_SETUP,
+             ManagedJobStatus.FAILED_NO_RESOURCE,
+             ManagedJobStatus.FAILED_CONTROLLER,
+             ManagedJobStatus.CANCELLED}
+
+_LOCAL = threading.local()
+
+
+def _db() -> sqlite3.Connection:
+    path = os.path.join(global_user_state.get_state_dir(),
+                        'managed_jobs.db')
+    conns = getattr(_LOCAL, 'conns', None)
+    if conns is None:
+        conns = _LOCAL.conns = {}
+    conn = conns.get(path)
+    if conn is None:
+        conn = sqlite3.connect(path, timeout=10.0)
+        conn.execute('PRAGMA journal_mode=WAL')
+        conn.execute("""
+            CREATE TABLE IF NOT EXISTS managed_jobs (
+                job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+                name TEXT,
+                task_yaml TEXT NOT NULL,
+                status TEXT NOT NULL,
+                cluster_name TEXT,
+                cluster_job_id INTEGER,
+                recovery_count INTEGER DEFAULT 0,
+                failure_reason TEXT,
+                controller_pid INTEGER,
+                submitted_at REAL,
+                started_at REAL,
+                ended_at REAL
+            )""")
+        conn.commit()
+        conns[path] = conn
+    return conn
+
+
+def create(name: str, task_yaml: Dict[str, Any]) -> int:
+    conn = _db()
+    cur = conn.execute(
+        'INSERT INTO managed_jobs (name, task_yaml, status, submitted_at) '
+        'VALUES (?,?,?,?)',
+        (name, json.dumps(task_yaml), ManagedJobStatus.PENDING.value,
+         time.time()))
+    conn.commit()
+    return int(cur.lastrowid)
+
+
+def set_status(job_id: int, status: ManagedJobStatus,
+               failure_reason: Optional[str] = None) -> None:
+    conn = _db()
+    now = time.time()
+    sets = ['status=?']
+    args: List[Any] = [status.value]
+    if status == ManagedJobStatus.RUNNING:
+        sets.append('started_at=COALESCE(started_at, ?)')
+        args.append(now)
+    if status.is_terminal():
+        sets.append('ended_at=?')
+        args.append(now)
+    if failure_reason is not None:
+        sets.append('failure_reason=?')
+        args.append(failure_reason)
+    args.append(job_id)
+    conn.execute(f'UPDATE managed_jobs SET {", ".join(sets)} '
+                 'WHERE job_id=?', args)
+    conn.commit()
+
+
+def update(job_id: int, **cols: Any) -> None:
+    conn = _db()
+    sets = ', '.join(f'{k}=?' for k in cols)
+    conn.execute(f'UPDATE managed_jobs SET {sets} WHERE job_id=?',
+                 (*cols.values(), job_id))
+    conn.commit()
+
+
+def bump_recovery(job_id: int) -> None:
+    conn = _db()
+    conn.execute('UPDATE managed_jobs SET recovery_count=recovery_count+1 '
+                 'WHERE job_id=?', (job_id,))
+    conn.commit()
+
+
+def get(job_id: int) -> Optional[Dict[str, Any]]:
+    rows = list_jobs(job_ids=[job_id])
+    return rows[0] if rows else None
+
+
+def list_jobs(job_ids: Optional[List[int]] = None
+              ) -> List[Dict[str, Any]]:
+    q = ('SELECT job_id, name, task_yaml, status, cluster_name, '
+         'cluster_job_id, recovery_count, failure_reason, controller_pid, '
+         'submitted_at, started_at, ended_at FROM managed_jobs')
+    args: List[Any] = []
+    if job_ids:
+        q += f' WHERE job_id IN ({",".join("?" * len(job_ids))})'
+        args = list(job_ids)
+    q += ' ORDER BY job_id DESC'
+    out = []
+    for row in _db().execute(q, args):
+        out.append({
+            'job_id': row[0], 'name': row[1],
+            'task_yaml': json.loads(row[2]),
+            'status': ManagedJobStatus(row[3]),
+            'cluster_name': row[4], 'cluster_job_id': row[5],
+            'recovery_count': row[6], 'failure_reason': row[7],
+            'controller_pid': row[8], 'submitted_at': row[9],
+            'started_at': row[10], 'ended_at': row[11],
+        })
+    return out
+
+
+def cancel_requested(job_id: int) -> bool:
+    row = get(job_id)
+    return row is not None and row['status'] in (
+        ManagedJobStatus.CANCELLING, ManagedJobStatus.CANCELLED)

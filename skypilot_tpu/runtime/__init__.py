@@ -14,5 +14,9 @@ scheduling sky/backends/cloud_vm_ray_backend.py:389-545) with:
 The gang is the TPU slice itself: all hosts of a slice exist atomically, so
 rank assignment is just the provisioner's stable host order — no placement
 groups, no rendezvous service. jax.distributed coordination uses host 0 as
-coordinator via SKYTPU_COORDINATOR_ADDR.
+coordinator via SKYTPU_COORDINATOR_ADDR; workloads call
+``skypilot_tpu.runtime.init()`` (distributed.py) to join the global mesh.
 """
+from skypilot_tpu.runtime.distributed import init, is_initialized, shutdown
+
+__all__ = ['init', 'is_initialized', 'shutdown']

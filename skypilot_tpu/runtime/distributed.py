@@ -1,0 +1,92 @@
+"""jax.distributed glue: turn a provisioned slice into ONE JAX program.
+
+The agent exports the SKYTPU_* rank contract (constants.py:13-28) into every
+job process; this module consumes it. Reference counterpart: the
+SKYPILOT_NODE_RANK/NODE_IPS contract consumed by torchrun task YAMLs
+(reference sky/skylet/constants.py:320-323,
+examples/distributed-pytorch/train.yaml:18-33) — but this framework owns the
+model layer, so rendezvous is a library call, not a YAML idiom:
+
+    import skypilot_tpu.runtime as rt
+    rt.init()            # no-op on single-host; jax.distributed on a pod
+    mesh = ...           # jax.devices() is now the GLOBAL device list
+
+Kept import-light on purpose (no skypilot_tpu/__init__ weight): jobs import
+this at the top of their training scripts.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+from skypilot_tpu.runtime import constants
+
+_INITIALIZED = False
+
+
+def is_initialized() -> bool:
+    return _INITIALIZED
+
+
+def init(coordinator_address: Optional[str] = None,
+         num_processes: Optional[int] = None,
+         process_id: Optional[int] = None,
+         local_device_ids: Optional[Sequence[int]] = None,
+         timeout_s: int = 300) -> bool:
+    """Initialize the JAX coordination service from the SKYTPU_* contract.
+
+    Reads ``SKYTPU_COORDINATOR_ADDR`` / ``SKYTPU_NUM_PROCESSES`` /
+    ``SKYTPU_PROCESS_ID`` (exported by the on-host agent for every job rank,
+    runtime/agent.py) unless explicit values are passed. Host 0 of the slice
+    is the coordinator.
+
+    Returns True if ``jax.distributed.initialize`` was called, False if this
+    is a single-process run (contract absent or num_processes == 1) — in
+    which case jax works as-is and no coordination service is needed.
+
+    Safe to call twice (second call is a no-op).
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return True
+
+    env = os.environ
+    if coordinator_address is None:
+        coordinator_address = env.get(constants.ENV_COORDINATOR_ADDR)
+    if num_processes is None:
+        raw = env.get(constants.ENV_NUM_PROCESSES)
+        num_processes = int(raw) if raw else None
+    if process_id is None:
+        raw = env.get(constants.ENV_PROCESS_ID)
+        process_id = int(raw) if raw else None
+
+    if not coordinator_address or not num_processes or num_processes <= 1:
+        return False
+    if process_id is None:
+        raise ValueError(
+            f'{constants.ENV_COORDINATOR_ADDR} is set but '
+            f'{constants.ENV_PROCESS_ID} is missing — the rank contract is '
+            'incomplete; jobs must run under the skypilot_tpu agent or set '
+            'both explicitly.')
+
+    import jax
+    kwargs = {}
+    if local_device_ids is not None:
+        kwargs['local_device_ids'] = list(local_device_ids)
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        initialization_timeout=timeout_s,
+        **kwargs)
+    _INITIALIZED = True
+    return True
+
+
+def shutdown() -> None:
+    global _INITIALIZED
+    if not _INITIALIZED:
+        return
+    import jax
+    jax.distributed.shutdown()
+    _INITIALIZED = False

@@ -40,6 +40,10 @@ def __getattr__(name):  # lazy: heavy modules only on use
         'cancel': ('skypilot_tpu.core', 'cancel'),
         'tail_logs': ('skypilot_tpu.core', 'tail_logs'),
         'job_status': ('skypilot_tpu.core', 'job_status'),
+        'serve_up': ('skypilot_tpu.serve.core', 'up'),
+        'serve_status': ('skypilot_tpu.serve.core', 'status'),
+        'serve_down': ('skypilot_tpu.serve.core', 'down'),
+        'ServiceSpec': ('skypilot_tpu.serve.service_spec', 'ServiceSpec'),
     }
     if name in _lazy:
         import importlib

@@ -351,6 +351,69 @@ def jobs_logs(job_id, no_follow, controller):
 
 
 @cli.group()
+def serve():
+    """Autoscaled, load-balanced services (SkyServe analog)."""
+
+
+@serve.command('up')
+@click.argument('entrypoint')
+@click.option('--service-name', '-n', required=True)
+def serve_up(entrypoint, service_name):
+    """Start a service from a task YAML with a `service:` section."""
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.serve import core as serve_core
+    task = task_lib.Task.from_yaml(entrypoint)
+    result = serve_core.up(task, service_name)
+    click.echo(f'Service {result["name"]!r} starting. '
+               f'Endpoint: {result["endpoint"]}')
+    click.echo(f'Watch: skytpu serve status {service_name}')
+
+
+@serve.command('status')
+@click.argument('service_names', nargs=-1)
+def serve_status(service_names):
+    """Show services and their replicas."""
+    from skypilot_tpu.serve import core as serve_core
+    rows = serve_core.status(list(service_names) or None)
+    if not rows:
+        click.echo('No services.')
+        return
+    fmt = '{:<20} {:<16} {:<28} {:<8}'
+    click.echo(fmt.format('NAME', 'STATUS', 'ENDPOINT', 'REPLICAS'))
+    for r in rows:
+        n_ready = sum(1 for rep in r['replicas']
+                      if rep['status'].value == 'READY')
+        n_live = sum(1 for rep in r['replicas'] if rep['status'].is_live())
+        click.echo(fmt.format(r['name'], r['status'].value,
+                              r['endpoint'] or '-', f'{n_ready}/{n_live}'))
+        for rep in r['replicas']:
+            click.echo(f'  rep{rep["replica_id"]:<4} '
+                       f'{rep["status"].value:<22} {rep["url"] or "-"}')
+
+
+@serve.command('down')
+@click.argument('service_names', nargs=-1, required=True)
+@click.option('--yes', '-y', is_flag=True)
+def serve_down(service_names, yes):
+    """Tear down service(s) and their replicas."""
+    from skypilot_tpu.serve import core as serve_core
+    if not yes:
+        click.confirm(f'Tear down service(s) {", ".join(service_names)}?',
+                      abort=True)
+    for name in service_names:
+        serve_core.down(name)
+        click.echo(f'Service {name!r} torn down.')
+
+
+@serve.command('logs')
+@click.argument('service_name')
+def serve_logs(service_name):
+    """Show a service's controller log."""
+    from skypilot_tpu.serve import core as serve_core
+    click.echo(serve_core.controller_logs(service_name))
+
+
+@cli.group()
 def api():
     """Manage the local API server."""
 

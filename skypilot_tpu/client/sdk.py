@@ -158,6 +158,19 @@ def tail_logs(cluster_name: str, job_id: Optional[int] = None,
                                 'job_id': job_id, 'follow': follow})
 
 
+def serve_up(task, service_name: str) -> str:
+    return submit('serve_up', {'task': task.to_yaml_config(),
+                               'service_name': service_name})
+
+
+def serve_status(service_names: Optional[List[str]] = None) -> str:
+    return submit('serve_status', {'service_names': service_names})
+
+
+def serve_down(service_name: str) -> str:
+    return submit('serve_down', {'service_name': service_name})
+
+
 def check() -> str:
     return submit('check', {})
 

@@ -112,6 +112,7 @@ SERVICE_SCHEMA: Dict[str, Any] = {
             'additionalProperties': False,
         },
         'replicas': {'type': 'integer'},
+        'replica_port': {'type': 'integer'},
         'load_balancing_policy': {'type': ['string', 'null']},
         'tls': {'type': 'object'},
     },

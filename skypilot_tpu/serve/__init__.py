@@ -1,0 +1,22 @@
+"""Serving layer: autoscaled, load-balanced replica fleets (SkyServe analog).
+
+Counterpart of reference ``sky/serve/`` (service_spec.py, controller.py:64,
+autoscalers.py:441, replica_managers.py:60/830/1201, load_balancer.py:22,
+load_balancing_policies.py:89/115). TPU-native redesign:
+
+- the controller is ONE process (autoscaler loop + replica manager + a tiny
+  stdlib-HTTP control endpoint) — no FastAPI, no codegen-over-SSH;
+- replicas are ordinary skypilot_tpu clusters launched through
+  ``execution.launch`` (same recursion as the reference's ``sky.launch``
+  inside replica_managers.py:60) — on the local cloud they are real
+  subprocess-backed hosts, so the whole serve path is hermetically testable;
+- readiness probing tolerates multi-minute XLA-compile cold starts via
+  ``initial_delay_seconds`` (reference replica_managers.py:1316) — on TPU
+  the first forward pass compiles for tens of seconds, so this is
+  first-class, not an afterthought;
+- the load balancer is a stdlib ThreadingHTTPServer reverse proxy with
+  streamed (chunked) responses and pluggable policies.
+"""
+from skypilot_tpu.serve.service_spec import ServiceSpec
+
+__all__ = ['ServiceSpec']

@@ -1,0 +1,92 @@
+"""Request-rate autoscaler with hysteresis.
+
+Counterpart of reference ``sky/serve/autoscalers.py`` (RequestRateAutoscaler
+:441, hysteresis base :357). Behavior:
+
+- target = ceil(observed_qps / target_qps_per_replica), clipped to
+  [min_replicas, max_replicas]; fixed fleets (no target_qps) pin to
+  min_replicas;
+- a changed target must persist for ``upscale_delay_seconds`` (or
+  ``downscale_delay_seconds``) of consecutive evaluations before it is
+  adopted — one QPS spike never thrashes the fleet;
+- the controller feeds request timestamps reported by the load balancer
+  (collect_requests) and calls evaluate() once per tick.
+
+Pure logic, injected clock: unit-testable with synthetic timestamps exactly
+like the reference's tests/test_serve_autoscaler.py drive.
+"""
+from __future__ import annotations
+
+import math
+import time
+from typing import List, Optional
+
+from skypilot_tpu.serve import service_spec as spec_lib
+
+
+class RequestRateAutoscaler:
+
+    def __init__(self, spec: spec_lib.ServiceSpec,
+                 decision_interval_seconds: float = 20.0):
+        self.policy = spec.replica_policy
+        self.interval = max(decision_interval_seconds, 1e-6)
+        self._request_times: List[float] = []
+        # Hysteresis state: how many consecutive evaluations proposed a
+        # higher/lower target than the adopted one.
+        self._upscale_needed = max(
+            1, int(self.policy.upscale_delay_seconds / self.interval))
+        self._downscale_needed = max(
+            1, int(self.policy.downscale_delay_seconds / self.interval))
+        self._upscale_counter = 0
+        self._downscale_counter = 0
+        self.target_num_replicas = self.policy.min_replicas
+
+    # -- request accounting ---------------------------------------------------
+    def collect_requests(self, timestamps: List[float],
+                         now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        cutoff = now - self.policy.qps_window_seconds
+        self._request_times = (
+            [t for t in self._request_times if t >= cutoff]
+            + [t for t in timestamps if t >= cutoff])
+
+    def observed_qps(self, now: Optional[float] = None) -> float:
+        now = time.time() if now is None else now
+        cutoff = now - self.policy.qps_window_seconds
+        n = sum(1 for t in self._request_times if t >= cutoff)
+        return n / self.policy.qps_window_seconds
+
+    # -- target computation ---------------------------------------------------
+    def _clip(self, n: int) -> int:
+        lo = self.policy.min_replicas
+        hi = (self.policy.max_replicas
+              if self.policy.max_replicas is not None else lo)
+        return max(lo, min(n, hi))
+
+    def _raw_target(self, now: float) -> int:
+        if self.policy.target_qps_per_replica is None:
+            return self.policy.min_replicas
+        qps = self.observed_qps(now)
+        return self._clip(
+            math.ceil(qps / self.policy.target_qps_per_replica))
+
+    def evaluate(self, now: Optional[float] = None) -> int:
+        """One autoscaler tick: returns the adopted target replica count."""
+        now = time.time() if now is None else now
+        proposed = self._raw_target(now)
+        if proposed > self.target_num_replicas:
+            self._upscale_counter += 1
+            self._downscale_counter = 0
+            if self._upscale_counter >= self._upscale_needed:
+                self.target_num_replicas = proposed
+                self._upscale_counter = 0
+        elif proposed < self.target_num_replicas:
+            self._downscale_counter += 1
+            self._upscale_counter = 0
+            if self._downscale_counter >= self._downscale_needed:
+                self.target_num_replicas = proposed
+                self._downscale_counter = 0
+        else:
+            self._upscale_counter = 0
+            self._downscale_counter = 0
+        return self.target_num_replicas

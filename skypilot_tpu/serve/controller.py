@@ -1,0 +1,182 @@
+"""Serve controller process: autoscaler loop + replica manager + control API.
+
+Counterpart of reference ``sky/serve/controller.py`` (:64 _run_autoscaler,
+:100 FastAPI endpoints) — collapsed into one stdlib process:
+
+- main loop (tick = $SKYTPU_SERVE_TICK seconds): feed the autoscaler,
+  reconcile the replica fleet, probe replicas, refresh the service status;
+- control HTTP endpoint (ThreadingHTTPServer on the recorded
+  controller_port): GET /replicas for the LB's sync, POST /load for the
+  LB's request-rate reports, GET /status for CLI/SDK;
+- shutdown: ``serve down`` flips the service row to SHUTTING_DOWN in
+  sqlite; the controller notices, terminates every replica cluster, removes
+  the service, and exits.
+
+Entry: ``python -m skypilot_tpu.serve.controller --service-name NAME``
+(spawned detached by serve.core.up).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import threading
+import time
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from skypilot_tpu.serve import autoscaler as autoscaler_lib
+from skypilot_tpu.serve import replica_manager as rm_lib
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve import service_spec as spec_lib
+
+ServiceStatus = serve_state.ServiceStatus
+ReplicaStatus = serve_state.ReplicaStatus
+
+
+def _tick() -> float:
+    return float(os.environ.get('SKYTPU_SERVE_TICK', '20'))
+
+
+class _ControlHandler(BaseHTTPRequestHandler):
+    controller: 'ServeController' = None  # injected
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _json(self, code: int, payload) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header('Content-Type', 'application/json')
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802
+        c = self.controller
+        if self.path == '/replicas':
+            self._json(200, {'ready_urls': c.manager.ready_urls()})
+        elif self.path == '/status':
+            self._json(200, c.status_payload())
+        else:
+            self._json(404, {'error': f'no route {self.path}'})
+
+    def do_POST(self):  # noqa: N802
+        c = self.controller
+        length = int(self.headers.get('Content-Length', 0))
+        try:
+            payload = json.loads(self.rfile.read(length) or b'{}')
+        except json.JSONDecodeError:
+            self._json(400, {'error': 'bad json'})
+            return
+        if self.path == '/load':
+            stamps = [float(t) for t in payload.get('timestamps', [])]
+            c.autoscaler.collect_requests(stamps)
+            self._json(200, {'ok': True,
+                             'target': c.autoscaler.target_num_replicas})
+        else:
+            self._json(404, {'error': f'no route {self.path}'})
+
+
+class ServeController:
+
+    def __init__(self, service_name: str):
+        self.name = service_name
+        row = serve_state.get_service(service_name)
+        assert row is not None, f'service {service_name} missing'
+        self.spec = spec_lib.ServiceSpec.from_yaml_config(row['spec'])
+        self.autoscaler = autoscaler_lib.RequestRateAutoscaler(
+            self.spec, decision_interval_seconds=_tick())
+        self.manager = rm_lib.ReplicaManager(
+            service_name, self.spec, row['task_yaml'],
+            log=self._log)
+        self.controller_port: int = 0  # assigned at bind time
+        self._http: ThreadingHTTPServer = None
+
+    def _log(self, msg: str) -> None:
+        print(f'[{self.name}] {msg}', flush=True)
+
+    def status_payload(self):
+        row = serve_state.get_service(self.name)
+        return {
+            'name': self.name,
+            'status': row['status'].value if row else 'UNKNOWN',
+            'target_replicas': self.autoscaler.target_num_replicas,
+            'qps': self.autoscaler.observed_qps(),
+            'replicas': [
+                {'replica_id': r['replica_id'], 'status': r['status'].value,
+                 'url': r['url'], 'cluster_name': r['cluster_name']}
+                for r in self.manager.replicas()
+            ],
+        }
+
+    def _serve_http(self) -> None:
+        # Bind port 0 and record the kernel-assigned port: no TOCTOU window
+        # (vs. a parent pre-picking a "free" port we bind seconds later).
+        handler = type('Handler', (_ControlHandler,), {'controller': self})
+        self._http = ThreadingHTTPServer(('127.0.0.1', 0), handler)
+        self.controller_port = self._http.server_address[1]
+        serve_state.update_service(self.name,
+                                   controller_port=self.controller_port)
+        threading.Thread(target=self._http.serve_forever,
+                         name='control-http', daemon=True).start()
+
+    def _refresh_service_status(self) -> None:
+        replicas = self.manager.replicas()
+        n_ready = sum(1 for r in replicas
+                      if r['status'] == ReplicaStatus.READY)
+        live = self.manager.nonterminal_replicas()
+        if n_ready > 0:
+            status = ServiceStatus.READY
+        elif live:
+            status = ServiceStatus.REPLICA_INIT
+        elif replicas and all(r['status'].is_failed() for r in replicas):
+            status = ServiceStatus.FAILED
+        else:
+            status = ServiceStatus.NO_REPLICA
+        serve_state.set_status_unless_shutting_down(self.name, status)
+
+    def run(self) -> None:
+        serve_state.update_service(self.name, controller_pid=os.getpid())
+        self._serve_http()
+        serve_state.set_status_unless_shutting_down(
+            self.name, ServiceStatus.REPLICA_INIT)
+        self._log(f'controller up on :{self.controller_port}, '
+                  f'min={self.spec.replica_policy.min_replicas}')
+        while True:
+            row = serve_state.get_service(self.name)
+            if row is None or row['status'] == ServiceStatus.SHUTTING_DOWN:
+                self._log('shutting down: terminating replicas')
+                self.manager.terminate_all()
+                serve_state.remove_service(self.name)
+                self._http.shutdown()
+                return
+            try:
+                target = self.autoscaler.evaluate()
+                self.manager.reconcile(target)
+                self.manager.probe_all()
+                self._refresh_service_status()
+            except Exception as e:  # noqa: BLE001
+                # A transient failure (sqlite busy, cloud API hiccup) must
+                # not kill the controller: the fleet would run unsupervised.
+                self._log(f'tick error (will retry): '
+                          f'{type(e).__name__}: {e}')
+                traceback.print_exc()
+            time.sleep(_tick())
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--service-name', required=True)
+    args = parser.parse_args()
+    try:
+        ServeController(args.service_name).run()
+    except Exception:  # noqa: BLE001
+        traceback.print_exc()
+        serve_state.update_service(args.service_name,
+                                   status=ServiceStatus.CONTROLLER_FAILED)
+        raise
+
+
+if __name__ == '__main__':
+    main()

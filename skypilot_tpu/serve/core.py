@@ -1,0 +1,155 @@
+"""Serve client ops: up / status / down / logs.
+
+Counterpart of reference ``sky/serve/server/core.py`` + ``service.py:_start``
+(:139 forks controller + LB). ``up`` records the service and spawns the two
+detached processes; ``down`` flips the row to SHUTTING_DOWN and the
+controller tears the fleet down (falling back to inline cleanup if the
+controller died).
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.serve import serve_state
+
+ServiceStatus = serve_state.ServiceStatus
+
+
+def _serve_dir(service_name: str) -> str:
+    d = os.path.join(global_user_state.get_state_dir(), 'serve',
+                     service_name)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _spawn(module: str, service_name: str, log_name: str) -> int:
+    log_path = os.path.join(_serve_dir(service_name), log_name)
+    with open(log_path, 'ab') as log:
+        proc = subprocess.Popen(
+            [sys.executable, '-m', module, '--service-name', service_name],
+            stdout=log, stderr=log, start_new_session=True,
+            env=dict(os.environ))
+    return proc.pid
+
+
+def up(task: task_lib.Task, service_name: str) -> Dict[str, Any]:
+    """Start a service; returns {'name', 'endpoint'} immediately."""
+    if task.service is None:
+        raise exceptions.InvalidTaskError(
+            "Task has no 'service:' section; add one to use serve.")
+    from skypilot_tpu.utils import common_utils
+    common_utils.check_cluster_name_is_valid(service_name)
+    created = serve_state.add_service(
+        service_name,
+        spec=task.service.to_yaml_config(),
+        task_yaml=task.to_yaml_config(),
+        requested_replicas=task.service.replica_policy.min_replicas)
+    if not created:
+        raise exceptions.ClusterError(
+            f'Service {service_name!r} already exists. '
+            f"Use 'serve down {service_name}' first.")
+    controller_pid = _spawn('skypilot_tpu.serve.controller', service_name,
+                            'controller.log')
+    lb_pid = _spawn('skypilot_tpu.serve.load_balancer', service_name,
+                    'load_balancer.log')
+    serve_state.update_service(service_name, controller_pid=controller_pid,
+                               lb_pid=lb_pid)
+    # Controller and LB bind port 0 themselves and publish the assigned
+    # ports (no pre-pick race); wait for the LB endpoint to report it.
+    deadline = time.time() + 60
+    lb_port = None
+    while time.time() < deadline:
+        row = serve_state.get_service(service_name)
+        if row and row['lb_port']:
+            lb_port = row['lb_port']
+            break
+        if not _pid_alive(controller_pid) and not _pid_alive(lb_pid):
+            raise exceptions.ClusterError(
+                f'Service {service_name!r} processes died during startup; '
+                f'see {_serve_dir(service_name)}/controller.log')
+        time.sleep(0.2)
+    return {'name': service_name,
+            'endpoint': (f'http://127.0.0.1:{lb_port}'
+                         if lb_port else None)}
+
+
+def status(service_names: Optional[List[str]] = None
+           ) -> List[Dict[str, Any]]:
+    rows = serve_state.list_services(names=service_names)
+    out = []
+    for row in rows:
+        replicas = serve_state.list_replicas(row['name'])
+        out.append({
+            'name': row['name'],
+            'status': row['status'],
+            'endpoint': (f'http://127.0.0.1:{row["lb_port"]}'
+                         if row['lb_port'] else None),
+            'requested_replicas': row['requested_replicas'],
+            'replicas': replicas,
+        })
+    return out
+
+
+def _pid_alive(pid: Optional[int]) -> bool:
+    if not pid:
+        return False
+    try:
+        os.kill(pid, 0)
+        with open(f'/proc/{pid}/stat') as f:
+            return f.read().split(') ')[-1].split()[0] != 'Z'
+    except (ProcessLookupError, PermissionError, FileNotFoundError,
+            IndexError):
+        return False
+
+
+def down(service_name: str, timeout: float = 180.0) -> None:
+    row = serve_state.get_service(service_name)
+    if row is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Service {service_name!r} does not exist.')
+    lb_pid = row['lb_pid']
+    if _pid_alive(row['controller_pid']):
+        serve_state.update_service(service_name,
+                                   status=ServiceStatus.SHUTTING_DOWN)
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if serve_state.get_service(service_name) is None:
+                break
+            time.sleep(0.2)
+        else:
+            raise exceptions.ClusterError(
+                f'Service {service_name!r} did not shut down within '
+                f'{timeout}s; controller pid {row["controller_pid"]}.')
+    else:
+        # Controller died: clean up inline.
+        from skypilot_tpu import core as core_lib
+        for replica in serve_state.list_replicas(service_name):
+            if replica['status'].is_terminal():
+                continue
+            try:
+                core_lib.down(replica['cluster_name'])
+            except exceptions.SkyTpuError:
+                pass
+        serve_state.remove_service(service_name)
+    if _pid_alive(lb_pid):
+        try:
+            os.kill(lb_pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
+def controller_logs(service_name: str) -> str:
+    try:
+        with open(os.path.join(_serve_dir(service_name),
+                               'controller.log')) as f:
+            return f.read()
+    except FileNotFoundError:
+        return ''

@@ -1,0 +1,202 @@
+"""Load balancer process: streaming reverse proxy in front of replicas.
+
+Counterpart of reference ``sky/serve/load_balancer.py`` (SkyServeLoadBalancer
+:22-296 — FastAPI + httpx). Ours is a stdlib ThreadingHTTPServer:
+
+- syncs the READY replica list from the controller every
+  $SKYTPU_SERVE_LB_SYNC seconds (reference LB_CONTROLLER_SYNC_INTERVAL);
+- forwards any method/path/body to the policy-selected replica and streams
+  the response back chunk-by-chunk (generation endpoints stream tokens —
+  buffering would destroy TTFT);
+- reports request timestamps to the controller's POST /load for the
+  request-rate autoscaler.
+
+Entry: ``python -m skypilot_tpu.serve.load_balancer --service-name NAME``
+(spawned detached by serve.core.up).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List
+
+from skypilot_tpu.serve import load_balancing_policies as policies_lib
+from skypilot_tpu.serve import serve_state
+
+_HOP_HEADERS = {'connection', 'keep-alive', 'proxy-authenticate',
+                'proxy-authorization', 'te', 'trailers',
+                'transfer-encoding', 'upgrade', 'host', 'content-length'}
+
+
+def _sync_interval() -> float:
+    return float(os.environ.get('SKYTPU_SERVE_LB_SYNC', '5'))
+
+
+class LoadBalancer:
+
+    def __init__(self, service_name: str):
+        self.name = service_name
+        row = serve_state.get_service(service_name)
+        assert row is not None, f'service {service_name} missing'
+        # The controller binds port 0 and records the assigned port; wait
+        # for that record instead of racing a pre-picked port.
+        deadline = time.time() + 120
+        while not row['controller_port'] and time.time() < deadline:
+            time.sleep(0.2)
+            row = serve_state.get_service(service_name)
+            if row is None:
+                raise RuntimeError(f'service {service_name} removed while '
+                                   'LB was starting')
+        if not row['controller_port']:
+            raise RuntimeError('controller never published its port')
+        self.controller_url = f'http://127.0.0.1:{row["controller_port"]}'
+        policy_name = (row['spec'].get('load_balancing_policy')
+                       or 'least_load')
+        self.policy = policies_lib.make(policy_name)
+        self._pending_timestamps: List[float] = []
+        self._ts_lock = threading.Lock()
+
+    # -- controller sync ------------------------------------------------------
+    def _sync_loop(self) -> None:
+        while True:
+            try:
+                with urllib.request.urlopen(
+                        self.controller_url + '/replicas',
+                        timeout=10) as resp:
+                    data = json.loads(resp.read())
+                self.policy.set_replicas(data.get('ready_urls', []))
+            except (urllib.error.URLError, OSError, ValueError):
+                pass  # controller briefly unavailable; keep last list
+            self._report_load()
+            time.sleep(_sync_interval())
+
+    def _report_load(self) -> None:
+        with self._ts_lock:
+            stamps, self._pending_timestamps = self._pending_timestamps, []
+        if not stamps:
+            return
+        try:
+            req = urllib.request.Request(
+                self.controller_url + '/load',
+                data=json.dumps({'timestamps': stamps}).encode(),
+                headers={'Content-Type': 'application/json'})
+            urllib.request.urlopen(req, timeout=10).read()
+        except (urllib.error.URLError, OSError):
+            with self._ts_lock:  # retry next sync
+                self._pending_timestamps = \
+                    stamps + self._pending_timestamps
+
+    def record_request(self) -> None:
+        with self._ts_lock:
+            self._pending_timestamps.append(time.time())
+
+    # -- serving --------------------------------------------------------------
+    def run(self) -> None:
+        lb = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = 'HTTP/1.1'
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _proxy(self):
+                lb.record_request()
+                url = lb.policy.select()
+                if url is None:
+                    body = json.dumps({
+                        'error': 'no ready replicas',
+                        'detail': 'service is starting or scaled to zero',
+                    }).encode()
+                    self.send_response(503)
+                    self.send_header('Content-Type', 'application/json')
+                    self.send_header('Content-Length', str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                length = int(self.headers.get('Content-Length', 0))
+                body = self.rfile.read(length) if length else None
+                headers = {k: v for k, v in self.headers.items()
+                           if k.lower() not in _HOP_HEADERS}
+                upstream = url.rstrip('/') + self.path
+                req = urllib.request.Request(upstream, data=body,
+                                             headers=headers,
+                                             method=self.command)
+                lb.policy.on_request_start(url)
+                try:
+                    with urllib.request.urlopen(req, timeout=600) as resp:
+                        self.send_response(resp.status)
+                        for k, v in resp.headers.items():
+                            if k.lower() not in _HOP_HEADERS:
+                                self.send_header(k, v)
+                        self.send_header('X-Skytpu-Replica', url)
+                        chunked = (resp.headers.get('Content-Length')
+                                   is None)
+                        if chunked:
+                            self.send_header('Transfer-Encoding', 'chunked')
+                        else:
+                            self.send_header(
+                                'Content-Length',
+                                resp.headers['Content-Length'])
+                        self.end_headers()
+                        # Stream through: tokens reach the client as the
+                        # replica emits them.
+                        while True:
+                            chunk = resp.read(16384)
+                            if not chunk:
+                                break
+                            if chunked:
+                                self.wfile.write(
+                                    f'{len(chunk):x}\r\n'.encode())
+                                self.wfile.write(chunk + b'\r\n')
+                            else:
+                                self.wfile.write(chunk)
+                        if chunked:
+                            self.wfile.write(b'0\r\n\r\n')
+                except urllib.error.HTTPError as e:
+                    payload = e.read()
+                    self.send_response(e.code)
+                    self.send_header('Content-Length', str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                except (urllib.error.URLError, OSError) as e:
+                    payload = json.dumps(
+                        {'error': f'replica unreachable: {e}'}).encode()
+                    self.send_response(502)
+                    self.send_header('Content-Length', str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                finally:
+                    lb.policy.on_request_end(url)
+
+            do_GET = do_POST = do_PUT = do_DELETE = do_PATCH = _proxy
+
+        threading.Thread(target=self._sync_loop, name='lb-sync',
+                         daemon=True).start()
+        # Bind port 0 (or a pinned $SKYTPU_SERVE_LB_PORT) and publish the
+        # assigned port — serve.core.up waits for it to report the endpoint.
+        pinned = int(os.environ.get('SKYTPU_SERVE_LB_PORT', '0'))
+        server = ThreadingHTTPServer(('0.0.0.0', pinned), Handler)
+        lb_port = server.server_address[1]
+        serve_state.update_service(self.name, lb_pid=os.getpid(),
+                                   lb_port=lb_port)
+        print(f'[{self.name}] load balancer on :{lb_port} '
+              f'-> {self.controller_url}', flush=True)
+        server.serve_forever()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--service-name', required=True)
+    args = parser.parse_args()
+    LoadBalancer(args.service_name).run()
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,99 @@
+"""Load-balancing policies (registry by name).
+
+Counterpart of reference ``sky/serve/load_balancing_policies.py``
+(RoundRobinPolicy :89, LeastLoadPolicy :115 — the default). Policies hold
+the replica list and pick a URL per request; `least_load` tracks in-flight
+requests per replica, which matters on TPU replicas where a single long
+generation can occupy a replica for seconds.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+_POLICIES = {}
+
+
+def register(name: str):
+    def deco(cls):
+        _POLICIES[name] = cls
+        return cls
+    return deco
+
+
+def make(name: str) -> 'LoadBalancingPolicy':
+    try:
+        return _POLICIES[name]()
+    except KeyError:
+        raise ValueError(
+            f'Unknown load balancing policy {name!r}; '
+            f'available: {sorted(_POLICIES)}') from None
+
+
+class LoadBalancingPolicy:
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._urls: List[str] = []
+
+    def set_replicas(self, urls: List[str]) -> None:
+        with self._lock:
+            self._urls = list(urls)
+
+    @property
+    def urls(self) -> List[str]:
+        with self._lock:
+            return list(self._urls)
+
+    def select(self) -> Optional[str]:
+        raise NotImplementedError
+
+    # In-flight accounting hooks (no-ops unless the policy cares).
+    def on_request_start(self, url: str) -> None:
+        pass
+
+    def on_request_end(self, url: str) -> None:
+        pass
+
+
+@register('round_robin')
+class RoundRobinPolicy(LoadBalancingPolicy):
+
+    def __init__(self):
+        super().__init__()
+        self._index = 0
+
+    def select(self) -> Optional[str]:
+        with self._lock:
+            if not self._urls:
+                return None
+            url = self._urls[self._index % len(self._urls)]
+            self._index += 1
+            return url
+
+
+@register('least_load')
+class LeastLoadPolicy(LoadBalancingPolicy):
+
+    def __init__(self):
+        super().__init__()
+        self._inflight: Dict[str, int] = {}
+
+    def set_replicas(self, urls: List[str]) -> None:
+        with self._lock:
+            self._urls = list(urls)
+            self._inflight = {u: self._inflight.get(u, 0) for u in urls}
+
+    def select(self) -> Optional[str]:
+        with self._lock:
+            if not self._urls:
+                return None
+            return min(self._urls, key=lambda u: self._inflight.get(u, 0))
+
+    def on_request_start(self, url: str) -> None:
+        with self._lock:
+            self._inflight[url] = self._inflight.get(url, 0) + 1
+
+    def on_request_end(self, url: str) -> None:
+        with self._lock:
+            self._inflight[url] = max(0, self._inflight.get(url, 0) - 1)

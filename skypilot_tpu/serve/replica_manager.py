@@ -1,0 +1,286 @@
+"""Replica manager: launch/probe/terminate/replace replica clusters.
+
+Counterpart of reference ``sky/serve/replica_managers.py`` (launch via
+sky.launch :60, preemption handling :830, prober :1201). Each replica is an
+ordinary skypilot_tpu cluster named ``<service>-rep<N>`` launched through
+execution.launch — the same recursion the reference uses. The controller
+calls :meth:`reconcile` once per tick with the autoscaler's target; the
+manager converges the fleet:
+
+- fewer live replicas than target  -> launch (worker threads; provisioning
+  a TPU slice takes minutes and must not block probing);
+- more than target                 -> terminate, unhealthiest first
+  (ReplicaStatus.scale_down_priority), then newest;
+- preempted replica (cluster gone from cloud truth while tracked)  ->
+  mark PREEMPTED, clean up, and let the target top back up — the TPU
+  analog of spot GPU preemption recovery;
+- probe failures: STARTING replicas get ``initial_delay_seconds`` of grace
+  (XLA compile + weight load), then FAILED_INITIAL_DELAY; READY replicas
+  degrade to NOT_READY and are replaced after a failure budget.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve import service_spec as spec_lib
+from skypilot_tpu.utils import common_utils
+
+ReplicaStatus = serve_state.ReplicaStatus
+
+# READY replicas may fail this many consecutive probes before being replaced.
+PROBE_FAILURE_LIMIT = 10
+# Probes run concurrently; a slow replica must not starve the others.
+_PROBE_POOL = 8
+
+
+class ReplicaManager:
+
+    def __init__(self, service_name: str, spec: spec_lib.ServiceSpec,
+                 task_yaml: Dict, log=print):
+        self.service = service_name
+        self.spec = spec
+        self.task_yaml = {k: v for k, v in task_yaml.items()
+                          if k != 'service'}
+        self.log = log
+        self._inflight: Dict[int, threading.Thread] = {}
+        self._lock = threading.Lock()
+        self._probe_pool = ThreadPoolExecutor(
+            max_workers=_PROBE_POOL, thread_name_prefix='probe')
+        self._is_local = (
+            (self.task_yaml.get('resources') or {}).get('cloud') == 'local')
+
+    # -- fleet accounting -----------------------------------------------------
+    def replicas(self) -> List[Dict]:
+        return serve_state.list_replicas(self.service)
+
+    def nonterminal_replicas(self) -> List[Dict]:
+        return [r for r in self.replicas() if r['status'].is_live()]
+
+    def ready_urls(self) -> List[str]:
+        return [r['url'] for r in self.replicas()
+                if r['status'] == ReplicaStatus.READY and r['url']]
+
+    # -- reconcile ------------------------------------------------------------
+    def reconcile(self, target: int) -> None:
+        self._reap_finished_threads()
+        live = self.nonterminal_replicas()
+        if len(live) < target:
+            for _ in range(target - len(live)):
+                self._launch_one()
+        elif len(live) > target:
+            victims = sorted(
+                live, key=lambda r: (r['status'].scale_down_priority,
+                                     -r['replica_id']))
+            for victim in victims[:len(live) - target]:
+                self._terminate_one(victim['replica_id'], reason='scale down')
+
+    def _reap_finished_threads(self) -> None:
+        with self._lock:
+            done = [rid for rid, t in self._inflight.items()
+                    if not t.is_alive()]
+            for rid in done:
+                del self._inflight[rid]
+
+    # -- launch ---------------------------------------------------------------
+    def _launch_one(self) -> None:
+        replica_id = serve_state.next_replica_id(self.service)
+        cluster = f'{self.service}-rep{replica_id}'
+        # Local replicas share one machine: every replica needs its own port.
+        port = (common_utils.find_free_port() if self._is_local
+                else self.spec.replica_port)
+        serve_state.add_replica(self.service, replica_id, cluster, port)
+        t = threading.Thread(target=self._launch_replica,
+                             args=(replica_id, cluster, port),
+                             name=f'launch-rep{replica_id}', daemon=True)
+        with self._lock:
+            self._inflight[replica_id] = t
+        t.start()
+
+    def _launch_replica(self, replica_id: int, cluster: str,
+                        port: int) -> None:
+        from skypilot_tpu import execution
+        from skypilot_tpu import task as task_lib
+        serve_state.update_replica(self.service, replica_id,
+                                   status=ReplicaStatus.PROVISIONING)
+        try:
+            task = task_lib.Task.from_yaml_config(dict(self.task_yaml))
+            task.update_envs({'SKYTPU_SERVE_REPLICA_PORT': str(port),
+                              'SKYTPU_SERVE_REPLICA_ID': str(replica_id)})
+            _, handle = execution.launch(task, cluster_name=cluster,
+                                         detach_run=True, stream_logs=False)
+            from skypilot_tpu import provision as provision_lib
+            # Probes and LB traffic come from outside the replica's network:
+            # the serving port must be reachable (reference opens ports via
+            # the task's resources; sky/provision/gcp/config.py firewall).
+            provision_lib.open_ports(handle.cloud, cluster, handle.region,
+                                     [str(port)])
+            info = provision_lib.get_cluster_info(handle.cloud, cluster,
+                                                  handle.region)
+            ip = info.hosts[0].external_ip or info.hosts[0].internal_ip
+            url = f'http://{ip}:{port}'
+            serve_state.update_replica(self.service, replica_id,
+                                       status=ReplicaStatus.STARTING,
+                                       url=url)
+            self.log(f'replica {replica_id}: STARTING at {url}')
+        except exceptions.SkyTpuError as e:
+            serve_state.update_replica(
+                self.service, replica_id,
+                status=ReplicaStatus.FAILED_PROVISION,
+                failure_reason=f'{type(e).__name__}: {e}')
+            self.log(f'replica {replica_id}: FAILED_PROVISION: {e}')
+        except Exception as e:  # noqa: BLE001 — keep controller alive
+            serve_state.update_replica(
+                self.service, replica_id, status=ReplicaStatus.FAILED,
+                failure_reason=f'{type(e).__name__}: {e}')
+            self.log(f'replica {replica_id}: launch error: {e}')
+
+    # -- terminate ------------------------------------------------------------
+    def _terminate_one(self, replica_id: int, reason: str,
+                       final_status: ReplicaStatus = ReplicaStatus.TERMINATED
+                       ) -> None:
+        with self._lock:
+            if replica_id in self._inflight and \
+                    self._inflight[replica_id].is_alive():
+                # A launch (or prior terminate) is still in flight; touching
+                # the cluster now could orphan a half-provisioned slice.
+                # Leave the replica as-is — reconcile retries next tick.
+                return
+        serve_state.update_replica(self.service, replica_id,
+                                   status=ReplicaStatus.SHUTTING_DOWN)
+        t = threading.Thread(
+            target=self._terminate_replica,
+            args=(replica_id, reason, final_status),
+            name=f'down-rep{replica_id}', daemon=True)
+        with self._lock:
+            self._inflight[replica_id] = t
+        t.start()
+
+    def _terminate_replica(self, replica_id: int, reason: str,
+                           final_status: ReplicaStatus) -> None:
+        from skypilot_tpu import core
+        rows = [r for r in self.replicas() if r['replica_id'] == replica_id]
+        if not rows:
+            return
+        cluster = rows[0]['cluster_name']
+        try:
+            core.down(cluster)
+        except exceptions.SkyTpuError:
+            pass  # already gone (e.g. preempted)
+        serve_state.update_replica(self.service, replica_id,
+                                   status=final_status,
+                                   failure_reason=reason)
+        self.log(f'replica {replica_id}: {final_status.value} ({reason})')
+
+    def terminate_all(self) -> None:
+        """Converge the whole fleet to terminal states.
+
+        Re-issues terminations every pass: a replica whose *launch* thread
+        is still in flight is skipped by _terminate_one (touching a
+        half-provisioned slice could orphan it), so one-shot termination
+        would leak exactly those clusters. Loop until every replica is
+        terminal and no thread is in flight.
+        """
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            self._reap_finished_threads()
+            pending = [r for r in self.replicas()
+                       if r['status'].is_live()
+                       or r['status'] == ReplicaStatus.SHUTTING_DOWN]
+            with self._lock:
+                inflight = bool(self._inflight)
+            if not pending and not inflight:
+                return
+            for r in pending:
+                if r['status'] != ReplicaStatus.SHUTTING_DOWN:
+                    self._terminate_one(r['replica_id'],
+                                        reason='service down')
+            time.sleep(0.2)
+        self.log('terminate_all timed out; some replicas may need manual '
+                 '`skytpu down`')
+
+    # -- probing & preemption -------------------------------------------------
+    def probe_all(self) -> None:
+        to_probe = [r for r in self.replicas()
+                    if r['status'] in (ReplicaStatus.STARTING,
+                                       ReplicaStatus.READY,
+                                       ReplicaStatus.NOT_READY)]
+        list(self._probe_pool.map(self._probe_one, to_probe))
+
+    def _cluster_alive(self, cluster: str) -> bool:
+        from skypilot_tpu import global_user_state
+        from skypilot_tpu import provision as provision_lib
+        record = global_user_state.get_cluster_from_name(cluster)
+        if record is None or record['handle'] is None:
+            return False
+        handle = record['handle']
+        try:
+            states = provision_lib.query_instances(handle.cloud, cluster,
+                                                   handle.region)
+        except exceptions.SkyTpuError:
+            return True  # cloud unreachable: do not false-positive preemption
+        return bool(states) and set(states.values()) == {'running'}
+
+    def _probe_one(self, replica: Dict) -> None:
+        rid = replica['replica_id']
+        if not self._cluster_alive(replica['cluster_name']):
+            # The slice was taken out from under us: preemption.
+            serve_state.update_replica(self.service, rid,
+                                       status=ReplicaStatus.PREEMPTED,
+                                       failure_reason='cluster preempted')
+            self.log(f'replica {rid}: PREEMPTED')
+            self._terminate_one(rid, reason='preempted cleanup',
+                                final_status=ReplicaStatus.PREEMPTED)
+            return
+        ok = self._http_probe(replica['url'])
+        now = time.time()
+        if ok:
+            updates = {'status': ReplicaStatus.READY,
+                       'consecutive_probe_failures': 0}
+            if replica['first_ready_at'] is None:
+                updates['first_ready_at'] = now
+                self.log(f'replica {rid}: READY')
+            serve_state.update_replica(self.service, rid, **updates)
+            return
+        if replica['status'] == ReplicaStatus.STARTING:
+            started = replica['launched_at'] or now
+            if now - started > self.spec.readiness_probe.initial_delay_seconds:
+                self._terminate_one(
+                    rid, reason='readiness probe never succeeded within '
+                    'initial_delay_seconds',
+                    final_status=ReplicaStatus.FAILED_INITIAL_DELAY)
+            return
+        failures = replica['consecutive_probe_failures'] + 1
+        if failures >= PROBE_FAILURE_LIMIT:
+            self._terminate_one(rid, reason='probe failure budget exhausted',
+                                final_status=ReplicaStatus.FAILED_PROBING)
+        else:
+            serve_state.update_replica(
+                self.service, rid, status=ReplicaStatus.NOT_READY,
+                consecutive_probe_failures=failures)
+
+    def _http_probe(self, url: Optional[str]) -> bool:
+        if not url:
+            return False
+        probe = self.spec.readiness_probe
+        full = url.rstrip('/') + probe.path
+        try:
+            data = None
+            headers = dict(probe.headers or {})
+            if probe.post_data is not None:
+                data = (probe.post_data if isinstance(probe.post_data, str)
+                        else json.dumps(probe.post_data)).encode()
+                headers.setdefault('Content-Type', 'application/json')
+            req = urllib.request.Request(full, data=data, headers=headers)
+            with urllib.request.urlopen(
+                    req, timeout=probe.timeout_seconds) as resp:
+                return 200 <= resp.status < 300
+        except (urllib.error.URLError, OSError, ValueError):
+            return False

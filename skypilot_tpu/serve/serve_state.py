@@ -1,0 +1,251 @@
+"""Serve state: sqlite tables for services and replicas.
+
+Counterpart of reference ``sky/serve/serve_state.py`` (ReplicaStatus :91-139,
+ServiceStatus :187-209). The controller process owns all writes; the load
+balancer and CLI read. WAL mode so the LB's reads never block the
+controller's writes.
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import global_user_state
+
+
+class ServiceStatus(enum.Enum):
+    CONTROLLER_INIT = 'CONTROLLER_INIT'
+    REPLICA_INIT = 'REPLICA_INIT'     # no READY replica yet
+    READY = 'READY'
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+    CONTROLLER_FAILED = 'CONTROLLER_FAILED'
+    FAILED = 'FAILED'                 # all replicas terminally failed
+    NO_REPLICA = 'NO_REPLICA'         # scaled to zero / all lost
+
+    def is_terminal(self) -> bool:
+        return self in (ServiceStatus.CONTROLLER_FAILED, ServiceStatus.FAILED)
+
+
+class ReplicaStatus(enum.Enum):
+    PENDING = 'PENDING'
+    PROVISIONING = 'PROVISIONING'
+    STARTING = 'STARTING'             # cluster UP, waiting on readiness probe
+    READY = 'READY'
+    NOT_READY = 'NOT_READY'           # was READY, probe failing
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+    PREEMPTED = 'PREEMPTED'
+    FAILED_PROVISION = 'FAILED_PROVISION'
+    FAILED_INITIAL_DELAY = 'FAILED_INITIAL_DELAY'
+    FAILED_PROBING = 'FAILED_PROBING'
+    FAILED = 'FAILED'                 # replica job exited non-zero
+    TERMINATED = 'TERMINATED'
+
+    def is_terminal(self) -> bool:
+        return self in _TERMINAL_REPLICA
+
+    def is_failed(self) -> bool:
+        return self in (ReplicaStatus.FAILED, ReplicaStatus.FAILED_PROVISION,
+                        ReplicaStatus.FAILED_INITIAL_DELAY,
+                        ReplicaStatus.FAILED_PROBING)
+
+    def is_live(self) -> bool:
+        """Counts toward the fleet the autoscaler/operator cares about:
+        excludes terminal states AND the states on their way out
+        (SHUTTING_DOWN) or already lost (PREEMPTED)."""
+        return self in (ReplicaStatus.PENDING, ReplicaStatus.PROVISIONING,
+                        ReplicaStatus.STARTING, ReplicaStatus.READY,
+                        ReplicaStatus.NOT_READY)
+
+    @property
+    def scale_down_priority(self) -> int:
+        """Lower = scaled down first (prefer killing unhealthy replicas)."""
+        order = [ReplicaStatus.FAILED, ReplicaStatus.FAILED_PROVISION,
+                 ReplicaStatus.FAILED_PROBING,
+                 ReplicaStatus.FAILED_INITIAL_DELAY,
+                 ReplicaStatus.PREEMPTED, ReplicaStatus.NOT_READY,
+                 ReplicaStatus.PENDING, ReplicaStatus.PROVISIONING,
+                 ReplicaStatus.STARTING, ReplicaStatus.READY]
+        try:
+            return order.index(self)
+        except ValueError:
+            return len(order)
+
+
+_TERMINAL_REPLICA = {ReplicaStatus.TERMINATED, ReplicaStatus.FAILED,
+                     ReplicaStatus.FAILED_PROVISION,
+                     ReplicaStatus.FAILED_INITIAL_DELAY,
+                     ReplicaStatus.FAILED_PROBING}
+
+_LOCAL = threading.local()
+
+
+def _db() -> sqlite3.Connection:
+    path = os.path.join(global_user_state.get_state_dir(), 'serve.db')
+    conns = getattr(_LOCAL, 'conns', None)
+    if conns is None:
+        conns = _LOCAL.conns = {}
+    conn = conns.get(path)
+    if conn is None:
+        conn = sqlite3.connect(path, timeout=10.0)
+        conn.execute('PRAGMA journal_mode=WAL')
+        conn.execute("""
+            CREATE TABLE IF NOT EXISTS services (
+                name TEXT PRIMARY KEY,
+                spec TEXT NOT NULL,
+                task_yaml TEXT NOT NULL,
+                status TEXT NOT NULL,
+                controller_pid INTEGER,
+                lb_pid INTEGER,
+                controller_port INTEGER,
+                lb_port INTEGER,
+                requested_replicas INTEGER,
+                created_at REAL
+            )""")
+        conn.execute("""
+            CREATE TABLE IF NOT EXISTS replicas (
+                service TEXT NOT NULL,
+                replica_id INTEGER NOT NULL,
+                cluster_name TEXT NOT NULL,
+                status TEXT NOT NULL,
+                url TEXT,
+                port INTEGER,
+                launched_at REAL,
+                first_ready_at REAL,
+                consecutive_probe_failures INTEGER DEFAULT 0,
+                failure_reason TEXT,
+                PRIMARY KEY (service, replica_id)
+            )""")
+        conn.commit()
+        conns[path] = conn
+    return conn
+
+
+# ---- services ---------------------------------------------------------------
+def add_service(name: str, spec: Dict[str, Any], task_yaml: Dict[str, Any],
+                requested_replicas: int) -> bool:
+    conn = _db()
+    try:
+        conn.execute(
+            'INSERT INTO services (name, spec, task_yaml, status, '
+            'requested_replicas, created_at) VALUES (?,?,?,?,?,?)',
+            (name, json.dumps(spec), json.dumps(task_yaml),
+             ServiceStatus.CONTROLLER_INIT.value, requested_replicas,
+             time.time()))
+        conn.commit()
+        return True
+    except sqlite3.IntegrityError:
+        return False
+
+
+def update_service(name: str, **cols: Any) -> None:
+    if 'status' in cols and isinstance(cols['status'], ServiceStatus):
+        cols['status'] = cols['status'].value
+    conn = _db()
+    sets = ', '.join(f'{k}=?' for k in cols)
+    conn.execute(f'UPDATE services SET {sets} WHERE name=?',
+                 (*cols.values(), name))
+    conn.commit()
+
+
+def set_status_unless_shutting_down(name: str,
+                                    status: ServiceStatus) -> None:
+    """Status refresh used by the controller's tick: never clobbers a
+    SHUTTING_DOWN written by ``serve down`` (that write happens once, from
+    another process, and must survive until the controller observes it)."""
+    conn = _db()
+    conn.execute(
+        'UPDATE services SET status=? WHERE name=? AND status != ?',
+        (status.value, name, ServiceStatus.SHUTTING_DOWN.value))
+    conn.commit()
+
+
+def remove_service(name: str) -> None:
+    conn = _db()
+    conn.execute('DELETE FROM replicas WHERE service=?', (name,))
+    conn.execute('DELETE FROM services WHERE name=?', (name,))
+    conn.commit()
+
+
+def get_service(name: str) -> Optional[Dict[str, Any]]:
+    rows = list_services(names=[name])
+    return rows[0] if rows else None
+
+
+def list_services(names: Optional[List[str]] = None) -> List[Dict[str, Any]]:
+    q = ('SELECT name, spec, task_yaml, status, controller_pid, lb_pid, '
+         'controller_port, lb_port, requested_replicas, created_at '
+         'FROM services')
+    args: List[Any] = []
+    if names:
+        q += f' WHERE name IN ({",".join("?" * len(names))})'
+        args = list(names)
+    q += ' ORDER BY name'
+    out = []
+    for row in _db().execute(q, args):
+        out.append({
+            'name': row[0], 'spec': json.loads(row[1]),
+            'task_yaml': json.loads(row[2]),
+            'status': ServiceStatus(row[3]),
+            'controller_pid': row[4], 'lb_pid': row[5],
+            'controller_port': row[6], 'lb_port': row[7],
+            'requested_replicas': row[8], 'created_at': row[9],
+        })
+    return out
+
+
+# ---- replicas ---------------------------------------------------------------
+def add_replica(service: str, replica_id: int, cluster_name: str,
+                port: int) -> None:
+    conn = _db()
+    conn.execute(
+        'INSERT OR REPLACE INTO replicas (service, replica_id, cluster_name,'
+        ' status, port, launched_at) VALUES (?,?,?,?,?,?)',
+        (service, replica_id, cluster_name, ReplicaStatus.PENDING.value,
+         port, time.time()))
+    conn.commit()
+
+
+def update_replica(service: str, replica_id: int, **cols: Any) -> None:
+    if 'status' in cols and isinstance(cols['status'], ReplicaStatus):
+        cols['status'] = cols['status'].value
+    conn = _db()
+    sets = ', '.join(f'{k}=?' for k in cols)
+    conn.execute(
+        f'UPDATE replicas SET {sets} WHERE service=? AND replica_id=?',
+        (*cols.values(), service, replica_id))
+    conn.commit()
+
+
+def remove_replica(service: str, replica_id: int) -> None:
+    conn = _db()
+    conn.execute('DELETE FROM replicas WHERE service=? AND replica_id=?',
+                 (service, replica_id))
+    conn.commit()
+
+
+def list_replicas(service: str) -> List[Dict[str, Any]]:
+    out = []
+    for row in _db().execute(
+            'SELECT replica_id, cluster_name, status, url, port, '
+            'launched_at, first_ready_at, consecutive_probe_failures, '
+            'failure_reason FROM replicas WHERE service=? '
+            'ORDER BY replica_id', (service,)):
+        out.append({
+            'replica_id': row[0], 'cluster_name': row[1],
+            'status': ReplicaStatus(row[2]), 'url': row[3], 'port': row[4],
+            'launched_at': row[5], 'first_ready_at': row[6],
+            'consecutive_probe_failures': row[7], 'failure_reason': row[8],
+        })
+    return out
+
+
+def next_replica_id(service: str) -> int:
+    row = _db().execute(
+        'SELECT COALESCE(MAX(replica_id), 0) FROM replicas WHERE service=?',
+        (service,)).fetchone()
+    return int(row[0]) + 1

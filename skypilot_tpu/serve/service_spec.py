@@ -1,0 +1,157 @@
+"""ServiceSpec: the ``service:`` section of a task YAML.
+
+Counterpart of reference ``sky/serve/service_spec.py`` (SkyServiceSpec:
+readiness probe, replica policy, QPS targets). Validated by
+schemas.SERVICE_SCHEMA before reaching this object layer.
+
+Example YAML::
+
+    service:
+      readiness_probe:
+        path: /health
+        initial_delay_seconds: 600     # TPU cold start: XLA compile time
+      replica_policy:
+        min_replicas: 1
+        max_replicas: 4
+        target_qps_per_replica: 10
+      load_balancing_policy: least_load
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+
+DEFAULT_INITIAL_DELAY_SECONDS = 1200.0  # generous: XLA compile + weights load
+DEFAULT_PROBE_TIMEOUT_SECONDS = 15.0
+DEFAULT_QPS_WINDOW_SECONDS = 60.0
+DEFAULT_UPSCALE_DELAY_SECONDS = 300.0
+DEFAULT_DOWNSCALE_DELAY_SECONDS = 1200.0
+DEFAULT_REPLICA_PORT = 8080
+
+
+@dataclasses.dataclass(frozen=True)
+class ReadinessProbe:
+    path: str = '/health'
+    initial_delay_seconds: float = DEFAULT_INITIAL_DELAY_SECONDS
+    timeout_seconds: float = DEFAULT_PROBE_TIMEOUT_SECONDS
+    post_data: Optional[Any] = None   # dict/str => probe with POST
+    headers: Optional[Dict[str, str]] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaPolicy:
+    min_replicas: int = 1
+    max_replicas: Optional[int] = None          # None => fixed at min
+    target_qps_per_replica: Optional[float] = None
+    qps_window_seconds: float = DEFAULT_QPS_WINDOW_SECONDS
+    upscale_delay_seconds: float = DEFAULT_UPSCALE_DELAY_SECONDS
+    downscale_delay_seconds: float = DEFAULT_DOWNSCALE_DELAY_SECONDS
+
+    def __post_init__(self):
+        if self.min_replicas < 0:
+            raise exceptions.InvalidYamlError('min_replicas must be >= 0')
+        if (self.max_replicas is not None
+                and self.max_replicas < self.min_replicas):
+            raise exceptions.InvalidYamlError(
+                f'max_replicas ({self.max_replicas}) < min_replicas '
+                f'({self.min_replicas})')
+        if (self.max_replicas is not None
+                and self.max_replicas > self.min_replicas
+                and self.target_qps_per_replica is None):
+            raise exceptions.InvalidYamlError(
+                'autoscaling (max_replicas > min_replicas) requires '
+                'target_qps_per_replica')
+
+
+@dataclasses.dataclass(frozen=True)
+class ServiceSpec:
+    readiness_probe: ReadinessProbe = dataclasses.field(
+        default_factory=ReadinessProbe)
+    replica_policy: ReplicaPolicy = dataclasses.field(
+        default_factory=ReplicaPolicy)
+    load_balancing_policy: str = 'least_load'
+    replica_port: int = DEFAULT_REPLICA_PORT
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'ServiceSpec':
+        from skypilot_tpu import schemas
+        schemas.validate_service_config(config)
+
+        probe_cfg = config['readiness_probe']
+        if isinstance(probe_cfg, str):
+            probe = ReadinessProbe(path=probe_cfg)
+        else:
+            probe = ReadinessProbe(
+                path=probe_cfg['path'],
+                initial_delay_seconds=float(
+                    probe_cfg.get('initial_delay_seconds',
+                                  DEFAULT_INITIAL_DELAY_SECONDS)),
+                timeout_seconds=float(
+                    probe_cfg.get('timeout_seconds',
+                                  DEFAULT_PROBE_TIMEOUT_SECONDS)),
+                post_data=probe_cfg.get('post_data'),
+                headers=dict(probe_cfg['headers'])
+                if probe_cfg.get('headers') else None,
+            )
+
+        rp = dict(config.get('replica_policy') or {})
+        if 'replicas' in config:  # shorthand: fixed replica count
+            if rp:
+                raise exceptions.InvalidYamlError(
+                    "use either 'replicas' or 'replica_policy', not both")
+            rp = {'min_replicas': int(config['replicas'])}
+        policy = ReplicaPolicy(
+            min_replicas=int(rp.get('min_replicas', 1)),
+            max_replicas=(int(rp['max_replicas'])
+                          if rp.get('max_replicas') is not None else None),
+            target_qps_per_replica=(
+                float(rp['target_qps_per_replica'])
+                if rp.get('target_qps_per_replica') is not None else None),
+            qps_window_seconds=float(
+                rp.get('qps_window_seconds', DEFAULT_QPS_WINDOW_SECONDS)),
+            upscale_delay_seconds=float(
+                rp.get('upscale_delay_seconds',
+                       DEFAULT_UPSCALE_DELAY_SECONDS)),
+            downscale_delay_seconds=float(
+                rp.get('downscale_delay_seconds',
+                       DEFAULT_DOWNSCALE_DELAY_SECONDS)),
+        )
+        return cls(
+            readiness_probe=probe,
+            replica_policy=policy,
+            load_balancing_policy=config.get('load_balancing_policy')
+            or 'least_load',
+            replica_port=int(config.get('replica_port',
+                                        DEFAULT_REPLICA_PORT)),
+        )
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        probe: Dict[str, Any] = {
+            'path': self.readiness_probe.path,
+            'initial_delay_seconds': self.readiness_probe.initial_delay_seconds,
+            'timeout_seconds': self.readiness_probe.timeout_seconds,
+        }
+        if self.readiness_probe.post_data is not None:
+            probe['post_data'] = self.readiness_probe.post_data
+        if self.readiness_probe.headers:
+            probe['headers'] = dict(self.readiness_probe.headers)
+        rp: Dict[str, Any] = {
+            'min_replicas': self.replica_policy.min_replicas,
+            'qps_window_seconds': self.replica_policy.qps_window_seconds,
+            'upscale_delay_seconds': self.replica_policy.upscale_delay_seconds,
+            'downscale_delay_seconds':
+                self.replica_policy.downscale_delay_seconds,
+        }
+        if self.replica_policy.max_replicas is not None:
+            rp['max_replicas'] = self.replica_policy.max_replicas
+        if self.replica_policy.target_qps_per_replica is not None:
+            rp['target_qps_per_replica'] = \
+                self.replica_policy.target_qps_per_replica
+        return {
+            'readiness_probe': probe,
+            'replica_policy': rp,
+            'load_balancing_policy': self.load_balancing_policy,
+            'replica_port': self.replica_port,
+        }

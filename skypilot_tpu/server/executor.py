@@ -112,6 +112,37 @@ def _ep_optimize(payload: Dict[str, Any]) -> Any:
     }
 
 
+def _ep_serve_up(payload: Dict[str, Any]) -> Any:
+    from skypilot_tpu.serve import core as serve_core
+    task = _task_from_payload(payload)
+    return serve_core.up(task, payload['service_name'])
+
+
+def _ep_serve_status(payload: Dict[str, Any]) -> Any:
+    from skypilot_tpu.serve import core as serve_core
+    out = []
+    for row in serve_core.status(payload.get('service_names')):
+        out.append({
+            'name': row['name'],
+            'status': row['status'].value,
+            'endpoint': row['endpoint'],
+            'requested_replicas': row['requested_replicas'],
+            'replicas': [
+                {'replica_id': r['replica_id'],
+                 'cluster_name': r['cluster_name'],
+                 'status': r['status'].value, 'url': r['url']}
+                for r in row['replicas']
+            ],
+        })
+    return out
+
+
+def _ep_serve_down(payload: Dict[str, Any]) -> Any:
+    from skypilot_tpu.serve import core as serve_core
+    serve_core.down(payload['service_name'])
+    return {'name': payload['service_name'], 'down': True}
+
+
 ENTRYPOINTS: Dict[str, Callable[[Dict[str, Any]], Any]] = {
     'launch': _ep_launch,
     'exec': _ep_exec,
@@ -127,9 +158,13 @@ ENTRYPOINTS: Dict[str, Callable[[Dict[str, Any]], Any]] = {
     'tail_logs': _ep_tail_logs,
     'check': _ep_check,
     'optimize': _ep_optimize,
+    'serve_up': _ep_serve_up,
+    'serve_status': _ep_serve_status,
+    'serve_down': _ep_serve_down,
 }
 
-LONG_OPS = {'launch', 'exec', 'tail_logs'}
+# serve_down blocks on the controller draining the whole replica fleet.
+LONG_OPS = {'launch', 'exec', 'tail_logs', 'serve_up', 'serve_down'}
 
 
 def schedule_type_for(op: str) -> store.ScheduleType:

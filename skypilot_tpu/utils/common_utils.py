@@ -17,6 +17,18 @@ CLUSTER_NAME_VALID_REGEX = re.compile(r'^[a-zA-Z]([-_.a-zA-Z0-9]*[a-zA-Z0-9])?$'
 USER_HASH_LENGTH = 8
 
 
+def find_free_port(host: str = '127.0.0.1') -> int:
+    """An OS-assigned free port. NOTE: bind-then-close is inherently racy —
+    only use where the consumer binds immediately (e.g. picking distinct
+    ports for local replicas); long-lived servers should bind port 0
+    themselves and report the assigned port."""
+    s = socket.socket()
+    s.bind((host, 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
 def get_user_hash() -> str:
     """Stable per-user hash, used to namespace generated cloud resources."""
     env = os.environ.get('SKYTPU_USER_HASH')

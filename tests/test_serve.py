@@ -1,0 +1,382 @@
+"""Serving layer tests: spec parsing, autoscaler hysteresis, LB policies,
+and the local-cloud end-to-end scale 1→2→1 under synthetic QPS.
+
+Reference coverage model: tests/test_serve_autoscaler.py (synthetic request
+timestamps, no clusters) + smoke test_sky_serve.py (real clouds). Our e2e
+runs hermetically on the local cloud — replicas are real subprocess-backed
+HTTP servers behind the real controller/LB processes.
+"""
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from skypilot_tpu.serve import autoscaler as autoscaler_lib
+from skypilot_tpu.serve import load_balancing_policies as lb_policies
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve import service_spec as spec_lib
+
+ReplicaStatus = serve_state.ReplicaStatus
+ServiceStatus = serve_state.ServiceStatus
+
+
+# ---- spec -------------------------------------------------------------------
+class TestServiceSpec:
+
+    def test_yaml_roundtrip(self):
+        cfg = {
+            'readiness_probe': {'path': '/health',
+                                'initial_delay_seconds': 30},
+            'replica_policy': {'min_replicas': 1, 'max_replicas': 3,
+                               'target_qps_per_replica': 2.5},
+            'load_balancing_policy': 'round_robin',
+            'replica_port': 9000,
+        }
+        spec = spec_lib.ServiceSpec.from_yaml_config(cfg)
+        assert spec.readiness_probe.path == '/health'
+        assert spec.readiness_probe.initial_delay_seconds == 30
+        assert spec.replica_policy.max_replicas == 3
+        assert spec.load_balancing_policy == 'round_robin'
+        spec2 = spec_lib.ServiceSpec.from_yaml_config(spec.to_yaml_config())
+        assert spec2 == spec
+
+    def test_string_probe_and_replicas_shorthand(self):
+        spec = spec_lib.ServiceSpec.from_yaml_config({
+            'readiness_probe': '/ready', 'replicas': 2})
+        assert spec.readiness_probe.path == '/ready'
+        assert spec.replica_policy.min_replicas == 2
+        assert spec.replica_policy.max_replicas is None
+
+    def test_task_yaml_service_section(self, tmp_path):
+        yaml_path = tmp_path / 'svc.yaml'
+        yaml_path.write_text('''
+name: myservice
+resources:
+  cloud: local
+service:
+  readiness_probe: /health
+  replica_policy:
+    min_replicas: 2
+    max_replicas: 4
+    target_qps_per_replica: 3
+run: echo serving
+''')
+        import skypilot_tpu as sky
+        task = sky.Task.from_yaml(str(yaml_path))
+        assert task.service is not None
+        assert task.service.replica_policy.min_replicas == 2
+        cfg = task.to_yaml_config()
+        assert cfg['service']['replica_policy']['max_replicas'] == 4
+        task2 = sky.Task.from_yaml_config(cfg)
+        assert task2.service == task.service
+
+    def test_autoscaling_requires_qps_target(self):
+        from skypilot_tpu import exceptions
+        with pytest.raises(exceptions.InvalidYamlError,
+                           match='target_qps_per_replica'):
+            spec_lib.ServiceSpec.from_yaml_config({
+                'readiness_probe': '/health',
+                'replica_policy': {'min_replicas': 1, 'max_replicas': 3},
+            })
+
+
+# ---- autoscaler -------------------------------------------------------------
+def _make_autoscaler(upscale=60.0, downscale=120.0, interval=20.0,
+                     target_qps=2.0, minr=1, maxr=4, window=60.0):
+    spec = spec_lib.ServiceSpec(
+        replica_policy=spec_lib.ReplicaPolicy(
+            min_replicas=minr, max_replicas=maxr,
+            target_qps_per_replica=target_qps,
+            qps_window_seconds=window,
+            upscale_delay_seconds=upscale,
+            downscale_delay_seconds=downscale))
+    return autoscaler_lib.RequestRateAutoscaler(
+        spec, decision_interval_seconds=interval)
+
+
+class TestAutoscaler:
+
+    def test_fixed_fleet_without_qps_target(self):
+        spec = spec_lib.ServiceSpec(
+            replica_policy=spec_lib.ReplicaPolicy(min_replicas=3))
+        a = autoscaler_lib.RequestRateAutoscaler(spec, 20.0)
+        a.collect_requests([time.time()] * 100)
+        assert a.evaluate() == 3
+
+    def test_upscale_needs_sustained_load(self):
+        # upscale delay 60s at 20s interval => 3 consecutive evaluations.
+        a = _make_autoscaler(upscale=60.0, interval=20.0, target_qps=2.0,
+                             window=60.0)
+        now = 1000.0
+        # 300 requests in the window -> 5 qps -> proposes ceil(5/2)=3.
+        a.collect_requests([now - i * 0.2 for i in range(300)], now=now)
+        assert a.evaluate(now=now) == 1        # tick 1: not yet
+        assert a.evaluate(now=now + 1) == 1    # tick 2: not yet
+        assert a.evaluate(now=now + 2) == 3    # tick 3: adopted
+        # A brief lull must not immediately downscale (delay 120s => 6 ticks)
+        a2_now = now + 3
+        assert a.evaluate(now=a2_now) == 3
+
+    def test_spike_does_not_upscale(self):
+        a = _make_autoscaler(upscale=60.0, interval=20.0, target_qps=2.0,
+                             window=60.0)
+        now = 1000.0
+        a.collect_requests([now - i * 0.2 for i in range(300)], now=now)
+        assert a.evaluate(now=now) == 1
+        # Load disappears before the hysteresis is satisfied: counter resets.
+        a._request_times = []
+        assert a.evaluate(now=now + 1) == 1
+        a.collect_requests([now + 2 - i * 0.2 for i in range(300)],
+                           now=now + 2)
+        assert a.evaluate(now=now + 2) == 1  # needs 3 fresh consecutive
+
+    def test_downscale_after_sustained_quiet(self):
+        a = _make_autoscaler(upscale=20.0, downscale=40.0, interval=20.0,
+                             target_qps=2.0, window=60.0)
+        now = 1000.0
+        a.collect_requests([now - i * 0.1 for i in range(600)], now=now)
+        assert a.evaluate(now=now) == 4  # 10qps/2 = 5, clipped to max 4
+        # Traffic stops; downscale needs 2 consecutive quiet evaluations.
+        later = now + 100  # all requests aged out of the window
+        assert a.evaluate(now=later) == 4
+        assert a.evaluate(now=later + 1) == 1
+
+    def test_clipping_to_min_max(self):
+        a = _make_autoscaler(upscale=20.0, interval=20.0, target_qps=0.001,
+                             minr=1, maxr=2, window=60.0)
+        now = 1000.0
+        a.collect_requests([now - i * 0.01 for i in range(1000)], now=now)
+        assert a.evaluate(now=now) == 2  # clipped at max
+
+
+# ---- LB policies ------------------------------------------------------------
+class TestPolicies:
+
+    def test_round_robin_cycles(self):
+        p = lb_policies.make('round_robin')
+        p.set_replicas(['a', 'b'])
+        assert [p.select() for _ in range(4)] == ['a', 'b', 'a', 'b']
+
+    def test_least_load_prefers_idle(self):
+        p = lb_policies.make('least_load')
+        p.set_replicas(['a', 'b'])
+        first = p.select()
+        p.on_request_start(first)
+        second = p.select()
+        assert second != first
+        p.on_request_start(second)
+        p.on_request_end(first)
+        assert p.select() == first
+
+    def test_empty_returns_none(self):
+        p = lb_policies.make('least_load')
+        assert p.select() is None
+
+    def test_unknown_policy(self):
+        with pytest.raises(ValueError, match='least_load'):
+            lb_policies.make('nope')
+
+
+# ---- e2e on the local cloud -------------------------------------------------
+_REPLICA_SERVER = r'''
+import http.server, json, os
+PORT = int(os.environ['SKYTPU_SERVE_REPLICA_PORT'])
+RID = os.environ.get('SKYTPU_SERVE_REPLICA_ID', '?')
+
+class H(http.server.BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+    def do_GET(self):
+        body = json.dumps({'replica': RID, 'path': self.path}).encode()
+        self.send_response(200)
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+http.server.ThreadingHTTPServer(('127.0.0.1', PORT), H).serve_forever()
+'''
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read(), dict(resp.headers)
+
+
+def _wait(predicate, timeout, what, interval=0.3):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        result = predicate()
+        if result:
+            return result
+        time.sleep(interval)
+    raise TimeoutError(f'timed out waiting for {what}')
+
+
+def _ready_replicas(service):
+    return [r for r in serve_state.list_replicas(service)
+            if r['status'] == ReplicaStatus.READY]
+
+
+@pytest.fixture()
+def fast_serve_env(monkeypatch, tmp_path):
+    script = tmp_path / 'replica_server.py'
+    script.write_text(_REPLICA_SERVER)
+    monkeypatch.setenv('SKYTPU_SERVE_TICK', '0.2')
+    monkeypatch.setenv('SKYTPU_SERVE_LB_SYNC', '0.2')
+    return script
+
+
+def _service_task(script, min_replicas=1, max_replicas=None,
+                  target_qps=None, **policy_kw):
+    import skypilot_tpu as sky
+    task = sky.Task(run=f'{sys.executable} {script}')
+    task.set_resources([sky.Resources(cloud='local')])
+    rp = {'min_replicas': min_replicas, **policy_kw}
+    if max_replicas is not None:
+        rp['max_replicas'] = max_replicas
+    if target_qps is not None:
+        rp['target_qps_per_replica'] = target_qps
+    task.set_service(spec_lib.ServiceSpec.from_yaml_config({
+        'readiness_probe': {'path': '/health',
+                            'initial_delay_seconds': 60,
+                            'timeout_seconds': 2},
+        'replica_policy': rp,
+    }))
+    return task
+
+
+class TestServeE2E:
+
+    def test_up_scale_up_down_cycle(self, fast_serve_env):
+        """The VERDICT round-2 acceptance: 1→2→1 under synthetic QPS with
+        the LB proxying responses."""
+        from skypilot_tpu.serve import core as serve_core
+        task = _service_task(
+            fast_serve_env, min_replicas=1, max_replicas=2, target_qps=2.0,
+            qps_window_seconds=2.0,
+            upscale_delay_seconds=0.4, downscale_delay_seconds=0.4)
+        result = serve_core.up(task, 'svc-e2e')
+        endpoint = result['endpoint']
+        try:
+            _wait(lambda: len(_ready_replicas('svc-e2e')) == 1, 120,
+                  'first replica READY')
+            svc = serve_state.get_service('svc-e2e')
+            assert svc['status'] == ServiceStatus.READY
+
+            # LB proxies to the replica.
+            status_code, body, headers = _get(endpoint + '/whoami')
+            assert status_code == 200
+            payload = json.loads(body)
+            assert payload['path'] == '/whoami'
+            assert 'X-Skytpu-Replica' in headers
+
+            # Push sustained traffic through the LB -> scale to 2.
+            def push_and_check():
+                for _ in range(8):
+                    try:
+                        _get(endpoint + '/load-gen', timeout=5)
+                    except (urllib.error.URLError, OSError):
+                        pass
+                return len(_ready_replicas('svc-e2e')) == 2
+
+            _wait(push_and_check, 120, 'scale up to 2 READY replicas',
+                  interval=0.1)
+
+            # Traffic stops -> scale back down to 1.
+            _wait(lambda: len([
+                r for r in serve_state.list_replicas('svc-e2e')
+                if not r['status'].is_terminal()
+                and r['status'] != ReplicaStatus.SHUTTING_DOWN]) == 1,
+                  120, 'scale down to 1 replica')
+        finally:
+            serve_core.down('svc-e2e')
+        assert serve_state.get_service('svc-e2e') is None
+        # All replica clusters are gone from cluster state too.
+        from skypilot_tpu import global_user_state
+        leftovers = [r['name'] for r in global_user_state.get_clusters()
+                     if r['name'].startswith('svc-e2e-rep')]
+        assert not leftovers, leftovers
+
+    def test_replica_preemption_recovery(self, fast_serve_env):
+        """Kill a replica's cluster out-of-band: the controller must mark
+        it PREEMPTED and top the fleet back up (reference
+        replica_managers._handle_preemption:830)."""
+        from skypilot_tpu import global_user_state
+        from skypilot_tpu.provision import local_impl
+        from skypilot_tpu.serve import core as serve_core
+        task = _service_task(fast_serve_env, min_replicas=1)
+        serve_core.up(task, 'svc-preempt')
+        try:
+            first = _wait(
+                lambda: _ready_replicas('svc-preempt') or None, 120,
+                'replica READY')[0]
+            # Preempt: terminate the cluster beneath the service.
+            local_impl.terminate_instances(first['cluster_name'], 'local')
+            global_user_state.remove_cluster(first['cluster_name'],
+                                            terminate=True)
+
+            def recovered():
+                ready = _ready_replicas('svc-preempt')
+                return (ready and
+                        ready[0]['replica_id'] != first['replica_id'])
+
+            _wait(recovered, 120, 'replacement replica READY')
+            rows = serve_state.list_replicas('svc-preempt')
+            preempted = [r for r in rows
+                         if r['status'] == ReplicaStatus.PREEMPTED]
+            assert preempted, [r['status'] for r in rows]
+        finally:
+            serve_core.down('svc-preempt')
+
+    def test_serve_via_api_server(self, fast_serve_env, monkeypatch):
+        """serve_up/status/down through the API server + SDK."""
+        import socket
+        from skypilot_tpu.client import sdk
+        from skypilot_tpu.server import server as server_lib
+        s = socket.socket()
+        s.bind(('127.0.0.1', 0))
+        port = s.getsockname()[1]
+        s.close()
+        httpd = server_lib.serve(port=port, background=True)
+        monkeypatch.setenv('SKYTPU_API_SERVER_URL',
+                           f'http://127.0.0.1:{port}')
+        try:
+            task = _service_task(fast_serve_env, min_replicas=1)
+            result = sdk.get(sdk.serve_up(task, 'svc-api'))
+            assert result['endpoint'].startswith('http://')
+
+            def one_ready():
+                rows = sdk.get(sdk.serve_status(['svc-api']))
+                reps = [r for r in rows[0]['replicas']
+                        if r['status'] == 'READY']
+                return rows[0]['status'] == 'READY' and len(reps) == 1
+
+            _wait(one_ready, 120, 'service READY via API')
+            assert sdk.get(sdk.serve_down('svc-api'))['down'] is True
+            assert sdk.get(sdk.serve_status(None)) == []
+        finally:
+            httpd.shutdown()
+
+    def test_lb_503_with_no_replicas(self, fast_serve_env):
+        from skypilot_tpu.serve import core as serve_core
+        task = _service_task(fast_serve_env, min_replicas=0)
+        result = serve_core.up(task, 'svc-zero')
+        try:
+            def lb_answers():
+                try:
+                    urllib.request.urlopen(result['endpoint'] + '/x',
+                                           timeout=5)
+                except urllib.error.HTTPError as e:
+                    return e.code
+                except (urllib.error.URLError, OSError):
+                    return None
+                return None
+
+            code = _wait(lb_answers, 60, 'LB up')
+            assert code == 503
+        finally:
+            serve_core.down('svc-zero')

@@ -1,0 +1,248 @@
+"""TPU-native autoregressive decode engine (slot-based continuous batching).
+
+This is the serving engine the BASELINE anchors require (reference anchor:
+JetStream serving numbers in /root/reference/examples/tpu/v6e/README.md:94-130;
+the reference itself ships no engine — it orchestrates JetStream/vLLM).
+
+Design (vs the correctness-oracle ``LlamaModel.decode_step``):
+  - one **stacked KV cache** ``[L, B, M, kvh, d]`` held in a donated
+    ``DecodeState``; every jitted op updates it via dynamic-slice /
+    scatter so XLA aliases buffers in place — no per-step ``jnp.stack``.
+  - ``lax.scan`` over layers (O(1) HLO in depth, fast compiles).
+  - **slots**: a fixed decode batch of B independent sequences with
+    per-row ``lengths``; requests are prefilled one at a time (padded to a
+    static bucket), inserted into a free slot, and decoded together —
+    continuous batching, the TPU-friendly JetStream architecture.
+  - sampling (greedy / temperature / top-k) runs inside the step jit, so
+    the only per-step host traffic is B sampled token ids.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from skypilot_tpu.models.llama import LlamaConfig, LlamaModel, Params
+from skypilot_tpu.ops import attention as attention_ops
+from skypilot_tpu.ops.layers import apply_rotary, precompute_rotary, rms_norm
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class DecodeState:
+    """Batched decode state: stacked KV cache + per-slot bookkeeping."""
+    k: jax.Array            # [L, B, M, kvh, d]
+    v: jax.Array            # [L, B, M, kvh, d]
+    lengths: jax.Array      # [B] int32: tokens currently in each slot's cache
+    last_tokens: jax.Array  # [B] int32: next token to feed per slot
+    active: jax.Array       # [B] bool: slot occupied
+
+
+class DecodeEngine:
+    """Jitted prefill / insert / step over a fixed slot batch.
+
+    ``batch_slots`` and ``max_len`` are static (one compiled program);
+    prompts are padded to power-of-two buckets so prefill compiles a small
+    number of variants.
+    """
+
+    def __init__(self, config: LlamaConfig, batch_slots: int = 8,
+                 max_len: Optional[int] = None):
+        self.config = config
+        self.batch_slots = batch_slots
+        self.max_len = max_len or config.max_seq_len
+        self._prefill = jax.jit(self._prefill_impl)
+        self._insert = jax.jit(self._insert_impl, donate_argnums=(0,))
+        self._step = jax.jit(self._step_impl, donate_argnums=(1,),
+                             static_argnames=('temperature', 'top_k'))
+
+    # -- state --------------------------------------------------------------
+    def init_state(self) -> DecodeState:
+        c = self.config
+        shape = (c.num_layers, self.batch_slots, self.max_len,
+                 c.num_kv_heads, c.head_dim)
+        b = self.batch_slots
+        return DecodeState(
+            k=jnp.zeros(shape, c.dtype),
+            v=jnp.zeros(shape, c.dtype),
+            lengths=jnp.zeros((b,), jnp.int32),
+            last_tokens=jnp.zeros((b,), jnp.int32),
+            active=jnp.zeros((b,), bool),
+        )
+
+    # -- prefill ------------------------------------------------------------
+    def prefill(self, params: Params, tokens: jax.Array,
+                true_len: int) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """Run a single prompt [T_padded] through the model.
+
+        Returns (k [L, T_padded, kvh, d], v, last_logits [V]). End-padding is
+        benign under causal attention; the garbage keys past ``true_len``
+        are masked out at decode time by the slot length. The caller samples
+        the FIRST generated token from ``last_logits`` (that token is the
+        TTFT token) and feeds it to ``insert`` as ``last_token``.
+        """
+        return self._prefill(params, tokens,
+                             jnp.asarray(true_len, jnp.int32))
+
+    def _prefill_impl(self, params, tokens, true_len):
+        c = self.config
+        t = tokens.shape[0]
+        positions = jnp.arange(t)
+        cos, sin = precompute_rotary(c.head_dim, c.max_seq_len, c.rope_theta)
+        x = params['embed'][tokens][None].astype(c.dtype)  # [1, T, e]
+
+        def layer(x, lp):
+            h = rms_norm(x, lp['attn_norm'], c.norm_eps)
+            q = jnp.einsum('bse,ehd->bshd', h, lp['wq'])
+            k = jnp.einsum('bse,ehd->bshd', h, lp['wk'])
+            v = jnp.einsum('bse,ehd->bshd', h, lp['wv'])
+            q = apply_rotary(q, cos, sin, positions)
+            k = apply_rotary(k, cos, sin, positions)
+            attn = attention_ops.attention(q, k, v, causal=True)
+            x = x + jnp.einsum('bshd,hde->bse', attn, lp['wo'])
+            h = rms_norm(x, lp['mlp_norm'], c.norm_eps)
+            gated = jax.nn.silu(jnp.einsum('bse,em->bsm', h, lp['w_gate'])) \
+                * jnp.einsum('bse,em->bsm', h, lp['w_up'])
+            x = x + jnp.einsum('bsm,me->bse', gated, lp['w_down'])
+            return x, (k[0], v[0])
+
+        x, (ks, vs) = lax.scan(layer, x, params['layers'])
+        x = rms_norm(x, params['final_norm'], c.norm_eps)
+        head = (params['embed'].T if c.tie_embeddings else params['lm_head'])
+        # Logits only for the last real token — avoids the [T, V] matmul.
+        last = x[0, true_len - 1].astype(jnp.float32)
+        logits = last @ head.astype(jnp.float32)
+        return ks, vs, logits
+
+    # -- insert -------------------------------------------------------------
+    def insert(self, state: DecodeState, k: jax.Array, v: jax.Array,
+               true_len: jax.Array, last_token: jax.Array,
+               slot: jax.Array) -> DecodeState:
+        """Write a prefilled prompt's KV into ``slot`` and mark it active."""
+        return self._insert(state, k, v, jnp.asarray(true_len, jnp.int32),
+                            jnp.asarray(last_token, jnp.int32),
+                            jnp.asarray(slot, jnp.int32))
+
+    def _insert_impl(self, state, k, v, true_len, last_token, slot):
+        t = k.shape[1]
+        pad_m = self.max_len - t
+        if pad_m < 0:
+            raise ValueError(f'prefill length {t} exceeds max_len '
+                             f'{self.max_len}')
+        # [L, T, kvh, d] -> [L, 1, M, kvh, d] zero-extended, then one
+        # dynamic_update_slice into the stacked cache (in-place: donated).
+        kf = jnp.pad(k, ((0, 0), (0, pad_m), (0, 0), (0, 0)))[:, None]
+        vf = jnp.pad(v, ((0, 0), (0, pad_m), (0, 0), (0, 0)))[:, None]
+        new_k = lax.dynamic_update_slice(state.k, kf.astype(state.k.dtype),
+                                         (0, slot, 0, 0, 0))
+        new_v = lax.dynamic_update_slice(state.v, vf.astype(state.v.dtype),
+                                         (0, slot, 0, 0, 0))
+        return DecodeState(
+            k=new_k, v=new_v,
+            lengths=state.lengths.at[slot].set(true_len),
+            last_tokens=state.last_tokens.at[slot].set(last_token),
+            active=state.active.at[slot].set(True),
+        )
+
+    def release(self, state: DecodeState, slot: int) -> DecodeState:
+        """Mark a slot free (cache contents are dead; lengths gate reads)."""
+        return DecodeState(k=state.k, v=state.v,
+                           lengths=state.lengths.at[slot].set(0),
+                           last_tokens=state.last_tokens,
+                           active=state.active.at[slot].set(False))
+
+    # -- decode step --------------------------------------------------------
+    def step(self, params: Params, state: DecodeState, rng: jax.Array,
+             temperature: float = 0.0,
+             top_k: int = 0) -> Tuple[DecodeState, jax.Array]:
+        """One token for every active slot. Returns (state, sampled [B])."""
+        return self._step(params, state, rng, temperature=temperature,
+                          top_k=top_k)
+
+    def _step_impl(self, params, state, rng, *, temperature, top_k):
+        c = self.config
+        b = self.batch_slots
+        grp = c.num_heads // c.num_kv_heads
+        cos, sin = precompute_rotary(c.head_dim, c.max_seq_len, c.rope_theta)
+        positions = state.lengths[:, None]  # [B, 1]: new token's position
+        x = params['embed'][state.last_tokens][:, None].astype(c.dtype)
+        rows = jnp.arange(b)
+        kv_pos = jnp.arange(self.max_len)
+        # New key written at index ``lengths`` -> valid keys are <= lengths.
+        valid = kv_pos[None] <= state.lengths[:, None]  # [B, M]
+
+        def layer(carry, inputs):
+            x, cache_k, cache_v = carry
+            lp, i = inputs
+            h = rms_norm(x, lp['attn_norm'], c.norm_eps)
+            q = jnp.einsum('bse,ehd->bshd', h, lp['wq'])
+            k = jnp.einsum('bse,ehd->bshd', h, lp['wk'])
+            v = jnp.einsum('bse,ehd->bshd', h, lp['wv'])
+            q = apply_rotary(q, cos, sin, positions)
+            k = apply_rotary(k, cos, sin, positions)
+            # Scatter the new K/V row into layer i at each slot's length
+            # (in-place on the donated carry).
+            cache_k = cache_k.at[i, rows, state.lengths].set(
+                k[:, 0].astype(cache_k.dtype))
+            cache_v = cache_v.at[i, rows, state.lengths].set(
+                v[:, 0].astype(cache_v.dtype))
+            k_layer = cache_k[i]  # [B, M, kvh, d]
+            v_layer = cache_v[i]
+            # Grouped-query attention without repeating KV ([B,kvh,grp,d]).
+            qg = q[:, 0].reshape(b, c.num_kv_heads, grp, c.head_dim)
+            s = jnp.einsum('bkgd,bmkd->bkgm', qg.astype(jnp.float32),
+                           k_layer.astype(jnp.float32))
+            s = s * (c.head_dim**-0.5)
+            s = jnp.where(valid[:, None, None, :], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            attn = jnp.einsum('bkgm,bmkd->bkgd', p,
+                              v_layer.astype(jnp.float32))
+            attn = attn.reshape(b, 1, c.num_heads, c.head_dim).astype(c.dtype)
+            x = x + jnp.einsum('bshd,hde->bse', attn, lp['wo'])
+            h = rms_norm(x, lp['mlp_norm'], c.norm_eps)
+            gated = jax.nn.silu(jnp.einsum('bse,em->bsm', h, lp['w_gate'])) \
+                * jnp.einsum('bse,em->bsm', h, lp['w_up'])
+            x = x + jnp.einsum('bsm,me->bse', gated, lp['w_down'])
+            return (x, cache_k, cache_v), None
+
+        n_layers = c.num_layers
+        (x, new_k, new_v), _ = lax.scan(
+            layer, (x, state.k, state.v),
+            (params['layers'], jnp.arange(n_layers)))
+
+        x = rms_norm(x, params['final_norm'], c.norm_eps)
+        head = (params['embed'].T if c.tie_embeddings else params['lm_head'])
+        logits = jnp.einsum('be,ev->bv', x[:, 0].astype(jnp.float32),
+                            head.astype(jnp.float32))
+        sampled = _sample(logits, rng, temperature, top_k)
+        active_i = state.active.astype(jnp.int32)
+        return DecodeState(
+            k=new_k, v=new_v,
+            lengths=state.lengths + active_i,
+            last_tokens=jnp.where(state.active, sampled, state.last_tokens),
+            active=state.active,
+        ), sampled
+
+
+def _sample(logits: jax.Array, rng: jax.Array, temperature: float,
+            top_k: int) -> jax.Array:
+    """Greedy (temperature 0) / temperature / top-k sampling, inside jit."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / temperature
+    if top_k > 0:
+        kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+        scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
+    return jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+
+
+def prefill_bucket(length: int, max_len: int, floor: int = 16) -> int:
+    """Smallest power-of-two bucket >= length (bounded by max_len)."""
+    b = floor
+    while b < length:
+        b *= 2
+    return min(b, max_len)

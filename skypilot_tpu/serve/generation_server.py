@@ -1,0 +1,358 @@
+"""HTTP generation server: continuous batching over the DecodeEngine.
+
+Runs as a serve replica (readiness at ``/health`` matches the default
+``ReadinessProbe`` in service_spec.py). The reference orchestrates external
+engines (JetStream/vLLM, reference examples/tpu/v6e/README.md:94-130); this
+framework owns the model layer, so the engine is in-tree and TPU-native.
+
+Architecture: one background scheduler thread owns all device state.
+  - pending requests queue -> prefill (padded to pow2 bucket) -> insert
+    into a free slot of the shared DecodeState;
+  - one ``DecodeEngine.step`` advances every active slot a token;
+  - per-request token queues feed streaming HTTP responses;
+  - slots free on EOS / max_tokens.
+
+API (JSON over stdlib http.server, threaded):
+  POST /generate  {"tokens": [..]} or {"text": ".."}, opts: max_tokens,
+                  temperature, top_k, stream, eos_id
+    -> {"tokens": [...], "text": ..., "ttft_ms": .., "latency_ms": ..}
+    -> stream=true: newline-delimited JSON chunks {"token": id}
+  GET /health     200 once the engine is warm (first compile done)
+  GET /stats      slot occupancy / counters
+"""
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.models.decode import DecodeEngine, prefill_bucket
+from skypilot_tpu.models.llama import PRESETS, LlamaConfig, LlamaModel
+
+
+class ByteTokenizer:
+    """Trivial reversible tokenizer: UTF-8 bytes + BOS/EOS specials.
+
+    Lets text requests work with any vocab >= 258 without external
+    tokenizer assets; production callers send token ids directly.
+    """
+    BOS = 256
+    EOS = 257
+
+    def encode(self, text: str) -> List[int]:
+        return [self.BOS] + list(text.encode('utf-8'))
+
+    def decode(self, tokens: List[int]) -> str:
+        return bytes(t for t in tokens if t < 256).decode('utf-8', 'replace')
+
+
+class _Request:
+    __slots__ = ('tokens', 'max_tokens', 'temperature', 'top_k', 'eos_id',
+                 'out_queue', 'submitted_at', 'first_token_at', 'done')
+
+    def __init__(self, tokens, max_tokens, temperature, top_k, eos_id):
+        self.tokens = tokens
+        self.max_tokens = max_tokens
+        self.temperature = temperature
+        self.top_k = top_k
+        self.eos_id = eos_id
+        self.out_queue: 'queue.Queue[Optional[int]]' = queue.Queue()
+        self.submitted_at = time.perf_counter()
+        self.first_token_at: Optional[float] = None
+        self.done = False
+
+
+class GenerationScheduler:
+    """Owns params + DecodeState; runs the continuous-batching loop."""
+
+    def __init__(self, config: LlamaConfig, params: Any,
+                 batch_slots: int = 8, max_len: Optional[int] = None):
+        import jax
+        self.config = config
+        self.params = params
+        self.engine = DecodeEngine(config, batch_slots=batch_slots,
+                                   max_len=max_len)
+        self.state = self.engine.init_state()
+        self._rng = jax.random.key(0)
+        self._pending: 'queue.Queue[_Request]' = queue.Queue()
+        self._slots: List[Optional[_Request]] = [None] * batch_slots
+        self._emitted: List[int] = [0] * batch_slots
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self.warm = threading.Event()
+        self.counters = {'requests': 0, 'tokens_out': 0}
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name='generation-scheduler')
+
+    # -- public -------------------------------------------------------------
+    def start(self, warmup: bool = True) -> None:
+        if warmup:
+            threading.Thread(target=self._warmup, daemon=True).start()
+        else:
+            self.warm.set()
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+
+    def submit(self, req: _Request) -> None:
+        self.counters['requests'] += 1
+        self._pending.put(req)
+        self._wake.set()
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            'slots_total': self.engine.batch_slots,
+            'slots_active': sum(r is not None for r in self._slots),
+            'pending': self._pending.qsize(),
+            **self.counters,
+        }
+
+    # -- internals ----------------------------------------------------------
+    def _warmup(self) -> None:
+        """Compile prefill (smallest bucket) + step before serving traffic."""
+        import jax
+        eng = self.engine
+        toks = jax.numpy.zeros((prefill_bucket(1, eng.max_len),),
+                               jax.numpy.int32)
+        eng.prefill(self.params, toks, 1)
+        state = eng.init_state()
+        state, _ = eng.step(self.params, state, self._rng)
+        jax.block_until_ready(state.lengths)
+        self.warm.set()
+
+    def _admit(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from skypilot_tpu.models.decode import _sample
+        eng = self.engine
+        while True:
+            free = [i for i, r in enumerate(self._slots) if r is None]
+            if not free or self._pending.empty():
+                return
+            req = self._pending.get()
+            slot = free[0]
+            prompt = req.tokens[:eng.max_len - 1]
+            bucket = prefill_bucket(len(prompt), eng.max_len)
+            padded = jnp.asarray(
+                prompt + [0] * (bucket - len(prompt)), jnp.int32)
+            k, v, logits = eng.prefill(self.params, padded, len(prompt))
+            # The FIRST generated token comes from the prefill logits — it
+            # is the TTFT token, emitted before the request joins the batch.
+            self._rng, sub = jax.random.split(self._rng)
+            first_tok = int(_sample(logits[None], sub, req.temperature,
+                                    req.top_k)[0])
+            req.first_token_at = time.perf_counter()
+            req.out_queue.put(first_tok)
+            self.counters['tokens_out'] += 1
+            hit_eos = (req.eos_id is not None and first_tok == req.eos_id)
+            if hit_eos or req.max_tokens <= 1:
+                req.done = True
+                req.out_queue.put(None)
+                continue
+            self.state = eng.insert(self.state, k, v, len(prompt),
+                                    first_tok, slot)
+            self._slots[slot] = req
+            self._emitted[slot] = 1
+
+    def _loop(self) -> None:
+        import jax
+        while not self._stop.is_set():
+            self._admit()
+            active = [r for r in self._slots if r is not None]
+            if not active:
+                self._wake.wait(timeout=0.2)
+                self._wake.clear()
+                continue
+            # Temperature/top_k are static per compiled step: use the first
+            # active request's settings for the batch (homogeneous fleets in
+            # practice; per-slot temperature would go inside the jit).
+            req0 = active[0]
+            self._rng, sub = jax.random.split(self._rng)
+            self.state, sampled = self.engine.step(
+                self.params, self.state, sub,
+                temperature=req0.temperature, top_k=req0.top_k)
+            tokens = sampled.tolist()  # B ints: the only per-step D2H
+            now = time.perf_counter()
+            for slot, req in enumerate(self._slots):
+                if req is None:
+                    continue
+                tok = int(tokens[slot])
+                if req.first_token_at is None:
+                    req.first_token_at = now
+                req.out_queue.put(tok)
+                self.counters['tokens_out'] += 1
+                self._emitted[slot] += 1
+                hit_eos = (req.eos_id is not None and tok == req.eos_id)
+                full = (self.state.lengths[slot] >= self.engine.max_len - 1)
+                if hit_eos or self._emitted[slot] >= req.max_tokens or full:
+                    req.done = True
+                    req.out_queue.put(None)  # sentinel: stream end
+                    self.state = self.engine.release(self.state, slot)
+                    self._slots[slot] = None
+
+
+class GenerationServer:
+    """Threaded HTTP front end around a GenerationScheduler."""
+
+    def __init__(self, scheduler: GenerationScheduler, host: str = '0.0.0.0',
+                 port: int = 0):
+        self.scheduler = scheduler
+        self.tokenizer = ByteTokenizer()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = 'HTTP/1.1'
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                if self.path == '/health':
+                    if outer.scheduler.warm.is_set():
+                        self._json(200, {'status': 'ok'})
+                    else:
+                        self._json(503, {'status': 'warming up'})
+                elif self.path == '/stats':
+                    self._json(200, outer.scheduler.stats())
+                else:
+                    self._json(404, {'error': 'not found'})
+
+            def do_POST(self):
+                if self.path != '/generate':
+                    self._json(404, {'error': 'not found'})
+                    return
+                try:
+                    length = int(self.headers.get('Content-Length', 0))
+                    body = json.loads(self.rfile.read(length) or b'{}')
+                    outer._handle_generate(self, body)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # noqa: BLE001 — report to client
+                    try:
+                        self._json(400, {'error': str(e)})
+                    except Exception:
+                        pass
+
+            def _json(self, code, payload):
+                data = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header('Content-Type', 'application/json')
+                self.send_header('Content-Length', str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+
+    def _handle_generate(self, handler, body: Dict[str, Any]) -> None:
+        if 'tokens' in body:
+            tokens = [int(t) for t in body['tokens']]
+            is_text = False
+        elif 'text' in body:
+            tokens = self.tokenizer.encode(body['text'])
+            is_text = True
+        else:
+            raise ValueError('request needs "tokens" or "text"')
+        if not tokens:
+            raise ValueError('empty prompt')
+        req = _Request(
+            tokens=tokens,
+            max_tokens=int(body.get('max_tokens', 64)),
+            temperature=float(body.get('temperature', 0.0)),
+            top_k=int(body.get('top_k', 0)),
+            eos_id=body.get('eos_id',
+                            ByteTokenizer.EOS if is_text else None),
+        )
+        self.scheduler.submit(req)
+
+        if body.get('stream'):
+            handler.send_response(200)
+            handler.send_header('Content-Type', 'application/x-ndjson')
+            handler.send_header('Transfer-Encoding', 'chunked')
+            handler.end_headers()
+
+            def chunk(payload):
+                data = (json.dumps(payload) + '\n').encode()
+                handler.wfile.write(hex(len(data))[2:].encode() + b'\r\n'
+                                    + data + b'\r\n')
+
+            while True:
+                tok = req.out_queue.get()
+                if tok is None:
+                    break
+                chunk({'token': tok})
+            chunk({'done': True, 'ttft_ms': _ttft_ms(req)})
+            handler.wfile.write(b'0\r\n\r\n')
+            return
+
+        out: List[int] = []
+        while True:
+            tok = req.out_queue.get()
+            if tok is None:
+                break
+            out.append(tok)
+        result = {
+            'tokens': out,
+            'num_tokens': len(out),
+            'ttft_ms': _ttft_ms(req),
+            'latency_ms': round(
+                (time.perf_counter() - req.submitted_at) * 1e3, 2),
+        }
+        if is_text:
+            result['text'] = self.tokenizer.decode(out)
+        payload = json.dumps(result).encode()
+        handler.send_response(200)
+        handler.send_header('Content-Type', 'application/json')
+        handler.send_header('Content-Length', str(len(payload)))
+        handler.end_headers()
+        handler.wfile.write(payload)
+
+    def serve_forever(self) -> None:
+        self.httpd.serve_forever()
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+        self.scheduler.stop()
+
+
+def _ttft_ms(req: _Request) -> Optional[float]:
+    if req.first_token_at is None:
+        return None
+    return round((req.first_token_at - req.submitted_at) * 1e3, 2)
+
+
+def main() -> None:
+    """CLI entry: ``python -m skypilot_tpu.serve.generation_server``."""
+    import argparse
+
+    import jax
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--preset', default='llama-1b',
+                        choices=sorted(PRESETS))
+    parser.add_argument('--port', type=int, default=8001)
+    parser.add_argument('--batch-slots', type=int, default=8)
+    parser.add_argument('--max-len', type=int, default=None)
+    args = parser.parse_args()
+
+    config = PRESETS[args.preset]
+    model = LlamaModel(config)
+    params = jax.jit(model.init)(jax.random.key(0))
+    scheduler = GenerationScheduler(config, params,
+                                    batch_slots=args.batch_slots,
+                                    max_len=args.max_len)
+    scheduler.start()
+    server = GenerationServer(scheduler, port=args.port)
+    print(f'generation server on :{server.port} '
+          f'(preset={args.preset}, slots={args.batch_slots})', flush=True)
+    server.serve_forever()
+
+
+if __name__ == '__main__':
+    main()

@@ -6,5 +6,8 @@ here the model layer is in-tree so benchmarks, serving, and parallelism are
 owned end-to-end by the framework.
 """
 from skypilot_tpu.models.llama import (LlamaConfig, LlamaModel, PRESETS)
+from skypilot_tpu.models.mixtral import (MixtralConfig, MixtralModel,
+                                         PRESETS as MOE_PRESETS)
 
-__all__ = ['LlamaConfig', 'LlamaModel', 'PRESETS']
+__all__ = ['LlamaConfig', 'LlamaModel', 'PRESETS', 'MixtralConfig',
+           'MixtralModel', 'MOE_PRESETS']

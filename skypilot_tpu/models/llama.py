@@ -49,6 +49,9 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     tie_embeddings: bool = False
     remat: bool = True
+    # Pipeline parallelism: microbatch count when the mesh has pp > 1
+    # (None -> one microbatch per stage, the minimum busy schedule).
+    pp_microbatches: Optional[int] = None
 
     @property
     def num_params(self) -> int:
@@ -113,7 +116,18 @@ class LlamaModel:
                  rules: LogicalRules = DEFAULT_RULES):
         self.config = config
         self.mesh = mesh
+        if mesh is not None and mesh.shape.get('pp', 1) > 1:
+            # Stage-major layer stacking: shard the layer dim over pp so each
+            # stage's weights live on its own devices (parallel/pipeline.py).
+            rules = rules.with_overrides(layers='pp')
         self.rules = rules
+
+    @property
+    def aux_loss_weight(self) -> float:
+        return 0.0
+
+    def logical_axes(self) -> Params:
+        return logical_axes(self.config)
 
     # -- init ---------------------------------------------------------------
     def init(self, rng: jax.Array) -> Params:
@@ -151,7 +165,7 @@ class LlamaModel:
         from skypilot_tpu.parallel.sharding import tree_shardings
         mesh = mesh or self.mesh
         assert mesh is not None
-        return tree_shardings(mesh, self.rules, logical_axes(self.config))
+        return tree_shardings(mesh, self.rules, self.logical_axes())
 
     # -- helpers ------------------------------------------------------------
     def _constrain(self, x, *axes):
@@ -180,10 +194,59 @@ class LlamaModel:
             return fn(q, k, v)
         return attention_ops.attention(q, k, v, causal=True)
 
+    # -- transformer blocks (overridable; Mixtral swaps the MLP for MoE) ----
+    def _attn_delta(self, lp: Params, x: jax.Array, cos, sin, positions,
+                    constrain: bool = True) -> jax.Array:
+        c = self.config
+        con = self._constrain if constrain else (lambda a, *axes: a)
+        h = rms_norm(x, lp['attn_norm'], c.norm_eps)
+        q = jnp.einsum('bse,ehd->bshd', h, lp['wq'])
+        k = jnp.einsum('bse,ehd->bshd', h, lp['wk'])
+        v = jnp.einsum('bse,ehd->bshd', h, lp['wv'])
+        q = apply_rotary(q, cos, sin, positions)
+        k = apply_rotary(k, cos, sin, positions)
+        q = con(q, 'batch', 'seq', 'act_heads', None)
+        k = con(k, 'batch', 'seq', 'act_kv_heads', None)
+        v = con(v, 'batch', 'seq', 'act_kv_heads', None)
+        attn = self._attend(q, k, v)
+        return jnp.einsum('bshd,hde->bse', attn, lp['wo'])
+
+    def _mlp_delta(self, lp: Params, x: jax.Array,
+                   constrain: bool = True) -> Tuple[jax.Array, jax.Array]:
+        """Post-attention feed-forward. Returns (delta, aux_loss_scalar)."""
+        c = self.config
+        con = self._constrain if constrain else (lambda a, *axes: a)
+        h = rms_norm(x, lp['mlp_norm'], c.norm_eps)
+        gate = jnp.einsum('bse,em->bsm', h, lp['w_gate'])
+        up = jnp.einsum('bse,em->bsm', h, lp['w_up'])
+        gated = con(jax.nn.silu(gate) * up, 'batch', 'seq', 'act_mlp')
+        return (jnp.einsum('bsm,me->bse', gated, lp['w_down']),
+                jnp.zeros((), jnp.float32))
+
+    def _layer_step(self, lp: Params, x: jax.Array, cos, sin, positions,
+                    constrain: bool = True) -> Tuple[jax.Array, jax.Array]:
+        con = self._constrain if constrain else (lambda a, *axes: a)
+        x = x + self._attn_delta(lp, x, cos, sin, positions, constrain)
+        x = con(x, 'batch', 'seq', 'act_embed')
+        delta, aux = self._mlp_delta(lp, x, constrain)
+        x = con(x + delta, 'batch', 'seq', 'act_embed')
+        return x, aux
+
     # -- forward ------------------------------------------------------------
     def apply(self, params: Params, tokens: jax.Array,
               positions: Optional[jax.Array] = None) -> jax.Array:
         """tokens [B, S] int32 -> logits [B, S, V] (f32)."""
+        return self.apply_with_aux(params, tokens, positions)[0]
+
+    def apply_with_aux(self, params: Params, tokens: jax.Array,
+                       positions: Optional[jax.Array] = None
+                       ) -> Tuple[jax.Array, jax.Array]:
+        """Forward returning (logits, mean per-layer aux loss).
+
+        aux is 0 for dense models; MoE models return the router
+        load-balancing loss (weighted into the train loss by the Trainer via
+        ``aux_loss_weight``).
+        """
         c = self.config
         if positions is None:
             positions = jnp.arange(tokens.shape[1])
@@ -192,38 +255,60 @@ class LlamaModel:
         x = params['embed'][tokens].astype(c.dtype)
         x = self._constrain(x, 'batch', 'seq', 'act_embed')
 
-        def layer(x, lp):
-            h = rms_norm(x, lp['attn_norm'], c.norm_eps)
-            q = jnp.einsum('bse,ehd->bshd', h, lp['wq'])
-            k = jnp.einsum('bse,ehd->bshd', h, lp['wk'])
-            v = jnp.einsum('bse,ehd->bshd', h, lp['wv'])
-            q = apply_rotary(q, cos, sin, positions)
-            k = apply_rotary(k, cos, sin, positions)
-            q = self._constrain(q, 'batch', 'seq', 'act_heads', None)
-            k = self._constrain(k, 'batch', 'seq', 'act_kv_heads', None)
-            v = self._constrain(v, 'batch', 'seq', 'act_kv_heads', None)
-            attn = self._attend(q, k, v)
-            x = x + jnp.einsum('bshd,hde->bse', attn, lp['wo'])
-            x = self._constrain(x, 'batch', 'seq', 'act_embed')
+        pp = self.mesh.shape.get('pp', 1) if self.mesh is not None else 1
+        if pp > 1:
+            x, aux = self._apply_pipelined(params['layers'], x, cos, sin,
+                                           positions, pp)
+        else:
+            def layer(x, lp):
+                return self._layer_step(lp, x, cos, sin, positions)
 
-            h = rms_norm(x, lp['mlp_norm'], c.norm_eps)
-            gate = jnp.einsum('bse,em->bsm', h, lp['w_gate'])
-            up = jnp.einsum('bse,em->bsm', h, lp['w_up'])
-            gated = self._constrain(jax.nn.silu(gate) * up,
-                                    'batch', 'seq', 'act_mlp')
-            x = x + jnp.einsum('bsm,me->bse', gated, lp['w_down'])
-            x = self._constrain(x, 'batch', 'seq', 'act_embed')
-            return x, None
-
-        if c.remat:
-            layer = jax.checkpoint(layer)
-        x, _ = lax.scan(layer, x, params['layers'])
+            if c.remat:
+                layer = jax.checkpoint(layer)
+            x, auxs = lax.scan(layer, x, params['layers'])
+            aux = jnp.mean(auxs)
 
         x = rms_norm(x, params['final_norm'], c.norm_eps)
         head = (params['embed'].T if c.tie_embeddings else params['lm_head'])
         logits = jnp.einsum('bse,ev->bsv', x.astype(jnp.float32),
                             head.astype(jnp.float32))
-        return self._constrain(logits, 'batch', 'seq', 'act_vocab')
+        return self._constrain(logits, 'batch', 'seq', 'act_vocab'), aux
+
+    def _apply_pipelined(self, layers: Params, x: jax.Array, cos, sin,
+                         positions, pp: int) -> Tuple[jax.Array, jax.Array]:
+        """Run the block stack as ``pp`` pipeline stages (parallel/pipeline).
+
+        Inside the manual-pp shard_map body, sharding constraints cannot
+        reference the pp axis, so the per-layer constraints are skipped —
+        dp/fsdp/tp shardings propagate from the inputs (GSPMD-auto axes).
+        Ring attention (sp > 1) composes with pp via the same manual-axis
+        mechanism but is not yet supported together — asserted here.
+        """
+        from skypilot_tpu.parallel.pipeline import pipeline, split_stages
+        if self._sp_size() > 1:
+            raise NotImplementedError('pp > 1 with sp > 1 is not supported '
+                                      'yet; use ring attention without '
+                                      'pipeline stages or vice versa')
+        c = self.config
+
+        def stage_fn(local_layers, h, cos, sin, positions):
+            def one(h, lp):
+                return self._layer_step(lp, h, cos, sin, positions,
+                                        constrain=False)
+
+            if c.remat:
+                one = jax.checkpoint(one)
+            h, auxs = lax.scan(one, h, local_layers)
+            return h, jnp.mean(auxs)
+
+        out, aux = pipeline(stage_fn, split_stages(layers, pp), x,
+                            cos, sin, positions,
+                            mesh=self.mesh,
+                            num_microbatches=c.pp_microbatches,
+                            with_aux=True)
+        # stage_fn's aux is a mean over its layers; pipeline sums the stage
+        # means over pp, so divide to get the global per-layer mean.
+        return out, aux / pp
 
     # -- decode (serving) ---------------------------------------------------
     def init_cache(self, batch: int, max_len: int) -> Params:
@@ -269,10 +354,7 @@ class LlamaModel:
             valid = kv_pos[None, :] <= positions[:, None]  # [T, max_len]
             attn = _cached_attention(q, k_cache, v_cache, valid)
             x = x + jnp.einsum('bshd,hde->bse', attn, lp['wo'])
-            h = rms_norm(x, lp['mlp_norm'], c.norm_eps)
-            gated = jax.nn.silu(jnp.einsum('bse,em->bsm', h, lp['w_gate'])) \
-                * jnp.einsum('bse,em->bsm', h, lp['w_up'])
-            x = x + jnp.einsum('bsm,me->bse', gated, lp['w_down'])
+            x = x + self._mlp_delta(lp, x, constrain=False)[0]
 
         x = rms_norm(x, params['final_norm'], c.norm_eps)
         head = (params['embed'].T if c.tie_embeddings else params['lm_head'])

@@ -1,0 +1,127 @@
+"""Mixtral-family sparse-MoE decoder, TPU-first.
+
+Reuses the Llama block (GQA + RoPE + RMSNorm, scan-over-layers, pipeline
+support) and swaps the SwiGLU feed-forward for a top-k routed
+mixture-of-experts (ops/moe.py): expert-stacked weights with the leading
+``expert`` dim sharded over the ``ep`` mesh axis, capacity-based dense
+dispatch so the whole layer is MXU einsums + one all-to-all.
+
+The reference has no MoE implementation (BASELINE.md workload #5 runs
+Mixtral via a user container; reference sky/examples only set rank env
+vars — SURVEY.md §2.8). Architecture constants follow the public
+Mixtral-8x7B config (32 layers, 8 experts, top-2, 14336 ffn dim).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.models.llama import (LlamaConfig, LlamaModel, Params,
+                                       logical_axes as llama_logical_axes)
+from skypilot_tpu.ops.layers import rms_norm
+from skypilot_tpu.ops.moe import moe_ffn
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralConfig(LlamaConfig):
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    router_aux_weight: float = 0.02
+
+    @property
+    def num_params(self) -> int:
+        e, l, v = self.embed_dim, self.num_layers, self.vocab_size
+        qkv = e * self.head_dim * (self.num_heads + 2 * self.num_kv_heads)
+        o = self.num_heads * self.head_dim * e
+        moe = self.num_experts * 3 * e * self.mlp_dim + e * self.num_experts
+        per_layer = qkv + o + moe + 2 * e
+        head = 0 if self.tie_embeddings else e * v
+        return v * e + l * per_layer + e + head
+
+    @property
+    def active_params(self) -> int:
+        """Params touched per token (top-k experts) — the FLOPs-relevant
+        count for MFU/throughput accounting."""
+        e, l, v = self.embed_dim, self.num_layers, self.vocab_size
+        qkv = e * self.head_dim * (self.num_heads + 2 * self.num_kv_heads)
+        o = self.num_heads * self.head_dim * e
+        moe = self.top_k * 3 * e * self.mlp_dim + e * self.num_experts
+        per_layer = qkv + o + moe + 2 * e
+        head = 0 if self.tie_embeddings else e * v
+        return v * e + l * per_layer + e + head
+
+
+PRESETS: Dict[str, MixtralConfig] = {
+    'test-tiny-moe': MixtralConfig(vocab_size=256, embed_dim=64, num_layers=2,
+                                   num_heads=4, num_kv_heads=2, head_dim=16,
+                                   mlp_dim=128, max_seq_len=512,
+                                   dtype=jnp.float32, remat=False,
+                                   num_experts=4, top_k=2,
+                                   capacity_factor=4.0),
+    # BASELINE workload #5 anchor (Mixtral 8x7B on preemptible v5e).
+    'mixtral-8x7b': MixtralConfig(vocab_size=32000, embed_dim=4096,
+                                  num_layers=32, num_heads=32, num_kv_heads=8,
+                                  head_dim=128, mlp_dim=14336,
+                                  max_seq_len=32768, rope_theta=1e6,
+                                  num_experts=8, top_k=2),
+}
+
+
+def logical_axes(config: MixtralConfig) -> Params:
+    axes = llama_logical_axes(config)
+    axes['layers'].pop('w_gate')
+    axes['layers'].pop('w_up')
+    axes['layers'].pop('w_down')
+    axes['layers'].update({
+        'router': ('layers', 'embed', None),
+        'we_gate': ('layers', 'expert', 'embed', 'mlp'),
+        'we_up': ('layers', 'expert', 'embed', 'mlp'),
+        'we_down': ('layers', 'expert', 'mlp', 'embed'),
+    })
+    return axes
+
+
+class MixtralModel(LlamaModel):
+    """Llama block stack with a routed-MoE feed-forward."""
+
+    config: MixtralConfig
+
+    @property
+    def aux_loss_weight(self) -> float:
+        return self.config.router_aux_weight
+
+    def logical_axes(self) -> Params:
+        return logical_axes(self.config)
+
+    def init(self, rng: jax.Array) -> Params:
+        c = self.config
+        params = super().init(rng)
+        lp = params['layers']
+        for name in ('w_gate', 'w_up', 'w_down'):
+            lp.pop(name)
+        l, e, m, ne = c.num_layers, c.embed_dim, c.mlp_dim, c.num_experts
+        keys = jax.random.split(jax.random.fold_in(rng, 17), 4)
+
+        def dense(key, shape, fan_in):
+            return (jax.random.normal(key, shape, jnp.float32)
+                    * fan_in**-0.5).astype(c.dtype)
+
+        lp['router'] = (jax.random.normal(keys[0], (l, e, ne), jnp.float32)
+                        * e**-0.5)  # f32: routing decisions stay stable
+        lp['we_gate'] = dense(keys[1], (l, ne, e, m), e)
+        lp['we_up'] = dense(keys[2], (l, ne, e, m), e)
+        lp['we_down'] = dense(keys[3], (l, ne, m, e), m)
+        return params
+
+    def _mlp_delta(self, lp: Params, x: jax.Array,
+                   constrain: bool = True) -> Tuple[jax.Array, jax.Array]:
+        c = self.config
+        h = rms_norm(x, lp['mlp_norm'], c.norm_eps)
+        y, aux = moe_ffn(h, lp['router'], lp['we_gate'], lp['we_up'],
+                         lp['we_down'], top_k=c.top_k,
+                         capacity_factor=c.capacity_factor)
+        return y, aux['aux_loss']

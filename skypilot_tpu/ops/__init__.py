@@ -13,11 +13,13 @@ flagship model family: attention (flash), RMSNorm, rotary embeddings.
 dispatching entry point); layer helpers are re-exported at package level.
 """
 from skypilot_tpu.ops import attention
+from skypilot_tpu.ops import moe
 from skypilot_tpu.ops.layers import (apply_rotary, precompute_rotary,
                                      rms_norm)
 
 __all__ = [
     'attention',
+    'moe',
     'apply_rotary',
     'precompute_rotary',
     'rms_norm',

@@ -1,0 +1,117 @@
+"""Mixture-of-Experts routing + expert FFN, TPU-first.
+
+Capacity-based top-k routing in the GShard/Switch style: every einsum is
+dense with static shapes (dispatch/combine tensors), so the whole layer
+lowers to MXU matmuls + one all-to-all when the ``expert`` dim is sharded
+over the ``ep`` mesh axis — no gather/scatter, no dynamic shapes, nothing
+XLA can't tile.
+
+Routing algorithm (top-k, token-priority):
+  1. router probs = softmax(x @ w_router)            [N, E] (f32)
+  2. top-k experts per token, gates renormalized to sum 1 (Mixtral style)
+  3. queue position of each (choice, token) in its expert via cumsum,
+     choice-0 assignments take priority over choice-1 (GShard ordering)
+  4. tokens past expert capacity C are *dropped* (contribute zero); with
+     ``capacity_factor`` >= E/k no token can ever be dropped — tests use
+     that regime to match the dense per-token reference exactly.
+
+The load-balancing auxiliary loss is the Switch-Transformer form:
+``E * sum_e f_e * p_e`` with f = fraction of tokens routed (top-1 of the
+kept assignments), p = mean router prob.
+
+The reference contains no MoE implementation (parallelism is user-space
+there — SURVEY.md §2.8); BASELINE.md workload #5 (Mixtral 8x7B on
+preemptible v5e) is the anchor this enables.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def expert_capacity(num_tokens: int, num_experts: int, top_k: int,
+                    capacity_factor: float) -> int:
+    """Per-expert queue length C, padded to a multiple of 8 (TPU sublanes)."""
+    cap = int(math.ceil(top_k * num_tokens / num_experts * capacity_factor))
+    return max(8, ((cap + 7) // 8) * 8)
+
+
+def top_k_routing(router_logits: jax.Array, top_k: int,
+                  capacity: int) -> Tuple[jax.Array, jax.Array, Dict]:
+    """Build dispatch/combine tensors from router logits.
+
+    Args:
+      router_logits: [N, E] f32.
+      top_k: experts per token.
+      capacity: per-expert queue length C.
+
+    Returns:
+      dispatch: [N, E, C] one-hot (f32) — token n occupies slot c of expert e.
+      combine:  [N, E, C] f32 — dispatch scaled by the (renormalized) gate.
+      aux: dict with 'aux_loss' (load-balance), 'dropped_frac'.
+    """
+    n, e = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    gate_vals, gate_idx = lax.top_k(probs, top_k)  # [N, k]
+    gate_vals = gate_vals / jnp.maximum(
+        gate_vals.sum(-1, keepdims=True), 1e-9)
+
+    # [k, N, E] one-hot of assignments, choice-major so cumsum gives choice-0
+    # assignments priority over choice-1 for capacity slots.
+    oh = jax.nn.one_hot(gate_idx.T, e, dtype=jnp.float32)  # [k, N, E]
+    flat = oh.reshape(top_k * n, e)
+    pos = jnp.cumsum(flat, axis=0) - flat  # queue position per assignment
+    keep = (pos < capacity).astype(jnp.float32) * flat  # [k*N, E]
+    pos_k = pos.reshape(top_k, n, e)
+    keep_k = keep.reshape(top_k, n, e)
+
+    # dispatch[n, e, c] = sum_k keep_k[k,n,e] * one_hot(pos_k[k,n,e] == c)
+    slot_oh = jax.nn.one_hot(pos_k.astype(jnp.int32), capacity,
+                             dtype=jnp.float32)  # [k, N, E, C]
+    dispatch = jnp.einsum('kne,knec->nec', keep_k, slot_oh)
+    combine = jnp.einsum('nk,kne,knec->nec', gate_vals, keep_k, slot_oh)
+
+    # Switch-style load-balance loss over the *intended* (pre-drop) routing.
+    frac_routed = oh.sum(axis=0).mean(axis=0)  # [E] incl. all k choices
+    mean_prob = probs.mean(axis=0)  # [E]
+    aux_loss = e * jnp.sum(frac_routed * mean_prob) / top_k
+    dropped = 1.0 - keep.sum() / (top_k * n)
+    return dispatch, combine, {'aux_loss': aux_loss, 'dropped_frac': dropped}
+
+
+def moe_ffn(x: jax.Array,
+            w_router: jax.Array,
+            w_gate: jax.Array,
+            w_up: jax.Array,
+            w_down: jax.Array,
+            top_k: int = 2,
+            capacity_factor: float = 1.25) -> Tuple[jax.Array, Dict]:
+    """SwiGLU expert FFN with top-k routing.
+
+    Args:
+      x: [B, S, D] activations.
+      w_router: [D, E] (kept f32 for routing stability).
+      w_gate/w_up: [E, D, M]; w_down: [E, M, D] — expert-stacked, shard the
+        leading dim over ``ep``.
+
+    Returns ([B, S, D] output, aux dict). Output dtype follows x.
+    """
+    b, s, d = x.shape
+    e = w_router.shape[-1]
+    n = b * s
+    xt = x.reshape(n, d)
+    logits = xt.astype(jnp.float32) @ w_router.astype(jnp.float32)
+    cap = expert_capacity(n, e, top_k, capacity_factor)
+    dispatch, combine, aux = top_k_routing(logits, top_k, cap)
+
+    compute_t = x.dtype
+    xe = jnp.einsum('nec,nd->ecd', dispatch.astype(compute_t), xt)
+    h = jax.nn.silu(jnp.einsum('ecd,edm->ecm', xe, w_gate)) \
+        * jnp.einsum('ecd,edm->ecm', xe, w_up)
+    ye = jnp.einsum('ecm,emd->ecd', h, w_down)
+    y = jnp.einsum('nec,ecd->nd', combine.astype(compute_t), ye)
+    return y.reshape(b, s, d), aux

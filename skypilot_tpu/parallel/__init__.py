@@ -19,9 +19,12 @@ from skypilot_tpu.parallel.mesh import (MESH_AXES, MeshSpec, make_mesh)
 from skypilot_tpu.parallel.sharding import (LogicalRules, NamedSharding,
                                             logical_sharding,
                                             shard_constraint)
+from skypilot_tpu.parallel.pipeline import pipeline, split_stages
 from skypilot_tpu.parallel.ring_attention import ring_attention
 
 __all__ = [
+    'pipeline',
+    'split_stages',
     'MESH_AXES',
     'MeshSpec',
     'make_mesh',

@@ -1,0 +1,132 @@
+"""GPipe-style pipeline parallelism via ``shard_map`` + ``ppermute``.
+
+The ``pp`` mesh axis holds pipeline *stages*: each device group owns a
+contiguous block of layers (stage-major stacked params) and microbatches
+flow stage-to-stage over ICI with ``lax.ppermute``. The schedule is the
+collective-permute loop from the scaling-book playbook: ``T = M + S - 1``
+ticks, stage 0 ingests microbatch ``t`` while stage ``S-1`` retires
+microbatch ``t - (S - 1)``; the bubble fraction is ``(S-1)/T``.
+
+Design notes (TPU-first):
+  - ``shard_map`` is *manual only over pp* (``axis_names={'pp'}``); dp/fsdp/tp
+    stay GSPMD-auto inside the body, so the stage computation is still
+    automatically sharded over the remaining mesh axes.
+  - Backward is plain autodiff of the scan: ``ppermute`` transposes to the
+    reverse permutation, giving the symmetric reverse-pipeline schedule
+    without hand-written adjoints.
+  - All stages compute every tick (idle stages chew on zeros); this wastes
+    bubble FLOPs but keeps the step graph static — no data-dependent control
+    flow, which is what XLA needs to pipeline the collectives.
+
+The reference delegates pipeline parallelism entirely to user frameworks
+(reference sky/backends/cloud_vm_ray_backend.py RayCodeGen just sets rank
+env vars; SURVEY.md §2.8) — there is no counterpart implementation.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def split_stages(params: Any, num_stages: int) -> Any:
+    """Reshape layer-stacked leaves [L, ...] -> stage-major [S, L/S, ...]."""
+
+    def reshape(p):
+        if p.shape[0] % num_stages:
+            raise ValueError(
+                f'layer dim {p.shape[0]} not divisible by {num_stages} stages')
+        return p.reshape(num_stages, p.shape[0] // num_stages, *p.shape[1:])
+
+    return jax.tree.map(reshape, params)
+
+
+def pipeline(stage_fn: Callable[..., Any],
+             stage_params: Any,
+             x: jax.Array,
+             *broadcast_args: Any,
+             mesh: Mesh,
+             axis_name: str = 'pp',
+             num_microbatches: Optional[int] = None,
+             with_aux: bool = False) -> Any:
+    """Run ``x`` through ``S`` pipeline stages of ``stage_fn``.
+
+    Args:
+      stage_fn: ``(local_params, h, *broadcast_args) -> h`` (or ``(h, aux)``
+        when ``with_aux``; aux must be a scalar and is summed over stages
+        and microbatches).
+      stage_params: pytree whose leaves are stage-major: leading dim ``S``
+        (use :func:`split_stages` to build it from layer-stacked params).
+      x: ``[B, ...]`` activations; ``B`` is split into ``M`` microbatches.
+      broadcast_args: replicated extras (rotary tables, positions, ...).
+      num_microbatches: default ``S`` (minimum that keeps every stage busy
+        in steady state; more microbatches shrink the bubble).
+
+    Returns ``[B, ...]`` outputs (and the aux scalar when ``with_aux``),
+    replicated over the pp axis.
+    """
+    S = mesh.shape[axis_name]
+    M = num_microbatches or S
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f'batch {B} not divisible by {M} microbatches')
+    x_mb = x.reshape(M, B // M, *x.shape[1:])
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def body(local_params, x_mb, *bargs):
+        local_params = jax.tree.map(lambda p: p[0], local_params)
+        idx = lax.axis_index(axis_name)
+        state = jnp.zeros_like(x_mb[0])
+        outputs = jnp.zeros_like(x_mb)
+        x_mb_v = x_mb
+        aux0 = jnp.zeros((), jnp.float32)
+
+        def step(carry, t):
+            state, outputs, aux = carry
+            inp = lax.dynamic_index_in_dim(x_mb_v, jnp.clip(t, 0, M - 1), 0,
+                                           keepdims=False)
+            cur = jnp.where(idx == 0, inp, state)
+            if with_aux:
+                out, a = stage_fn(local_params, cur, *bargs)
+                # Only ticks where this stage held a real microbatch count:
+                # stage s is live for t in [s, s + M).
+                live = (t >= idx) & (t < idx + M)
+                aux = aux + jnp.where(live, a.astype(jnp.float32), 0.0)
+            else:
+                out = stage_fn(local_params, cur, *bargs)
+            out_t = t - (S - 1)
+            write = (idx == S - 1) & (out_t >= 0)
+            upd = lax.dynamic_update_index_in_dim(outputs, out,
+                                                  jnp.clip(out_t, 0, M - 1), 0)
+            outputs = jnp.where(write, upd, outputs)
+            state = lax.ppermute(out, axis_name, perm)
+            return (state, outputs, aux), None
+
+        (_, outputs, aux), _ = lax.scan(step, (state, outputs, aux0),
+                                        jnp.arange(M + S - 1))
+        outputs = lax.psum(
+            jnp.where(idx == S - 1, outputs, jnp.zeros_like(outputs)),
+            axis_name)
+        if with_aux:
+            return outputs, lax.psum(aux, axis_name) / M
+        return outputs
+
+    n_b = len(broadcast_args)
+    # check_vma=False: stage_fn is arbitrary user/layer code whose internal
+    # scans create fresh (non-pp-varying) carries; strict varying-manual-axes
+    # typing would force pcast plumbing through every op it calls.
+    f = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis_name), P()) + tuple(P() for _ in range(n_b)),
+        out_specs=(P(), P()) if with_aux else P(),
+        axis_names={axis_name},
+        check_vma=False)
+    if with_aux:
+        out, aux = f(stage_params, x_mb, *broadcast_args)
+        return out.reshape(B, *out.shape[2:]), aux
+    out = f(stage_params, x_mb, *broadcast_args)
+    return out.reshape(B, *out.shape[2:])

@@ -76,9 +76,11 @@ class Trainer:
         model = self.model
 
         def loss_fn(params, batch):
-            logits = model.apply(params, batch['tokens'])
-            return cross_entropy_loss(logits, batch['targets'],
+            logits, aux = model.apply_with_aux(params, batch['tokens'])
+            loss = cross_entropy_loss(logits, batch['targets'],
                                       batch.get('mask'))
+            # MoE router load-balance loss (0 weight for dense models).
+            return loss + model.aux_loss_weight * aux
 
         def step(state: TrainState, batch):
             loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)

@@ -1,0 +1,263 @@
+"""Pipeline parallelism + MoE/expert parallelism tests (8-dev CPU mesh).
+
+Counterpart strategy: the reference has no in-tree parallelism to test;
+SURVEY.md §2.8 assigns PP/EP to this rebuild. Tests pin the parallel
+implementations to dense single-device oracles (exact math, no drops).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from skypilot_tpu.models.llama import LlamaConfig, LlamaModel
+from skypilot_tpu.models.mixtral import MixtralConfig, MixtralModel, PRESETS
+from skypilot_tpu.ops import moe as moe_ops
+from skypilot_tpu.parallel import MeshSpec, make_mesh, pipeline, split_stages
+
+
+class TestPipelinePrimitive:
+
+    def _mesh(self):
+        return make_mesh(MeshSpec(pp=4, fsdp=2))
+
+    def test_forward_matches_dense(self):
+        mesh = self._mesh()
+        L, d, M, mb = 8, 16, 8, 2
+        Ws = jax.random.normal(jax.random.key(0), (L, d, d)) * 0.1
+        x = jax.random.normal(jax.random.key(1), (M * mb, d))
+
+        def stage_fn(local_W, h):
+            def layer(h, W):
+                return jnp.tanh(h @ W), None
+            h, _ = lax.scan(layer, h, local_W)
+            return h
+
+        out = jax.jit(lambda W, x: pipeline(
+            stage_fn, split_stages(W, 4), x, mesh=mesh,
+            num_microbatches=M))(Ws, x)
+        ref = np.asarray(x)
+        for i in range(L):
+            ref = np.tanh(ref @ np.asarray(Ws[i]))
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
+
+    def test_grads_match_dense(self):
+        mesh = self._mesh()
+        L, d, M, mb = 4, 8, 4, 2
+        Ws = jax.random.normal(jax.random.key(0), (L, d, d)) * 0.1
+        x = jax.random.normal(jax.random.key(1), (M * mb, d))
+
+        def stage_fn(local_W, h):
+            def layer(h, W):
+                return jnp.tanh(h @ W), None
+            h, _ = lax.scan(layer, h, local_W)
+            return h
+
+        def loss_pipe(W):
+            y = pipeline(stage_fn, split_stages(W, 4), x, mesh=mesh,
+                         num_microbatches=M)
+            return (y**2).sum()
+
+        def loss_dense(W):
+            h = x
+            for i in range(L):
+                h = jnp.tanh(h @ W[i])
+            return (h**2).sum()
+
+        g1 = jax.jit(jax.grad(loss_pipe))(Ws)
+        g2 = jax.jit(jax.grad(loss_dense))(Ws)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=1e-5)
+
+    def test_batch_not_divisible_raises(self):
+        mesh = self._mesh()
+        with pytest.raises(ValueError, match='not divisible'):
+            pipeline(lambda p, h: h, jnp.zeros((4, 1)), jnp.zeros((6, 2)),
+                     mesh=mesh, num_microbatches=4)
+
+
+def _tiny_config(**kw):
+    base = dict(vocab_size=256, embed_dim=64, num_layers=4, num_heads=4,
+                num_kv_heads=2, head_dim=16, mlp_dim=128, max_seq_len=128,
+                dtype=jnp.float32, remat=False)
+    base.update(kw)
+    return LlamaConfig(**base)
+
+
+class TestLlamaPipelined:
+
+    def test_pp_forward_matches_dense(self):
+        config = _tiny_config()
+        dense = LlamaModel(config)
+        params = jax.jit(dense.init)(jax.random.key(0))
+        tokens = jax.random.randint(jax.random.key(1), (4, 16), 0,
+                                    config.vocab_size)
+        ref = jax.jit(dense.apply)(params, tokens)
+
+        mesh = make_mesh(MeshSpec(pp=2, fsdp=2, tp=2))
+        model = LlamaModel(config, mesh=mesh)
+        with jax.set_mesh(mesh):
+            sharded = jax.device_put(params, model.param_shardings())
+            out = jax.jit(model.apply)(sharded, tokens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-4, rtol=2e-4)
+
+    def test_pp_grads_match_dense(self):
+        config = _tiny_config(num_layers=2)
+        dense = LlamaModel(config)
+        params = jax.jit(dense.init)(jax.random.key(0))
+        tokens = jax.random.randint(jax.random.key(1), (4, 8), 0,
+                                    config.vocab_size)
+
+        mesh = make_mesh(MeshSpec(pp=2, dp=4))
+        model = LlamaModel(config, mesh=mesh)
+
+        def loss(m):
+            def f(p):
+                return (m.apply(p, tokens).astype(jnp.float32)**2).mean()
+            return f
+
+        g_ref = jax.jit(jax.grad(loss(dense)))(params)
+        with jax.set_mesh(mesh):
+            sharded = jax.device_put(params, model.param_shardings())
+            g_pp = jax.jit(jax.grad(loss(model)))(sharded)
+        flat_ref = jax.tree.leaves(g_ref)
+        flat_pp = jax.tree.leaves(g_pp)
+        for a, b in zip(flat_ref, flat_pp):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       atol=5e-4, rtol=5e-4)
+
+    def test_pp_with_sp_raises(self):
+        config = _tiny_config()
+        mesh = make_mesh(MeshSpec(pp=2, sp=2, fsdp=2))
+        model = LlamaModel(config, mesh=mesh)
+        params = jax.jit(model.init)(jax.random.key(0))
+        tokens = jnp.zeros((4, 16), jnp.int32)
+        with pytest.raises(NotImplementedError):
+            with jax.set_mesh(mesh):
+                model.apply(params, tokens)
+
+
+class TestMoeOps:
+
+    def test_routing_matches_loop_reference(self):
+        """With ample capacity, moe_ffn == per-token dense top-k mixture."""
+        n, d, m, e, k = 16, 8, 12, 4, 2
+        key = jax.random.key(0)
+        ks = jax.random.split(key, 5)
+        x = jax.random.normal(ks[0], (2, n // 2, d))
+        w_router = jax.random.normal(ks[1], (d, e))
+        w_gate = jax.random.normal(ks[2], (e, d, m)) * 0.2
+        w_up = jax.random.normal(ks[3], (e, d, m)) * 0.2
+        w_down = jax.random.normal(ks[4], (e, m, d)) * 0.2
+
+        y, aux = moe_ffn_jit(x, w_router, w_gate, w_up, w_down, k, 8.0)
+        assert float(aux['dropped_frac']) == 0.0
+
+        xt = np.asarray(x).reshape(n, d)
+        probs = np.asarray(jax.nn.softmax(xt @ np.asarray(w_router), axis=-1))
+        y_ref = np.zeros_like(xt)
+        for i in range(n):
+            top = np.argsort(-probs[i])[:k]
+            gates = probs[i][top] / probs[i][top].sum()
+            for g, ei in zip(gates, top):
+                h = (_silu(xt[i] @ np.asarray(w_gate[ei]))
+                     * (xt[i] @ np.asarray(w_up[ei])))
+                y_ref[i] += g * (h @ np.asarray(w_down[ei]))
+        np.testing.assert_allclose(np.asarray(y).reshape(n, d), y_ref,
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_capacity_drops_tokens(self):
+        """Tiny capacity forces drops; dropped fraction reported > 0."""
+        n, d, e, k = 64, 8, 2, 1
+        x = jax.random.normal(jax.random.key(0), (1, n, d))
+        # Router that sends everything to expert 0 -> overflow.
+        w_router = jnp.zeros((d, e)).at[:, 0].set(10.0)
+        w = jnp.ones((e, d, d)) * 0.1
+        _, aux = moe_ffn_jit(x, w_router, w, w, w, k, 0.25)
+        assert float(aux['dropped_frac']) > 0.4
+
+    def test_aux_loss_balanced_routing_is_one(self):
+        """Perfectly uniform routing gives aux loss ~= 1 (Switch convention)."""
+        n, e = 128, 4
+        logits = jnp.zeros((n, e))
+        cap = moe_ops.expert_capacity(n, e, 2, 2.0)
+        _, _, aux = moe_ops.top_k_routing(logits, 2, cap)
+        assert abs(float(aux['aux_loss']) - 1.0) < 0.05
+
+
+def _silu(v):
+    return v / (1.0 + np.exp(-v))
+
+
+def moe_ffn_jit(x, w_router, w_gate, w_up, w_down, k, cf):
+    import functools
+    f = jax.jit(functools.partial(moe_ops.moe_ffn, top_k=k,
+                                  capacity_factor=cf))
+    return f(x, w_router, w_gate, w_up, w_down)
+
+
+class TestMixtral:
+
+    def test_forward_shapes_and_finite(self):
+        config = PRESETS['test-tiny-moe']
+        model = MixtralModel(config)
+        params = jax.jit(model.init)(jax.random.key(0))
+        tokens = jax.random.randint(jax.random.key(1), (2, 16), 0,
+                                    config.vocab_size)
+        logits, aux = jax.jit(model.apply_with_aux)(params, tokens)
+        assert logits.shape == (2, 16, config.vocab_size)
+        assert bool(jnp.isfinite(logits).all())
+        assert float(aux) > 0.0  # router aux loss is live
+
+    def test_train_loss_decreases_on_ep_mesh(self):
+        config = PRESETS['test-tiny-moe']
+        mesh = make_mesh(MeshSpec(dp=2, ep=4))
+        model = MixtralModel(config, mesh=mesh)
+        from skypilot_tpu.train import Trainer
+        trainer = Trainer(model, learning_rate=1e-2)
+        with jax.set_mesh(mesh):
+            state = trainer.init_fn()(jax.random.key(0))
+            step = trainer.step_fn()
+            tokens = jax.random.randint(jax.random.key(1), (8, 32), 0,
+                                        config.vocab_size)
+            batch = trainer.shard_batch({
+                'tokens': tokens,
+                'targets': jnp.roll(tokens, -1, axis=1),
+            })
+            losses = []
+            for _ in range(8):
+                state, metrics = step(state, batch)
+                losses.append(float(metrics['loss']))
+        assert losses[-1] < losses[0], losses
+
+    def test_active_params_less_than_total(self):
+        c = PRESETS['mixtral-8x7b']
+        assert c.active_params < c.num_params
+        # 8x7B: ~46.7B total, ~12.9B active (public figures; tolerate 5%).
+        assert abs(c.num_params / 46.7e9 - 1) < 0.05
+        assert abs(c.active_params / 12.9e9 - 1) < 0.05
+
+    def test_mixtral_pipelined_matches_dense(self):
+        config = dataclasses_replace(PRESETS['test-tiny-moe'], num_layers=2)
+        dense = MixtralModel(config)
+        params = jax.jit(dense.init)(jax.random.key(0))
+        tokens = jax.random.randint(jax.random.key(1), (4, 8), 0,
+                                    config.vocab_size)
+        ref, ref_aux = jax.jit(dense.apply_with_aux)(params, tokens)
+
+        mesh = make_mesh(MeshSpec(pp=2, ep=2, dp=2))
+        model = MixtralModel(config, mesh=mesh)
+        with jax.set_mesh(mesh):
+            sharded = jax.device_put(params, model.param_shardings())
+            out, aux = jax.jit(model.apply_with_aux)(sharded, tokens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-4, rtol=2e-4)
+        # aux is computed per microbatch in the pipelined path (nonlinear in
+        # the token set), so it only approximates the full-batch value.
+        np.testing.assert_allclose(float(aux), float(ref_aux), rtol=0.25)
+
+
+def dataclasses_replace(c, **kw):
+    import dataclasses
+    return dataclasses.replace(c, **kw)

@@ -19,7 +19,6 @@ Design (vs the correctness-oracle ``LlamaModel.decode_step``):
 from __future__ import annotations
 
 import dataclasses
-import functools
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -28,7 +27,7 @@ from jax import lax
 
 from skypilot_tpu.models.llama import LlamaConfig, LlamaModel, Params
 from skypilot_tpu.ops import attention as attention_ops
-from skypilot_tpu.ops.layers import apply_rotary, precompute_rotary, rms_norm
+from skypilot_tpu.ops.layers import precompute_rotary, rms_norm
 
 
 @jax.tree_util.register_dataclass
@@ -51,14 +50,19 @@ class DecodeEngine:
     """
 
     def __init__(self, config: LlamaConfig, batch_slots: int = 8,
-                 max_len: Optional[int] = None):
+                 max_len: Optional[int] = None,
+                 model: Optional[LlamaModel] = None):
         self.config = config
+        # Engine reuses the model's block methods (_qkv/_mlp_delta) so the
+        # transformer math lives once; pass a MixtralModel to serve MoE.
+        self.model = model or LlamaModel(config)
         self.batch_slots = batch_slots
         self.max_len = max_len or config.max_seq_len
         self._prefill = jax.jit(self._prefill_impl)
         self._insert = jax.jit(self._insert_impl, donate_argnums=(0,))
-        self._step = jax.jit(self._step_impl, donate_argnums=(1,),
-                             static_argnames=('temperature', 'top_k'))
+        # temperature/top_k are *traced* [B] args — any per-request sampling
+        # settings reuse the one compiled step (no recompile DoS).
+        self._step = jax.jit(self._step_impl, donate_argnums=(1,))
 
     # -- state --------------------------------------------------------------
     def init_state(self) -> DecodeState:
@@ -94,20 +98,13 @@ class DecodeEngine:
         positions = jnp.arange(t)
         cos, sin = precompute_rotary(c.head_dim, c.max_seq_len, c.rope_theta)
         x = params['embed'][tokens][None].astype(c.dtype)  # [1, T, e]
+        model = self.model
 
         def layer(x, lp):
-            h = rms_norm(x, lp['attn_norm'], c.norm_eps)
-            q = jnp.einsum('bse,ehd->bshd', h, lp['wq'])
-            k = jnp.einsum('bse,ehd->bshd', h, lp['wk'])
-            v = jnp.einsum('bse,ehd->bshd', h, lp['wv'])
-            q = apply_rotary(q, cos, sin, positions)
-            k = apply_rotary(k, cos, sin, positions)
+            q, k, v = model._qkv(lp, x, cos, sin, positions, constrain=False)
             attn = attention_ops.attention(q, k, v, causal=True)
             x = x + jnp.einsum('bshd,hde->bse', attn, lp['wo'])
-            h = rms_norm(x, lp['mlp_norm'], c.norm_eps)
-            gated = jax.nn.silu(jnp.einsum('bse,em->bsm', h, lp['w_gate'])) \
-                * jnp.einsum('bse,em->bsm', h, lp['w_up'])
-            x = x + jnp.einsum('bsm,me->bse', gated, lp['w_down'])
+            x = x + model._mlp_delta(lp, x, constrain=False)[0]
             return x, (k[0], v[0])
 
         x, (ks, vs) = lax.scan(layer, x, params['layers'])
@@ -157,13 +154,20 @@ class DecodeEngine:
 
     # -- decode step --------------------------------------------------------
     def step(self, params: Params, state: DecodeState, rng: jax.Array,
-             temperature: float = 0.0,
-             top_k: int = 0) -> Tuple[DecodeState, jax.Array]:
-        """One token for every active slot. Returns (state, sampled [B])."""
-        return self._step(params, state, rng, temperature=temperature,
-                          top_k=top_k)
+             temperature=0.0, top_k=0) -> Tuple[DecodeState, jax.Array]:
+        """One token for every active slot. Returns (state, sampled [B]).
 
-    def _step_impl(self, params, state, rng, *, temperature, top_k):
+        ``temperature``/``top_k`` may be scalars or per-slot [B] arrays;
+        they are traced (not static), so heterogeneous sampling settings
+        never trigger recompilation.
+        """
+        b = self.batch_slots
+        temperature = jnp.broadcast_to(
+            jnp.asarray(temperature, jnp.float32), (b,))
+        top_k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (b,))
+        return self._step(params, state, rng, temperature, top_k)
+
+    def _step_impl(self, params, state, rng, temperature, top_k):
         c = self.config
         b = self.batch_slots
         grp = c.num_heads // c.num_kv_heads
@@ -175,15 +179,12 @@ class DecodeEngine:
         # New key written at index ``lengths`` -> valid keys are <= lengths.
         valid = kv_pos[None] <= state.lengths[:, None]  # [B, M]
 
+        model = self.model
+
         def layer(carry, inputs):
             x, cache_k, cache_v = carry
             lp, i = inputs
-            h = rms_norm(x, lp['attn_norm'], c.norm_eps)
-            q = jnp.einsum('bse,ehd->bshd', h, lp['wq'])
-            k = jnp.einsum('bse,ehd->bshd', h, lp['wk'])
-            v = jnp.einsum('bse,ehd->bshd', h, lp['wv'])
-            q = apply_rotary(q, cos, sin, positions)
-            k = apply_rotary(k, cos, sin, positions)
+            q, k, v = model._qkv(lp, x, cos, sin, positions, constrain=False)
             # Scatter the new K/V row into layer i at each slot's length
             # (in-place on the donated carry).
             cache_k = cache_k.at[i, rows, state.lengths].set(
@@ -203,10 +204,7 @@ class DecodeEngine:
                               v_layer.astype(jnp.float32))
             attn = attn.reshape(b, 1, c.num_heads, c.head_dim).astype(c.dtype)
             x = x + jnp.einsum('bshd,hde->bse', attn, lp['wo'])
-            h = rms_norm(x, lp['mlp_norm'], c.norm_eps)
-            gated = jax.nn.silu(jnp.einsum('bse,em->bsm', h, lp['w_gate'])) \
-                * jnp.einsum('bse,em->bsm', h, lp['w_up'])
-            x = x + jnp.einsum('bsm,me->bse', gated, lp['w_down'])
+            x = x + model._mlp_delta(lp, x, constrain=False)[0]
             return (x, cache_k, cache_v), None
 
         n_layers = c.num_layers
@@ -228,16 +226,27 @@ class DecodeEngine:
         ), sampled
 
 
-def _sample(logits: jax.Array, rng: jax.Array, temperature: float,
-            top_k: int) -> jax.Array:
-    """Greedy (temperature 0) / temperature / top-k sampling, inside jit."""
-    if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    scaled = logits / temperature
-    if top_k > 0:
-        kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
-        scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
-    return jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+def _sample(logits: jax.Array, rng: jax.Array, temperature,
+            top_k) -> jax.Array:
+    """Greedy (temperature 0) / temperature / top-k sampling, inside jit.
+
+    ``temperature`` [B] f32 and ``top_k`` [B] int32 are traced per-row
+    values (scalars broadcast); out-of-range top_k is clamped to the vocab,
+    so malformed requests cannot crash the compiled step.
+    """
+    v = logits.shape[-1]
+    temperature = jnp.broadcast_to(
+        jnp.asarray(temperature, jnp.float32), logits.shape[:1])
+    top_k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), logits.shape[:1])
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    srt = jnp.sort(scaled, axis=-1)  # ascending
+    kth_idx = jnp.clip(v - top_k, 0, v - 1)
+    kth = jnp.take_along_axis(srt, kth_idx[:, None], axis=-1)
+    kth = jnp.where((top_k > 0)[:, None], kth, -jnp.inf)
+    filtered = jnp.where(scaled >= kth, scaled, -jnp.inf)
+    sampled = jax.random.categorical(rng, filtered, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy, sampled)
 
 
 def prefill_bucket(length: int, max_len: int, floor: int = 16) -> int:

@@ -195,8 +195,11 @@ class LlamaModel:
         return attention_ops.attention(q, k, v, causal=True)
 
     # -- transformer blocks (overridable; Mixtral swaps the MLP for MoE) ----
-    def _attn_delta(self, lp: Params, x: jax.Array, cos, sin, positions,
-                    constrain: bool = True) -> jax.Array:
+    def _qkv(self, lp: Params, x: jax.Array, cos, sin, positions,
+             constrain: bool = True
+             ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """Pre-attention norm + QKV projections + rotary (shared with the
+        decode engine, models/decode.py, so the block math lives once)."""
         c = self.config
         con = self._constrain if constrain else (lambda a, *axes: a)
         h = rms_norm(x, lp['attn_norm'], c.norm_eps)
@@ -208,6 +211,11 @@ class LlamaModel:
         q = con(q, 'batch', 'seq', 'act_heads', None)
         k = con(k, 'batch', 'seq', 'act_kv_heads', None)
         v = con(v, 'batch', 'seq', 'act_kv_heads', None)
+        return q, k, v
+
+    def _attn_delta(self, lp: Params, x: jax.Array, cos, sin, positions,
+                    constrain: bool = True) -> jax.Array:
+        q, k, v = self._qkv(lp, x, cos, sin, positions, constrain)
         attn = self._attend(q, k, v)
         return jnp.einsum('bshd,hde->bse', attn, lp['wo'])
 
@@ -337,12 +345,7 @@ class LlamaModel:
         new_k, new_v = [], []
         for i in range(c.num_layers):
             lp = jax.tree.map(lambda p: p[i], params['layers'])
-            h = rms_norm(x, lp['attn_norm'], c.norm_eps)
-            q = jnp.einsum('bse,ehd->bshd', h, lp['wq'])
-            k = jnp.einsum('bse,ehd->bshd', h, lp['wk'])
-            v = jnp.einsum('bse,ehd->bshd', h, lp['wv'])
-            q = apply_rotary(q, cos, sin, positions)
-            k = apply_rotary(k, cos, sin, positions)
+            q, k, v = self._qkv(lp, x, cos, sin, positions, constrain=False)
             k_cache = lax.dynamic_update_slice(
                 cache['k'][i], k, (0, start, 0, 0))
             v_cache = lax.dynamic_update_slice(

@@ -51,7 +51,8 @@ class ByteTokenizer:
 
 class _Request:
     __slots__ = ('tokens', 'max_tokens', 'temperature', 'top_k', 'eos_id',
-                 'out_queue', 'submitted_at', 'first_token_at', 'done')
+                 'out_queue', 'submitted_at', 'first_token_at', 'done',
+                 'error')
 
     def __init__(self, tokens, max_tokens, temperature, top_k, eos_id):
         self.tokens = tokens
@@ -63,6 +64,12 @@ class _Request:
         self.submitted_at = time.perf_counter()
         self.first_token_at: Optional[float] = None
         self.done = False
+        self.error: Optional[str] = None
+
+    def fail(self, msg: str) -> None:
+        self.error = msg
+        self.done = True
+        self.out_queue.put(None)
 
 
 class GenerationScheduler:
@@ -80,6 +87,10 @@ class GenerationScheduler:
         self._pending: 'queue.Queue[_Request]' = queue.Queue()
         self._slots: List[Optional[_Request]] = [None] * batch_slots
         self._emitted: List[int] = [0] * batch_slots
+        # Host mirror of state.lengths for active slots — avoids a per-slot
+        # device gather + D2H in the hot loop (sampled.tolist() stays the
+        # only per-step transfer).
+        self._host_lengths: List[int] = [0] * batch_slots
         self._wake = threading.Event()
         self._stop = threading.Event()
         self.warm = threading.Event()
@@ -137,16 +148,20 @@ class GenerationScheduler:
                 return
             req = self._pending.get()
             slot = free[0]
-            prompt = req.tokens[:eng.max_len - 1]
-            bucket = prefill_bucket(len(prompt), eng.max_len)
-            padded = jnp.asarray(
-                prompt + [0] * (bucket - len(prompt)), jnp.int32)
-            k, v, logits = eng.prefill(self.params, padded, len(prompt))
-            # The FIRST generated token comes from the prefill logits — it
-            # is the TTFT token, emitted before the request joins the batch.
-            self._rng, sub = jax.random.split(self._rng)
-            first_tok = int(_sample(logits[None], sub, req.temperature,
-                                    req.top_k)[0])
+            try:
+                prompt = req.tokens[:eng.max_len - 1]
+                bucket = prefill_bucket(len(prompt), eng.max_len)
+                padded = jnp.asarray(
+                    prompt + [0] * (bucket - len(prompt)), jnp.int32)
+                k, v, logits = eng.prefill(self.params, padded, len(prompt))
+                # The FIRST generated token comes from the prefill logits —
+                # it is the TTFT token, emitted before joining the batch.
+                self._rng, sub = jax.random.split(self._rng)
+                first_tok = int(_sample(logits[None], sub, req.temperature,
+                                        req.top_k)[0])
+            except Exception as e:  # noqa: BLE001 — fail THIS request only
+                req.fail(f'prefill failed: {e!r}')
+                continue
             req.first_token_at = time.perf_counter()
             req.out_queue.put(first_tok)
             self.counters['tokens_out'] += 1
@@ -159,42 +174,60 @@ class GenerationScheduler:
                                     first_tok, slot)
             self._slots[slot] = req
             self._emitted[slot] = 1
+            self._host_lengths[slot] = len(prompt)
 
     def _loop(self) -> None:
-        import jax
         while not self._stop.is_set():
-            self._admit()
-            active = [r for r in self._slots if r is not None]
-            if not active:
-                self._wake.wait(timeout=0.2)
-                self._wake.clear()
+            try:
+                self._tick()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                # Fail every in-flight request but keep serving: a wedged
+                # scheduler thread would hang all future requests while
+                # /health kept returning 200.
+                import traceback
+                traceback.print_exc()
+                err = 'generation scheduler error (request aborted)'
+                for slot, req in enumerate(self._slots):
+                    if req is not None:
+                        req.fail(err)
+                        self._slots[slot] = None
+                self.state = self.engine.init_state()
+
+    def _tick(self) -> None:
+        import jax
+        self._admit()
+        active = [r for r in self._slots if r is not None]
+        if not active:
+            self._wake.wait(timeout=0.2)
+            self._wake.clear()
+            return
+        # Per-slot sampling settings; traced args, so heterogeneous values
+        # share one compiled step.
+        temps = [r.temperature if r is not None else 0.0
+                 for r in self._slots]
+        topks = [r.top_k if r is not None else 0 for r in self._slots]
+        self._rng, sub = jax.random.split(self._rng)
+        self.state, sampled = self.engine.step(
+            self.params, self.state, sub, temperature=temps, top_k=topks)
+        tokens = sampled.tolist()  # B ints: the only per-step D2H
+        now = time.perf_counter()
+        for slot, req in enumerate(self._slots):
+            if req is None:
                 continue
-            # Temperature/top_k are static per compiled step: use the first
-            # active request's settings for the batch (homogeneous fleets in
-            # practice; per-slot temperature would go inside the jit).
-            req0 = active[0]
-            self._rng, sub = jax.random.split(self._rng)
-            self.state, sampled = self.engine.step(
-                self.params, self.state, sub,
-                temperature=req0.temperature, top_k=req0.top_k)
-            tokens = sampled.tolist()  # B ints: the only per-step D2H
-            now = time.perf_counter()
-            for slot, req in enumerate(self._slots):
-                if req is None:
-                    continue
-                tok = int(tokens[slot])
-                if req.first_token_at is None:
-                    req.first_token_at = now
-                req.out_queue.put(tok)
-                self.counters['tokens_out'] += 1
-                self._emitted[slot] += 1
-                hit_eos = (req.eos_id is not None and tok == req.eos_id)
-                full = (self.state.lengths[slot] >= self.engine.max_len - 1)
-                if hit_eos or self._emitted[slot] >= req.max_tokens or full:
-                    req.done = True
-                    req.out_queue.put(None)  # sentinel: stream end
-                    self.state = self.engine.release(self.state, slot)
-                    self._slots[slot] = None
+            tok = int(tokens[slot])
+            if req.first_token_at is None:
+                req.first_token_at = now
+            req.out_queue.put(tok)
+            self.counters['tokens_out'] += 1
+            self._emitted[slot] += 1
+            self._host_lengths[slot] += 1
+            hit_eos = (req.eos_id is not None and tok == req.eos_id)
+            full = self._host_lengths[slot] >= self.engine.max_len - 1
+            if hit_eos or self._emitted[slot] >= req.max_tokens or full:
+                req.done = True
+                req.out_queue.put(None)  # sentinel: stream end
+                self.state = self.engine.release(self.state, slot)
+                self._slots[slot] = None
 
 
 class GenerationServer:
@@ -261,11 +294,20 @@ class GenerationServer:
             raise ValueError('request needs "tokens" or "text"')
         if not tokens:
             raise ValueError('empty prompt')
+        vocab = self.scheduler.config.vocab_size
+        if any(t < 0 or t >= vocab for t in tokens):
+            raise ValueError(f'token id out of range [0, {vocab})')
+        temperature = float(body.get('temperature', 0.0))
+        if not (temperature >= 0.0):  # also rejects NaN
+            raise ValueError('temperature must be >= 0')
+        top_k = int(body.get('top_k', 0))
+        if top_k < 0:
+            raise ValueError('top_k must be >= 0')
         req = _Request(
             tokens=tokens,
-            max_tokens=int(body.get('max_tokens', 64)),
-            temperature=float(body.get('temperature', 0.0)),
-            top_k=int(body.get('top_k', 0)),
+            max_tokens=max(1, int(body.get('max_tokens', 64))),
+            temperature=temperature,
+            top_k=min(top_k, vocab),
             eos_id=body.get('eos_id',
                             ByteTokenizer.EOS if is_text else None),
         )
@@ -287,7 +329,10 @@ class GenerationServer:
                 if tok is None:
                     break
                 chunk({'token': tok})
-            chunk({'done': True, 'ttft_ms': _ttft_ms(req)})
+            final = {'done': True, 'ttft_ms': _ttft_ms(req)}
+            if req.error:
+                final['error'] = req.error
+            chunk(final)
             handler.wfile.write(b'0\r\n\r\n')
             return
 
@@ -304,10 +349,12 @@ class GenerationServer:
             'latency_ms': round(
                 (time.perf_counter() - req.submitted_at) * 1e3, 2),
         }
+        if req.error:
+            result['error'] = req.error
         if is_text:
             result['text'] = self.tokenizer.decode(out)
         payload = json.dumps(result).encode()
-        handler.send_response(200)
+        handler.send_response(500 if req.error else 200)
         handler.send_header('Content-Type', 'application/json')
         handler.send_header('Content-Length', str(len(payload)))
         handler.end_headers()

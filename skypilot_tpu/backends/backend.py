@@ -60,7 +60,9 @@ class Backend:
         raise NotImplementedError
 
     def sync_file_mounts(self, handle: ResourceHandle,
-                         file_mounts: Optional[Dict[str, str]]) -> None:
+                         file_mounts: Optional[Dict[str, str]],
+                         storage_mounts: Optional[Dict[str, Any]] = None
+                         ) -> None:
         raise NotImplementedError
 
     def setup(self, handle: ResourceHandle, task: task_lib.Task) -> None:

@@ -28,9 +28,6 @@ from skypilot_tpu.runtime import agent as agent_lib
 from skypilot_tpu.runtime import constants as rt_constants
 from skypilot_tpu.utils import common_utils
 
-_PROVISION_LOCK = threading.Lock()
-
-
 def _quote_path(path: str) -> str:
     """shlex.quote that preserves a leading ~/ for remote home expansion."""
     if path == '~' or path.startswith('~/'):
@@ -176,7 +173,10 @@ class SliceBackend(backend_lib.Backend):
         if dryrun:
             return None
         provisioner = RetryingProvisioner(retry_until_up=retry_until_up)
-        with _PROVISION_LOCK:
+        from skypilot_tpu.utils import locks
+        # Reentrant under execution._execute's lock (same-thread filelock);
+        # also guards direct backend.provision callers (jobs/serve).
+        with locks.cluster_lock(cluster_name):
             global_user_state.add_or_update_cluster(
                 cluster_name, handle=None,
                 requested_resources=task.resources, ready=False)
@@ -288,17 +288,35 @@ class SliceBackend(backend_lib.Backend):
             runner.rsync(workdir, rt_constants.WORKDIR + '/', up=True)
 
     def sync_file_mounts(self, handle: backend_lib.ResourceHandle,
-                         file_mounts: Optional[Dict[str, str]]) -> None:
-        if not file_mounts:
+                         file_mounts: Optional[Dict[str, str]],
+                         storage_mounts: Optional[Dict[str, Any]] = None
+                         ) -> None:
+        if not file_mounts and not storage_mounts:
             return
+        from skypilot_tpu.data import storage as storage_lib
         for runner in self._runners(handle):
-            for dst, src in file_mounts.items():
+            for dst, src in (file_mounts or {}).items():
                 src = os.path.expanduser(src)
                 if src.endswith('/') and not dst.endswith('/'):
                     dst += '/'
                 parent = os.path.dirname(dst.rstrip('/')) or '.'
                 runner.run(f'mkdir -p {_quote_path(parent)}', timeout=60)
                 runner.rsync(src, dst, up=True)
+            # Bucket-backed mounts: the host pulls (COPY) or FUSE-mounts
+            # (MOUNT) directly from the store — data never proxies through
+            # the client (reference sky/data COPY/MOUNT split).
+            for dst, storage in (storage_mounts or {}).items():
+                assert isinstance(storage, storage_lib.Storage), storage
+                if storage.mode is storage_lib.StorageMode.MOUNT:
+                    cmd = storage.store.mount_command(dst)
+                else:
+                    cmd = storage.store.download_command(dst)
+                result = runner.run(cmd, timeout=600)
+                if result.returncode != 0:
+                    raise exceptions.StorageError(
+                        f'{storage.mode.value} of {storage.url} at {dst} '
+                        f'failed (rc={result.returncode}): '
+                        f'{result.stderr[-500:] or result.stdout[-500:]}')
 
     def setup(self, handle: backend_lib.ResourceHandle,
               task: task_lib.Task) -> None:

@@ -284,6 +284,42 @@ def optimize(entrypoint, minimize):
 
 
 @cli.group()
+def storage():
+    """Bucket storage attached to tasks (reference sky/cli.py:3773)."""
+
+
+@storage.command('ls')
+def storage_ls():
+    """List storages recorded by task launches."""
+    import datetime
+
+    from skypilot_tpu import global_user_state
+    rows = global_user_state.get_storages()
+    if not rows:
+        click.echo('No storages.')
+        return
+    fmt = '{:<24} {:<40} {:<7} {:<19}'
+    click.echo(fmt.format('NAME', 'URL', 'MODE', 'LAUNCHED'))
+    for r in rows:
+        ts = datetime.datetime.fromtimestamp(
+            r['launched_at']).strftime('%Y-%m-%d %H:%M:%S')
+        click.echo(fmt.format(r['name'][:24], r['url'][:40], r['mode'], ts))
+
+
+@storage.command('delete')
+@click.argument('names', nargs=-1, required=True)
+@click.option('--yes', '-y', is_flag=True)
+def storage_delete(names, yes):
+    """Forget storage records (bucket contents are not touched)."""
+    from skypilot_tpu import global_user_state
+    for name in names:
+        if not yes:
+            click.confirm(f'Delete storage record {name!r}?', abort=True)
+        global_user_state.remove_storage(name)
+        click.echo(f'Storage {name!r} removed from state.')
+
+
+@cli.group()
 def jobs():
     """Managed jobs with auto-recovery."""
 

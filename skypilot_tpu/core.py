@@ -11,21 +11,41 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional
 
+import filelock
+
 from skypilot_tpu import backends
 from skypilot_tpu import exceptions
 from skypilot_tpu import global_user_state
 from skypilot_tpu import provision as provision_lib
+from skypilot_tpu.utils import locks
 
 ClusterStatus = global_user_state.ClusterStatus
 
 
 def _refresh_record(record: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     """Reconcile one cluster record against the cloud; returns the updated
-    record, or None if the cluster no longer exists on the cloud."""
+    record, or None if the cluster no longer exists on the cloud.
+
+    Takes the per-cluster lock *non-blocking*: if a lifecycle op (provision/
+    start/stop/down) holds it, the cached record is returned unmodified
+    rather than racing the mutation (reference refresh_cluster_record
+    acquires with a short timeout and falls back to the cached row).
+    """
     handle = record['handle']
     name = record['name']
     if handle is None:
         return record  # mid-provision INIT record; leave as-is
+    try:
+        with locks.cluster_lock(name).acquire(timeout=0):
+            return _refresh_record_locked(record)
+    except filelock.Timeout:
+        return record  # lifecycle op in flight: keep the cached record
+
+
+def _refresh_record_locked(record: Dict[str, Any]
+                           ) -> Optional[Dict[str, Any]]:
+    handle = record['handle']
+    name = record['name']
     try:
         states = provision_lib.query_instances(handle.cloud, name,
                                                handle.region)
@@ -82,24 +102,29 @@ def _get_handle(cluster_name: str, need_up: bool = False
 
 
 def start(cluster_name: str) -> None:
-    handle = _get_handle(cluster_name)
-    backends.SliceBackend().restart(handle)
+    with locks.cluster_lock(cluster_name):
+        handle = _get_handle(cluster_name)
+        backends.SliceBackend().restart(handle)
 
 
 def stop(cluster_name: str) -> None:
-    handle = _get_handle(cluster_name)
-    backends.SliceBackend().teardown(handle, terminate=False)
+    with locks.cluster_lock(cluster_name):
+        handle = _get_handle(cluster_name)
+        backends.SliceBackend().teardown(handle, terminate=False)
 
 
 def down(cluster_name: str) -> None:
-    handle = _get_handle(cluster_name)
-    backends.SliceBackend().teardown(handle, terminate=True)
+    with locks.cluster_lock(cluster_name):
+        handle = _get_handle(cluster_name)
+        backends.SliceBackend().teardown(handle, terminate=True)
 
 
 def autostop(cluster_name: str, idle_minutes: int,
              down_on_idle: bool = False) -> None:
-    handle = _get_handle(cluster_name, need_up=True)
-    backends.SliceBackend().set_autostop(handle, idle_minutes, down_on_idle)
+    with locks.cluster_lock(cluster_name):
+        handle = _get_handle(cluster_name, need_up=True)
+        backends.SliceBackend().set_autostop(handle, idle_minutes,
+                                             down_on_idle)
 
 
 def queue(cluster_name: str) -> List[Dict[str, Any]]:

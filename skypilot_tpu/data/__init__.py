@@ -1,0 +1,19 @@
+"""Data plane: bucket storage (COPY/MOUNT) for task file mounts.
+
+Counterpart of reference ``sky/data`` (Storage/AbstractStore with COPY and
+MOUNT modes, sky/data/storage.py:118,265,279,519; FUSE mount script
+generation, sky/data/mounting_utils.py:41-464). GCS-first; a hermetic
+``file://`` store backs unit/e2e tests the way the local cloud backs the
+provisioner tests.
+"""
+from skypilot_tpu.data.storage import (AbstractStore, GcsStore, LocalStore,
+                                       Storage, StorageMode, parse_store_url)
+
+__all__ = [
+    'AbstractStore',
+    'GcsStore',
+    'LocalStore',
+    'Storage',
+    'StorageMode',
+    'parse_store_url',
+]

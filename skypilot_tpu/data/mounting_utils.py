@@ -1,0 +1,45 @@
+"""FUSE mount script generation (gcsfuse-first).
+
+Counterpart of reference ``sky/data/mounting_utils.py:41-464`` (per-tool
+install + mount command builders wrapped in a guard script). Only the GCS
+path is generated here; the hermetic LocalStore "mounts" via symlink (see
+data/storage.py) so tests never need FUSE.
+"""
+from __future__ import annotations
+
+import shlex
+
+GCSFUSE_VERSION = '2.4.0'
+
+_INSTALL_GCSFUSE = (
+    'command -v gcsfuse >/dev/null || { '
+    'ARCH=$(uname -m | grep -q aarch64 && echo arm64 || echo amd64); '
+    'curl -fsSL -o /tmp/gcsfuse.deb '
+    'https://github.com/GoogleCloudPlatform/gcsfuse/releases/download/'
+    f'v{GCSFUSE_VERSION}/gcsfuse_{GCSFUSE_VERSION}_$ARCH.deb && '
+    'sudo dpkg -i /tmp/gcsfuse.deb || sudo apt-get install -f -y; }')
+
+
+def gcsfuse_mount_command(bucket: str, mount_point: str,
+                          sub_path: str = '') -> str:
+    """Idempotent install + mount script for one bucket.
+
+    ``--implicit-dirs`` so object prefixes act as directories; the
+    ``only_dir`` flag scopes a bucket subpath (reference mounting_utils
+    gcsfuse branch).
+    """
+    q = shlex.quote
+    only_dir = f'--only-dir {q(sub_path)} ' if sub_path else ''
+    return (
+        f'{_INSTALL_GCSFUSE} && '
+        f'sudo mkdir -p {q(mount_point)} && '
+        f'sudo chown $(id -u):$(id -g) {q(mount_point)} && '
+        f'(mountpoint -q {q(mount_point)} || '
+        f'gcsfuse --implicit-dirs {only_dir}{q(bucket)} {q(mount_point)})')
+
+
+def unmount_command(mount_point: str) -> str:
+    q = shlex.quote
+    return (f'mountpoint -q {q(mount_point)} && '
+            f'(fusermount -u {q(mount_point)} || '
+            f'sudo umount {q(mount_point)}) || true')

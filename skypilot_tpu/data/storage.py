@@ -1,0 +1,295 @@
+"""Storage: named buckets attachable to tasks as COPY or MOUNT file mounts.
+
+Counterpart of reference ``sky/data/storage.py`` (Storage :519, StorageMode
+:265, AbstractStore :118). Differences for the TPU-native rebuild:
+
+- Store operations are expressed as *remote shell commands* (download /
+  upload / mount) executed on cluster hosts through the CommandRunner —
+  there is no Ray task plumbing.
+- GCS is the first-class store (TPU slices live in GCP; intra-region
+  traffic is free and rides Google's backbone). S3 and others can register
+  via ``register_store``.
+- A hermetic ``file://`` store (bucket = directory) backs tests end-to-end,
+  the same design stance as the emulated local cloud (SURVEY.md §4: the
+  reference can only smoke-test storage against real clouds).
+"""
+from __future__ import annotations
+
+import enum
+import os
+import shlex
+import shutil
+import subprocess
+from typing import Any, Dict, Optional, Tuple, Type
+
+from skypilot_tpu import exceptions
+
+
+class StorageMode(enum.Enum):
+    COPY = 'COPY'    # materialize bucket contents onto host disk
+    MOUNT = 'MOUNT'  # FUSE-mount the bucket at the mount point
+
+
+class AbstractStore:
+    """One bucket (+ optional subpath) in one object-store provider.
+
+    Subclasses provide shell-command *generators* (run on cluster hosts)
+    plus client-side upload/exists used by ``Storage.sync_local_source``.
+    """
+
+    SCHEME = ''
+
+    def __init__(self, bucket: str, sub_path: str = ''):
+        self.bucket = bucket
+        self.sub_path = sub_path.strip('/')
+
+    @property
+    def url(self) -> str:
+        suffix = f'/{self.sub_path}' if self.sub_path else ''
+        return f'{self.SCHEME}://{self.bucket}{suffix}'
+
+    def __repr__(self) -> str:
+        return f'{type(self).__name__}({self.url!r})'
+
+    # -- remote-side command generation (run via CommandRunner) -------------
+    def download_command(self, dst: str) -> str:
+        """Shell that materializes the bucket path into ``dst`` (COPY)."""
+        raise NotImplementedError
+
+    def upload_command(self, src: str) -> str:
+        """Shell that syncs host path ``src`` up into the bucket."""
+        raise NotImplementedError
+
+    def mount_command(self, mount_point: str) -> str:
+        """Shell that FUSE-mounts the bucket at ``mount_point`` (MOUNT)."""
+        raise NotImplementedError
+
+    # -- client-side ops ----------------------------------------------------
+    def upload_local(self, local_path: str) -> None:
+        """Upload a local file/dir tree into the bucket (client machine)."""
+        raise NotImplementedError
+
+    def exists(self) -> bool:
+        raise NotImplementedError
+
+
+class GcsStore(AbstractStore):
+    """Google Cloud Storage via the gcloud CLI (remote hosts have it: they
+    are GCP VMs/TPU-VMs) and gcsfuse for MOUNT.
+
+    Reference counterpart: sky/data/storage.py GcsStore + gcsfuse branch of
+    sky/data/mounting_utils.py:41-120.
+    """
+
+    SCHEME = 'gs'
+
+    def download_command(self, dst: str) -> str:
+        q = shlex.quote
+        return (f'mkdir -p {q(dst)} && '
+                f'(command -v gcloud >/dev/null && '
+                f'gcloud storage rsync -r {q(self.url)} {q(dst)} || '
+                f'gsutil -m rsync -r {q(self.url)} {q(dst)})')
+
+    def upload_command(self, src: str) -> str:
+        q = shlex.quote
+        return (f'(command -v gcloud >/dev/null && '
+                f'gcloud storage rsync -r {q(src)} {q(self.url)} || '
+                f'gsutil -m rsync -r {q(src)} {q(self.url)})')
+
+    def mount_command(self, mount_point: str) -> str:
+        from skypilot_tpu.data import mounting_utils
+        return mounting_utils.gcsfuse_mount_command(
+            self.bucket, mount_point, sub_path=self.sub_path)
+
+    def upload_local(self, local_path: str) -> None:
+        local_path = os.path.expanduser(local_path)
+        cmd = ['gsutil', '-m', 'rsync', '-r', local_path, self.url]
+        if shutil.which('gcloud'):
+            cmd = ['gcloud', 'storage', 'rsync', '-r', local_path, self.url]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise exceptions.StorageError(
+                f'upload to {self.url} failed: {proc.stderr[-500:]}')
+
+    def exists(self) -> bool:
+        tool = 'gcloud' if shutil.which('gcloud') else 'gsutil'
+        if tool == 'gcloud':
+            cmd = ['gcloud', 'storage', 'ls', self.url]
+        else:
+            cmd = ['gsutil', 'ls', self.url]
+        return subprocess.run(cmd, capture_output=True).returncode == 0
+
+
+class LocalStore(AbstractStore):
+    """Hermetic test store: the 'bucket' is a directory path.
+
+    ``file:///abs/dir`` URLs exercise every Storage/mount code path with no
+    cloud. MOUNT is a symlink (a faithful stand-in for a FUSE mount from
+    the task's point of view: same path indirection, shared backing files).
+    """
+
+    SCHEME = 'file'
+
+    @property
+    def root(self) -> str:
+        path = f'/{self.bucket}'
+        return os.path.join(path, self.sub_path) if self.sub_path else path
+
+    @property
+    def url(self) -> str:
+        return f'file://{self.root}'
+
+    def download_command(self, dst: str) -> str:
+        q = shlex.quote
+        return (f'mkdir -p {q(dst)} && '
+                f'cp -a {q(self.root)}/. {q(dst)}/')
+
+    def upload_command(self, src: str) -> str:
+        q = shlex.quote
+        return (f'mkdir -p {q(self.root)} && '
+                f'cp -a {q(src)}/. {q(self.root)}/')
+
+    def mount_command(self, mount_point: str) -> str:
+        q = shlex.quote
+        return (f'mkdir -p {q(self.root)} && '
+                f'mkdir -p $(dirname {q(mount_point)}) && '
+                f'rm -rf {q(mount_point)} && '
+                f'ln -sfn {q(self.root)} {q(mount_point)}')
+
+    def upload_local(self, local_path: str) -> None:
+        local_path = os.path.expanduser(local_path)
+        os.makedirs(self.root, exist_ok=True)
+        if os.path.isdir(local_path):
+            shutil.copytree(local_path, self.root, dirs_exist_ok=True)
+        else:
+            shutil.copy2(local_path, self.root)
+
+    def exists(self) -> bool:
+        return os.path.isdir(self.root)
+
+
+_STORES: Dict[str, Type[AbstractStore]] = {}
+
+
+def register_store(cls: Type[AbstractStore]) -> Type[AbstractStore]:
+    _STORES[cls.SCHEME] = cls
+    return cls
+
+
+register_store(GcsStore)
+register_store(LocalStore)
+
+
+def is_store_url(value: str) -> bool:
+    scheme = value.split('://', 1)[0] if '://' in value else ''
+    return scheme in _STORES
+
+
+def parse_store_url(url: str) -> AbstractStore:
+    if '://' not in url:
+        raise exceptions.StorageError(f'not a store URL: {url!r}')
+    scheme, rest = url.split('://', 1)
+    if scheme not in _STORES:
+        raise exceptions.StorageError(
+            f'unsupported store scheme {scheme!r} (have: '
+            f'{sorted(_STORES)})')
+    rest = rest.rstrip('/')
+    if scheme == 'file':
+        # file:///abs/dir -> bucket is the abs path minus leading slash.
+        bucket, sub = rest.lstrip('/'), ''
+    else:
+        bucket, _, sub = rest.partition('/')
+    if not bucket:
+        raise exceptions.StorageError(f'empty bucket in {url!r}')
+    return _STORES[scheme](bucket, sub)
+
+
+class Storage:
+    """A named bucket a task mounts (MOUNT) or materializes (COPY).
+
+    Reference: sky/data/storage.py:519 Storage. YAML forms accepted in
+    ``file_mounts`` (same surface as the reference):
+
+        /data: gs://bucket/path              # implicit COPY storage
+        /ckpt:
+          name: my-bucket                    # or source: gs://...
+          store: gcs
+          mode: MOUNT
+        /out:
+          source: ./local_dir                # uploaded, then mounted
+          store: gcs
+          mode: COPY
+    """
+
+    def __init__(self, name: Optional[str] = None,
+                 source: Optional[str] = None,
+                 mode: StorageMode = StorageMode.COPY,
+                 store: Optional[str] = None):
+        if name is None and source is None:
+            raise exceptions.StorageError(
+                'Storage needs a name or a source')
+        self.mode = mode
+        self.local_source: Optional[str] = None
+
+        if source is not None and is_store_url(source):
+            self.store: AbstractStore = parse_store_url(source)
+        elif source is not None:
+            # Local path to be uploaded into a named bucket.
+            expanded = os.path.expanduser(source)
+            if not os.path.exists(expanded):
+                raise exceptions.StorageError(
+                    f'storage source {source!r} does not exist locally')
+            if name is None:
+                raise exceptions.StorageError(
+                    f'storage with local source {source!r} needs a bucket '
+                    'name')
+            self.local_source = expanded
+            scheme = store or 'gs'
+            self.store = _STORES[_normalize_scheme(scheme)](name)
+        else:
+            scheme = store or 'gs'
+            self.store = _STORES[_normalize_scheme(scheme)](name)
+
+    @property
+    def url(self) -> str:
+        return self.store.url
+
+    def sync_local_source(self) -> None:
+        """Upload the local source into the bucket (no-op otherwise)."""
+        if self.local_source is not None:
+            self.store.upload_local(self.local_source)
+
+    # -- YAML ---------------------------------------------------------------
+    @classmethod
+    def from_yaml_config(cls, config: Any) -> 'Storage':
+        if isinstance(config, str):
+            return cls(source=config)
+        if not isinstance(config, dict):
+            raise exceptions.StorageError(
+                f'bad storage config: {config!r}')
+        mode = StorageMode(str(config.get('mode', 'COPY')).upper())
+        return cls(name=config.get('name'), source=config.get('source'),
+                   mode=mode, store=config.get('store'))
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {'mode': self.mode.value}
+        if self.local_source is not None:
+            out['source'] = self.local_source
+            out['name'] = self.store.bucket
+            out['store'] = self.store.SCHEME
+        else:
+            out['source'] = self.store.url
+        return out
+
+    def __repr__(self) -> str:
+        return f'Storage({self.store.url!r}, mode={self.mode.value})'
+
+
+def _normalize_scheme(store: str) -> str:
+    aliases = {'gcs': 'gs', 'gs': 'gs', 'file': 'file', 'local': 'file'}
+    try:
+        return aliases[store.lower()]
+    except KeyError:
+        raise exceptions.StorageError(
+            f'unknown store {store!r} (have: {sorted(set(aliases))})'
+        ) from None

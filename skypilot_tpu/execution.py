@@ -70,37 +70,45 @@ def _execute(task: task_lib.Task,
     optimize_target = (optimize_target
                        or optimizer_lib.OptimizeTarget.COST)
 
-    handle = _existing_up_handle(cluster_name)
+    # Existence check + provision are atomic under the per-cluster file
+    # lock: concurrent `launch -c same-name` from other processes (API
+    # server workers, parallel CLIs) must not double-provision (reference
+    # sky/execution.py:510-523).
+    from skypilot_tpu.utils import locks
+    with locks.cluster_lock(cluster_name):
+        handle = _existing_up_handle(cluster_name)
 
-    if handle is None:
-        if Stage.OPTIMIZE in stages:
-            optimizer_lib.optimize(task, minimize=optimize_target,
-                                   quiet=dryrun)
-        if dryrun:
-            return None, None
-        if Stage.PROVISION in stages:
-            handle = backend.provision(task, cluster_name,
-                                       retry_until_up=retry_until_up)
-    else:
-        if dryrun:
-            return None, handle
-        # Reusing a live cluster: the requested resources must fit it
-        # (reference check_cluster_available + resources check).
-        launched = handle.launched_resources
-        for want in task.resources:
-            if want.less_demanding_than(launched):
-                break
+        if handle is None:
+            if Stage.OPTIMIZE in stages:
+                optimizer_lib.optimize(task, minimize=optimize_target,
+                                       quiet=dryrun)
+            if dryrun:
+                return None, None
+            if Stage.PROVISION in stages:
+                handle = backend.provision(task, cluster_name,
+                                           retry_until_up=retry_until_up)
         else:
-            raise exceptions.ResourcesMismatchError(
-                f'Task requests {list(task.resources)} but cluster '
-                f'{cluster_name!r} has {launched}.')
+            if dryrun:
+                return None, handle
+            # Reusing a live cluster: the requested resources must fit it
+            # (reference check_cluster_available + resources check).
+            launched = handle.launched_resources
+            for want in task.resources:
+                if want.less_demanding_than(launched):
+                    break
+            else:
+                raise exceptions.ResourcesMismatchError(
+                    f'Task requests {list(task.resources)} but cluster '
+                    f'{cluster_name!r} has {launched}.')
 
     assert handle is not None
 
     if Stage.SYNC_WORKDIR in stages and task.workdir:
         backend.sync_workdir(handle, task.workdir)
     if Stage.SYNC_FILE_MOUNTS in stages:
-        backend.sync_file_mounts(handle, task.file_mounts)
+        task.sync_storage_mounts()  # client-side: local sources -> buckets
+        backend.sync_file_mounts(handle, task.file_mounts,
+                                 task.storage_mounts)
     if Stage.SETUP in stages and task.setup:
         backend.setup(handle, task)
 

@@ -75,6 +75,14 @@ def _create_tables(conn: sqlite3.Connection) -> None:
             PRIMARY KEY (cluster_hash, launched_at)
         )""")
     conn.execute("""
+        CREATE TABLE IF NOT EXISTS storage (
+            name TEXT PRIMARY KEY,
+            url TEXT,
+            mode TEXT,
+            launched_at INTEGER,
+            last_use TEXT
+        )""")
+    conn.execute("""
         CREATE TABLE IF NOT EXISTS kv (
             key TEXT PRIMARY KEY,
             value TEXT
@@ -236,6 +244,39 @@ def get_cluster_history() -> List[Dict[str, Any]]:
             'duration_s': duration_s,
         })
     return out
+
+
+# ---- storage ---------------------------------------------------------------
+def add_or_update_storage(name: str, url: str, mode: str) -> None:
+    """Record a bucket a task has synced/mounted (reference
+    global_user_state storage table :57-111)."""
+    import time as time_lib
+    db = _db()
+    db.execute(
+        'INSERT INTO storage (name, url, mode, launched_at, last_use) '
+        'VALUES (?,?,?,?,?) ON CONFLICT(name) DO UPDATE SET '
+        'url=excluded.url, mode=excluded.mode, last_use=excluded.last_use',
+        (name, url, mode, int(time_lib.time()),
+         common_utils_last_command()))
+    db.commit()
+
+
+def get_storages() -> List[Dict[str, Any]]:
+    rows = _db().execute('SELECT name, url, mode, launched_at, last_use '
+                         'FROM storage ORDER BY launched_at').fetchall()
+    return [{'name': n, 'url': u, 'mode': m, 'launched_at': t,
+             'last_use': lu} for n, u, m, t, lu in rows]
+
+
+def remove_storage(name: str) -> None:
+    db = _db()
+    db.execute('DELETE FROM storage WHERE name=?', (name,))
+    db.commit()
+
+
+def common_utils_last_command() -> str:
+    import sys
+    return ' '.join(sys.argv[:4])
 
 
 # ---- kv --------------------------------------------------------------------

@@ -35,7 +35,17 @@ _QUOTA_MARKERS = ('quota', 'rate limit')
 
 
 class HttpTransport:
-    """Real transport: requests + google-auth token."""
+    """Real transport: requests + google-auth token.
+
+    Transient failures (connection errors, 5xx, throttling 429 without a
+    capacity marker) are retried with exponential backoff — the TPU API
+    throttles routinely, and a single 503 must not abort a provision
+    (reference wraps discovery calls in per-call retries).
+    """
+
+    MAX_ATTEMPTS = 5
+    BACKOFF_S = 1.0
+    _RETRY_STATUSES = (429, 500, 502, 503, 504)
 
     def __init__(self):
         self._session = None
@@ -56,19 +66,41 @@ class HttpTransport:
     def request(self, method: str, url: str,
                 json_body: Optional[Dict[str, Any]] = None,
                 params: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
-        self._ensure()
-        resp = self._session.request(
-            method, url, json=json_body, params=params,
-            headers={'Authorization': f'Bearer {self._creds.token}'},
-            timeout=60)
-        if resp.status_code >= 400:
+        import requests
+        last_exc: Optional[Exception] = None
+        for attempt in range(self.MAX_ATTEMPTS):
+            if attempt:
+                time.sleep(min(self.BACKOFF_S * 2**(attempt - 1), 30))
+            self._ensure()
+            try:
+                resp = self._session.request(
+                    method, url, json=json_body, params=params,
+                    headers={'Authorization': f'Bearer {self._creds.token}'},
+                    timeout=60)
+            except (requests.ConnectionError, requests.Timeout) as e:
+                last_exc = e
+                continue
+            if resp.status_code < 400:
+                return resp.json() if resp.content else {}
             try:
                 payload = resp.json().get('error', {})
                 message = payload.get('message', resp.text)
             except Exception:
                 message = resp.text
-            raise classify_error(resp.status_code, message)
-        return resp.json() if resp.content else {}
+            err = classify_error(resp.status_code, message)
+            # Genuine capacity stockouts must surface immediately (they
+            # drive zone failover); plain throttling/5xx is retried.
+            is_stockout = any(m in (message or '').lower()
+                              for m in _CAPACITY_MARKERS)
+            if resp.status_code in self._RETRY_STATUSES and not is_stockout:
+                last_exc = err
+                continue
+            raise err
+        assert last_exc is not None
+        raise (last_exc if isinstance(last_exc, exceptions.CloudError)
+               else exceptions.CloudError(
+                   f'transport failure after {self.MAX_ATTEMPTS} attempts: '
+                   f'{last_exc!r}'))
 
 
 _transport: Any = None

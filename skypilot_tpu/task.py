@@ -67,7 +67,9 @@ class Task:
         self.num_nodes = num_nodes if num_nodes is not None else 1
         self.file_mounts: Optional[Dict[str, str]] = (
             dict(file_mounts) if file_mounts else None)
+        # mount_path -> data.Storage (reference sky/task.py storage_mounts).
         self.storage_mounts: Dict[str, Any] = {}
+        self._extract_storage_mounts()
         self._resources: Tuple[resources_lib.Resources, ...] = (
             resources_lib.Resources(),)
         self._resources_ordered = False
@@ -82,6 +84,46 @@ class Task:
         current = dag_lib.get_current_dag()
         if current is not None:
             current.add(self)
+
+    def _extract_storage_mounts(self) -> None:
+        """Split bucket-backed entries out of ``file_mounts``.
+
+        ``/data: gs://bucket/path`` (implicit COPY) and dict-valued entries
+        (full storage specs) become ``storage_mounts``; plain local-path
+        entries stay in ``file_mounts`` (reference sky/task.py:1028
+        sync_storage_mounts split).
+        """
+        if not self.file_mounts:
+            return
+        from skypilot_tpu.data import storage as storage_lib
+        plain: Dict[str, str] = {}
+        for dst, src in self.file_mounts.items():
+            if isinstance(src, dict) or (
+                    isinstance(src, str) and storage_lib.is_store_url(src)):
+                self.storage_mounts[dst] = (
+                    storage_lib.Storage.from_yaml_config(src))
+            else:
+                plain[dst] = src
+        self.file_mounts = plain or None
+
+    def set_storage_mounts(self, mounts: Optional[Dict[str, Any]]) -> 'Task':
+        from skypilot_tpu.data import storage as storage_lib
+        self.storage_mounts = {}
+        for dst, spec in (mounts or {}).items():
+            if isinstance(spec, storage_lib.Storage):
+                self.storage_mounts[dst] = spec
+            else:
+                self.storage_mounts[dst] = (
+                    storage_lib.Storage.from_yaml_config(spec))
+        return self
+
+    def sync_storage_mounts(self) -> None:
+        """Client-side phase: upload local sources into their buckets."""
+        from skypilot_tpu import global_user_state
+        for storage in self.storage_mounts.values():
+            storage.sync_local_source()
+            global_user_state.add_or_update_storage(
+                storage.store.bucket, storage.url, storage.mode.value)
 
     # ---- validation -------------------------------------------------------
     def _validate(self) -> None:
@@ -205,7 +247,7 @@ class Task:
         task.set_resources(res if isinstance(res, list) else [res],
                            ordered=ordered)
         if config.get('storage_mounts'):
-            task.storage_mounts = dict(config['storage_mounts'])
+            task.set_storage_mounts(config['storage_mounts'])
         if config.get('service'):
             from skypilot_tpu.serve import service_spec  # lazy import
             task.set_service(
@@ -248,7 +290,9 @@ class Task:
         add('secrets', self._secrets or None)
         add('workdir', self.workdir)
         add('file_mounts', self.file_mounts)
-        add('storage_mounts', self.storage_mounts or None)
+        add('storage_mounts',
+            {dst: s.to_yaml_config()
+             for dst, s in self.storage_mounts.items()} or None)
         add('setup', self.setup)
         add('run', self.run if isinstance(self.run, str) else None)
         if self.service is not None:

@@ -5,7 +5,9 @@ in reference examples/tpu/v6e/train-llama3-8b.yaml); here the framework owns
 an idiomatic-JAX trainer so the BASELINE.md throughput anchors are measured
 in-tree.
 """
+from skypilot_tpu.train.checkpoint import CheckpointManager
 from skypilot_tpu.train.step import (Trainer, TrainState,
                                      cross_entropy_loss)
 
-__all__ = ['Trainer', 'TrainState', 'cross_entropy_loss']
+__all__ = ['CheckpointManager', 'Trainer', 'TrainState',
+           'cross_entropy_loss']

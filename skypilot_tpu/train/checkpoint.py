@@ -1,0 +1,83 @@
+"""Orbax-backed train-state checkpointing (save / resume).
+
+The reference leaves model checkpointing entirely to user code (SURVEY.md
+§5.4: "recovery = relaunch-and-rerun, checkpoint-based resume is the
+user's job", pattern reference examples/managed_job_with_storage.yaml —
+a bucket MOUNT the user writes into). This framework owns the model layer,
+so managed-job recovery composes with a first-class helper:
+
+    mgr = CheckpointManager(ckpt_dir)              # dir may be a MOUNT path
+    state = trainer.restore_or_init(mgr, rng)      # resumes at latest step
+    ...
+    mgr.save(state)                                # async, sharded
+
+Sharded-state aware: restore targets are built from the live TrainState's
+shapes/shardings, so an FSDP-sharded 8B state restores without ever
+materializing unsharded (same stance as train/step.py sharded init).
+GCS paths work through orbax's gcsfs backend when credentials exist; local
+paths (incl. gcsfuse mounts) need nothing.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+
+class CheckpointManager:
+    """Thin wrapper over ``orbax.checkpoint.CheckpointManager``."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 save_interval_steps: int = 1):
+        import orbax.checkpoint as ocp
+        self.directory = os.path.abspath(os.path.expanduser(directory))
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+                enable_async_checkpointing=True,
+            ),
+        )
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def save(self, state: Any, step: Optional[int] = None,
+             force: bool = False) -> bool:
+        """Persist ``state`` (a TrainState pytree). step defaults to
+        ``int(state.step)``. Async: returns once staged to host."""
+        import orbax.checkpoint as ocp
+        if step is None:
+            step = int(jax.device_get(state.step))
+        return self._mgr.save(step, args=ocp.args.StandardSave(state),
+                              force=force)
+
+    def restore(self, target: Any, step: Optional[int] = None) -> Any:
+        """Restore into the shapes/shardings of ``target`` (a live or
+        abstract TrainState); returns the restored pytree."""
+        import orbax.checkpoint as ocp
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(
+                f'no checkpoint under {self.directory}')
+        abstract = jax.tree.map(_abstractify, target)
+        return self._mgr.restore(step,
+                                 args=ocp.args.StandardRestore(abstract))
+
+    def wait(self) -> None:
+        """Block until any in-flight async save is durable."""
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
+
+
+def _abstractify(x: Any) -> Any:
+    if isinstance(x, jax.Array):
+        return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                    sharding=getattr(x, 'sharding', None))
+    return x

@@ -97,6 +97,19 @@ class Trainer:
 
         return jax.jit(step, donate_argnums=0)
 
+    def restore_or_init(self, ckpt_mgr, rng: jax.Array) -> TrainState:
+        """Resume from the latest checkpoint if one exists, else fresh init.
+
+        The restore target comes from the sharded init (shapes + shardings),
+        so restoration never materializes an unsharded state — the
+        managed-jobs recovery path (jobs/controller.py) relies on this to
+        resume from step N instead of restarting at 0.
+        """
+        state = self.init_fn()(rng)
+        if ckpt_mgr.latest_step() is None:
+            return state
+        return ckpt_mgr.restore(state)
+
     def shard_batch(self, batch: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
         """Place a host batch onto the mesh, sharded over (dp, fsdp) [+ sp]."""
         if self.mesh is None:

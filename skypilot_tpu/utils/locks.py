@@ -1,0 +1,54 @@
+"""Cross-process cluster locks.
+
+The API server executes operations in forked worker processes and multiple
+CLIs can run concurrently, so thread locks cannot serialize cluster
+lifecycle ops — two simultaneous ``launch -c same-name`` must not both pass
+the existence check and double-provision. Per-cluster ``filelock`` files
+under the state dir give process-level mutual exclusion, mirroring the
+reference's per-cluster locking (reference sky/execution.py:510-523,
+sky/backends/backend_utils.py cluster_status_lock).
+
+Locks are cached per (state_dir, name) so every caller in a process shares
+one ``FileLock`` instance: acquisition is reentrant within a thread and
+mutually exclusive across threads and processes.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict
+
+import filelock
+
+# Reference uses 20s for cluster-status locks; lifecycle ops here can
+# legitimately hold the lock for a whole provision, so wait generously.
+CLUSTER_LOCK_TIMEOUT_S = float(
+    os.environ.get('SKYTPU_CLUSTER_LOCK_TIMEOUT', 600))
+
+_locks: Dict[str, filelock.FileLock] = {}
+_guard = threading.Lock()
+
+
+class ClusterLockTimeout(Exception):
+    """Another process held the cluster lock past the timeout."""
+
+
+def _lock_path(name: str) -> str:
+    from skypilot_tpu import global_user_state
+    lock_dir = os.path.join(global_user_state.get_state_dir(), 'locks')
+    os.makedirs(lock_dir, exist_ok=True)
+    return os.path.join(lock_dir, f'cluster.{name}.lock')
+
+
+def cluster_lock(cluster_name: str,
+                 timeout: float = None) -> filelock.FileLock:
+    """Process-wide shared FileLock for a cluster (use as context manager)."""
+    path = _lock_path(cluster_name)
+    with _guard:
+        lock = _locks.get(path)
+        if lock is None:
+            lock = filelock.FileLock(
+                path, timeout=CLUSTER_LOCK_TIMEOUT_S
+                if timeout is None else timeout)
+            _locks[path] = lock
+    return lock

@@ -1,0 +1,200 @@
+"""Cross-process cluster locking + transport retry tests.
+
+Counterpart behavior: reference per-cluster filelocks
+(sky/execution.py:510-523, sky/backends/backend_utils.py) and per-call
+cloud-API retries.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import core
+from skypilot_tpu import execution
+from skypilot_tpu import global_user_state
+from skypilot_tpu.utils import locks
+
+
+def _local_task(run='echo locked'):
+    task = sky.Task(run=run, num_nodes=1)
+    task.set_resources([sky.Resources(cloud='local')])
+    return task
+
+
+class TestClusterLock:
+
+    def test_reentrant_within_thread(self):
+        lock = locks.cluster_lock('re-c')
+        with lock:
+            with locks.cluster_lock('re-c'):  # same cached instance
+                assert lock.is_locked
+        assert not lock.is_locked
+
+    def test_excludes_other_process(self, tmp_path):
+        """A child process cannot acquire while we hold the lock."""
+        lock = locks.cluster_lock('xproc')
+        script = (
+            'import os, sys, filelock\n'
+            'from skypilot_tpu.utils import locks\n'
+            'try:\n'
+            '    with locks.cluster_lock("xproc").acquire(timeout=0.5):\n'
+            '        print("ACQUIRED")\n'
+            'except filelock.Timeout:\n'
+            '    print("TIMEOUT")\n')
+        env = dict(os.environ)
+        with lock:
+            out = subprocess.run([sys.executable, '-c', script], env=env,
+                                 capture_output=True, text=True, timeout=120)
+        assert 'TIMEOUT' in out.stdout, (out.stdout, out.stderr)
+        # Released: the child can take it now.
+        out = subprocess.run([sys.executable, '-c', script], env=env,
+                             capture_output=True, text=True, timeout=120)
+        assert 'ACQUIRED' in out.stdout, (out.stdout, out.stderr)
+
+    def test_concurrent_launch_provisions_once(self, monkeypatch):
+        """Two concurrent launches of one name -> exactly one provision."""
+        from skypilot_tpu import provision as provision_lib
+        calls = []
+        real_run = provision_lib.run_instances
+
+        def counting_run(*args, **kwargs):
+            calls.append(args)
+            time.sleep(0.3)  # widen the race window
+            return real_run(*args, **kwargs)
+
+        monkeypatch.setattr(provision_lib, 'run_instances', counting_run)
+        errs = []
+
+        def do_launch():
+            try:
+                execution.launch(_local_task(), cluster_name='t-race',
+                                 detach_run=True)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=do_launch) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        assert not errs, errs
+        assert len(calls) == 1, f'double provision: {len(calls)} calls'
+        record = global_user_state.get_cluster_from_name('t-race')
+        assert record is not None
+        core.down('t-race')
+
+    def test_status_refresh_skips_locked_cluster(self, monkeypatch):
+        """While a lifecycle op holds the lock, refresh returns the cached
+        record instead of racing the mutation."""
+        execution.launch(_local_task(), cluster_name='t-skip',
+                         detach_run=True)
+        from skypilot_tpu import provision as provision_lib
+        queried = []
+        real_query = provision_lib.query_instances
+
+        def counting_query(*args, **kwargs):
+            queried.append(args)
+            return real_query(*args, **kwargs)
+
+        monkeypatch.setattr(provision_lib, 'query_instances', counting_query)
+        with locks.cluster_lock('t-skip'):
+            # Refresh from another thread (lock is thread-exclusive).
+            result = {}
+            t = threading.Thread(
+                target=lambda: result.update(
+                    rows=core.status(['t-skip'], refresh=True)))
+            t.start()
+            t.join(timeout=60)
+        assert result['rows'][0]['name'] == 't-skip'
+        assert not queried  # cloud never consulted while locked
+        # Unlocked: refresh reaches the cloud again.
+        core.status(['t-skip'], refresh=True)
+        assert queried
+        core.down('t-skip')
+
+
+class TestTransportRetry:
+
+    def _transport(self):
+        from skypilot_tpu.provision.gcp_api import HttpTransport
+        t = HttpTransport.__new__(HttpTransport)
+        t._creds = type('C', (), {'valid': True, 'token': 'tok'})()
+        return t
+
+    def _session(self, responses):
+        class Resp:
+            def __init__(self, code, body):
+                self.status_code = code
+                self._body = body
+                self.content = json.dumps(body).encode()
+                self.text = json.dumps(body)
+
+            def json(self):
+                return self._body
+
+        class Session:
+            def __init__(self):
+                self.calls = 0
+
+            def request(self, *args, **kwargs):
+                item = responses[min(self.calls, len(responses) - 1)]
+                self.calls += 1
+                if isinstance(item, Exception):
+                    raise item
+                code, body = item
+                return Resp(code, body)
+
+        return Session()
+
+    def test_retries_transient_5xx(self, monkeypatch):
+        from skypilot_tpu.provision import gcp_api
+        monkeypatch.setattr(gcp_api.HttpTransport, 'BACKOFF_S', 0.01)
+        t = self._transport()
+        t._session = self._session([
+            (503, {'error': {'message': 'backend unavailable'}}),
+            (503, {'error': {'message': 'backend unavailable'}}),
+            (200, {'ok': True}),
+        ])
+        assert t.request('GET', 'https://x/y') == {'ok': True}
+        assert t._session.calls == 3
+
+    def test_capacity_error_not_retried(self, monkeypatch):
+        from skypilot_tpu import exceptions
+        from skypilot_tpu.provision import gcp_api
+        monkeypatch.setattr(gcp_api.HttpTransport, 'BACKOFF_S', 0.01)
+        t = self._transport()
+        t._session = self._session([
+            (429, {'error': {'message': 'No more capacity in the zone'}}),
+        ])
+        with pytest.raises(exceptions.InsufficientCapacityError):
+            t.request('POST', 'https://x/y')
+        assert t._session.calls == 1  # stockouts fail over, not retry
+
+    def test_permission_error_not_retried(self, monkeypatch):
+        from skypilot_tpu import exceptions
+        from skypilot_tpu.provision import gcp_api
+        monkeypatch.setattr(gcp_api.HttpTransport, 'BACKOFF_S', 0.01)
+        t = self._transport()
+        t._session = self._session([
+            (403, {'error': {'message': 'permission denied'}}),
+        ])
+        with pytest.raises(exceptions.CloudError):
+            t.request('GET', 'https://x/y')
+        assert t._session.calls == 1
+
+    def test_exhausted_raises_last_error(self, monkeypatch):
+        from skypilot_tpu import exceptions
+        from skypilot_tpu.provision import gcp_api
+        monkeypatch.setattr(gcp_api.HttpTransport, 'BACKOFF_S', 0.001)
+        t = self._transport()
+        t._session = self._session([
+            (503, {'error': {'message': 'unavailable'}}),
+        ])
+        with pytest.raises(exceptions.CloudError, match='unavailable'):
+            t.request('GET', 'https://x/y')
+        assert t._session.calls == gcp_api.HttpTransport.MAX_ATTEMPTS

@@ -114,3 +114,52 @@ class TestManagedJobs:
         buf = io.StringIO()
         rc = jobs_core.tail_logs(job_id, follow=False, out=buf)
         assert 'SUCCEEDED' in buf.getvalue()
+
+    def test_preemption_resume_from_checkpoint(self, tmp_path):
+        """Recovery resumes from persisted progress, not from scratch.
+
+        The job checkpoints a step counter into a MOUNT-backed bucket
+        (the reference's managed_job_with_storage.yaml pattern); after an
+        injected preemption the relaunched job must continue past the
+        checkpointed step instead of restarting at 1.
+        """
+        bucket = tmp_path / 'ckpt-bucket'
+        bucket.mkdir()
+        # Steps are slow enough that preemption lands mid-run, and progress
+        # is durably visible in the bucket before it.
+        script = (
+            'last=$(cat ../ckpt/step 2>/dev/null || echo 0); '
+            'start=$((last + 1)); '
+            'for i in $(seq $start 40); do '
+            'echo step-$i; echo $i > ../ckpt/step; sleep 0.4; done')
+        task = sky.Task(run=script, file_mounts={
+            './ckpt': {'source': f'file://{bucket}', 'mode': 'MOUNT'}})
+        task.set_resources([sky.Resources(cloud='local')])
+        job_id = jobs_core.launch(task)
+        _wait_status(job_id, {ManagedJobStatus.RUNNING})
+        # Let it make some progress, then kill the cluster out-of-band.
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if (bucket / 'step').exists() and int(
+                    (bucket / 'step').read_text() or 0) >= 3:
+                break
+            time.sleep(0.3)
+        steps_before = int((bucket / 'step').read_text())
+        assert steps_before >= 3
+        row = jobs_state.get(job_id)
+        from skypilot_tpu.provision import local_impl
+        local_impl.terminate_instances(row['cluster_name'], 'local')
+
+        _wait_status(job_id, {ManagedJobStatus.RECOVERING}, timeout=30)
+        _wait_status(job_id, {ManagedJobStatus.RUNNING}, timeout=60)
+        # Resumed run continues from the checkpoint.
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if int((bucket / 'step').read_text() or 0) > steps_before:
+                break
+            time.sleep(0.3)
+        resumed_logs = jobs_core.controller_logs(job_id)
+        after = int((bucket / 'step').read_text())
+        assert after > steps_before, resumed_logs
+        jobs_core.cancel([job_id])
+        _wait_status(job_id, {ManagedJobStatus.CANCELLED}, timeout=60)

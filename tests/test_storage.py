@@ -1,0 +1,162 @@
+"""Data plane tests: Storage COPY/MOUNT on the local cloud + checkpoints.
+
+Counterpart: reference only covers sky/data with real-cloud smoke tests
+(tests/smoke_tests/test_mount_and_storage.py); here the hermetic file://
+store drives the same code paths (task YAML -> storage_mounts -> backend
+download/mount on emulated hosts) with no cloud.
+"""
+import os
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import core
+from skypilot_tpu import exceptions
+from skypilot_tpu import execution
+from skypilot_tpu.data import (GcsStore, LocalStore, Storage, StorageMode,
+                               parse_store_url)
+
+
+class TestStoreUrls:
+
+    def test_parse_gs(self):
+        s = parse_store_url('gs://bucket/sub/path')
+        assert isinstance(s, GcsStore)
+        assert s.bucket == 'bucket' and s.sub_path == 'sub/path'
+        assert s.url == 'gs://bucket/sub/path'
+
+    def test_parse_file(self, tmp_path):
+        s = parse_store_url(f'file://{tmp_path}')
+        assert isinstance(s, LocalStore)
+        assert s.root == str(tmp_path)
+
+    def test_unknown_scheme(self):
+        with pytest.raises(exceptions.StorageError, match='unsupported'):
+            parse_store_url('s4://nope')
+
+    def test_gcs_commands_shape(self):
+        s = GcsStore('b', 'p')
+        assert 'gs://b/p' in s.download_command('/data')
+        assert 'gcsfuse' in s.mount_command('/data')
+        assert '--only-dir' in s.mount_command('/data')
+
+
+class TestTaskStorageParsing:
+
+    def test_file_mounts_url_becomes_copy_storage(self, tmp_path):
+        task = sky.Task(run='true',
+                        file_mounts={'/data': f'file://{tmp_path}',
+                                     '/plain': str(tmp_path)})
+        assert task.file_mounts == {'/plain': str(tmp_path)}
+        st = task.storage_mounts['/data']
+        assert st.mode is StorageMode.COPY
+        assert st.url == f'file://{tmp_path}'
+
+    def test_dict_spec_mount_mode(self, tmp_path):
+        task = sky.Task(run='true', file_mounts={
+            '/ckpt': {'source': f'file://{tmp_path}', 'mode': 'MOUNT'}})
+        assert task.storage_mounts['/ckpt'].mode is StorageMode.MOUNT
+
+    def test_local_source_uploads(self, tmp_path):
+        src = tmp_path / 'src'
+        src.mkdir()
+        (src / 'a.txt').write_text('hello')
+        bucket = tmp_path / 'bucket'
+        task = sky.Task(run='true', file_mounts={
+            '/data': {'source': str(src), 'name': str(bucket).lstrip('/'),
+                      'store': 'local', 'mode': 'COPY'}})
+        task.sync_storage_mounts()
+        assert (bucket / 'a.txt').read_text() == 'hello'
+
+    def test_yaml_round_trip(self, tmp_path):
+        task = sky.Task(run='true', file_mounts={
+            '/d': {'source': f'file://{tmp_path}', 'mode': 'MOUNT'}})
+        cfg = task.to_yaml_config()
+        again = sky.Task.from_yaml_config(cfg)
+        assert again.storage_mounts['/d'].mode is StorageMode.MOUNT
+        assert again.storage_mounts['/d'].url == f'file://{tmp_path}'
+
+
+def _local_task(run, **kw):
+    task = sky.Task(run=run, **kw)
+    task.set_resources([sky.Resources(cloud='local')])
+    return task
+
+
+class TestStorageE2E:
+
+    def test_copy_mount_e2e(self, tmp_path):
+        bucket = tmp_path / 'bucket'
+        bucket.mkdir()
+        (bucket / 'payload.txt').write_text('bucket-payload')
+        # Mount destinations are home-relative (here: the emulated host
+        # dir); the job's cwd is the workdir one level below.
+        task = _local_task(
+            'cat ../data/payload.txt && echo from-job > ../mnt/out.txt',
+            file_mounts={
+                './data': f'file://{bucket}',                   # COPY
+                './mnt': {'source': f'file://{bucket}',          # MOUNT
+                          'mode': 'MOUNT'},
+            })
+        job_id, handle = execution.launch(task, cluster_name='t-storage',
+                                          detach_run=True)
+        from tests.test_e2e_local import _logs_text, _wait_job
+        assert _wait_job('t-storage', job_id) == 'SUCCEEDED'
+        assert 'bucket-payload' in _logs_text('t-storage', job_id)
+        # MOUNT is shared: the job's write is visible in the bucket.
+        assert (bucket / 'out.txt').read_text().strip() == 'from-job'
+        core.down('t-storage')
+
+    def test_copy_failure_surfaces(self, tmp_path):
+        task = _local_task('true', file_mounts={
+            './data': f'file://{tmp_path}/does-not-exist'})
+        with pytest.raises(exceptions.StorageError, match='COPY'):
+            execution.launch(task, cluster_name='t-storage-bad',
+                             detach_run=True)
+        core.down('t-storage-bad')
+
+
+class TestCheckpointResume:
+
+    def test_trainer_restore_or_init_resumes(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        from skypilot_tpu.models.llama import LlamaConfig, LlamaModel
+        from skypilot_tpu.parallel import MeshSpec, make_mesh
+        from skypilot_tpu.train import CheckpointManager, Trainer
+
+        config = LlamaConfig(vocab_size=128, embed_dim=32, num_layers=2,
+                             num_heads=2, num_kv_heads=1, head_dim=16,
+                             mlp_dim=64, max_seq_len=64, dtype=jnp.float32,
+                             remat=False)
+        mesh = make_mesh(MeshSpec(fsdp=4, tp=2))
+        model = LlamaModel(config, mesh=mesh)
+        trainer = Trainer(model, learning_rate=1e-2)
+        ckpt = CheckpointManager(str(tmp_path / 'ckpt'))
+        tokens = jax.random.randint(jax.random.key(1), (4, 16), 0,
+                                    config.vocab_size)
+        with jax.set_mesh(mesh):
+            batch = trainer.shard_batch(
+                {'tokens': tokens, 'targets': jnp.roll(tokens, -1, 1)})
+            state = trainer.restore_or_init(ckpt, jax.random.key(0))
+            assert int(state.step) == 0
+            step = trainer.step_fn()
+            for _ in range(3):
+                state, metrics = step(state, batch)
+            ckpt.save(state)
+            ckpt.wait()
+            loss_at_3 = float(metrics['loss'])
+
+            # Simulate preemption: fresh trainer + restore.
+            trainer2 = Trainer(model, learning_rate=1e-2)
+            state2 = trainer2.restore_or_init(ckpt, jax.random.key(0))
+            assert int(state2.step) == 3  # resumed, not restarted
+            # Shardings survived the round trip.
+            flat1 = jax.tree.leaves(state.params)
+            flat2 = jax.tree.leaves(state2.params)
+            for a, b in zip(flat1, flat2):
+                assert a.sharding == b.sharding
+            state2, metrics2 = trainer2.step_fn()(state2, batch)
+            assert float(metrics2['loss']) < loss_at_3 * 1.5  # sane continue
+        ckpt.close()

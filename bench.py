@@ -1,25 +1,49 @@
-"""Flagship benchmark: train-step token throughput per chip.
+"""Flagship benchmark: train-step token throughput per chip, with MFU.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 
 Baseline anchor (BASELINE.md): the reference's Llama-3-8B torch-XLA FSDP
-recipe reaches 0.476 samples/s at seq 8192 on a tpu-v6e-8, i.e.
-0.476 * 8192 / 8 = 487.4 train tokens/s/chip. We measure our JAX trainer's
-tokens/s on one chip (model size auto-scaled to fit a single chip's HBM) and
-report vs_baseline = ours / 487.4. Extra context (model, MFU, hardware) goes
-to stderr so stdout stays a single JSON line.
+recipe reaches 0.476 samples/s at seq 8192 on a tpu-v6e-8 host =
+0.476 * 8192 / 8 = 487.4 train tokens/s/chip AT 8B.
+
+Honest comparison (VERDICT r1): tokens/s scales ~1/params at fixed
+hardware FLOP/s, so raw tokens/s of a smaller model must not be compared
+to the 8B anchor. This bench runs the largest config that fits the chip's
+HBM (8B needs ~80GB of train state; a v5e chip has 16GB), reports the
+measured tokens/s for THAT model as "value", and computes
+``vs_baseline`` from the **8B-equivalent** rate:
+    tok8b = tok/s * N_params / 8.03e9
+Extra context (model size, MFU vs the detected chip's bf16 peak, hardware)
+rides in the same JSON object and on stderr.
 """
 from __future__ import annotations
 
 import json
-import os
 import sys
 import time
 
-BASELINE_TOK_PER_S_PER_CHIP = 0.476 * 8192 / 8  # 487.4
+BASELINE_8B_TOK_PER_S_PER_CHIP = 0.476 * 8192 / 8  # 487.4
+LLAMA3_8B_PARAMS = 8.03e9
+
+# bf16 peak TFLOP/s per chip by device kind (public specs).
+_PEAKS = {
+    'TPU v2': 46, 'TPU v3': 123, 'TPU v4': 275,
+    'TPU v5 lite': 197, 'TPU v5e': 197, 'TPU v5p': 459, 'TPU v5': 459,
+    'TPU v6 lite': 918, 'TPU v6e': 918,
+}
+
+
+def chip_peak_tflops(device) -> float:
+    kind = getattr(device, 'device_kind', '') or ''
+    for name, peak in _PEAKS.items():
+        if kind.startswith(name):
+            return float(peak)
+    return 197.0  # conservative default: v5e
 
 
 def main():
+    import dataclasses
+
     import jax
     import jax.numpy as jnp
 
@@ -29,11 +53,14 @@ def main():
     backend = jax.default_backend()
     on_tpu = backend in ('tpu', 'axon')
     if on_tpu:
-        preset, batch, seq, steps = 'llama-1b', 4, 2048, 8
+        # Largest preset whose ~10N-byte train state + activations fit one
+        # chip's HBM (v5e: 16GB). 'dots' remat + Pallas flash fwd/bwd.
+        preset, batch, seq, steps = 'llama-1b', 1, 8192, 8
+        config = dataclasses.replace(PRESETS[preset], remat_policy='dots')
     else:  # CPU fallback so the bench always emits a record
         preset, batch, seq, steps = 'test-tiny', 4, 256, 4
+        config = PRESETS[preset]
 
-    config = PRESETS[preset]
     n_chips = jax.device_count()
     mesh = None
     if n_chips > 1:
@@ -44,44 +71,58 @@ def main():
         batch *= n_chips
     model = LlamaModel(config, mesh=mesh)
     trainer = Trainer(model)
-    print(f'bench: backend={backend} preset={preset} chips={n_chips} '
-          f'params={config.num_params/1e9:.2f}B batch={batch} seq={seq}',
-          file=sys.stderr)
+    device = jax.devices()[0]
+    peak = chip_peak_tflops(device)
+    print(f'bench: backend={backend} device={device.device_kind!r} '
+          f'preset={preset} chips={n_chips} '
+          f'params={config.num_params/1e9:.2f}B batch={batch} seq={seq} '
+          f'remat={config.remat_policy}', file=sys.stderr)
 
     state = trainer.init_fn()(jax.random.key(0))
-    jax.block_until_ready(state.params)
     step = trainer.step_fn()
-    tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0,
-                                config.vocab_size)
-    batch_data = trainer.shard_batch(
-        {'tokens': tokens, 'targets': jnp.roll(tokens, -1, axis=1)})
+    # Distinct batch per step: the dev tunnel backend memoizes identical
+    # executions, which would make repeated-batch timings fictitious.
+    batches = []
+    for i in range(steps + 2):
+        tokens = jax.random.randint(jax.random.key(i), (batch, seq), 0,
+                                    config.vocab_size)
+        batches.append(trainer.shard_batch(
+            {'tokens': tokens, 'targets': jnp.roll(tokens, -1, axis=1)}))
 
-    # Warmup (compile) then timed steps. The loss is fetched to host each
-    # step: on the tunneled dev backend block_until_ready alone does not
-    # guarantee the remote step ran, and one scalar D2H per step is noise
-    # relative to a 0.1s+ train step.
-    for _ in range(2):
-        state, metrics = step(state, batch_data)
+    # Warmup (compile); the scalar fetch is the only reliable sync on the
+    # tunneled backend (block_until_ready does not wait there).
+    for i in range(2):
+        state, metrics = step(state, batches[i])
     float(metrics['loss'])
     t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = step(state, batch_data)
-        last_loss = float(metrics['loss'])
+    for i in range(steps):
+        state, metrics = step(state, batches[2 + i])
+    last_loss = float(metrics['loss'])
     dt = time.perf_counter() - t0
 
     tok_per_s_per_chip = batch * seq * steps / dt / n_chips
-    model_tflops = 6 * config.num_params * batch * seq / 1e12
-    tflops_per_s = model_tflops * steps / dt / n_chips
-    print(f'bench: {tok_per_s_per_chip:,.0f} tok/s/chip, '
-          f'~{tflops_per_s:.1f} model TFLOP/s/chip, '
+    model_tflops_step = 6 * config.num_params * batch * seq / 1e12
+    tflops_per_s = model_tflops_step * steps / dt / n_chips
+    mfu = tflops_per_s / peak
+    tok8b_equiv = tok_per_s_per_chip * config.num_params / LLAMA3_8B_PARAMS
+    vs_baseline = tok8b_equiv / BASELINE_8B_TOK_PER_S_PER_CHIP
+
+    print(f'bench: {tok_per_s_per_chip:,.0f} tok/s/chip @ '
+          f'{config.num_params/1e9:.2f}B, {tflops_per_s:.1f} model TFLOP/s '
+          f'(MFU {mfu*100:.1f}% of {peak:.0f} peak), '
+          f'8B-equivalent {tok8b_equiv:,.0f} tok/s/chip, '
           f'loss={last_loss:.3f}', file=sys.stderr)
 
     print(json.dumps({
         'metric': 'train_tokens_per_sec_per_chip',
         'value': round(tok_per_s_per_chip, 2),
-        'unit': 'tokens/s/chip',
-        'vs_baseline': round(tok_per_s_per_chip / BASELINE_TOK_PER_S_PER_CHIP,
-                             3),
+        'unit': f'tokens/s/chip @ {config.num_params/1e9:.2f}B seq {seq}',
+        'vs_baseline': round(vs_baseline, 3),
+        'equivalent_8b_tokens_per_sec_per_chip': round(tok8b_equiv, 2),
+        'model_params_b': round(config.num_params / 1e9, 3),
+        'mfu_pct': round(mfu * 100, 1),
+        'chip': device.device_kind,
+        'seq_len': seq,
     }))
 
 

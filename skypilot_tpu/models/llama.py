@@ -49,6 +49,12 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     tie_embeddings: bool = False
     remat: bool = True
+    # Rematerialization policy: 'full' recomputes the whole layer in the
+    # backward (min memory, ~+2NP FLOPs); 'dots' saves matmul outputs with
+    # no batch dims (optimizer-friendly middle ground); 'offload' is 'full'
+    # with layer inputs kept in f32->bf16 (reserved). Selective remat is the
+    # VERDICT r1 MFU lever: full-layer remat costs ~25% of step FLOPs.
+    remat_policy: str = 'full'
     # Pipeline parallelism: microbatch count when the mesh has pp > 1
     # (None -> one microbatch per stage, the minimum busy schedule).
     pp_microbatches: Optional[int] = None
@@ -215,19 +221,22 @@ class LlamaModel:
 
     def _attn_delta(self, lp: Params, x: jax.Array, cos, sin, positions,
                     constrain: bool = True) -> jax.Array:
+        from jax.ad_checkpoint import checkpoint_name
         q, k, v = self._qkv(lp, x, cos, sin, positions, constrain)
-        attn = self._attend(q, k, v)
+        attn = checkpoint_name(self._attend(q, k, v), 'attn_out')
         return jnp.einsum('bshd,hde->bse', attn, lp['wo'])
 
     def _mlp_delta(self, lp: Params, x: jax.Array,
                    constrain: bool = True) -> Tuple[jax.Array, jax.Array]:
         """Post-attention feed-forward. Returns (delta, aux_loss_scalar)."""
+        from jax.ad_checkpoint import checkpoint_name
         c = self.config
         con = self._constrain if constrain else (lambda a, *axes: a)
         h = rms_norm(x, lp['mlp_norm'], c.norm_eps)
         gate = jnp.einsum('bse,em->bsm', h, lp['w_gate'])
         up = jnp.einsum('bse,em->bsm', h, lp['w_up'])
         gated = con(jax.nn.silu(gate) * up, 'batch', 'seq', 'act_mlp')
+        gated = checkpoint_name(gated, 'mlp_gated')
         return (jnp.einsum('bsm,me->bse', gated, lp['w_down']),
                 jnp.zeros((), jnp.float32))
 
@@ -271,15 +280,16 @@ class LlamaModel:
             def layer(x, lp):
                 return self._layer_step(lp, x, cos, sin, positions)
 
-            if c.remat:
-                layer = jax.checkpoint(layer)
+            layer = _maybe_remat(layer, c)
             x, auxs = lax.scan(layer, x, params['layers'])
             aux = jnp.mean(auxs)
 
         x = rms_norm(x, params['final_norm'], c.norm_eps)
         head = (params['embed'].T if c.tie_embeddings else params['lm_head'])
-        logits = jnp.einsum('bse,ev->bsv', x.astype(jnp.float32),
-                            head.astype(jnp.float32))
+        # bf16 operands + f32 accumulation: runs at bf16 MXU rate (an f32
+        # matmul on TPU is ~4x slower) with f32-accurate logits.
+        logits = jnp.einsum('bse,ev->bsv', x, head,
+                            preferred_element_type=jnp.float32)
         return self._constrain(logits, 'batch', 'seq', 'act_vocab'), aux
 
     def _apply_pipelined(self, layers: Params, x: jax.Array, cos, sin,
@@ -304,8 +314,7 @@ class LlamaModel:
                 return self._layer_step(lp, h, cos, sin, positions,
                                         constrain=False)
 
-            if c.remat:
-                one = jax.checkpoint(one)
+            one = _maybe_remat(one, c)
             h, auxs = lax.scan(one, h, local_layers)
             return h, jnp.mean(auxs)
 
@@ -369,6 +378,31 @@ class LlamaModel:
             'length': start + tokens.shape[1],
         }
         return logits, new_cache
+
+
+def _maybe_remat(layer_fn, config: LlamaConfig):
+    """Apply the configured rematerialization policy to a scan body."""
+    if not config.remat:
+        return layer_fn
+    cp = jax.checkpoint_policies
+    if config.remat_policy == 'dots':
+        # Save matmul outputs AND the flash-attention residuals (softmax
+        # stats + context): without the latter, backward re-runs the whole
+        # flash forward per layer.
+        return jax.checkpoint(
+            layer_fn,
+            policy=cp.save_from_both_policies(
+                cp.dots_with_no_batch_dims_saveable,
+                cp.save_only_these_names('flash_out', 'flash_lse')))
+    if config.remat_policy == 'names':
+        # Selective: keep only the fattest per-layer activations
+        # (attention context + stats, SwiGLU product); backward recomputes
+        # norms/projections/rotary from the saved layer input.
+        return jax.checkpoint(
+            layer_fn,
+            policy=cp.save_only_these_names(
+                'attn_out', 'mlp_gated', 'flash_out', 'flash_lse'))
+    return jax.checkpoint(layer_fn)
 
 
 def _cached_attention(q, k, v, valid):

@@ -115,23 +115,29 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 # Pallas TPU flash-attention forward kernel.
 # ---------------------------------------------------------------------------
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, scale,
+_INTERPRET = False  # set True in tests to run Pallas kernels on CPU
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale,
                       block_q, block_k, seq_len, q_start):
     """Grid: (batch*heads, n_q_blocks). Whole K/V rows are resident in VMEM;
     the kernel scans K blocks with the online-softmax accumulators in
-    registers/VMEM scratch-free form (f32)."""
+    registers/VMEM scratch-free form (f32). Also emits the per-row
+    logsumexp so the backward kernels can reconstruct P exactly."""
     from jax.experimental import pallas as pl  # local: TPU-only path
 
     q_idx = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale  # [block_q, d]
+    # Matmul operands stay in the input dtype (bf16 on TPU) with f32 MXU
+    # accumulation — an f32xf32 dot runs at ~1/4 the bf16 MXU rate.
+    q = q_ref[0]  # [block_q, d]
     n_k_blocks = seq_len // block_k
 
     def body(i, carry):
         m, l, o = carry
-        k_blk = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(i * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(i * block_k, block_k), :]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             # q_start = sk - sq: queries sit at the END of the kv sequence
             # (matches mha_reference/blockwise semantics for a KV prefix).
@@ -143,7 +149,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, scale,
         corr = jnp.exp(m - m_new)
         l_new = l * corr + p.sum(axis=-1, keepdims=True)
         o_new = o * corr + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(q.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l_new, o_new
 
@@ -157,11 +163,79 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, scale,
     else:
         upper = n_k_blocks
     m, l, o = lax.fori_loop(0, upper, body, (m0, l0, o0))
-    o_ref[0] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (o / l).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l)  # [block_q, 1]
 
 
-def _flash_attention_fwd_tpu(q, k, v, causal, scale, block_q=256,
-                             block_k=512):
+def _flash_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dk_ref, dv_ref, dqp_ref, *, causal, scale, block_q,
+                      block_k, q_start):
+    """Fused backward: grid (batch*heads, n_k_blocks, n_q_blocks).
+
+    One pass computes S and P per tile (the 2-pass form recomputes them,
+    7 matmuls vs 5): dk/dv accumulate in revisited VMEM output blocks over
+    the sequential inner q dim; dq is emitted as one PARTIAL tile per
+    (k-block, q-block) — each written exactly once — and summed over the
+    k dim by XLA afterwards.
+
+    dV = P^T dO;  ds = P * (dO V^T - delta);  dK = ds^T Q * scale;
+    dQ_partial = ds K * scale  (flash-attention-2 backward using the saved
+    logsumexp, no m/l recomputation)."""
+    from jax.experimental import pallas as pl
+
+    k_idx = pl.program_id(1)
+    q_idx = pl.program_id(2)
+
+    @pl.when(q_idx == 0)
+    def _init():
+        dk_ref[0] = jnp.zeros_like(dk_ref[0])
+        dv_ref[0] = jnp.zeros_like(dv_ref[0])
+
+    q_lo = q_start + q_idx * block_q
+    live = True
+    if causal:
+        # This (q, k) tile contributes iff the q block's last row can see
+        # the k block's first column.
+        live = q_lo + block_q - 1 >= k_idx * block_k
+
+    @pl.when(live)
+    def _compute():
+        k_blk = k_ref[0]  # [block_k, d]
+        v_blk = v_ref[0]
+        q_blk = q_ref[0]  # [block_q, d]
+        do_blk = do_ref[0]
+        lse_blk = lse_ref[0]      # [block_q, 1]
+        delta_blk = delta_ref[0]  # [block_q, 1]
+        s = jax.lax.dot_general(q_blk, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = q_lo + lax.iota(jnp.int32, block_q)
+            k_pos = k_idx * block_k + lax.iota(jnp.int32, block_k)
+            s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, _NEG_INF)
+        p = jnp.exp(s - lse_blk).astype(q_blk.dtype)  # [block_q, block_k]
+        dv_ref[0] += jax.lax.dot_general(
+            p, do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+        dp = jax.lax.dot_general(do_blk, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p.astype(jnp.float32) * (dp - delta_blk)).astype(q_blk.dtype)
+        dk_ref[0] += (jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale).astype(dk_ref.dtype)
+        dqp_ref[0, 0] = (jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale).astype(dqp_ref.dtype)
+
+    @pl.when(jnp.logical_not(live))
+    def _dead():
+        # Dead causal tiles still own their dq-partial block: zero it so
+        # the XLA sum over the k dim is correct.
+        dqp_ref[0, 0] = jnp.zeros_like(dqp_ref[0, 0])
+
+
+def _flash_attention_fwd_tpu(q, k, v, causal, scale, block_q=512,
+                             block_k=2048):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -177,7 +251,7 @@ def _flash_attention_fwd_tpu(q, k, v, causal, scale, block_q=256,
     kernel = functools.partial(_flash_fwd_kernel, causal=causal, scale=scale,
                                block_q=block_q, block_k=block_k, seq_len=sk,
                                q_start=sk - sq)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, sq // block_q),
         in_specs=[
@@ -185,32 +259,105 @@ def _flash_attention_fwd_tpu(q, k, v, causal, scale, block_q=256,
             pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
             pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+            # TPU tiling needs >=2 trailing dims aligned; keep lse 3-D with
+            # a unit lane dim.
+            pl.BlockSpec((1, block_q, 1), lambda bh, i: (bh, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
+        ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=('parallel', 'arbitrary')),
+        interpret=_INTERPRET,
     )(qt, kt, vt)
-    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3), (qt, kt, vt, out,
+                                                            lse)
+
+
+def _flash_attention_bwd_tpu(res, g, causal, scale, block_q=512,
+                             block_k=2048):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    qt, kt, vt, ot, lse = res
+    bh, sq, d = qt.shape
+    sk = kt.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    dot = g.transpose(0, 2, 1, 3).reshape(bh, sq, d)
+
+    # delta = rowsum(dO * O): tiny elementwise reduce, XLA fuses it.
+    delta = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [bh, sq, 1]
+
+    n_k = sk // block_k
+    kernel = functools.partial(_flash_bwd_kernel, causal=causal, scale=scale,
+                               block_q=block_q, block_k=block_k,
+                               q_start=sk - sq)
+    # dk/dv accumulate in f32 output blocks; dq arrives as n_k partials
+    # summed below (cast to the primal dtype by the vjp wrapper).
+    dk, dv, dqp = pl.pallas_call(
+        kernel,
+        grid=(bh, n_k, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b_, j, i: (b_, i, 0)),  # q
+            pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0)),  # k
+            pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0)),  # v
+            pl.BlockSpec((1, block_q, d), lambda b_, j, i: (b_, i, 0)),  # do
+            pl.BlockSpec((1, block_q, 1), lambda b_, j, i: (b_, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b_, j, i: (b_, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, j, i: (b_, j, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sk, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, n_k, sq, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=('parallel', 'parallel', 'arbitrary')),
+        interpret=_INTERPRET,
+    )(qt, kt, vt, dot, lse, delta)
+    dq = dqp.sum(axis=1)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash_attention(q, k, v, causal, scale):
-    return _flash_attention_fwd_tpu(q, k, v, causal, scale)
+    out, _ = _flash_attention_fwd_tpu(q, k, v, causal, scale)
+    return out
 
 
 def _flash_vjp_fwd(q, k, v, causal, scale):
-    return _flash_attention_fwd_tpu(q, k, v, causal, scale), (q, k, v)
+    from jax.ad_checkpoint import checkpoint_name
+    out, (qt, kt, vt, ot, lse) = _flash_attention_fwd_tpu(q, k, v, causal,
+                                                          scale)
+    # Name the pallas outputs so remat policies can *save* them: they are
+    # not dots, so without names every policy rematerializes the whole
+    # flash forward inside the backward pass.
+    ot = checkpoint_name(ot, 'flash_out')
+    lse = checkpoint_name(lse, 'flash_lse')
+    return out, ((qt, kt, vt, ot, lse), q.shape)
 
 
-def _flash_vjp_bwd(causal, scale, res, g):
-    # Backward rematerializes through the blockwise implementation (exact
-    # same math, O(S) memory); a dedicated Pallas backward kernel can slot in
-    # here later without touching callers.
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: blockwise_attention(q_, k_, v_, causal=causal,
-                                               scale=scale), q, k, v)
-    return vjp(g)
+def _flash_vjp_bwd(causal, scale, packed, g):
+    (qt, kt, vt, ot, lse), q_shape = packed
+    b, sq, h, d = q_shape
+    dq, dk, dv = _flash_attention_bwd_tpu((qt, kt, vt, ot, lse), g,
+                                          causal, scale)
+    sk = kt.shape[1]
+
+    def unflat(x, s, dtype):
+        return x.reshape(b, h, s, -1).transpose(0, 2, 1, 3).astype(dtype)
+
+    return (unflat(dq, sq, qt.dtype), unflat(dk, sk, kt.dtype),
+            unflat(dv, sk, vt.dtype))
 
 
 _flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)

@@ -238,3 +238,27 @@ def is_tpu(accelerator_name: Optional[str]) -> bool:
     if accelerator_name is None:
         return False
     return TpuSlice.maybe_from_name(accelerator_name) is not None
+
+
+# jax `device.device_kind` strings → generation (for MFU / perf accounting
+# on a live backend; the dev-tunnel backend reports the v5e string).
+_DEVICE_KIND_TO_GEN = {
+    'TPU v2': 'v2', 'TPU v3': 'v3', 'TPU v4': 'v4',
+    'TPU v5 lite': 'v5e', 'TPU v5e': 'v5e',
+    'TPU v5p': 'v5p', 'TPU v5': 'v5p',
+    'TPU v6 lite': 'v6e', 'TPU v6e': 'v6e',
+}
+
+
+def generation_for_device_kind(kind: Optional[str]
+                               ) -> Optional[TpuGeneration]:
+    """Map a jax ``device.device_kind`` to its TpuGeneration, else None."""
+    if not kind:
+        return None
+    # Longest-prefix match ('TPU v5 lite' must not hit 'TPU v5').
+    best = None
+    for prefix, gen in _DEVICE_KIND_TO_GEN.items():
+        if kind.startswith(prefix) and (best is None
+                                        or len(prefix) > len(best[0])):
+            best = (prefix, gen)
+    return GENERATIONS[best[1]] if best else None

@@ -155,16 +155,25 @@ class SliceBackend(backend_lib.Backend):
             return sys.executable, f'PYTHONPATH={shlex.quote(pkg_parent)}'
         return 'python3', 'PYTHONPATH=$HOME/.skytpu/code'
 
-    def _jobcli(self, handle: backend_lib.ResourceHandle, args_str: str,
-                stream_to: Optional[str] = None, timeout: float = 120
-                ) -> 'Any':
+    def run_module(self, handle: backend_lib.ResourceHandle, module: str,
+                   args_str: str, stream_to: Optional[str] = None,
+                   timeout: Optional[float] = 120) -> 'Any':
+        """Run a skypilot_tpu control-plane module on the head host."""
         python, env_prefix = self._python(handle)
         head = self._runners(handle)[0]
-        cmd = (f'{env_prefix} {python} -m skypilot_tpu.runtime.jobcli '
-               f'{args_str} --runtime-dir {rt_constants.RUNTIME_DIR}')
+        cmd = (f'{rt_constants.control_plane_prefix()}{env_prefix} '
+               f'{python} -m {module} {args_str}')
         res = head.run(cmd, timeout=None if stream_to else timeout,
                        stream_to=stream_to)
         return res
+
+    def _jobcli(self, handle: backend_lib.ResourceHandle, args_str: str,
+                stream_to: Optional[str] = None, timeout: float = 120
+                ) -> 'Any':
+        return self.run_module(
+            handle, 'skypilot_tpu.runtime.jobcli',
+            f'{args_str} --runtime-dir {rt_constants.RUNTIME_DIR}',
+            stream_to=stream_to, timeout=timeout)
 
     # ---- provision ---------------------------------------------------------
     def provision(self, task: task_lib.Task, cluster_name: str,
@@ -242,7 +251,8 @@ class SliceBackend(backend_lib.Backend):
                     f'test -f {rtdir}/{rt_constants.AGENT_PID_FILE} && '
                     f'kill -0 $(cat {rtdir}/{rt_constants.AGENT_PID_FILE}) '
                     f'2>/dev/null || '
-                    f'(nohup env {env_prefix} {python} -m '
+                    f'(nohup env {rt_constants.control_plane_prefix()}'
+                    f'{env_prefix} {python} -m '
                     f'skypilot_tpu.runtime.agent --runtime-dir {rtdir} '
                     f'--tick {tick} >> {rtdir}/{rt_constants.AGENT_LOG_FILE} '
                     f'2>&1 < /dev/null &) ')
@@ -419,7 +429,8 @@ class SliceBackend(backend_lib.Backend):
     def set_autostop(self, handle: backend_lib.ResourceHandle,
                      idle_minutes: int, down: bool = False) -> None:
         python, env_prefix = self._python(handle)
-        hook = (f'{env_prefix} {python} -m skypilot_tpu.runtime.self_stop '
+        hook = (f'{rt_constants.control_plane_prefix()}{env_prefix} '
+                f'{python} -m skypilot_tpu.runtime.self_stop '
                 f'--cloud {handle.cloud} --cluster {handle.cluster_name} '
                 f'--region {handle.region}' + (' --down' if down else ''))
         cfg = json.dumps({'idle_minutes': idle_minutes, 'down': down,

@@ -367,6 +367,8 @@ def jobs_queue():
 @click.option('--all', 'all_jobs', is_flag=True)
 def jobs_cancel(job_ids, all_jobs):
     """Cancel managed job(s)."""
+    if not job_ids and not all_jobs:
+        raise click.UsageError('Specify job ids or --all.')
     from skypilot_tpu import jobs as jobs_lib
     cancelled = jobs_lib.cancel(list(job_ids) or None, all_jobs=all_jobs)
     click.echo(f'Cancelling managed jobs: {cancelled}')

@@ -207,11 +207,13 @@ def api_start(port: Optional[int] = None, wait: float = 10.0) -> None:
         port = urlparse(server_url()).port or 46580
     log_dir = os.path.join(global_user_state.get_state_dir(), 'server')
     os.makedirs(log_dir, exist_ok=True)
+    from skypilot_tpu.runtime import constants as rt_constants
     with open(os.path.join(log_dir, 'server.log'), 'ab') as log:
         proc = subprocess.Popen(
             [sys.executable, '-m', 'skypilot_tpu.server.server',
              '--port', str(port)],
-            stdout=log, stderr=log, start_new_session=True)
+            stdout=log, stderr=log, start_new_session=True,
+            env={**os.environ, **rt_constants.control_plane_env()})
     os.makedirs(os.path.dirname(_server_pid_file()), exist_ok=True)
     with open(_server_pid_file(), 'w') as f:
         f.write(str(proc.pid))

@@ -1,16 +1,14 @@
 """Managed jobs: preemption-recovering job execution.
 
 Counterpart of reference ``sky/jobs/`` (JobsController controller.py:119-508,
-recovery strategies recovery_strategy.py:382-466, scheduler, sqlite state).
-Differences:
-
-- The controller is a plain detached process (one per managed job) started
-  by ``jobs.launch`` — on this machine by default; a controller cluster is
-  just a different place to spawn it (the reference always round-trips
-  through a controller VM, templates/jobs-controller.yaml.j2).
-- Preemption detection is slice-atomic: a TPU slice that lost capacity
-  shows the whole cluster gone/preempted (reference must reason about
-  partial node loss).
+recovery strategies recovery_strategy.py:382-466, scheduler.py:86,275-295,
+sqlite state). Controllers run on a dedicated controller cluster
+(templates/jobs-controller.yaml.j2 analog — ``local`` cloud by default,
+config-pointed at a GCE VM for real deployments), scheduled under
+CPU/mem-derived launch/job parallelism caps (jobs/scheduler.py).
+Preemption detection is slice-atomic: a TPU slice that lost capacity shows
+the whole cluster gone/preempted (reference must reason about partial node
+loss).
 """
 from skypilot_tpu.jobs.core import (cancel, launch, queue, tail_logs)
 from skypilot_tpu.jobs.state import ManagedJobStatus

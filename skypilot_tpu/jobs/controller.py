@@ -25,6 +25,7 @@ from skypilot_tpu import core
 from skypilot_tpu import exceptions
 from skypilot_tpu import task as task_lib
 from skypilot_tpu.jobs import recovery_strategy
+from skypilot_tpu.jobs import scheduler
 from skypilot_tpu.jobs import state
 from skypilot_tpu.runtime import job_lib as cluster_job_lib
 
@@ -70,6 +71,16 @@ class JobsController:
         except exceptions.SkyTpuError:
             pass
 
+    def _fail_no_resource(self, reason: str) -> None:
+        """Terminalize a failed provision — as CANCELLED if a cancel
+        arrived while the provision was in flight (user intent wins)."""
+        if state.cancel_requested(self.job_id):
+            self._down_cluster()
+            state.set_status(self.job_id, ManagedJobStatus.CANCELLED)
+            return
+        state.set_status(self.job_id, ManagedJobStatus.FAILED_NO_RESOURCE,
+                         failure_reason=reason)
+
     def _handle_cancel(self, cluster_job_id: Optional[int]) -> None:
         if cluster_job_id is not None:
             try:
@@ -82,15 +93,17 @@ class JobsController:
     # -- main ----------------------------------------------------------------
     def run(self) -> None:
         job_id = self.job_id
-        state.set_status(job_id, ManagedJobStatus.STARTING)
+        state.set_status(job_id, ManagedJobStatus.STARTING,
+                         respect_cancelling=True)
         try:
-            cluster_job_id = self.strategy.launch(retry_until_up=False)
+            with scheduler.launch_slot(job_id):
+                cluster_job_id = self.strategy.launch(retry_until_up=False)
         except exceptions.ResourcesUnavailableError as e:
-            state.set_status(job_id, ManagedJobStatus.FAILED_NO_RESOURCE,
-                             failure_reason=str(e))
+            self._fail_no_resource(str(e))
             return
         state.update(job_id, cluster_job_id=cluster_job_id)
-        state.set_status(job_id, ManagedJobStatus.RUNNING)
+        state.set_status(job_id, ManagedJobStatus.RUNNING,
+                         respect_cancelling=True)
 
         while True:
             if state.cancel_requested(job_id):
@@ -99,18 +112,19 @@ class JobsController:
             status = self._cluster_job_status(cluster_job_id)
             if status is None:
                 # Preemption (slice terminated / cluster unreachable).
-                state.set_status(job_id, ManagedJobStatus.RECOVERING)
+                state.set_status(job_id, ManagedJobStatus.RECOVERING,
+                                 respect_cancelling=True)
                 state.bump_recovery(job_id)
                 self._down_cluster()
                 try:
-                    cluster_job_id = self.strategy.recover()
+                    with scheduler.launch_slot(self.job_id):
+                        cluster_job_id = self.strategy.recover()
                 except exceptions.ResourcesUnavailableError as e:
-                    state.set_status(job_id,
-                                     ManagedJobStatus.FAILED_NO_RESOURCE,
-                                     failure_reason=str(e))
+                    self._fail_no_resource(str(e))
                     return
                 state.update(job_id, cluster_job_id=cluster_job_id)
-                state.set_status(job_id, ManagedJobStatus.RUNNING)
+                state.set_status(job_id, ManagedJobStatus.RUNNING,
+                                 respect_cancelling=True)
             elif status == cluster_job_lib.JobStatus.SUCCEEDED:
                 state.set_status(job_id, ManagedJobStatus.SUCCEEDED)
                 self._down_cluster()
@@ -123,12 +137,15 @@ class JobsController:
             elif status == cluster_job_lib.JobStatus.FAILED:
                 # User-code failure on a healthy cluster.
                 if self.strategy.should_restart_on_failure():
-                    state.set_status(job_id, ManagedJobStatus.RECOVERING)
+                    state.set_status(job_id, ManagedJobStatus.RECOVERING,
+                                     respect_cancelling=True)
                     state.bump_recovery(job_id)
-                    cluster_job_id = self.strategy.launch(
-                        retry_until_up=False)
+                    with scheduler.launch_slot(self.job_id):
+                        cluster_job_id = self.strategy.launch(
+                            retry_until_up=False)
                     state.update(job_id, cluster_job_id=cluster_job_id)
-                    state.set_status(job_id, ManagedJobStatus.RUNNING)
+                    state.set_status(job_id, ManagedJobStatus.RUNNING,
+                                     respect_cancelling=True)
                 else:
                     state.set_status(
                         job_id, ManagedJobStatus.FAILED,
@@ -152,6 +169,8 @@ def main() -> None:
         traceback.print_exc()
         state.set_status(args.job_id, ManagedJobStatus.FAILED_CONTROLLER,
                          failure_reason=f'{type(e).__name__}: {e}')
+    finally:
+        scheduler.job_done(args.job_id)
 
 
 if __name__ == '__main__':

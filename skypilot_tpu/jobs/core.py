@@ -1,12 +1,18 @@
-"""Managed-jobs client ops: launch/queue/cancel/tail_logs.
+"""Managed-jobs ops: client routing + on-controller implementations.
 
 Counterpart of reference ``sky/jobs/server/core.py`` + ``client/sdk.py``.
-``launch`` records the job and spawns a detached controller process.
+Jobs controllers run on a dedicated *controller cluster* (reference
+controller-on-cluster design, sky/utils/controller_utils.py:89;
+jobs-controller.yaml.j2): ``launch`` ensures the cluster is UP, then submits
+through ``jobs.jobcli`` on its head host. The ``*_on_controller`` functions
+are the implementations jobcli runs there (on the local cloud they share
+the client's state dir, which keeps tests hermetic).
 """
 from __future__ import annotations
 
+import json
 import os
-import subprocess
+import shlex
 import sys
 import time
 from typing import Any, Dict, List, Optional
@@ -19,26 +25,96 @@ from skypilot_tpu.jobs import state
 ManagedJobStatus = state.ManagedJobStatus
 
 
-def _controller_log(job_id: int) -> str:
-    d = os.path.join(global_user_state.get_state_dir(), 'jobs_controller')
-    os.makedirs(d, exist_ok=True)
-    return os.path.join(d, f'{job_id}.log')
+# ---- client side -----------------------------------------------------------
+def _controller_backend_and_handle(launch_if_missing: bool = True):
+    from skypilot_tpu import backends
+    from skypilot_tpu.utils import controller_utils
+    spec = controller_utils.JOBS_CONTROLLER
+    handle = controller_utils.get_controller_handle(spec)
+    if handle is None:
+        if not launch_if_missing:
+            return None, None
+        handle = controller_utils.ensure_controller_cluster(spec)
+    return backends.SliceBackend(), handle
+
+
+def _run_jobcli(args_str: str, stream_to=None,
+                timeout: Optional[float] = 120,
+                launch_if_missing: bool = True) -> Optional[Any]:
+    backend, handle = _controller_backend_and_handle(launch_if_missing)
+    if handle is None:
+        return None
+    return backend.run_module(handle, 'skypilot_tpu.jobs.jobcli', args_str,
+                              stream_to=stream_to, timeout=timeout)
+
+
+def _parse_json_line(res, op: str) -> Dict[str, Any]:
+    if res.returncode != 0:
+        raise exceptions.CommandError(res.returncode, f'jobs jobcli {op}',
+                                      res.stderr or res.stdout)
+    return json.loads(res.stdout.strip().splitlines()[-1])
 
 
 def launch(task: task_lib.Task, name: Optional[str] = None) -> int:
-    """Submit a managed job; returns the managed job id immediately."""
+    """Submit a managed job to the controller cluster; returns job id."""
     job_name = name or task.name or 'managed-job'
-    job_id = state.create(job_name, task.to_yaml_config())
-    with open(_controller_log(job_id), 'ab') as log:
-        proc = subprocess.Popen(
-            [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
-             '--job-id', str(job_id)],
-            stdout=log, stderr=log, start_new_session=True)
-    state.update(job_id, controller_pid=proc.pid)
-    state.set_status(job_id, ManagedJobStatus.SUBMITTED)
-    return job_id
+    task_json = json.dumps(task.to_yaml_config())
+    res = _run_jobcli(f'submit --name {shlex.quote(job_name)} '
+                      f'--task-json {shlex.quote(task_json)}')
+    return int(_parse_json_line(res, 'submit')['job_id'])
 
 
+def queue(refresh_controller: bool = True) -> List[Dict[str, Any]]:
+    """All managed jobs, as reported by the controller cluster."""
+    res = _run_jobcli('queue', launch_if_missing=False)
+    if res is None:
+        return []
+    rows = _parse_json_line(res, 'queue')['jobs']
+    for row in rows:
+        row['status'] = ManagedJobStatus(row['status'])
+        row['schedule_state'] = state.ScheduleState(row['schedule_state'])
+    return rows
+
+
+def cancel(job_ids: Optional[List[int]] = None,
+           all_jobs: bool = False) -> List[int]:
+    if not job_ids and not all_jobs:
+        raise ValueError('cancel() needs job_ids or all_jobs=True')
+    args = 'cancel' + (' --all' if all_jobs else '')
+    if job_ids:
+        args += ' --job-ids ' + ' '.join(str(j) for j in job_ids)
+    res = _run_jobcli(args, launch_if_missing=False)
+    if res is None:
+        return []
+    return _parse_json_line(res, 'cancel')['cancelled']
+
+
+def tail_logs(job_id: int, follow: bool = True, out=None) -> int:
+    out = out or sys.stdout
+    args = f'tail --job-id {job_id}' + (' --follow' if follow else '')
+    res = _run_jobcli(args, stream_to=out, launch_if_missing=False)
+    if res is None:
+        raise exceptions.JobNotFoundError(
+            f'No managed job {job_id} (no jobs controller cluster)')
+    return res.returncode
+
+
+def controller_logs(job_id: int) -> str:
+    """The controller process log for a job (debugging aid)."""
+    from skypilot_tpu.jobs import scheduler
+    try:  # local-cloud controller shares the filesystem: read directly
+        with open(scheduler.controller_log_path(job_id)) as f:
+            return f.read()
+    except FileNotFoundError:
+        pass
+    res = _run_jobcli(f'controller-log --job-id {job_id}',
+                      launch_if_missing=False)
+    if res is None or res.returncode != 0:
+        return ''
+    return res.stdout
+
+
+# ---- controller side -------------------------------------------------------
 def _controller_alive(pid: Optional[int]) -> bool:
     if not pid:
         return False
@@ -55,22 +131,43 @@ def _controller_alive(pid: Optional[int]) -> bool:
         return False
 
 
-def queue(refresh_controller: bool = True) -> List[Dict[str, Any]]:
-    """All managed jobs; reconciles rows whose controller died."""
-    rows = state.list_jobs()
-    for row in rows:
-        if (refresh_controller and not row['status'].is_terminal()
-                and row['status'] != ManagedJobStatus.PENDING
-                and not _controller_alive(row['controller_pid'])):
-            state.set_status(row['job_id'],
-                             ManagedJobStatus.FAILED_CONTROLLER,
-                             failure_reason='controller process died')
-            row['status'] = ManagedJobStatus.FAILED_CONTROLLER
+def queue_on_controller() -> List[Dict[str, Any]]:
+    """All managed jobs; reconciles rows whose controller died.
+
+    Reconciliation runs under the scheduler lock: controller spawning
+    (schedule_state=LAUNCHING -> Popen -> controller_pid update) is atomic
+    under the same lock, so a mid-spawn job can never be observed with a
+    NULL pid and misdiagnosed as dead.
+    """
+    from skypilot_tpu.jobs import scheduler
+    reconciled = False
+    with scheduler._scheduler_lock(blocking=True):
+        rows = state.list_jobs()
+        for row in rows:
+            if (not row['status'].is_terminal()
+                    and row['schedule_state'] in (
+                        state.ScheduleState.LAUNCHING,
+                        state.ScheduleState.ALIVE)
+                    and row['controller_pid'] is not None
+                    and not _controller_alive(row['controller_pid'])):
+                state.set_status(row['job_id'],
+                                 ManagedJobStatus.FAILED_CONTROLLER,
+                                 failure_reason='controller process died')
+                state.set_schedule_state(row['job_id'],
+                                         state.ScheduleState.DONE)
+                row['status'] = ManagedJobStatus.FAILED_CONTROLLER
+                row['schedule_state'] = state.ScheduleState.DONE
+                reconciled = True
+    if reconciled:
+        scheduler.maybe_schedule_next_jobs()  # freed slots
     return rows
 
 
-def cancel(job_ids: Optional[List[int]] = None,
-           all_jobs: bool = False) -> List[int]:
+def cancel_on_controller(job_ids: Optional[List[int]] = None,
+                         all_jobs: bool = False) -> List[int]:
+    from skypilot_tpu.jobs import scheduler
+    if not job_ids and not all_jobs:
+        raise ValueError('cancel needs explicit job ids or --all')
     targets = state.list_jobs(job_ids=None if all_jobs else job_ids)
     cancelled = []
     for row in targets:
@@ -78,10 +175,14 @@ def cancel(job_ids: Optional[List[int]] = None,
             continue
         state.set_status(row['job_id'], ManagedJobStatus.CANCELLING)
         cancelled.append(row['job_id'])
+    # WAITING jobs have no controller to act on CANCELLING; let the
+    # scheduler retire them.
+    scheduler.maybe_schedule_next_jobs()
     return cancelled
 
 
-def tail_logs(job_id: int, follow: bool = True, out=None) -> int:
+def tail_logs_on_controller(job_id: int, follow: bool = True,
+                            out=None) -> int:
     """Stream the managed job's task logs (through its current cluster)."""
     out = out or sys.stdout
     row = state.get(job_id)
@@ -109,15 +210,8 @@ def tail_logs(job_id: int, follow: bool = True, out=None) -> int:
             out.write(f'\n[managed job {job_id}] {row["status"].value}'
                       + (f': {row["failure_reason"]}'
                          if row['failure_reason'] else '') + '\n')
+            out.flush()
             return 0 if row['status'] == ManagedJobStatus.SUCCEEDED else 100
         if not follow:
             return 0
         time.sleep(1.0)  # RECOVERING: wait for the next cluster
-
-
-def controller_logs(job_id: int) -> str:
-    try:
-        with open(_controller_log(job_id)) as f:
-            return f.read()
-    except FileNotFoundError:
-        return ''

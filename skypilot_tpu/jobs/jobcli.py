@@ -1,0 +1,95 @@
+"""On-controller managed-jobs CLI: the client<->controller-cluster protocol.
+
+Analog of the reference's codegen-over-SSH for managed jobs
+(sky/jobs/utils.py ManagedJobCodeGen): instead of shipping python snippets,
+the client runs this module on the controller cluster's head host through a
+CommandRunner. Machine commands print ONE JSON line on stdout; ``tail``
+streams raw log text.
+
+Import-light on purpose: no execution/backends at module level — every
+invocation pays interpreter startup on the controller host.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _cmd_submit(args) -> int:
+    from skypilot_tpu.jobs import scheduler
+    from skypilot_tpu.jobs import state
+    task_config = json.loads(args.task_json)
+    job_id = state.create(args.name, task_config)
+    scheduler.submit(job_id)
+    print(json.dumps({'job_id': job_id}))
+    return 0
+
+
+def _cmd_queue(args) -> int:
+    from skypilot_tpu.jobs import core
+    rows = core.queue_on_controller()
+    for row in rows:
+        row['status'] = row['status'].value
+        row['schedule_state'] = row['schedule_state'].value
+    print(json.dumps({'jobs': rows}))
+    return 0
+
+
+def _cmd_cancel(args) -> int:
+    from skypilot_tpu.jobs import core
+    ids = None if args.all else [int(j) for j in args.job_ids]
+    cancelled = core.cancel_on_controller(job_ids=ids, all_jobs=args.all)
+    print(json.dumps({'cancelled': cancelled}))
+    return 0
+
+
+def _cmd_tail(args) -> int:
+    from skypilot_tpu.jobs import core
+    return core.tail_logs_on_controller(args.job_id,
+                                        follow=args.follow,
+                                        out=sys.stdout)
+
+
+def _cmd_controller_log(args) -> int:
+    from skypilot_tpu.jobs import scheduler
+    try:
+        with open(scheduler.controller_log_path(args.job_id)) as f:
+            sys.stdout.write(f.read())
+    except FileNotFoundError:
+        pass
+    return 0
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(prog='skytpu-jobs-jobcli')
+    sub = parser.add_subparsers(dest='cmd', required=True)
+
+    p = sub.add_parser('submit')
+    p.add_argument('--name', required=True)
+    p.add_argument('--task-json', required=True)
+    p.set_defaults(fn=_cmd_submit)
+
+    p = sub.add_parser('queue')
+    p.set_defaults(fn=_cmd_queue)
+
+    p = sub.add_parser('cancel')
+    p.add_argument('--job-ids', nargs='*', default=[])
+    p.add_argument('--all', action='store_true')
+    p.set_defaults(fn=_cmd_cancel)
+
+    p = sub.add_parser('tail')
+    p.add_argument('--job-id', type=int, required=True)
+    p.add_argument('--follow', action='store_true')
+    p.set_defaults(fn=_cmd_tail)
+
+    p = sub.add_parser('controller-log')
+    p.add_argument('--job-id', type=int, required=True)
+    p.set_defaults(fn=_cmd_controller_log)
+
+    args = parser.parse_args()
+    sys.exit(args.fn(args))
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,162 @@
+"""Controller-side job scheduler: parallelism limits + controller spawning.
+
+Counterpart of reference ``sky/jobs/scheduler.py`` (maybe_schedule_next_jobs
+:86, launch/job parallelism from CPU/mem :275-295). Runs on the jobs
+controller host. Two caps, both derived from the controller host's shape
+(env-overridable):
+
+- **job parallelism** (``SKYTPU_JOBS_MAX_PARALLEL_JOBS``): how many
+  controller processes may be alive at once — each holds a task graph +
+  polls a cluster; memory-bound (reference sizes by controller memory).
+- **launch parallelism** (``SKYTPU_JOBS_MAX_PARALLEL_LAUNCHES``): how many
+  cluster provisions may be in flight at once — provision fan-out is
+  CPU/network-bound (reference: LAUNCHES_PER_CPU).
+
+Schedule lane per job: WAITING -> LAUNCHING -> ALIVE -> DONE
+(state.ScheduleState). ``maybe_schedule_next_jobs`` is called at every
+transition edge (submit, launch-slot release, job done) and is safe to call
+from any process on the controller host — it takes a nonblocking file lock
+and no-ops if another scheduler pass is active (reference :86-101).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import subprocess
+import sys
+import time
+from typing import Iterator, Optional
+
+import filelock
+
+from skypilot_tpu import global_user_state
+from skypilot_tpu.jobs import state
+
+ScheduleState = state.ScheduleState
+
+_LAUNCHES_PER_CPU = 4
+_JOB_MEMORY_MB = 400  # sizing heuristic per alive controller process
+
+
+def max_parallel_launches() -> int:
+    override = os.environ.get('SKYTPU_JOBS_MAX_PARALLEL_LAUNCHES')
+    if override:
+        return max(1, int(override))
+    return max(4, (os.cpu_count() or 1) * _LAUNCHES_PER_CPU)
+
+
+def _total_memory_mb() -> int:
+    try:
+        with open('/proc/meminfo') as f:
+            for line in f:
+                if line.startswith('MemTotal:'):
+                    return int(line.split()[1]) // 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    return 8192
+
+
+def max_parallel_jobs() -> int:
+    override = os.environ.get('SKYTPU_JOBS_MAX_PARALLEL_JOBS')
+    if override:
+        return max(1, int(override))
+    return max(4, int(_total_memory_mb() * 0.6 / _JOB_MEMORY_MB))
+
+
+def _controller_log_dir() -> str:
+    d = os.path.join(global_user_state.get_state_dir(), 'jobs_controller')
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def controller_log_path(job_id: int) -> str:
+    return os.path.join(_controller_log_dir(), f'{job_id}.log')
+
+
+def _scheduler_lock(blocking: bool) -> filelock.FileLock:
+    path = os.path.join(_controller_log_dir(), 'scheduler.lock')
+    return filelock.FileLock(path, timeout=-1 if blocking else 0)
+
+
+def submit(job_id: int) -> None:
+    """Queue a created job for scheduling (status stays PENDING until its
+    controller starts)."""
+    state.set_schedule_state(job_id, ScheduleState.WAITING)
+    maybe_schedule_next_jobs()
+
+
+def _spawn_controller(job_id: int) -> None:
+    from skypilot_tpu.runtime import constants as rt_constants
+    with open(controller_log_path(job_id), 'ab') as log:
+        proc = subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
+             '--job-id', str(job_id)],
+            stdout=log, stderr=log, start_new_session=True,
+            env={**os.environ, **rt_constants.control_plane_env()})
+    state.update(job_id, controller_pid=proc.pid)
+    state.set_status(job_id, state.ManagedJobStatus.SUBMITTED,
+                     respect_cancelling=True)
+
+
+def maybe_schedule_next_jobs() -> None:
+    """Start controllers for WAITING jobs while under both caps."""
+    lock = _scheduler_lock(blocking=False)
+    try:
+        lock.acquire()
+    except filelock.Timeout:
+        return  # another pass is active; it will see our state change
+    try:
+        # Retire WAITING jobs cancelled before their controller ever
+        # started — needs no slot, so it must happen regardless of caps.
+        for row in state.list_jobs():
+            if (row['schedule_state'] == ScheduleState.WAITING
+                    and (row['status'].is_terminal() or row['status']
+                         == state.ManagedJobStatus.CANCELLING)):
+                state.set_schedule_state(row['job_id'], ScheduleState.DONE)
+                if not row['status'].is_terminal():
+                    state.set_status(row['job_id'],
+                                     state.ManagedJobStatus.CANCELLED)
+        while True:
+            alive = state.count_schedule_states(
+                {ScheduleState.LAUNCHING, ScheduleState.ALIVE})
+            launching = state.count_schedule_states(
+                {ScheduleState.LAUNCHING})
+            if (alive >= max_parallel_jobs()
+                    or launching >= max_parallel_launches()):
+                return
+            row = state.next_waiting_job()
+            if row is None:
+                return
+            state.set_schedule_state(row['job_id'], ScheduleState.LAUNCHING)
+            _spawn_controller(row['job_id'])
+    finally:
+        lock.release()
+
+
+@contextlib.contextmanager
+def launch_slot(job_id: int, poll: float = 1.0) -> Iterator[None]:
+    """Hold a launch-parallelism slot for the duration of a provision.
+
+    The initial launch already holds one (the scheduler transitioned the
+    job to LAUNCHING before spawning us); recovery launches wait for a
+    free slot (reference scheduler.wait_until_launch_okay).
+    """
+    while True:
+        with _scheduler_lock(blocking=True):
+            if state.get_schedule_state(job_id) == ScheduleState.LAUNCHING:
+                break  # initial-launch slot, already ours
+            if (state.count_schedule_states({ScheduleState.LAUNCHING})
+                    < max_parallel_launches()):
+                state.set_schedule_state(job_id, ScheduleState.LAUNCHING)
+                break
+        time.sleep(poll)
+    try:
+        yield
+    finally:
+        state.set_schedule_state(job_id, ScheduleState.ALIVE)
+        maybe_schedule_next_jobs()
+
+
+def job_done(job_id: int) -> None:
+    state.set_schedule_state(job_id, ScheduleState.DONE)
+    maybe_schedule_next_jobs()

@@ -47,6 +47,22 @@ _TERMINAL = {ManagedJobStatus.SUCCEEDED, ManagedJobStatus.FAILED,
              ManagedJobStatus.FAILED_CONTROLLER,
              ManagedJobStatus.CANCELLED}
 
+
+class ScheduleState(enum.Enum):
+    """Controller-side scheduling lane, orthogonal to ManagedJobStatus
+    (reference sky/jobs/state.py:323 ManagedJobScheduleState):
+
+    INACTIVE -> WAITING -> LAUNCHING -> ALIVE -> DONE
+
+    LAUNCHING counts against the launch-parallelism cap (a provision in
+    flight); LAUNCHING|ALIVE count against the job-parallelism cap.
+    """
+    INACTIVE = 'INACTIVE'
+    WAITING = 'WAITING'
+    LAUNCHING = 'LAUNCHING'
+    ALIVE = 'ALIVE'
+    DONE = 'DONE'
+
 _LOCAL = threading.local()
 
 
@@ -73,8 +89,14 @@ def _db() -> sqlite3.Connection:
                 controller_pid INTEGER,
                 submitted_at REAL,
                 started_at REAL,
-                ended_at REAL
+                ended_at REAL,
+                schedule_state TEXT NOT NULL DEFAULT 'INACTIVE'
             )""")
+        try:  # migrate pre-scheduler DBs
+            conn.execute('ALTER TABLE managed_jobs ADD COLUMN '
+                         "schedule_state TEXT NOT NULL DEFAULT 'INACTIVE'")
+        except sqlite3.OperationalError:
+            pass
         conn.commit()
         conns[path] = conn
     return conn
@@ -92,7 +114,15 @@ def create(name: str, task_yaml: Dict[str, Any]) -> int:
 
 
 def set_status(job_id: int, status: ManagedJobStatus,
-               failure_reason: Optional[str] = None) -> None:
+               failure_reason: Optional[str] = None,
+               respect_cancelling: bool = False) -> None:
+    """Set the job status.
+
+    ``respect_cancelling=True`` makes the write a no-op if the row is
+    already CANCELLING — controller progress transitions (STARTING/
+    RUNNING/RECOVERING) must not clobber a cancel issued while the
+    controller was inside a minutes-long provision.
+    """
     conn = _db()
     now = time.time()
     sets = ['status=?']
@@ -107,8 +137,10 @@ def set_status(job_id: int, status: ManagedJobStatus,
         sets.append('failure_reason=?')
         args.append(failure_reason)
     args.append(job_id)
-    conn.execute(f'UPDATE managed_jobs SET {", ".join(sets)} '
-                 'WHERE job_id=?', args)
+    where = 'WHERE job_id=?'
+    if respect_cancelling:
+        where += f" AND status != '{ManagedJobStatus.CANCELLING.value}'"
+    conn.execute(f'UPDATE managed_jobs SET {", ".join(sets)} {where}', args)
     conn.commit()
 
 
@@ -127,6 +159,34 @@ def bump_recovery(job_id: int) -> None:
     conn.commit()
 
 
+def set_schedule_state(job_id: int, schedule_state: ScheduleState) -> None:
+    conn = _db()
+    conn.execute('UPDATE managed_jobs SET schedule_state=? WHERE job_id=?',
+                 (schedule_state.value, job_id))
+    conn.commit()
+
+
+def get_schedule_state(job_id: int) -> ScheduleState:
+    row = _db().execute('SELECT schedule_state FROM managed_jobs '
+                        'WHERE job_id=?', (job_id,)).fetchone()
+    return ScheduleState(row[0]) if row else ScheduleState.INACTIVE
+
+
+def count_schedule_states(states: set) -> int:
+    vals = [s.value for s in states]
+    q = ('SELECT COUNT(*) FROM managed_jobs WHERE schedule_state IN '
+         f'({",".join("?" * len(vals))})')
+    return int(_db().execute(q, vals).fetchone()[0])
+
+
+def next_waiting_job() -> Optional[Dict[str, Any]]:
+    row = _db().execute(
+        'SELECT job_id FROM managed_jobs WHERE schedule_state=? '
+        'ORDER BY job_id ASC LIMIT 1',
+        (ScheduleState.WAITING.value,)).fetchone()
+    return get(int(row[0])) if row else None
+
+
 def get(job_id: int) -> Optional[Dict[str, Any]]:
     rows = list_jobs(job_ids=[job_id])
     return rows[0] if rows else None
@@ -136,7 +196,8 @@ def list_jobs(job_ids: Optional[List[int]] = None
               ) -> List[Dict[str, Any]]:
     q = ('SELECT job_id, name, task_yaml, status, cluster_name, '
          'cluster_job_id, recovery_count, failure_reason, controller_pid, '
-         'submitted_at, started_at, ended_at FROM managed_jobs')
+         'submitted_at, started_at, ended_at, schedule_state '
+         'FROM managed_jobs')
     args: List[Any] = []
     if job_ids:
         q += f' WHERE job_id IN ({",".join("?" * len(job_ids))})'
@@ -152,6 +213,7 @@ def list_jobs(job_ids: Optional[List[int]] = None
             'recovery_count': row[6], 'failure_reason': row[7],
             'controller_pid': row[8], 'submitted_at': row[9],
             'started_at': row[10], 'ended_at': row[11],
+            'schedule_state': ScheduleState(row[12]),
         })
     return out
 

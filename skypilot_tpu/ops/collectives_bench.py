@@ -1,0 +1,119 @@
+"""ICI/DCN collectives micro-benchmark: psum all-reduce bus bandwidth.
+
+TPU-native analog of the reference's NCCL all-reduce benchmark
+(examples/nccl_test.yaml:12-14 — torch c10d all_reduce_bench over 16 GPU
+ranks reporting ~3.85 GBps busbw). Here the collective is an XLA
+``jax.lax.psum`` over a named mesh axis, riding ICI within a slice (and DCN
+across slices when the mesh spans them).
+
+Bus bandwidth follows the standard ring-all-reduce accounting: each element
+crosses the wire 2*(n-1)/n times, so
+
+    busbw = bytes * 2 * (n - 1) / n / time
+
+Also validates the optimizer's ICI model: ``TpuSlice.ici_bisection_gbps``
+(accelerators.py) predicts the aggregate bandwidth the measurement should
+approach for large payloads.
+
+Run: ``python -m skypilot_tpu.ops.collectives_bench [--sizes-mb 1 16 128]``
+(multi-host: launch as a task; ``runtime.distributed.init()`` is called).
+"""
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import statistics
+import time
+from typing import List, Optional
+
+
+def run_bench(sizes_mb: Optional[List[float]] = None, axis_size: int = 0,
+              iters: int = 10, warmup: int = 3,
+              verbose: bool = True) -> List[dict]:
+    """Returns one record per payload size (bandwidths in GB/s)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    sizes_mb = sizes_mb or [1.0, 16.0, 128.0]
+    devices = jax.devices()
+    n = axis_size or len(devices)
+    mesh = Mesh(devices[:n], ('x',))
+
+    @functools.partial(jax.jit,
+                       in_shardings=NamedSharding(mesh, P('x')),
+                       out_shardings=NamedSharding(mesh, P('x')))
+    def allreduce(x):
+        return jax.shard_map(lambda s: jax.lax.psum(s, 'x'), mesh=mesh,
+                             in_specs=P('x'), out_specs=P('x'))(x)
+
+    records = []
+    for mb in sizes_mb:
+        # Payload is the PER-DEVICE shard (matches NCCL convention where
+        # every rank contributes the full buffer).
+        elems = int(mb * 1e6 / 4) * n
+        x = jnp.ones((elems,), jnp.float32)
+        sharded = jax.device_put(x, NamedSharding(mesh, P('x')))
+        out = allreduce(sharded)
+        jax.block_until_ready(out)  # compile + warm
+        times = []
+        for _ in range(warmup):
+            jax.block_until_ready(allreduce(sharded))
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            jax.block_until_ready(allreduce(sharded))
+            times.append(time.perf_counter() - t0)
+        t = statistics.median(times)
+        # NCCL-convention accounting: the benchmarked buffer B is the
+        # PER-RANK contribution (what each rank feeds the all-reduce);
+        # algbw = B/t, busbw = algbw * 2(n-1)/n.
+        nbytes = elems * 4 // n
+        algbw = nbytes / t / 1e9
+        busbw = algbw * 2 * (n - 1) / n
+        rec = {
+            'payload_mb': round(nbytes / 1e6, 2),
+            'ranks': n,
+            'time_ms': round(t * 1e3, 3),
+            'algbw_gbps': round(algbw, 3),
+            'busbw_gbps': round(busbw, 3),
+        }
+        records.append(rec)
+        if verbose:
+            print(f'allreduce {rec["payload_mb"]:>10.2f} MB x {n} ranks: '
+                  f'{rec["time_ms"]:>8.3f} ms  busbw {busbw:.2f} GB/s',
+                  flush=True)
+    return records
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--sizes-mb', type=float, nargs='*', default=None)
+    parser.add_argument('--iters', type=int, default=10)
+    args = parser.parse_args(argv)
+
+    from skypilot_tpu.runtime import distributed
+    distributed.init()
+
+    import jax
+    records = run_bench(args.sizes_mb, iters=args.iters)
+
+    # Compare against the catalog's ICI model when on real TPU hardware.
+    predicted = None
+    from skypilot_tpu import accelerators
+    gen = accelerators.generation_for_device_kind(
+        jax.devices()[0].device_kind)
+    if gen is not None:
+        n = records[0]['ranks']
+        slice_name = f'tpu-{gen.name}-{n * gen.cores_per_chip}'
+        s = accelerators.TpuSlice.maybe_from_name(slice_name)
+        if s is not None:
+            predicted = s.ici_bisection_gbps
+            print(f'ICI model ({s.name}): bisection '
+                  f'{predicted:.1f} GB/s predicted', flush=True)
+    print(json.dumps({'allreduce': records,
+                      'predicted_bisection_gbps': predicted}))
+
+
+if __name__ == '__main__':
+    main()

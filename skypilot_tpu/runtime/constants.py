@@ -44,6 +44,35 @@ LOG_DIR = 'logs'  # logs/<job_id>/rank<N>.log
 AGENT_TICK_LOCAL = 0.2
 AGENT_TICK_CLOUD = 5.0
 
+# -- control-plane interpreter startup ----------------------------------------
+# In dev-tunnel environments, sitecustomize eagerly initializes jax/PJRT when
+# PALLAS_AXON_POOL_IPS is set — >10s of startup that control-plane processes
+# (agent, jobcli, jobs/serve controllers) never need. Control-plane spawns
+# clear the variable and stash the original; ``rank_env`` restores it so user
+# job processes (which may need the TPU) see the real value.
+AXON_ENV = 'PALLAS_AXON_POOL_IPS'
+AXON_STASH_ENV = 'SKYTPU_AXON_STASH'
+
+
+def control_plane_env() -> dict:
+    """Env overrides for spawning a control-plane (non-jax) process."""
+    orig = os.environ.get(AXON_ENV, '')
+    stash = os.environ.get(AXON_STASH_ENV, '') or orig
+    if not stash:
+        return {}
+    return {AXON_ENV: '', AXON_STASH_ENV: stash}
+
+
+def control_plane_prefix() -> str:
+    """Shell prefix form of :func:`control_plane_env`.
+
+    Deliberately deferred to the EXECUTING shell (remote host or local
+    runner): the stash must capture the value of the machine the command
+    runs on, not the machine that composed the command.
+    """
+    return (f'{AXON_STASH_ENV}="${{{AXON_STASH_ENV}:-${AXON_ENV}}}" '
+            f'{AXON_ENV}= ')
+
 
 def rank_env(num_hosts: int, rank: int, ips: list, job_id: int,
              cluster_name: str, chips_per_host: int = 0) -> dict:
@@ -64,4 +93,9 @@ def rank_env(num_hosts: int, rank: int, ips: list, job_id: int,
     }
     if chips_per_host:
         env[ENV_COMPAT_NUM_GPUS] = str(chips_per_host)
+    # The agent itself runs with AXON_ENV cleared (control-plane startup
+    # optimization above); user jobs must get the original back.
+    stash = os.environ.get(AXON_STASH_ENV, '')
+    if stash and not os.environ.get(AXON_ENV):
+        env[AXON_ENV] = stash
     return env

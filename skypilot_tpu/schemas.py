@@ -8,8 +8,6 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
-import jsonschema
-
 from skypilot_tpu import exceptions
 
 _NUM_OR_PLUS = {
@@ -197,6 +195,11 @@ CONFIG_SCHEMA: Dict[str, Any] = {
 
 def _validate(doc: Dict[str, Any], schema: Dict[str, Any], kind: str,
               source: Optional[str] = None) -> None:
+    # Deferred: jsonschema's format checker transitively imports
+    # rfc3987_syntax, which costs >10s of interpreter startup in this
+    # environment — unaffordable in every spawned agent/jobcli/controller
+    # process (most never validate YAML).
+    import jsonschema
     try:
         jsonschema.validate(doc, schema)
     except jsonschema.ValidationError as e:

@@ -31,12 +31,13 @@ def _serve_dir(service_name: str) -> str:
 
 
 def _spawn(module: str, service_name: str, log_name: str) -> int:
+    from skypilot_tpu.runtime import constants as rt_constants
     log_path = os.path.join(_serve_dir(service_name), log_name)
     with open(log_path, 'ab') as log:
         proc = subprocess.Popen(
             [sys.executable, '-m', module, '--service-name', service_name],
             stdout=log, stderr=log, start_new_session=True,
-            env=dict(os.environ))
+            env={**os.environ, **rt_constants.control_plane_env()})
     return proc.pid
 
 

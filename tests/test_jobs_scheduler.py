@@ -1,0 +1,161 @@
+"""Jobs scheduler: parallelism caps + schedule-state lane (unit-level, fake
+spawns) and controller-cluster routing (e2e, local cloud)."""
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import global_user_state
+from skypilot_tpu.jobs import core as jobs_core
+from skypilot_tpu.jobs import scheduler
+from skypilot_tpu.jobs import state as jobs_state
+
+ScheduleState = jobs_state.ScheduleState
+ManagedJobStatus = jobs_state.ManagedJobStatus
+
+
+@pytest.fixture(autouse=True)
+def _fast_poll(monkeypatch):
+    monkeypatch.setenv('SKYTPU_JOBS_POLL_INTERVAL', '0.3')
+
+
+def _create(n=1):
+    ids = []
+    for i in range(n):
+        ids.append(jobs_state.create(f'j{i}', {'run': 'echo hi'}))
+    return ids
+
+
+class TestSchedulerUnit:
+    """maybe_schedule_next_jobs with spawning faked out."""
+
+    @pytest.fixture(autouse=True)
+    def _fake_spawn(self, monkeypatch):
+        self.spawned = []
+        monkeypatch.setattr(scheduler, '_spawn_controller',
+                            self.spawned.append)
+
+    def test_schedules_up_to_job_cap(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_JOBS_MAX_PARALLEL_JOBS', '2')
+        ids = _create(4)
+        for job_id in ids:
+            scheduler.submit(job_id)
+        assert self.spawned == ids[:2]
+        assert jobs_state.get_schedule_state(ids[0]) == \
+            ScheduleState.LAUNCHING
+        assert jobs_state.get_schedule_state(ids[2]) == ScheduleState.WAITING
+        # Finishing one job admits exactly one more, FIFO.
+        scheduler.job_done(ids[0])
+        assert self.spawned == ids[:3]
+
+    def test_launch_cap_blocks_even_below_job_cap(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_JOBS_MAX_PARALLEL_JOBS', '10')
+        monkeypatch.setenv('SKYTPU_JOBS_MAX_PARALLEL_LAUNCHES', '1')
+        ids = _create(3)
+        for job_id in ids:
+            scheduler.submit(job_id)
+        assert self.spawned == ids[:1]
+        # The first job's provision completing (LAUNCHING -> ALIVE) frees
+        # the launch slot.
+        jobs_state.set_schedule_state(ids[0], ScheduleState.ALIVE)
+        scheduler.maybe_schedule_next_jobs()
+        assert self.spawned == ids[:2]
+
+    def test_cancelled_waiting_job_is_retired_not_spawned(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_JOBS_MAX_PARALLEL_JOBS', '1')
+        ids = _create(2)
+        for job_id in ids:
+            scheduler.submit(job_id)
+        # ids[1] waits; cancel it before its controller exists.
+        jobs_state.set_status(ids[1], ManagedJobStatus.CANCELLING)
+        scheduler.job_done(ids[0])
+        assert self.spawned == ids[:1]
+        row = jobs_state.get(ids[1])
+        assert row['status'] == ManagedJobStatus.CANCELLED
+        assert row['schedule_state'] == ScheduleState.DONE
+
+    def test_launch_slot_waits_for_capacity(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_JOBS_MAX_PARALLEL_LAUNCHES', '1')
+        ids = _create(2)
+        jobs_state.set_schedule_state(ids[0], ScheduleState.LAUNCHING)
+        jobs_state.set_schedule_state(ids[1], ScheduleState.ALIVE)
+        t0 = time.time()
+        done = {}
+
+        import threading
+
+        def recover():
+            with scheduler.launch_slot(ids[1], poll=0.05):
+                done['acquired_at'] = time.time()
+
+        t = threading.Thread(target=recover)
+        t.start()
+        time.sleep(0.3)
+        assert 'acquired_at' not in done  # blocked on ids[0]'s slot
+        jobs_state.set_schedule_state(ids[0], ScheduleState.ALIVE)
+        t.join(timeout=5)
+        assert 'acquired_at' in done
+        assert done['acquired_at'] - t0 >= 0.3
+        assert jobs_state.get_schedule_state(ids[1]) == ScheduleState.ALIVE
+
+
+class TestStateGuards:
+
+    def test_progress_transition_respects_cancelling(self):
+        job_id = jobs_state.create('c', {'run': 'x'})
+        jobs_state.set_status(job_id, ManagedJobStatus.CANCELLING)
+        jobs_state.set_status(job_id, ManagedJobStatus.RUNNING,
+                              respect_cancelling=True)
+        assert jobs_state.get(job_id)['status'] == \
+            ManagedJobStatus.CANCELLING
+        # Unguarded (terminal) writes still go through.
+        jobs_state.set_status(job_id, ManagedJobStatus.CANCELLED)
+        assert jobs_state.get(job_id)['status'] == ManagedJobStatus.CANCELLED
+
+    def test_cancelled_waiting_retired_even_at_cap(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_JOBS_MAX_PARALLEL_JOBS', '1')
+        monkeypatch.setattr(scheduler, '_spawn_controller', lambda j: None)
+        a, b = _create(2)
+        scheduler.submit(a)
+        scheduler.submit(b)  # b WAITING behind the cap
+        jobs_state.set_status(b, ManagedJobStatus.CANCELLING)
+        scheduler.maybe_schedule_next_jobs()
+        row = jobs_state.get(b)
+        assert row['status'] == ManagedJobStatus.CANCELLED
+        assert row['schedule_state'] == ScheduleState.DONE
+
+    def test_cancel_requires_ids_or_all(self):
+        with pytest.raises(ValueError):
+            jobs_core.cancel()
+        with pytest.raises(ValueError):
+            jobs_core.cancel_on_controller(job_ids=[])
+
+
+class TestControllerCluster:
+    """Client ops route through the controller cluster (local cloud)."""
+
+    def test_launch_creates_controller_cluster_and_succeeds(self):
+        task = sky.Task(run='echo via-controller-cluster')
+        task.set_resources([sky.Resources(cloud='local')])
+        job_id = jobs_core.launch(task)
+        # The controller cluster exists and is UP.
+        record = global_user_state.get_cluster_from_name(
+            'skytpu-jobs-controller')
+        assert record is not None
+        assert record['status'] == global_user_state.ClusterStatus.UP
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            row = jobs_state.get(job_id)
+            if row['status'].is_terminal():
+                break
+            time.sleep(0.3)
+        assert row['status'] == ManagedJobStatus.SUCCEEDED, \
+            jobs_core.controller_logs(job_id)
+        assert row['schedule_state'] == ScheduleState.DONE
+        # queue() routes through the controller and reports it.
+        rows = {r['job_id']: r for r in jobs_core.queue()}
+        assert rows[job_id]['status'] == ManagedJobStatus.SUCCEEDED
+
+    def test_queue_without_controller_cluster_is_empty(self):
+        assert jobs_core.queue() == []
+        assert jobs_core.cancel(all_jobs=True) == []

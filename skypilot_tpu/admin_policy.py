@@ -1,0 +1,100 @@
+"""Pluggable admin policy: organization-wide request mutation/validation.
+
+Counterpart of reference ``sky/admin_policy.py`` (AdminPolicy/UserRequest/
+MutatedUserRequest) + its application point (sky/execution.py:180-187).
+Deployments point ``admin_policy: mypkg.MyPolicy`` in config at a class:
+
+    class MyPolicy(skypilot_tpu.admin_policy.AdminPolicy):
+        @classmethod
+        def validate_and_mutate(cls, user_request):
+            task = user_request.task
+            for r in task.resources:
+                if not r.use_spot and r.accelerators \
+                        and r.accelerators.chips > 64:
+                    raise ValueError('big slices must use spot')
+            return skypilot_tpu.admin_policy.MutatedUserRequest(task=task)
+
+Policies run in-process, CLIENT-side, on every launch/exec/jobs_launch/
+serve_up — before any cloud call, and before a managed-job task is shipped
+to a (possibly remote) controller cluster that does not carry the client's
+config. Controller-cluster bring-up itself arrives with
+``operation='controller_launch'`` so infrastructure can be exempted from
+workload rules.
+"""
+from __future__ import annotations
+
+import abc
+import dataclasses
+import importlib
+from typing import Any, Optional
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import exceptions
+
+
+@dataclasses.dataclass
+class RequestOptions:
+    """Context about the request (reference RequestOptions)."""
+    cluster_name: Optional[str] = None
+    operation: str = 'launch'      # launch | exec | jobs_launch | serve_up
+    dryrun: bool = False
+
+
+@dataclasses.dataclass
+class UserRequest:
+    task: Any                      # task_lib.Task
+    request_options: RequestOptions
+
+
+@dataclasses.dataclass
+class MutatedUserRequest:
+    task: Any
+
+
+class AdminPolicy(abc.ABC):
+    """Subclass + config `admin_policy: module.Class` to enforce."""
+
+    @classmethod
+    @abc.abstractmethod
+    def validate_and_mutate(cls, user_request: UserRequest
+                            ) -> MutatedUserRequest:
+        """Raise to reject; return a (possibly mutated) request to allow."""
+
+
+def _load_policy_class() -> Optional[type]:
+    path = config_lib.get_nested(('admin_policy',), None)
+    if not path:
+        return None
+    module_name, _, class_name = str(path).rpartition('.')
+    if not module_name:
+        raise exceptions.InvalidConfigError(
+            f'admin_policy must be a full import path, got {path!r}')
+    try:
+        module = importlib.import_module(module_name)
+        policy = getattr(module, class_name)
+    except (ImportError, AttributeError) as e:
+        raise exceptions.InvalidConfigError(
+            f'Cannot import admin policy {path!r}: {e}') from e
+    if not (isinstance(policy, type) and issubclass(policy, AdminPolicy)):
+        raise exceptions.InvalidConfigError(
+            f'{path!r} is not an AdminPolicy subclass')
+    return policy
+
+
+def apply(task: Any, cluster_name: Optional[str] = None,
+          operation: str = 'launch', dryrun: bool = False) -> Any:
+    """Run the configured policy over a task; returns the task to use."""
+    policy = _load_policy_class()
+    if policy is None:
+        return task
+    request = UserRequest(task=task, request_options=RequestOptions(
+        cluster_name=cluster_name, operation=operation, dryrun=dryrun))
+    try:
+        mutated = policy.validate_and_mutate(request)
+    except exceptions.SkyTpuError:
+        raise
+    except Exception as e:  # policy rejection
+        raise exceptions.AdminPolicyRejected(
+            f'Admin policy {policy.__name__} rejected the request: '
+            f'{e}') from e
+    return mutated.task

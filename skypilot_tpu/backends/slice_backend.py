@@ -27,6 +27,8 @@ from skypilot_tpu.backends import backend as backend_lib
 from skypilot_tpu.runtime import agent as agent_lib
 from skypilot_tpu.runtime import constants as rt_constants
 from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import timeline
+
 
 def _quote_path(path: str) -> str:
     """shlex.quote that preserves a leading ~/ for remote home expansion."""
@@ -176,6 +178,7 @@ class SliceBackend(backend_lib.Backend):
             stream_to=stream_to, timeout=timeout)
 
     # ---- provision ---------------------------------------------------------
+    @timeline.event
     def provision(self, task: task_lib.Task, cluster_name: str,
                   retry_until_up: bool = False,
                   dryrun: bool = False) -> Optional[backend_lib.ResourceHandle]:

@@ -1,0 +1,3 @@
+"""Benchmark tool: run one task across candidate TPU configs, compare
+seconds/step and $/step (reference ``sky bench``,
+sky/benchmark/benchmark_utils.py + benchmark_state.py)."""

@@ -1,0 +1,98 @@
+"""Benchmark records: sqlite (reference sky/benchmark/benchmark_state.py)."""
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import global_user_state
+
+_LOCAL = threading.local()
+
+
+def _db() -> sqlite3.Connection:
+    path = os.path.join(global_user_state.get_state_dir(), 'benchmark.db')
+    conns = getattr(_LOCAL, 'conns', None)
+    if conns is None:
+        conns = _LOCAL.conns = {}
+    conn = conns.get(path)
+    if conn is None:
+        conn = sqlite3.connect(path, timeout=10.0)
+        conn.execute('PRAGMA journal_mode=WAL')
+        conn.execute("""
+            CREATE TABLE IF NOT EXISTS benchmarks (
+                benchmark TEXT PRIMARY KEY,
+                task_name TEXT,
+                launched_at REAL
+            )""")
+        conn.execute("""
+            CREATE TABLE IF NOT EXISTS benchmark_results (
+                benchmark TEXT,
+                cluster TEXT,
+                resources TEXT,
+                hourly_cost REAL,
+                job_id INTEGER,
+                summary TEXT,
+                PRIMARY KEY (benchmark, cluster)
+            )""")
+        conn.commit()
+        conns[path] = conn
+    return conn
+
+
+def add_benchmark(benchmark: str, task_name: Optional[str]) -> None:
+    conn = _db()
+    conn.execute('INSERT OR REPLACE INTO benchmarks VALUES (?,?,?)',
+                 (benchmark, task_name, time.time()))
+    conn.commit()
+
+
+def add_result(benchmark: str, cluster: str, resources: str,
+               hourly_cost: float, job_id: Optional[int]) -> None:
+    conn = _db()
+    conn.execute(
+        'INSERT OR REPLACE INTO benchmark_results '
+        '(benchmark, cluster, resources, hourly_cost, job_id, summary) '
+        'VALUES (?,?,?,?,?,NULL)',
+        (benchmark, cluster, resources, hourly_cost, job_id))
+    conn.commit()
+
+
+def set_summary(benchmark: str, cluster: str,
+                summary: Dict[str, Any]) -> None:
+    conn = _db()
+    conn.execute('UPDATE benchmark_results SET summary=? '
+                 'WHERE benchmark=? AND cluster=?',
+                 (json.dumps(summary), benchmark, cluster))
+    conn.commit()
+
+
+def list_benchmarks() -> List[Dict[str, Any]]:
+    return [{'benchmark': r[0], 'task_name': r[1], 'launched_at': r[2]}
+            for r in _db().execute(
+                'SELECT benchmark, task_name, launched_at FROM benchmarks '
+                'ORDER BY launched_at DESC')]
+
+
+def get_results(benchmark: str) -> List[Dict[str, Any]]:
+    rows = []
+    for r in _db().execute(
+            'SELECT cluster, resources, hourly_cost, job_id, summary '
+            'FROM benchmark_results WHERE benchmark=?', (benchmark,)):
+        rows.append({
+            'cluster': r[0], 'resources': r[1], 'hourly_cost': r[2],
+            'job_id': r[3],
+            'summary': json.loads(r[4]) if r[4] else None,
+        })
+    return rows
+
+
+def delete_benchmark(benchmark: str) -> None:
+    conn = _db()
+    conn.execute('DELETE FROM benchmarks WHERE benchmark=?', (benchmark,))
+    conn.execute('DELETE FROM benchmark_results WHERE benchmark=?',
+                 (benchmark,))
+    conn.commit()

@@ -1,0 +1,141 @@
+"""Benchmark harness: fan a task out over candidate TPU configs.
+
+Counterpart of reference ``sky/benchmark/benchmark_utils.py`` (launches N
+candidate resource configs, collects per-step timing via sky_callback,
+reports $/step). Flow:
+
+    bench launch  ->  one cluster per candidate, task runs with
+                      SKYTPU_BENCHMARK_LOG_DIR armed (callbacks/ writes
+                      benchmark_summary.json on the head host)
+    bench show    ->  pulls summaries off each cluster, prints
+                      sec/step, steps/$ and $/step per candidate
+    bench down    ->  terminates the candidate clusters
+"""
+from __future__ import annotations
+
+import copy
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.benchmark import state
+from skypilot_tpu.runtime import constants as rt_constants
+
+# Remote dir (relative to the job's workdir) where the callback writes.
+_REMOTE_LOG_DIR = 'skytpu_benchmark'
+
+
+def _cluster_name(benchmark: str, idx: int) -> str:
+    return f'skytpu-bench-{benchmark}-{idx}'
+
+
+def _hourly_cost(resources: Any) -> float:
+    try:
+        from skypilot_tpu import clouds as clouds_lib
+        cloud = clouds_lib.get_cloud(resources.cloud)
+        return cloud.hourly_cost(resources, resources.region,
+                                 resources.zone)
+    except Exception:
+        return 0.0
+
+
+def launch(task: task_lib.Task, benchmark: str,
+           candidates: List[Any]) -> List[Dict[str, Any]]:
+    """Launch the task once per candidate Resources; returns per-candidate
+    {cluster, job_id or error}. Launches run in parallel (one provision
+    thread per candidate, the reference does the same)."""
+    from skypilot_tpu import execution
+    state.add_benchmark(benchmark, task.name)
+    results: List[Dict[str, Any]] = [dict() for _ in candidates]
+
+    def one(idx: int, resources: Any) -> None:
+        cand_task = copy.deepcopy(task)
+        cand_task.set_resources([resources])
+        cand_task.update_envs(
+            {'SKYTPU_BENCHMARK_LOG_DIR': _REMOTE_LOG_DIR})
+        cluster = _cluster_name(benchmark, idx)
+        try:
+            job_id, handle = execution.launch(
+                cand_task, cluster_name=cluster, detach_run=True,
+                stream_logs=False)
+            launched = (handle.launched_resources
+                        if handle is not None else resources)
+            state.add_result(benchmark, cluster, str(launched),
+                             _hourly_cost(launched), job_id)
+            results[idx] = {'cluster': cluster, 'job_id': job_id}
+        except Exception as e:  # noqa: BLE001 — any failure is a
+            # per-candidate result, never a dead thread + empty row
+            state.add_result(benchmark, cluster, str(resources), 0.0, None)
+            results[idx] = {'cluster': cluster, 'error': str(e)}
+
+    threads = [threading.Thread(target=one, args=(i, r))
+               for i, r in enumerate(candidates)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results
+
+
+def update_summaries(benchmark: str) -> None:
+    """Pull benchmark_summary.json off each candidate's head host."""
+    from skypilot_tpu import backends
+    backend = backends.SliceBackend()
+    for row in state.get_results(benchmark):
+        record = global_user_state.get_cluster_from_name(row['cluster'])
+        if record is None or record['handle'] is None:
+            continue
+        path = (f'{rt_constants.WORKDIR}/{_REMOTE_LOG_DIR}/'
+                'benchmark_summary.json')
+        head = backend._runners(record['handle'])[0]
+        res = head.run(f'cat {path}', timeout=60)
+        if res.returncode != 0:
+            continue
+        try:
+            state.set_summary(benchmark, row['cluster'],
+                              json.loads(res.stdout.strip()))
+        except (json.JSONDecodeError, ValueError):
+            continue
+
+
+def get_report(benchmark: str, refresh: bool = True
+               ) -> List[Dict[str, Any]]:
+    """Per-candidate comparison rows with derived $/step."""
+    if refresh:
+        update_summaries(benchmark)
+    report = []
+    for row in state.get_results(benchmark):
+        summary = row['summary'] or {}
+        sec_per_step = summary.get('seconds_per_step')
+        entry = {
+            'cluster': row['cluster'],
+            'resources': row['resources'],
+            'hourly_cost': row['hourly_cost'],
+            'num_steps': summary.get('num_steps'),
+            'seconds_per_step': sec_per_step,
+            'cost_per_step': (row['hourly_cost'] * sec_per_step / 3600
+                              if sec_per_step else None),
+        }
+        report.append(entry)
+    return report
+
+
+def down(benchmark: str) -> List[str]:
+    """Terminate all candidate clusters of a benchmark."""
+    from skypilot_tpu import core
+    downed = []
+    for row in state.get_results(benchmark):
+        try:
+            core.down(row['cluster'])
+            downed.append(row['cluster'])
+        except exceptions.SkyTpuError:
+            pass
+    return downed
+
+
+def delete(benchmark: str) -> None:
+    state.delete_benchmark(benchmark)

@@ -30,8 +30,16 @@ def _read(name: str) -> pd.DataFrame:
     if not os.path.exists(path):
         # Regenerate on first use (e.g. fresh checkout).
         from skypilot_tpu.catalog.fetchers import fetch_gcp
-        fetch_gcp.main()
+        fetch_gcp.refresh()
     return pd.read_csv(path)
+
+
+def refresh(online: bool = True) -> str:
+    """Re-fetch prices (Billing API when reachable) and reload the CSVs."""
+    from skypilot_tpu.catalog.fetchers import fetch_gcp
+    source = fetch_gcp.refresh(online=online)
+    _read.cache_clear()
+    return source
 
 
 def _tpus() -> pd.DataFrame:

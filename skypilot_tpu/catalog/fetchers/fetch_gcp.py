@@ -2,23 +2,37 @@
 
 Counterpart of the reference's catalog data fetchers
 (sky/clouds/service_catalog/data_fetchers/fetch_gcp.py:34-66, which scrapes
-the GCP pricing SKU API and gap-fills TPU zones by hand). In production this
-module would hit ``cloudbilling.googleapis.com``; offline it regenerates the
-baked-in CSVs from the static tables below, which mirror public on-demand
-per-chip-hour pricing and published TPU zone availability.
+the GCP pricing SKU API and gap-fills TPU zones by hand). Two price
+sources, merged:
 
-Run:  python -m skypilot_tpu.catalog.fetchers.fetch_gcp
+1. **Cloud Billing Catalog API** (``cloudbilling.googleapis.com/v1``):
+   ``refresh(online=True)`` walks services -> Cloud TPU SKUs, parses
+   per-chip-hour on-demand/preemptible unit prices per region from SKU
+   descriptions, and overrides the static table wherever a live price was
+   found. Reuses the TPU provisioner's retrying transport
+   (provision/gcp_api.py), so tests fake the billing API the same way they
+   fake the TPU API.
+2. **Static tables** below (public on-demand per-chip-hour pricing and
+   published TPU zone availability): the offline fallback — this build
+   environment has zero egress, and the reference likewise hand-gap-fills
+   zones its SKU scrape misses.
+
+Run:  python -m skypilot_tpu.catalog.fetchers.fetch_gcp [--online]
+      skytpu show-tpus --refresh
 """
 from __future__ import annotations
 
 import csv
 import os
-from typing import Dict, List, Tuple
+import re
+from typing import Any, Dict, List, Optional, Tuple
 
 from skypilot_tpu import accelerators as accel_lib
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 DATA_DIR = os.path.join(_HERE, '..', 'data')
+
+BILLING_BASE = 'https://cloudbilling.googleapis.com/v1'
 
 # Public on-demand $/chip-hour in US regions; spot is the public preemptible
 # discount (~0.35-0.45x depending on generation).
@@ -75,6 +89,118 @@ TPU_HOST_SHAPES: Dict[str, Tuple[int, float]] = {
 }
 
 
+# ---- Cloud Billing Catalog API fetch ---------------------------------------
+# SKU descriptions name generations inconsistently ("Tpu-v4", "Cloud TPU
+# v5e", "TPU v5 Lite", "Trillium"); normalize to our generation keys.
+_GEN_IN_DESCRIPTION = [
+    (re.compile(r'v5\s*lite|v5e', re.I), 'v5e'),
+    (re.compile(r'v5p', re.I), 'v5p'),
+    (re.compile(r'v6e|trillium', re.I), 'v6e'),
+    (re.compile(r'\bv2\b', re.I), 'v2'),
+    (re.compile(r'\bv3\b', re.I), 'v3'),
+    (re.compile(r'\bv4\b', re.I), 'v4'),
+]
+
+
+class BillingClient:
+    """Paginated reader for the Cloud Billing Catalog API.
+
+    Goes through ``provision.gcp_api``'s transport: retries/backoff for
+    free, and the tests' fake-transport seam covers this client too.
+    """
+
+    def __init__(self, transport: Optional[Any] = None):
+        if transport is None:
+            from skypilot_tpu.provision import gcp_api
+            transport = gcp_api.get_transport()
+        self._transport = transport
+
+    def _paginate(self, url: str, key: str,
+                  params: Optional[Dict[str, Any]] = None
+                  ) -> List[Dict[str, Any]]:
+        items: List[Dict[str, Any]] = []
+        params = dict(params or {})
+        while True:
+            resp = self._transport.request('GET', url, params=params)
+            items.extend(resp.get(key, []))
+            token = resp.get('nextPageToken')
+            if not token:
+                return items
+            params['pageToken'] = token
+
+    def find_service(self, display_name: str) -> Optional[str]:
+        """'Cloud TPU' -> 'services/E000-...' (resource name)."""
+        for svc in self._paginate(f'{BILLING_BASE}/services', 'services'):
+            if svc.get('displayName') == display_name:
+                return svc['name']
+        return None
+
+    def list_skus(self, service_name: str) -> List[Dict[str, Any]]:
+        return self._paginate(f'{BILLING_BASE}/{service_name}/skus',
+                              'skus', params={'currencyCode': 'USD'})
+
+
+def _unit_price(sku: Dict[str, Any]) -> Optional[float]:
+    """$/usage-unit from the SKU's first pricing tier (units + nanos)."""
+    try:
+        expr = sku['pricingInfo'][0]['pricingExpression']
+        rate = expr['tieredRates'][-1]['unitPrice']
+        return int(rate.get('units', 0) or 0) + rate.get('nanos', 0) / 1e9
+    except (KeyError, IndexError, TypeError, ValueError):
+        return None
+
+
+def parse_tpu_sku_prices(skus: List[Dict[str, Any]]
+                         ) -> Dict[Tuple[str, str], Dict[str, float]]:
+    """SKUs -> {(generation, region): {'OnDemand': $, 'Preemptible': $}}.
+
+    Only per-chip-hour compute SKUs count (usage unit hour); commitment /
+    storage / network SKUs are skipped (the reference's scraper filters the
+    same way, reference fetch_gcp.py TPU SKU handling).
+    """
+    prices: Dict[Tuple[str, str], Dict[str, float]] = {}
+    for sku in skus:
+        desc = sku.get('description', '')
+        category = sku.get('category', {})
+        usage_type = category.get('usageType', '')
+        if usage_type not in ('OnDemand', 'Preemptible'):
+            continue  # Commit1Yr etc.
+        gen = None
+        for pattern, g in _GEN_IN_DESCRIPTION:
+            if pattern.search(desc):
+                gen = g
+                break
+        if gen is None:
+            continue
+        try:
+            unit = (sku['pricingInfo'][0]['pricingExpression']
+                    .get('usageUnit', ''))
+        except (KeyError, IndexError):
+            continue
+        if unit not in ('h', 'hr', 'hour'):
+            continue
+        price = _unit_price(sku)
+        if price is None or price <= 0:
+            continue
+        for region in sku.get('serviceRegions', []):
+            entry = prices.setdefault((gen, region), {})
+            # Pods and single-host SKUs share per-chip pricing; keep the
+            # cheapest seen (some regions list legacy higher-priced SKUs).
+            if usage_type not in entry or price < entry[usage_type]:
+                entry[usage_type] = price
+    return prices
+
+
+def fetch_tpu_prices(transport: Optional[Any] = None
+                     ) -> Dict[Tuple[str, str], Dict[str, float]]:
+    """Live per-chip-hour prices keyed by (generation, region)."""
+    client = BillingClient(transport)
+    service = client.find_service('Cloud TPU')
+    if service is None:
+        return {}
+    return parse_tpu_sku_prices(client.list_skus(service))
+
+
 def _region_of(zone: str) -> str:
     return zone.rsplit('-', 1)[0]
 
@@ -86,7 +212,12 @@ def _multiplier(region: str) -> float:
     return 1.0
 
 
-def generate_tpu_rows() -> List[Dict[str, object]]:
+def generate_tpu_rows(
+    live_prices: Optional[Dict[Tuple[str, str], Dict[str, float]]] = None
+) -> List[Dict[str, object]]:
+    """One row per (slice, zone). ``live_prices`` (from the Billing API)
+    overrides the static per-chip-hour table wherever present."""
+    live_prices = live_prices or {}
     rows: List[Dict[str, object]] = []
     for name in accel_lib.list_slice_names():
         s = accel_lib.TpuSlice.from_name(name)
@@ -94,6 +225,12 @@ def generate_tpu_rows() -> List[Dict[str, object]]:
         for zone in _TPU_ZONES[s.generation]:
             region = _region_of(zone)
             mult = _multiplier(region)
+            per_chip = base * mult
+            per_chip_spot = base_spot * mult
+            live = live_prices.get((s.generation, region))
+            if live:
+                per_chip = live.get('OnDemand', per_chip)
+                per_chip_spot = live.get('Preemptible', per_chip_spot)
             rows.append({
                 'slice': s.name,
                 'generation': s.generation,
@@ -102,8 +239,8 @@ def generate_tpu_rows() -> List[Dict[str, object]]:
                 'topology': s.topology_str,
                 'region': region,
                 'zone': zone,
-                'price': round(base * s.chips * mult, 4),
-                'spot_price': round(base_spot * s.chips * mult, 4),
+                'price': round(per_chip * s.chips, 4),
+                'spot_price': round(per_chip_spot * s.chips, 4),
             })
     return rows
 
@@ -135,13 +272,48 @@ def write_csv(path: str, rows: List[Dict[str, object]]) -> None:
         writer.writerows(rows)
 
 
-def main() -> None:
-    tpu_rows = generate_tpu_rows()
+def refresh(online: bool = False,
+            transport: Optional[Any] = None) -> str:
+    """Regenerate both CSVs; returns 'online' or 'offline' (what happened).
+
+    ``online=True`` tries the Billing Catalog API first and silently falls
+    back to the static tables when unreachable (no credentials, no egress)
+    — cost optimization keeps working either way, the reference behaves the
+    same when its hosted catalog is stale.
+    """
+    live_prices: Dict[Tuple[str, str], Dict[str, float]] = {}
+    source = 'offline'
+    if online:
+        try:
+            live_prices = fetch_tpu_prices(transport)
+            if live_prices:
+                source = 'online'
+        except Exception as e:  # noqa: BLE001 — any failure means fallback
+            print(f'billing API unavailable ({type(e).__name__}: {e}); '
+                  'using static price tables')
+    tpu_rows = generate_tpu_rows(live_prices)
     vm_rows = generate_vm_rows()
-    write_csv(os.path.join(DATA_DIR, 'gcp_tpus.csv'), tpu_rows)
-    write_csv(os.path.join(DATA_DIR, 'gcp_vms.csv'), vm_rows)
+    try:
+        write_csv(os.path.join(DATA_DIR, 'gcp_tpus.csv'), tpu_rows)
+        write_csv(os.path.join(DATA_DIR, 'gcp_vms.csv'), vm_rows)
+    except OSError as e:
+        # Read-only install (e.g. root-owned site-packages): keep serving
+        # the existing CSVs rather than crashing the CLI.
+        print(f'catalog dir not writable ({e}); keeping existing CSVs')
+        return 'stale'
     print(f'Wrote {len(tpu_rows)} TPU rows, {len(vm_rows)} VM rows '
-          f'to {os.path.normpath(DATA_DIR)}')
+          f'to {os.path.normpath(DATA_DIR)} '
+          f'({source}; {len(live_prices)} live price points)')
+    return source
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    import argparse
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--online', action='store_true',
+                        help='fetch live prices from the Billing API')
+    args = parser.parse_args(argv)
+    refresh(online=args.online)
 
 
 if __name__ == '__main__':

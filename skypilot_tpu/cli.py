@@ -125,8 +125,10 @@ def status(clusters, refresh):
         autostop = f"{r['autostop']}m" if r['autostop'] >= 0 else '-'
         if r['to_down'] and r['autostop'] >= 0:
             autostop += ' (down)'
-        click.echo(fmt.format(r['name'], r['status'].value, res[:24],
-                              str(hosts), autostop))
+        from skypilot_tpu.utils import ux_utils
+        status_col = ux_utils.colorize_status(f"{r['status'].value:<10}")
+        click.echo(fmt.format(r['name'], status_col,
+                              res[:24], str(hosts), autostop))
 
 
 @cli.command()
@@ -231,11 +233,17 @@ def check():
 
 @cli.command('show-tpus')
 @click.option('--generation', default=None, help='e.g. v5e, v6e.')
-def show_tpus(generation):
+@click.option('--refresh', is_flag=True,
+              help='Re-fetch prices from the Cloud Billing API '
+                   '(falls back to the built-in tables offline).')
+def show_tpus(generation, refresh):
     """List TPU slice offerings with price and perf/$. (analog of
     reference `sky show-gpus`)."""
     from skypilot_tpu import accelerators as accel_lib
     from skypilot_tpu import catalog
+    if refresh:
+        source = catalog.refresh(online=True)
+        click.echo(f'Catalog refreshed ({source}).')
     df = catalog.list_tpu_slices(generation=generation)
     # Cheapest region per slice type.
     df = df.loc[df.groupby('slice')['price'].idxmin()]
@@ -449,6 +457,92 @@ def serve_logs(service_name):
     """Show a service's controller log."""
     from skypilot_tpu.serve import core as serve_core
     click.echo(serve_core.controller_logs(service_name))
+
+
+@cli.group()
+def bench():
+    """Benchmark a task across candidate TPU configs (reference `sky
+    bench`, sky/benchmark/benchmark_utils.py)."""
+
+
+@bench.command('launch')
+@click.argument('entrypoint')
+@click.option('--benchmark', '-b', required=True, help='Benchmark name.')
+@click.option('--candidates', required=True,
+              help='Comma-separated accelerators, e.g. tpu-v5e-8,tpu-v6e-8 '
+                   '(or "local" entries for testing).')
+def bench_launch(entrypoint, benchmark, candidates):
+    """Launch ENTRYPOINT once per candidate config."""
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.benchmark import utils as bench_utils
+    task = task_lib.Task.from_yaml(entrypoint)
+    cands = []
+    for item in candidates.split(','):
+        item = item.strip()
+        if item == 'local':
+            cands.append(resources_lib.Resources(cloud='local'))
+        else:
+            cands.append(resources_lib.Resources(accelerators=item))
+    results = bench_utils.launch(task, benchmark, cands)
+    for r in results:
+        mark = f"job {r['job_id']}" if 'job_id' in r else \
+            f"FAILED: {r.get('error', '?')[:60]}"
+        click.echo(f"  {r['cluster']}: {mark}")
+    click.echo(f"Results: skytpu bench show {benchmark}")
+
+
+@bench.command('ls')
+def bench_ls():
+    """List benchmarks."""
+    from skypilot_tpu.benchmark import state as bench_state
+    rows = bench_state.list_benchmarks()
+    if not rows:
+        click.echo('No benchmarks.')
+        return
+    for r in rows:
+        click.echo(f"{r['benchmark']:<24} {r['task_name'] or '-'}")
+
+
+@bench.command('show')
+@click.argument('benchmark')
+def bench_show(benchmark):
+    """Per-candidate sec/step and $/step."""
+    from skypilot_tpu.benchmark import utils as bench_utils
+    report = bench_utils.get_report(benchmark)
+    if not report:
+        click.echo(f'No results for benchmark {benchmark!r}.')
+        return
+    fmt = '{:<28} {:<26} {:<8} {:<8} {:<12} {:<12}'
+    click.echo(fmt.format('CLUSTER', 'RESOURCES', '$/HR', 'STEPS',
+                          'SEC/STEP', '$/STEP'))
+    for r in report:
+        click.echo(fmt.format(
+            r['cluster'][:28], r['resources'][:26],
+            f"{r['hourly_cost']:.2f}",
+            str(r['num_steps'] or '-'),
+            (f"{r['seconds_per_step']:.4f}"
+             if r['seconds_per_step'] else '-'),
+            (f"{r['cost_per_step']:.6f}"
+             if r['cost_per_step'] is not None else '-')))
+
+
+@bench.command('down')
+@click.argument('benchmark')
+def bench_down(benchmark):
+    """Terminate a benchmark's candidate clusters."""
+    from skypilot_tpu.benchmark import utils as bench_utils
+    for name in bench_utils.down(benchmark):
+        click.echo(f'Terminated {name}.')
+
+
+@bench.command('delete')
+@click.argument('benchmark')
+def bench_delete(benchmark):
+    """Delete a benchmark's records (clusters must be downed first)."""
+    from skypilot_tpu.benchmark import utils as bench_utils
+    bench_utils.delete(benchmark)
+    click.echo(f'Deleted benchmark {benchmark}.')
 
 
 @cli.group()

@@ -184,6 +184,14 @@ class NoClusterLaunchedError(SkyTpuError):
     """Failover ran out of candidates before launching anything."""
 
 
+class InvalidConfigError(SkyTpuError):
+    """Malformed ~/.skytpu/config.yaml content (e.g. bad admin_policy)."""
+
+
+class AdminPolicyRejected(SkyTpuError):
+    """The configured admin policy refused the request."""
+
+
 def serialize_exception(e: Exception) -> Dict[str, Any]:
     """JSON-serializable form for shipping errors across the API server."""
     return {

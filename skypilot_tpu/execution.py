@@ -20,6 +20,7 @@ from skypilot_tpu import exceptions
 from skypilot_tpu import global_user_state
 from skypilot_tpu import optimizer as optimizer_lib
 from skypilot_tpu import task as task_lib
+from skypilot_tpu.utils import timeline
 
 
 class Stage(enum.Enum):
@@ -55,6 +56,7 @@ def _existing_up_handle(cluster_name: str
     return record['handle']
 
 
+@timeline.event
 def _execute(task: task_lib.Task,
              cluster_name: str,
              stages: List[Stage],
@@ -130,10 +132,20 @@ def launch(task, cluster_name: str,
            backend: Optional[backends.Backend] = None,
            optimize_target=None,
            dryrun: bool = False,
-           stream_logs: bool = True) -> Tuple[Optional[int], Optional[Any]]:
-    """Provision (or reuse) a cluster and run the task on it."""
+           stream_logs: bool = True,
+           policy_operation: str = 'launch'
+           ) -> Tuple[Optional[int], Optional[Any]]:
+    """Provision (or reuse) a cluster and run the task on it.
+
+    ``policy_operation`` names this request to the admin policy
+    (controller bring-up passes 'controller_launch' so org policies can
+    distinguish infrastructure from user workloads).
+    """
     task = _to_task(task)
+    from skypilot_tpu import admin_policy
     from skypilot_tpu.utils import common_utils
+    task = admin_policy.apply(task, cluster_name=cluster_name,
+                              operation=policy_operation, dryrun=dryrun)
     common_utils.check_cluster_name_is_valid(cluster_name)
     job_id, handle = _execute(
         task, cluster_name, ALL_STAGES, backend=backend,
@@ -152,6 +164,9 @@ def exec_(task, cluster_name: str,
           stream_logs: bool = True) -> Tuple[Optional[int], Optional[Any]]:
     """Run a task on an existing UP cluster (no provision, no setup)."""
     task = _to_task(task)
+    from skypilot_tpu import admin_policy
+    task = admin_policy.apply(task, cluster_name=cluster_name,
+                              operation='exec')
     handle = _existing_up_handle(cluster_name)
     if handle is None:
         raise exceptions.ClusterNotUpError(

@@ -140,9 +140,13 @@ class JobsController:
                     state.set_status(job_id, ManagedJobStatus.RECOVERING,
                                      respect_cancelling=True)
                     state.bump_recovery(job_id)
-                    with scheduler.launch_slot(self.job_id):
-                        cluster_job_id = self.strategy.launch(
-                            retry_until_up=False)
+                    try:
+                        with scheduler.launch_slot(self.job_id):
+                            cluster_job_id = self.strategy.launch(
+                                retry_until_up=False)
+                    except exceptions.ResourcesUnavailableError as e:
+                        self._fail_no_resource(str(e))
+                        return
                     state.update(job_id, cluster_job_id=cluster_job_id)
                     state.set_status(job_id, ManagedJobStatus.RUNNING,
                                      respect_cancelling=True)

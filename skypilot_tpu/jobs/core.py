@@ -56,7 +56,14 @@ def _parse_json_line(res, op: str) -> Dict[str, Any]:
 
 
 def launch(task: task_lib.Task, name: Optional[str] = None) -> int:
-    """Submit a managed job to the controller cluster; returns job id."""
+    """Submit a managed job to the controller cluster; returns job id.
+
+    The admin policy runs HERE, client-side, before the task is shipped:
+    a remote controller cluster does not carry the client's config, so
+    enforcement on the controller would be silently absent.
+    """
+    from skypilot_tpu import admin_policy
+    task = admin_policy.apply(task, operation='jobs_launch')
     job_name = name or task.name or 'managed-job'
     task_json = json.dumps(task.to_yaml_config())
     res = _run_jobcli(f'submit --name {shlex.quote(job_name)} '
@@ -66,7 +73,8 @@ def launch(task: task_lib.Task, name: Optional[str] = None) -> int:
 
 def queue(refresh_controller: bool = True) -> List[Dict[str, Any]]:
     """All managed jobs, as reported by the controller cluster."""
-    res = _run_jobcli('queue', launch_if_missing=False)
+    args = 'queue' + ('' if refresh_controller else ' --no-reconcile')
+    res = _run_jobcli(args, launch_if_missing=False)
     if res is None:
         return []
     rows = _parse_json_line(res, 'queue')['jobs']
@@ -101,12 +109,20 @@ def tail_logs(job_id: int, follow: bool = True, out=None) -> int:
 
 def controller_logs(job_id: int) -> str:
     """The controller process log for a job (debugging aid)."""
-    from skypilot_tpu.jobs import scheduler
-    try:  # local-cloud controller shares the filesystem: read directly
-        with open(scheduler.controller_log_path(job_id)) as f:
-            return f.read()
-    except FileNotFoundError:
-        pass
+    from skypilot_tpu.utils import controller_utils
+    handle = controller_utils.get_controller_handle(
+        controller_utils.JOBS_CONTROLLER)
+    if handle is None or handle.cloud == 'local':
+        # Local controller (or none): its log dir is this filesystem.
+        # Never read this path for a REMOTE controller — a stale local
+        # file from a previous local-controller deployment would shadow
+        # the real log for the same job id.
+        from skypilot_tpu.jobs import scheduler
+        try:
+            with open(scheduler.controller_log_path(job_id)) as f:
+                return f.read()
+        except FileNotFoundError:
+            return ''
     res = _run_jobcli(f'controller-log --job-id {job_id}',
                       launch_if_missing=False)
     if res is None or res.returncode != 0:
@@ -131,7 +147,7 @@ def _controller_alive(pid: Optional[int]) -> bool:
         return False
 
 
-def queue_on_controller() -> List[Dict[str, Any]]:
+def queue_on_controller(reconcile: bool = True) -> List[Dict[str, Any]]:
     """All managed jobs; reconciles rows whose controller died.
 
     Reconciliation runs under the scheduler lock: controller spawning
@@ -140,6 +156,8 @@ def queue_on_controller() -> List[Dict[str, Any]]:
     NULL pid and misdiagnosed as dead.
     """
     from skypilot_tpu.jobs import scheduler
+    if not reconcile:
+        return state.list_jobs()
     reconciled = False
     with scheduler._scheduler_lock(blocking=True):
         rows = state.list_jobs()

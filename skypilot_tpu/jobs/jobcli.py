@@ -28,7 +28,7 @@ def _cmd_submit(args) -> int:
 
 def _cmd_queue(args) -> int:
     from skypilot_tpu.jobs import core
-    rows = core.queue_on_controller()
+    rows = core.queue_on_controller(reconcile=not args.no_reconcile)
     for row in rows:
         row['status'] = row['status'].value
         row['schedule_state'] = row['schedule_state'].value
@@ -71,6 +71,7 @@ def main() -> None:
     p.set_defaults(fn=_cmd_submit)
 
     p = sub.add_parser('queue')
+    p.add_argument('--no-reconcile', action='store_true')
     p.set_defaults(fn=_cmd_queue)
 
     p = sub.add_parser('cancel')

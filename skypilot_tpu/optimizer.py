@@ -26,6 +26,7 @@ from skypilot_tpu import dag as dag_lib
 from skypilot_tpu import exceptions
 from skypilot_tpu import resources as resources_lib
 from skypilot_tpu import task as task_lib
+from skypilot_tpu.utils import timeline
 
 
 class OptimizeTarget(enum.Enum):
@@ -140,6 +141,7 @@ def _print_candidate_table(task: task_lib.Task, candidates: List[Candidate],
                        '$/HR', 'TFLOPS/$']))
 
 
+@timeline.event
 def optimize(
     dag_or_task,
     minimize: OptimizeTarget = OptimizeTarget.COST,

@@ -46,6 +46,8 @@ def up(task: task_lib.Task, service_name: str) -> Dict[str, Any]:
     if task.service is None:
         raise exceptions.InvalidTaskError(
             "Task has no 'service:' section; add one to use serve.")
+    from skypilot_tpu import admin_policy
+    task = admin_policy.apply(task, operation='serve_up')
     from skypilot_tpu.utils import common_utils
     common_utils.check_cluster_name_is_valid(service_name)
     created = serve_state.add_service(

@@ -128,14 +128,18 @@ def main(argv=None) -> None:
         step = trainer.step_fn()
         tokens_per_step = args.batch * args.seq
         flops_per_step = 6 * config.num_params * tokens_per_step
+        from skypilot_tpu import callbacks as skytpu_callback
+        skytpu_callback.init(total_steps=args.steps)  # no-op outside bench
         t_window = time.perf_counter()
         for i in range(start_step, args.steps):
+            skytpu_callback.step_begin()
             data_rng = jax.random.fold_in(jax.random.key(1), i)
             tokens = jax.random.randint(
                 data_rng, (args.batch, args.seq), 0, config.vocab_size)
             batch = trainer.shard_batch(
                 {'tokens': tokens, 'targets': jnp.roll(tokens, -1, axis=1)})
             state, metrics = step(state, batch)
+            skytpu_callback.step_end()
             if (i + 1) % args.log_every == 0:
                 loss = float(metrics['loss'])  # sync point
                 dt = time.perf_counter() - t_window

@@ -67,7 +67,8 @@ def ensure_controller_cluster(spec: ControllerSpec) -> 'Any':
                 if resources.cloud != 'local' else None)
     _, handle = execution.launch(
         task, cluster_name=spec.cluster_name, detach_run=True,
-        idle_minutes_to_autostop=autostop, stream_logs=False)
+        idle_minutes_to_autostop=autostop, stream_logs=False,
+        policy_operation='controller_launch')
     assert handle is not None, f'{spec.name} cluster failed to come up'
     return handle
 

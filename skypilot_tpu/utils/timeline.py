@@ -1,0 +1,94 @@
+"""Chrome-trace-event recorder for control-plane latency analysis.
+
+Counterpart of reference ``sky/utils/timeline.py`` (:22-60 — Event context
+manager + @event decorator, atexit JSON dump viewable in
+chrome://tracing / Perfetto). Recording is off unless ``SKYTPU_TIMELINE``
+is set (to a path, or ``1`` for the default under the state dir) — tracing
+must cost nothing on the hot path when disabled.
+"""
+from __future__ import annotations
+
+import atexit
+import functools
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, List, Optional
+
+
+_events: List[dict] = []
+_lock = threading.Lock()
+_registered = False
+
+
+def enabled() -> bool:
+    return bool(os.environ.get('SKYTPU_TIMELINE'))
+
+
+def _dump_path() -> str:
+    raw = os.environ.get('SKYTPU_TIMELINE', '')
+    if raw and raw != '1':
+        return os.path.expanduser(raw)
+    from skypilot_tpu import global_user_state
+    d = os.path.join(global_user_state.get_state_dir(), 'timeline')
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, f'trace-{os.getpid()}.json')
+
+
+def save(path: Optional[str] = None) -> Optional[str]:
+    """Write accumulated events in Chrome trace-event format."""
+    with _lock:
+        if not _events:
+            return None
+        events = list(_events)
+    path = path or _dump_path()
+    with open(path, 'w') as f:
+        json.dump({'traceEvents': events, 'displayTimeUnit': 'ms'}, f)
+    return path
+
+
+def _record(name: str, ph: str, ts_us: float, **extra: Any) -> None:
+    global _registered
+    evt = {'name': name, 'ph': ph, 'ts': ts_us, 'pid': os.getpid(),
+           'tid': threading.get_ident() % 2**31, **extra}
+    with _lock:
+        _events.append(evt)
+        if not _registered:
+            _registered = True
+            atexit.register(save)
+
+
+class Event:
+    """Context manager emitting a begin/end pair."""
+
+    def __init__(self, name: str):
+        self._name = name
+
+    def __enter__(self):
+        if enabled():
+            _record(self._name, 'B', time.time() * 1e6)
+        return self
+
+    def __exit__(self, *exc):
+        if enabled():
+            _record(self._name, 'E', time.time() * 1e6)
+        return False
+
+
+def event(name_or_fn: Any = None) -> Callable:
+    """Decorator: wrap a function in an Event named after it."""
+    def wrap(fn: Callable, name: str) -> Callable:
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not enabled():
+                return fn(*args, **kwargs)
+            with Event(name):
+                return fn(*args, **kwargs)
+        return wrapper
+
+    if callable(name_or_fn):
+        return wrap(name_or_fn,
+                    f'{name_or_fn.__module__}.{name_or_fn.__qualname__}')
+    return lambda fn: wrap(fn, name_or_fn
+                           or f'{fn.__module__}.{fn.__qualname__}')

@@ -1,0 +1,72 @@
+"""Console UX: spinner statuses, log-path hints, colored status names.
+
+Role of reference ``sky/utils/rich_utils.py`` + ``ux_utils.py`` (safe
+spinner statuses, 'To see detailed logs: ...' hints). Uses ``rich`` when
+available and stdout is a TTY; otherwise degrades to plain line prints so
+API-server logs and CI output stay clean.
+"""
+from __future__ import annotations
+
+import contextlib
+import sys
+from typing import Iterator, Optional
+
+_SPINNER = None  # single live spinner (rich refuses nested Live displays)
+
+
+@contextlib.contextmanager
+def status(message: str) -> Iterator[None]:
+    """Spinner while a long operation runs; plain print off-TTY."""
+    global _SPINNER
+    use_rich = sys.stdout.isatty() and _SPINNER is None
+    if use_rich:
+        try:
+            import rich.console
+            console = rich.console.Console()
+            with console.status(f'[bold cyan]{message}[/]') as live:
+                _SPINNER = live
+                try:
+                    yield
+                finally:
+                    _SPINNER = None
+            return
+        except ImportError:
+            pass
+    print(message, flush=True)
+    yield
+
+
+def update_status(message: str) -> None:
+    if _SPINNER is not None:
+        _SPINNER.update(f'[bold cyan]{message}[/]')
+    else:
+        print(message, flush=True)
+
+
+def log_path_hint(path: str, what: str = 'detailed logs') -> str:
+    return f'To see {what}: tail -f {path}'
+
+
+_STATUS_COLORS = {
+    'UP': 'green', 'RUNNING': 'green', 'SUCCEEDED': 'green',
+    'READY': 'green', 'ALIVE': 'green',
+    'INIT': 'yellow', 'PENDING': 'yellow', 'STARTING': 'yellow',
+    'PROVISIONING': 'yellow', 'RECOVERING': 'yellow', 'STOPPED': 'yellow',
+    'FAILED': 'red', 'FAILED_SETUP': 'red', 'FAILED_NO_RESOURCE': 'red',
+    'FAILED_CONTROLLER': 'red', 'CANCELLED': 'red', 'SHUTTING_DOWN': 'red',
+}
+_ANSI = {'green': '\033[32m', 'yellow': '\033[33m', 'red': '\033[31m'}
+
+
+def colorize_status(name: str) -> str:
+    """ANSI-color a status name on TTYs; pass through otherwise.
+
+    Accepts pre-padded input (lookup strips whitespace) so fixed-width
+    table columns survive the invisible escape codes.
+    """
+    if not sys.stdout.isatty():
+        return name
+    color = _STATUS_COLORS.get(name.strip())
+    if color is None:
+        return name
+    return f'{_ANSI[color]}{name}\033[0m'

@@ -1,0 +1,113 @@
+"""Admin policy hook, timeline tracer, ux helpers."""
+import json
+import sys
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import admin_policy
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import timeline
+from skypilot_tpu.utils import ux_utils
+
+
+# Policies importable by dotted path for _load_policy_class.
+class ForbidOnDemand(admin_policy.AdminPolicy):
+
+    @classmethod
+    def validate_and_mutate(cls, user_request):
+        for r in user_request.task.resources:
+            if not r.use_spot:
+                raise ValueError('on-demand forbidden by org policy')
+        return admin_policy.MutatedUserRequest(task=user_request.task)
+
+
+class ForceName(admin_policy.AdminPolicy):
+
+    @classmethod
+    def validate_and_mutate(cls, user_request):
+        user_request.task.name = 'policy-named'
+        return admin_policy.MutatedUserRequest(task=user_request.task)
+
+
+def _task(spot=False):
+    task = sky.Task(run='echo hi')
+    task.set_resources([sky.Resources(cloud='local', use_spot=spot)])
+    return task
+
+
+class TestAdminPolicy:
+
+    def test_no_policy_is_noop(self):
+        task = _task()
+        assert admin_policy.apply(task) is task
+
+    def test_policy_rejects(self, monkeypatch):
+        with config_lib.override(
+                {'admin_policy': f'{__name__}.ForbidOnDemand'}):
+            with pytest.raises(exceptions.AdminPolicyRejected,
+                               match='on-demand forbidden'):
+                admin_policy.apply(_task(spot=False))
+            # Spot passes.
+            admin_policy.apply(_task(spot=True))
+
+    def test_policy_mutates(self):
+        with config_lib.override({'admin_policy': f'{__name__}.ForceName'}):
+            task = admin_policy.apply(_task())
+            assert task.name == 'policy-named'
+
+    def test_bad_policy_path_errors(self):
+        with config_lib.override({'admin_policy': 'nonexistent.Nope'}):
+            with pytest.raises(exceptions.InvalidConfigError):
+                admin_policy.apply(_task())
+
+    def test_applied_on_launch(self):
+        with config_lib.override(
+                {'admin_policy': f'{__name__}.ForbidOnDemand'}):
+            with pytest.raises(exceptions.AdminPolicyRejected):
+                sky.launch(_task(spot=False), cluster_name='pol-test')
+
+
+class TestTimeline:
+
+    def test_disabled_records_nothing(self, monkeypatch):
+        monkeypatch.delenv('SKYTPU_TIMELINE', raising=False)
+        before = len(timeline._events)
+        with timeline.Event('x'):
+            pass
+        assert len(timeline._events) == before
+
+    def test_event_pairs_and_save(self, tmp_path, monkeypatch):
+        path = tmp_path / 'trace.json'
+        monkeypatch.setenv('SKYTPU_TIMELINE', str(path))
+
+        @timeline.event
+        def traced():
+            return 42
+
+        assert traced() == 42
+        with timeline.Event('manual'):
+            pass
+        saved = timeline.save(str(path))
+        assert saved == str(path)
+        data = json.loads(path.read_text())
+        names = [e['name'] for e in data['traceEvents']]
+        assert any('traced' in n for n in names)
+        assert 'manual' in names
+        phases = [e['ph'] for e in data['traceEvents']]
+        assert phases.count('B') == phases.count('E')
+
+
+class TestUx:
+
+    def test_status_plain_fallback(self, capsys):
+        with ux_utils.status('Provisioning...'):
+            pass
+        assert 'Provisioning...' in capsys.readouterr().out
+
+    def test_colorize_passthrough_off_tty(self):
+        assert ux_utils.colorize_status('UP') == 'UP'  # pytest: not a tty
+
+    def test_log_path_hint(self):
+        assert 'tail -f /x/y.log' in ux_utils.log_path_hint('/x/y.log')

@@ -1,0 +1,65 @@
+"""Benchmark tool + callback lib: candidate fan-out on the local cloud,
+summary collection, $/step report."""
+import json
+import os
+import sys
+import time
+
+import skypilot_tpu as sky
+from skypilot_tpu.benchmark import state as bench_state
+from skypilot_tpu.benchmark import utils as bench_utils
+from skypilot_tpu import callbacks as skytpu_callback
+
+
+class TestCallback:
+
+    def test_noop_without_env(self, monkeypatch):
+        monkeypatch.delenv('SKYTPU_BENCHMARK_LOG_DIR', raising=False)
+        assert skytpu_callback.init() is False
+        with skytpu_callback.step():
+            pass  # must not raise
+
+    def test_summary_written(self, tmp_path):
+        assert skytpu_callback.init(total_steps=12,
+                                    log_dir=str(tmp_path)) is True
+        for _ in range(12):
+            with skytpu_callback.step():
+                time.sleep(0.01)
+        data = json.loads(
+            (tmp_path / skytpu_callback.SUMMARY_FILE).read_text())
+        assert data['num_steps'] == 12
+        assert data['seconds_per_step'] >= 0.005
+
+
+class TestBenchE2E:
+
+    def test_bench_two_local_candidates(self):
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        script = (
+            f'{sys.executable} -c "'
+            'import skypilot_tpu.callbacks as cb\n'
+            'cb.init(total_steps=10)\n'
+            'import time\n'
+            'for _ in range(10):\n'
+            '    cb.step_begin(); time.sleep(0.02); cb.step_end()\n'
+            '"')
+        task = sky.Task(run=script, envs={'PYTHONPATH': repo})
+        results = bench_utils.launch(
+            task, 'bt', [sky.Resources(cloud='local'),
+                         sky.Resources(cloud='local')])
+        assert all('job_id' in r for r in results), results
+        # Poll until both summaries land.
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            report = bench_utils.get_report('bt')
+            if all(r['seconds_per_step'] for r in report):
+                break
+            time.sleep(1.0)
+        assert len(report) == 2
+        for r in report:
+            assert r['num_steps'] == 10
+            assert 0.01 < r['seconds_per_step'] < 5.0
+            assert r['cost_per_step'] == 0.0  # local cloud is free
+        assert bench_utils.down('bt')
+        bench_utils.delete('bt')
+        assert bench_state.get_results('bt') == []

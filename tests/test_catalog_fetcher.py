@@ -1,0 +1,107 @@
+"""Billing-catalog fetcher: SKU parsing, pagination, live-price override,
+offline fallback — against a fake Billing API transport."""
+import pytest
+
+from skypilot_tpu.catalog.fetchers import fetch_gcp
+
+
+def _sku(desc, usage_type, regions, units=0, nanos=0, unit='h'):
+    return {
+        'description': desc,
+        'category': {'usageType': usage_type},
+        'serviceRegions': regions,
+        'pricingInfo': [{'pricingExpression': {
+            'usageUnit': unit,
+            'tieredRates': [{'unitPrice': {'currencyCode': 'USD',
+                                           'units': str(units),
+                                           'nanos': nanos}}],
+        }}],
+    }
+
+
+class FakeBillingTransport:
+    """Services list + paginated TPU SKUs."""
+
+    def __init__(self, skus):
+        self.skus = skus
+        self.calls = []
+
+    def request(self, method, url, json_body=None, params=None):
+        self.calls.append((method, url, dict(params or {})))
+        if url.endswith('/services'):
+            return {'services': [
+                {'name': 'services/ABC-COMPUTE',
+                 'displayName': 'Compute Engine'},
+                {'name': 'services/E000-TPU', 'displayName': 'Cloud TPU'},
+            ]}
+        assert 'services/E000-TPU/skus' in url
+        # Two pages to prove pagination.
+        if (params or {}).get('pageToken') == 'page2':
+            return {'skus': self.skus[1:]}
+        return {'skus': self.skus[:1], 'nextPageToken': 'page2'}
+
+
+SKUS = [
+    _sku('Cloud TPU v5e chip-hour', 'OnDemand', ['us-west4'],
+         units=1, nanos=560_000_000),                      # $1.56
+    _sku('Tpu-v5 Lite Preemptible', 'Preemptible', ['us-west4'],
+         nanos=480_000_000),                               # $0.48
+    _sku('Cloud TPU v5e commitment 1yr', 'Commit1Yr', ['us-west4'],
+         units=1),                                         # skipped
+    _sku('Trillium (v6e) pod', 'OnDemand', ['us-east5'],
+         units=3, nanos=100_000_000),                      # $3.10
+    _sku('TPU v4 storage GiB-month', 'OnDemand', ['us-central2'],
+         units=2, unit='GiBy.mo'),                         # wrong unit
+]
+
+
+def test_parse_and_pagination():
+    transport = FakeBillingTransport(SKUS)
+    prices = fetch_gcp.fetch_tpu_prices(transport)
+    assert prices[('v5e', 'us-west4')] == {'OnDemand': 1.56,
+                                           'Preemptible': 0.48}
+    assert prices[('v6e', 'us-east5')] == {'OnDemand': 3.10}
+    assert ('v4', 'us-central2') not in prices  # non-hour unit filtered
+    # Pagination: two sku pages fetched.
+    sku_calls = [c for c in transport.calls if 'skus' in c[1]]
+    assert len(sku_calls) == 2
+    assert sku_calls[1][2].get('pageToken') == 'page2'
+
+
+def test_live_prices_override_static_rows():
+    live = {('v5e', 'us-west4'): {'OnDemand': 9.99, 'Preemptible': 1.11}}
+    rows = fetch_gcp.generate_tpu_rows(live)
+    by_key = {(r['slice'], r['zone']): r for r in rows}
+    live_row = by_key[('tpu-v5e-8', 'us-west4-a')]
+    assert live_row['price'] == pytest.approx(9.99 * 8)
+    assert live_row['spot_price'] == pytest.approx(1.11 * 8)
+    # A zone the live fetch didn't cover keeps the static price.
+    static_base, _ = fetch_gcp._TPU_PRICE_PER_CHIP_HOUR['v5e']
+    other = by_key[('tpu-v5e-8', 'us-central1-a')]
+    assert other['price'] == pytest.approx(static_base * 8)
+
+
+def test_refresh_offline_fallback(tmp_path, monkeypatch):
+    monkeypatch.setattr(fetch_gcp, 'DATA_DIR', str(tmp_path))
+
+    class ExplodingTransport:
+        def request(self, *a, **k):
+            raise ConnectionError('no egress')
+
+    source = fetch_gcp.refresh(online=True,
+                               transport=ExplodingTransport())
+    assert source == 'offline'
+    assert (tmp_path / 'gcp_tpus.csv').exists()
+    assert (tmp_path / 'gcp_vms.csv').exists()
+
+
+def test_refresh_online(tmp_path, monkeypatch):
+    monkeypatch.setattr(fetch_gcp, 'DATA_DIR', str(tmp_path))
+    source = fetch_gcp.refresh(online=True,
+                               transport=FakeBillingTransport(SKUS))
+    assert source == 'online'
+    import csv
+    with open(tmp_path / 'gcp_tpus.csv') as f:
+        rows = {(r['slice'], r['zone']): r for r in csv.DictReader(f)}
+    assert float(rows[('tpu-v5e-8', 'us-west4-a')]['price']) == \
+        pytest.approx(1.56 * 8)

@@ -1,0 +1,40 @@
+// fuse-proxy wire helpers: line-framed requests over unix sockets plus
+// SCM_RIGHTS fd passing. C++ counterpart of the reference's Go addon
+// (reference addons/fuse-proxy/pkg/{client,server,common}) — the protocol
+// here is original: one request per connection,
+//
+//   client -> server:   "MOUNT\n" | "UNMOUNT\n" | "UNMOUNT_LAZY\n"
+//                       "OPTS <mount options>\n"      (MOUNT only)
+//                       "PATH <absolute mountpoint>\n"
+//                       "END\n"
+//   server -> client:   "OK\n"  (with the /dev/fuse fd attached via
+//                                SCM_RIGHTS for MOUNT)
+//                     | "ERR <message>\n"
+#pragma once
+
+#include <optional>
+#include <string>
+
+namespace fuse_proxy {
+
+// Blocking full-buffer send; returns false on error.
+bool send_all(int fd, const std::string& data);
+
+// Read until '\n' (consumed, not returned). nullopt on EOF/error.
+std::optional<std::string> recv_line(int fd);
+
+// Send `payload` with `fd_to_send` attached as SCM_RIGHTS ancillary data.
+bool send_with_fd(int sock, const std::string& payload, int fd_to_send);
+
+// Receive up to `max_len` bytes and an optional fd. Returns the received
+// byte count (-1 on error); *received_fd is -1 when no fd arrived.
+int recv_with_fd(int sock, char* buf, size_t max_len, int* received_fd);
+
+// Connect to a unix stream socket path. -1 on error.
+int connect_unix(const std::string& path);
+
+// Bind + listen on a unix stream socket path (unlinks stale file). -1 on
+// error.
+int listen_unix(const std::string& path);
+
+}  // namespace fuse_proxy

@@ -1,0 +1,290 @@
+// fuse-proxy-server: privileged side of rootless FUSE for containers.
+//
+// Runs as a privileged DaemonSet on each node, listening on a unix socket
+// in a host directory shared with unprivileged task Pods. For each MOUNT
+// request it opens /dev/fuse, performs the mount(2) the client is not
+// allowed to do, and passes the /dev/fuse fd back over SCM_RIGHTS; the
+// shim then hands that fd to libfuse exactly as real fusermount would.
+//
+// C++ counterpart of the reference's Go fusermount-server
+// (reference addons/fuse-proxy/cmd/fusermount-server) — implementation and
+// protocol are original.
+//
+// --fake mode keeps every privileged syscall out: mounts are recorded to a
+// log file and the returned "fuse fd" is /dev/null. This is the test seam
+// (mirrors the repo-wide pattern of faking the cloud control plane).
+#include <fcntl.h>
+#include <pwd.h>
+#include <sys/mount.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <iostream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "proto.h"
+
+namespace {
+
+using fuse_proxy::recv_line;
+using fuse_proxy::send_all;
+using fuse_proxy::send_with_fd;
+
+struct Request {
+  std::string op;    // MOUNT | UNMOUNT | UNMOUNT_LAZY
+  std::string opts;  // raw -o string from the shim
+  std::string path;  // absolute mountpoint
+};
+
+// Only forward mount options that are meaningful and safe for a fuse
+// mount's data string; everything else (e.g. setuid tricks) is dropped.
+const char* kAllowedOpts[] = {"allow_other", "default_permissions", "ro",
+                              "rw",          "nosuid",              "nodev",
+                              "noexec",      "async",               "sync"};
+const char* kAllowedPrefixes[] = {"max_read=", "blksize=", "subtype=",
+                                  "fsname="};
+
+bool opt_allowed(const std::string& opt) {
+  for (const char* a : kAllowedOpts)
+    if (opt == a) return true;
+  for (const char* p : kAllowedPrefixes)
+    if (opt.rfind(p, 0) == 0) return true;
+  return false;
+}
+
+struct ParsedOpts {
+  std::string data_extra;  // filtered, comma-joined (no fd/rootmode yet)
+  std::string fsname = "fuse-proxy";
+  std::string subtype;
+  unsigned long flags = MS_NOSUID | MS_NODEV;
+};
+
+ParsedOpts parse_opts(const std::string& raw) {
+  ParsedOpts out;
+  std::stringstream ss(raw);
+  std::string opt;
+  while (std::getline(ss, opt, ',')) {
+    if (opt.empty() || !opt_allowed(opt)) continue;
+    if (opt == "ro") {
+      out.flags |= MS_RDONLY;
+      continue;
+    }
+    if (opt == "rw") continue;
+    if (opt.rfind("fsname=", 0) == 0) {
+      out.fsname = opt.substr(7);
+      continue;
+    }
+    if (opt.rfind("subtype=", 0) == 0) {
+      out.subtype = opt.substr(8);
+      continue;
+    }
+    if (!out.data_extra.empty()) out.data_extra += ",";
+    out.data_extra += opt;
+  }
+  return out;
+}
+
+class Mounter {
+ public:
+  virtual ~Mounter() = default;
+  // Returns the fd to pass back (the opened /dev/fuse), or -1 + error.
+  virtual int MountFuse(const Request& req, std::string* error) = 0;
+  virtual bool Unmount(const Request& req, bool lazy, std::string* error) = 0;
+};
+
+class RealMounter : public Mounter {
+ public:
+  int MountFuse(const Request& req, std::string* error) override {
+    struct stat st {};
+    if (::stat(req.path.c_str(), &st) != 0) {
+      *error = "mountpoint does not exist: " + req.path;
+      return -1;
+    }
+    int fuse_fd = ::open("/dev/fuse", O_RDWR | O_CLOEXEC);
+    if (fuse_fd < 0) {
+      *error = std::string("open /dev/fuse: ") + std::strerror(errno);
+      return -1;
+    }
+    ParsedOpts opts = parse_opts(req.opts);
+    // rootmode: the mountpoint's file type bits, octal (fuse requires it).
+    char data[512];
+    std::snprintf(data, sizeof(data), "fd=%d,rootmode=%o,user_id=%u,gid=%u%s%s",
+                  fuse_fd, st.st_mode & S_IFMT, ::getuid(), ::getgid(),
+                  opts.data_extra.empty() ? "" : ",", opts.data_extra.c_str());
+    std::string fstype = "fuse";
+    if (!opts.subtype.empty()) fstype += "." + opts.subtype;
+    if (::mount(opts.fsname.c_str(), req.path.c_str(), fstype.c_str(),
+                opts.flags, data) != 0) {
+      *error = std::string("mount: ") + std::strerror(errno);
+      ::close(fuse_fd);
+      return -1;
+    }
+    return fuse_fd;
+  }
+
+  bool Unmount(const Request& req, bool lazy, std::string* error) override {
+    if (::umount2(req.path.c_str(), lazy ? MNT_DETACH : 0) != 0) {
+      *error = std::string("umount2: ") + std::strerror(errno);
+      return false;
+    }
+    return true;
+  }
+};
+
+class FakeMounter : public Mounter {
+ public:
+  explicit FakeMounter(std::string log_path) : log_path_(std::move(log_path)) {}
+
+  int MountFuse(const Request& req, std::string* error) override {
+    log("MOUNT " + req.path + " opts=" + req.opts);
+    int fd = ::open("/dev/null", O_RDWR | O_CLOEXEC);
+    if (fd < 0) *error = "open /dev/null failed";
+    return fd;
+  }
+
+  bool Unmount(const Request& req, bool lazy, std::string* error) override {
+    (void)error;
+    log(std::string(lazy ? "UNMOUNT_LAZY " : "UNMOUNT ") + req.path);
+    return true;
+  }
+
+ private:
+  void log(const std::string& line) {
+    std::ofstream f(log_path_, std::ios::app);
+    f << line << "\n";
+  }
+  std::string log_path_;
+};
+
+bool read_request(int conn, Request* req, std::string* error) {
+  auto op = recv_line(conn);
+  if (!op) {
+    *error = "no request op";
+    return false;
+  }
+  req->op = *op;
+  if (req->op != "MOUNT" && req->op != "UNMOUNT" &&
+      req->op != "UNMOUNT_LAZY") {
+    *error = "unknown op: " + req->op;
+    return false;
+  }
+  while (true) {
+    auto line = recv_line(conn);
+    if (!line) {
+      *error = "truncated request";
+      return false;
+    }
+    if (*line == "END") break;
+    if (line->rfind("OPTS ", 0) == 0) {
+      req->opts = line->substr(5);
+    } else if (line->rfind("PATH ", 0) == 0) {
+      req->path = line->substr(5);
+    } else {
+      *error = "unknown field: " + *line;
+      return false;
+    }
+  }
+  if (req->path.empty() || req->path[0] != '/') {
+    *error = "PATH must be absolute";
+    return false;
+  }
+  // Reject path traversal in the (attacker-controllable) mountpoint.
+  if (req->path.find("/../") != std::string::npos ||
+      (req->path.size() >= 3 &&
+       req->path.compare(req->path.size() - 3, 3, "/..") == 0)) {
+    *error = "PATH must not contain ..";
+    return false;
+  }
+  return true;
+}
+
+void handle_conn(int conn, Mounter* mounter, const std::string& allow_prefix) {
+  Request req;
+  std::string error;
+  if (!read_request(conn, &req, &error)) {
+    send_all(conn, "ERR " + error + "\n");
+    return;
+  }
+  if (!allow_prefix.empty() && req.path.rfind(allow_prefix, 0) != 0) {
+    send_all(conn, "ERR mountpoint outside allowed prefix " + allow_prefix +
+                       "\n");
+    return;
+  }
+  if (req.op == "MOUNT") {
+    int fd = mounter->MountFuse(req, &error);
+    if (fd < 0) {
+      send_all(conn, "ERR " + error + "\n");
+      return;
+    }
+    send_with_fd(conn, "OK\n", fd);
+    ::close(fd);
+  } else {
+    if (!mounter->Unmount(req, req.op == "UNMOUNT_LAZY", &error)) {
+      send_all(conn, "ERR " + error + "\n");
+      return;
+    }
+    send_all(conn, "OK\n");
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string socket_path = "/run/fuse-proxy/fuse-proxy.sock";
+  std::string allow_prefix;
+  std::string fake_log;
+  bool fake = false;
+  bool once = false;
+  for (int i = 1; i < argc; ++i) {
+    std::string arg = argv[i];
+    if (arg == "--socket" && i + 1 < argc) {
+      socket_path = argv[++i];
+    } else if (arg == "--allow-prefix" && i + 1 < argc) {
+      allow_prefix = argv[++i];
+    } else if (arg == "--fake") {
+      fake = true;
+    } else if (arg == "--fake-log" && i + 1 < argc) {
+      fake_log = argv[++i];
+    } else if (arg == "--once") {
+      once = true;  // serve one connection then exit (tests)
+    } else {
+      std::cerr << "usage: fuse-proxy-server [--socket PATH] "
+                   "[--allow-prefix PATH] [--fake --fake-log PATH] [--once]\n";
+      return 2;
+    }
+  }
+
+  RealMounter real;
+  FakeMounter fake_mounter(fake_log.empty() ? "/dev/null" : fake_log);
+  Mounter* mounter = fake ? static_cast<Mounter*>(&fake_mounter) : &real;
+
+  int listen_fd = fuse_proxy::listen_unix(socket_path);
+  if (listen_fd < 0) {
+    std::cerr << "fuse-proxy-server: cannot listen on " << socket_path << ": "
+              << std::strerror(errno) << "\n";
+    return 1;
+  }
+  ::chmod(socket_path.c_str(), 0666);  // task pods run as arbitrary uids
+  std::cerr << "fuse-proxy-server: listening on " << socket_path
+            << (fake ? " (fake mode)" : "") << "\n";
+
+  while (true) {
+    int conn = ::accept(listen_fd, nullptr, nullptr);
+    if (conn < 0) {
+      if (errno == EINTR) continue;
+      std::cerr << "accept: " << std::strerror(errno) << "\n";
+      return 1;
+    }
+    handle_conn(conn, mounter, allow_prefix);
+    ::close(conn);
+    if (once) return 0;
+  }
+}

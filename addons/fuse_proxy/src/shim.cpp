@@ -1,0 +1,108 @@
+// fusermount-shim: masks `fusermount`/`fusermount3` inside unprivileged
+// containers. libfuse execs fusermount with `-o <opts> <mountpoint>` and
+// the env var _FUSE_COMMFD (a unix-socket fd) on which it expects the
+// opened /dev/fuse fd back via SCM_RIGHTS. This shim forwards the request
+// to the privileged fuse-proxy-server over $FUSE_PROXY_SOCKET, receives
+// the fd the server obtained by mounting, and relays it to libfuse on
+// _FUSE_COMMFD — byte-compatible with real fusermount from the caller's
+// point of view.
+//
+// C++ counterpart of the reference's Go fusermount-shim
+// (reference addons/fuse-proxy/cmd/fusermount-shim); original code.
+#include <limits.h>
+#include <stdlib.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <iostream>
+#include <string>
+
+#include "proto.h"
+
+namespace {
+
+const char* kDefaultSocket = "/run/fuse-proxy/fuse-proxy.sock";
+
+int fail(const std::string& msg) {
+  std::cerr << "fusermount-shim: " << msg << "\n";
+  return 1;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string opts;
+  std::string mountpoint;
+  bool unmount = false;
+  bool lazy = false;
+  for (int i = 1; i < argc; ++i) {
+    std::string arg = argv[i];
+    if (arg == "-o" && i + 1 < argc) {
+      if (!opts.empty()) opts += ",";
+      opts += argv[++i];
+    } else if (arg == "-u") {
+      unmount = true;
+    } else if (arg == "-z") {
+      lazy = true;
+    } else if (arg == "-q") {
+      // quiet: accepted for fusermount compatibility
+    } else if (arg == "--") {
+      if (i + 1 < argc) mountpoint = argv[++i];
+    } else if (!arg.empty() && arg[0] != '-') {
+      mountpoint = arg;
+    } else {
+      return fail("unsupported flag: " + arg);
+    }
+  }
+  if (mountpoint.empty()) return fail("no mountpoint given");
+
+  // Resolve to an absolute path: the server runs in another mount
+  // namespace view of the shared host path, but relative paths are
+  // meaningless to it.
+  char resolved[PATH_MAX];
+  if (::realpath(mountpoint.c_str(), resolved) == nullptr)
+    return fail("cannot resolve mountpoint: " + mountpoint);
+
+  const char* socket_env = ::getenv("FUSE_PROXY_SOCKET");
+  std::string socket_path = socket_env ? socket_env : kDefaultSocket;
+  int sock = fuse_proxy::connect_unix(socket_path);
+  if (sock < 0)
+    return fail("cannot connect to fuse-proxy server at " + socket_path);
+
+  std::string req;
+  if (unmount) {
+    req = lazy ? "UNMOUNT_LAZY\n" : "UNMOUNT\n";
+  } else {
+    req = "MOUNT\nOPTS " + opts + "\n";
+  }
+  req += "PATH " + std::string(resolved) + "\nEND\n";
+  if (!fuse_proxy::send_all(sock, req)) return fail("request send failed");
+
+  char buf[4096];
+  int fuse_fd = -1;
+  int n = fuse_proxy::recv_with_fd(sock, buf, sizeof(buf) - 1, &fuse_fd);
+  if (n <= 0) return fail("no response from server");
+  buf[n] = '\0';
+  std::string resp(buf);
+  if (resp.rfind("OK", 0) != 0) {
+    if (fuse_fd >= 0) ::close(fuse_fd);
+    return fail("server: " + resp);
+  }
+
+  if (unmount) return 0;
+
+  if (fuse_fd < 0) return fail("server sent OK but no fuse fd");
+  const char* commfd_env = ::getenv("_FUSE_COMMFD");
+  if (commfd_env == nullptr) {
+    ::close(fuse_fd);
+    return fail("_FUSE_COMMFD not set (not called by libfuse?)");
+  }
+  int commfd = ::atoi(commfd_env);
+  // libfuse expects exactly one byte of payload with the fd attached.
+  if (!fuse_proxy::send_with_fd(commfd, std::string(1, '\0'), fuse_fd)) {
+    ::close(fuse_fd);
+    return fail("relaying fuse fd to _FUSE_COMMFD failed");
+  }
+  ::close(fuse_fd);
+  return 0;
+}

@@ -47,6 +47,10 @@ def add_benchmark(benchmark: str, task_name: Optional[str]) -> None:
     conn = _db()
     conn.execute('INSERT OR REPLACE INTO benchmarks VALUES (?,?,?)',
                  (benchmark, task_name, time.time()))
+    # Relaunch under an existing name starts fresh: stale candidate rows
+    # from a previous run must not survive into the new report.
+    conn.execute('DELETE FROM benchmark_results WHERE benchmark=?',
+                 (benchmark,))
     conn.commit()
 
 

@@ -86,19 +86,21 @@ def update_summaries(benchmark: str) -> None:
     from skypilot_tpu import backends
     backend = backends.SliceBackend()
     for row in state.get_results(benchmark):
-        record = global_user_state.get_cluster_from_name(row['cluster'])
-        if record is None or record['handle'] is None:
-            continue
-        path = (f'{rt_constants.WORKDIR}/{_REMOTE_LOG_DIR}/'
-                'benchmark_summary.json')
-        head = backend._runners(record['handle'])[0]
-        res = head.run(f'cat {path}', timeout=60)
-        if res.returncode != 0:
-            continue
         try:
+            record = global_user_state.get_cluster_from_name(row['cluster'])
+            if record is None or record['handle'] is None:
+                continue
+            path = (f'{rt_constants.WORKDIR}/{_REMOTE_LOG_DIR}/'
+                    'benchmark_summary.json')
+            head = backend._runners(record['handle'])[0]
+            res = head.run(f'cat {path}', timeout=60)
+            if res.returncode != 0:
+                continue
             state.set_summary(benchmark, row['cluster'],
                               json.loads(res.stdout.strip()))
-        except (json.JSONDecodeError, ValueError):
+        except Exception:  # noqa: BLE001 — one hung/broken candidate
+            # (SSH timeout, empty host list, bad JSON) must not take down
+            # the whole report; its row just stays summary-less.
             continue
 
 

@@ -44,7 +44,7 @@ def init(total_steps: Optional[int] = None,
         'total_steps': total_steps,
         'num_steps': 0,
         'start_ts': time.time(),
-        'first_step_ts': None,
+        'first_step_end_ts': None,
         'last_step_ts': None,
     }
     _write()
@@ -57,8 +57,12 @@ def _write() -> None:
     tmp = path + '.tmp'
     summary = {k: v for k, v in _state.items() if k != 'log_dir'}
     if _state['num_steps'] > 1:
+        # Steady-state rate: interval from END of step 1 to END of step N
+        # spans exactly N-1 steps and excludes step-1 compile/warm-up
+        # (which would otherwise skew $/step against slow-compiling
+        # configs).
         summary['seconds_per_step'] = (
-            (_state['last_step_ts'] - _state['first_step_ts'])
+            (_state['last_step_ts'] - _state['first_step_end_ts'])
             / (_state['num_steps'] - 1))
     with open(tmp, 'w') as f:
         json.dump(summary, f)
@@ -66,8 +70,7 @@ def _write() -> None:
 
 
 def step_begin() -> None:
-    if _state is not None and _state['first_step_ts'] is None:
-        _state['first_step_ts'] = time.time()
+    pass  # kept for API symmetry; timing anchors on step ends
 
 
 def step_end() -> None:
@@ -75,6 +78,8 @@ def step_end() -> None:
         return
     _state['num_steps'] += 1
     _state['last_step_ts'] = time.time()
+    if _state['first_step_end_ts'] is None:
+        _state['first_step_end_ts'] = _state['last_step_ts']
     if _state['num_steps'] % _SUMMARY_EVERY == 0 or \
             _state['num_steps'] == _state.get('total_steps'):
         _write()

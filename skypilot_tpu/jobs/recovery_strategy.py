@@ -67,7 +67,12 @@ class StrategyExecutor:
             try:
                 job_id, handle = execution.launch(
                     self.task, cluster_name=self.cluster_name,
-                    detach_run=True, stream_logs=False)
+                    detach_run=True, stream_logs=False,
+                    # The policy already admitted this task client-side as
+                    # 'jobs_launch'; keep that name for controller-side
+                    # (re)launches so operation-scoped policies don't
+                    # misclassify recovery launches as plain 'launch'.
+                    policy_operation='jobs_launch')
                 if handle is not None:
                     self.last_launched = handle.launched_resources
                 return job_id

@@ -114,8 +114,11 @@ class ReplicaManager:
             task = task_lib.Task.from_yaml_config(dict(self.task_yaml))
             task.update_envs({'SKYTPU_SERVE_REPLICA_PORT': str(port),
                               'SKYTPU_SERVE_REPLICA_ID': str(replica_id)})
+            # Policy already admitted the service task at `serve up`; keep
+            # the operation name for replica (re)launches.
             _, handle = execution.launch(task, cluster_name=cluster,
-                                         detach_run=True, stream_logs=False)
+                                         detach_run=True, stream_logs=False,
+                                         policy_operation='serve_up')
             from skypilot_tpu import provision as provision_lib
             # Probes and LB traffic come from outside the replica's network:
             # the serving port must be reachable (reference opens ports via

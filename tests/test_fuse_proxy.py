@@ -1,0 +1,145 @@
+"""C++ fuse-proxy addon: protocol + SCM_RIGHTS fd relay, unprivileged.
+
+Builds the addon with make (g++), runs the server in --fake mode (no
+privileged syscalls), and drives the fusermount-shim exactly as libfuse
+would: exec with -o/-u argv and a _FUSE_COMMFD socketpair, expecting the
+fuse fd back via SCM_RIGHTS.
+"""
+import array
+import os
+import shutil
+import socket
+import subprocess
+import time
+
+import pytest
+
+ADDON_DIR = os.path.join(os.path.dirname(__file__), '..', 'addons',
+                         'fuse_proxy')
+BIN = os.path.join(ADDON_DIR, 'bin')
+
+pytestmark = pytest.mark.skipif(shutil.which('g++') is None,
+                                reason='no C++ toolchain')
+
+
+@pytest.fixture(scope='module')
+def binaries():
+    subprocess.run(['make', '-C', ADDON_DIR], check=True,
+                   capture_output=True)
+    return {
+        'shim': os.path.join(BIN, 'fusermount-shim'),
+        'server': os.path.join(BIN, 'fuse-proxy-server'),
+    }
+
+
+@pytest.fixture
+def server(binaries, tmp_path):
+    sock = str(tmp_path / 'proxy.sock')
+    log = str(tmp_path / 'mounts.log')
+    proc = subprocess.Popen(
+        [binaries['server'], '--socket', sock, '--fake', '--fake-log', log])
+    deadline = time.time() + 10
+    while not os.path.exists(sock):
+        assert time.time() < deadline, 'server socket never appeared'
+        assert proc.poll() is None, 'server died at startup'
+        time.sleep(0.05)
+    yield {'socket': sock, 'log': log}
+    proc.terminate()
+    proc.wait(timeout=10)
+
+
+def _recv_fd(sock):
+    msg, ancdata, _, _ = sock.recvmsg(16, socket.CMSG_SPACE(4))
+    for level, type_, data in ancdata:
+        if level == socket.SOL_SOCKET and type_ == socket.SCM_RIGHTS:
+            return msg, array.array('i', data[:4])[0]
+    return msg, None
+
+
+def test_mount_relays_fd(binaries, server, tmp_path):
+    mnt = tmp_path / 'mnt'
+    mnt.mkdir()
+    parent, child = socket.socketpair(socket.AF_UNIX, socket.SOCK_STREAM)
+    env = dict(os.environ,
+               FUSE_PROXY_SOCKET=server['socket'],
+               _FUSE_COMMFD=str(child.fileno()))
+    rc = subprocess.run(
+        [binaries['shim'], '-o', 'rw,nosuid,nodev,allow_other,'
+         'subtype=gcsfuse', str(mnt)],
+        env=env, pass_fds=[child.fileno()], capture_output=True, text=True)
+    assert rc.returncode == 0, rc.stderr
+    payload, fd = _recv_fd(parent)
+    assert payload == b'\x00'          # libfuse's expected 1-byte payload
+    assert fd is not None and fd >= 0  # the (fake) /dev/fuse fd
+    os.write(fd, b'x')                 # /dev/null in fake mode: writable
+    os.close(fd)
+    with open(server['log']) as f:
+        log = f.read()
+    assert f'MOUNT {mnt}' in log
+    assert 'allow_other' in log
+    parent.close()
+    child.close()
+
+
+def test_unmount(binaries, server, tmp_path):
+    mnt = tmp_path / 'mnt2'
+    mnt.mkdir()
+    env = dict(os.environ, FUSE_PROXY_SOCKET=server['socket'])
+    rc = subprocess.run([binaries['shim'], '-u', '-z', str(mnt)], env=env,
+                        capture_output=True, text=True)
+    assert rc.returncode == 0, rc.stderr
+    with open(server['log']) as f:
+        assert f'UNMOUNT_LAZY {mnt}' in f.read()
+
+
+def test_relative_mountpoint_resolved(binaries, server, tmp_path):
+    mnt = tmp_path / 'relmnt'
+    mnt.mkdir()
+    parent, child = socket.socketpair(socket.AF_UNIX, socket.SOCK_STREAM)
+    env = dict(os.environ,
+               FUSE_PROXY_SOCKET=server['socket'],
+               _FUSE_COMMFD=str(child.fileno()))
+    rc = subprocess.run([binaries['shim'], '-o', 'rw', 'relmnt'],
+                        env=env, cwd=str(tmp_path),
+                        pass_fds=[child.fileno()],
+                        capture_output=True, text=True)
+    assert rc.returncode == 0, rc.stderr
+    _, fd = _recv_fd(parent)
+    assert fd is not None
+    os.close(fd)
+    with open(server['log']) as f:
+        assert f'MOUNT {mnt}' in f.read()  # absolute path reached server
+    parent.close()
+    child.close()
+
+
+def test_missing_mountpoint_errors(binaries, server, tmp_path):
+    env = dict(os.environ, FUSE_PROXY_SOCKET=server['socket'])
+    rc = subprocess.run(
+        [binaries['shim'], '-o', 'rw', str(tmp_path / 'nope')],
+        env=env, capture_output=True, text=True)
+    assert rc.returncode != 0
+    assert 'cannot resolve mountpoint' in rc.stderr
+
+
+def test_server_rejects_outside_allow_prefix(binaries, tmp_path):
+    sock = str(tmp_path / 'p.sock')
+    log = str(tmp_path / 'l.log')
+    proc = subprocess.Popen(
+        [binaries['server'], '--socket', sock, '--fake', '--fake-log', log,
+         '--allow-prefix', '/data/'])
+    try:
+        deadline = time.time() + 10
+        while not os.path.exists(sock):
+            assert time.time() < deadline
+            time.sleep(0.05)
+        mnt = tmp_path / 'mnt3'
+        mnt.mkdir()
+        env = dict(os.environ, FUSE_PROXY_SOCKET=sock)
+        rc = subprocess.run([binaries['shim'], '-u', str(mnt)], env=env,
+                            capture_output=True, text=True)
+        assert rc.returncode != 0
+        assert 'allowed prefix' in rc.stderr
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)

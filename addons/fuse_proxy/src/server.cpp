@@ -14,12 +14,16 @@
 // log file and the returned "fuse fd" is /dev/null. This is the test seam
 // (mirrors the repo-wide pattern of faking the cloud control plane).
 #include <fcntl.h>
+#include <limits.h>
 #include <pwd.h>
 #include <sys/mount.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
+#include <sys/time.h>
 #include <sys/wait.h>
 #include <unistd.h>
+
+#include <cstdlib>
 
 #include <cerrno>
 #include <cstdio>
@@ -196,10 +200,19 @@ bool read_request(int conn, Request* req, std::string* error) {
     *error = "PATH must be absolute";
     return false;
   }
-  // Reject path traversal in the (attacker-controllable) mountpoint.
-  if (req->path.find("/../") != std::string::npos ||
-      (req->path.size() >= 3 &&
-       req->path.compare(req->path.size() - 3, 3, "/..") == 0)) {
+  // Canonicalize SERVER-side: the client's realpath cannot be trusted (a
+  // raw-protocol client skips the shim entirely), and a symlink like
+  // /data/evil -> /usr/bin must not smuggle a mount past --allow-prefix.
+  // This also collapses any ".." components.
+  char resolved[PATH_MAX];
+  if (::realpath(req->path.c_str(), resolved) != nullptr) {
+    req->path = resolved;
+  } else if (req->op == "MOUNT") {
+    *error = "cannot resolve PATH: " + req->path;
+    return false;
+  } else if (req->path.find("..") != std::string::npos) {
+    // UNMOUNT of a dead FUSE mountpoint can fail realpath (ENOTCONN);
+    // accept the raw path but never with traversal components.
     *error = "PATH must not contain ..";
     return false;
   }
@@ -213,10 +226,16 @@ void handle_conn(int conn, Mounter* mounter, const std::string& allow_prefix) {
     send_all(conn, "ERR " + error + "\n");
     return;
   }
-  if (!allow_prefix.empty() && req.path.rfind(allow_prefix, 0) != 0) {
-    send_all(conn, "ERR mountpoint outside allowed prefix " + allow_prefix +
-                       "\n");
-    return;
+  if (!allow_prefix.empty()) {
+    // Directory-boundary prefix: /data must admit /data and /data/x but
+    // not /database-secrets.
+    std::string prefix = allow_prefix;
+    if (prefix.back() != '/') prefix += '/';
+    if (req.path + "/" != prefix && req.path.rfind(prefix, 0) != 0) {
+      send_all(conn, "ERR mountpoint outside allowed prefix " +
+                         allow_prefix + "\n");
+      return;
+    }
   }
   if (req.op == "MOUNT") {
     int fd = mounter->MountFuse(req, &error);
@@ -283,6 +302,12 @@ int main(int argc, char** argv) {
       std::cerr << "accept: " << std::strerror(errno) << "\n";
       return 1;
     }
+    // The socket is world-writable and the loop single-threaded: a client
+    // that connects and goes silent must not wedge every future mount on
+    // the node.
+    struct timeval tv {10, 0};
+    ::setsockopt(conn, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    ::setsockopt(conn, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
     handle_conn(conn, mounter, allow_prefix);
     ::close(conn);
     if (once) return 0;

@@ -431,6 +431,13 @@ class SliceBackend(backend_lib.Backend):
     # ---- lifecycle ---------------------------------------------------------
     def set_autostop(self, handle: backend_lib.ResourceHandle,
                      idle_minutes: int, down: bool = False) -> None:
+        if not down and idle_minutes >= 0:
+            # Autostop-without-down ends in stop_instances: refuse up
+            # front on clouds whose hosts cannot stop (e.g. kubernetes
+            # pods) instead of letting the idle hook die silently later.
+            cloud = clouds_lib.get_cloud(handle.cloud)
+            cloud.check_features_are_supported(
+                {clouds_lib.CloudFeature.STOP})
         python, env_prefix = self._python(handle)
         hook = (f'{rt_constants.control_plane_prefix()}{env_prefix} '
                 f'{python} -m skypilot_tpu.runtime.self_stop '

@@ -224,7 +224,7 @@ def cancel(cluster, job_ids, all_jobs):
 def check():
     """Probe cloud credentials and show enabled clouds."""
     from skypilot_tpu import check as check_lib
-    results = check_lib.check_capabilities()
+    results = check_lib.check_capabilities(quiet=True)
     for cloud_name, (ok, reason) in results.items():
         mark = '\x1b[32m✓\x1b[0m' if ok else '\x1b[31m✗\x1b[0m'
         click.echo(f'  {mark} {cloud_name}'

@@ -1,0 +1,98 @@
+"""Kubernetes cloud: pod-hosted tasks + GKE TPU podslices.
+
+Counterpart of reference ``sky/clouds/kubernetes.py`` + the GKE-TPU
+detection in ``sky/provision/kubernetes/utils.py`` (is_tpu_on_gke). One
+"region" per kube-context (in-cluster counts as its own); no zones, no
+stop (pods don't stop), cost 0 (cluster hardware is already paid for —
+the reference also treats k8s as zero marginal cost, so the optimizer
+prefers it when feasible).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu.clouds import cloud as cloud_lib
+
+KUBE_REGION = 'in-cluster'
+
+
+@cloud_lib.CLOUD_REGISTRY.register(name='kubernetes')
+class Kubernetes(cloud_lib.Cloud):
+    NAME = 'kubernetes'
+    _FEATURES = frozenset({
+        cloud_lib.CloudFeature.MULTI_HOST,
+        cloud_lib.CloudFeature.OPEN_PORTS,
+        cloud_lib.CloudFeature.AUTOSTOP,   # autostop hook tears pods down
+        cloud_lib.CloudFeature.STORAGE_MOUNTS,
+        # no STOP (pods), no SPOT (preemption comes from the node pool)
+    })
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision import k8s_api
+        try:
+            k8s_api.PodClient().version()
+            return True, None
+        except Exception as e:  # noqa: BLE001 — any failure = not enabled
+            return False, f'no reachable Kubernetes cluster: {e}'
+
+    @classmethod
+    def get_active_user_identity(cls) -> Optional[List[str]]:
+        return ['kubernetes']
+
+    def regions_for(self, resources) -> List[str]:
+        if resources.region not in (None, KUBE_REGION):
+            return []
+        return [KUBE_REGION]
+
+    def zones_for(self, resources, region: str) -> List[Optional[str]]:
+        return [None]
+
+    def hourly_cost(self, resources, region=None, zone=None) -> float:
+        return 0.0
+
+    def get_feasible_resources(self, resources) -> cloud_lib.FeasibleResources:
+        from skypilot_tpu.provision.kubernetes import GKE_TPU_ACCELERATOR
+        tpu = resources.tpu
+        if tpu is not None and tpu.generation not in GKE_TPU_ACCELERATOR:
+            return cloud_lib.FeasibleResources(
+                [], hint=f'TPU {tpu.generation} has no GKE podslice '
+                         'node-pool type')
+        if resources.use_spot:
+            return cloud_lib.FeasibleResources(
+                [], hint='kubernetes has no spot market (use a spot '
+                         'node pool instead)')
+        return cloud_lib.FeasibleResources(
+            [resources.copy(cloud=self.NAME)])
+
+    def make_deploy_variables(self, resources, cluster_name_on_cloud: str,
+                              region: str,
+                              zone: Optional[str]) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            'cloud': self.NAME,
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'namespace': config_lib.get_nested(
+                ('kubernetes', 'namespace'), 'default'),
+            'image': (resources.image_id or config_lib.get_nested(
+                ('kubernetes', 'image'), None)),
+            'num_hosts': resources.num_hosts,
+        }
+        # Pod resource quantities must be plain numbers: strip the '4+'
+        # at-least suffix (the request IS the at-least semantics in k8s).
+        from skypilot_tpu.utils import common_utils
+        cpus, _ = common_utils.parse_plus_number(resources.cpus, 'cpus')
+        if cpus is not None:
+            out['cpus'] = cpus
+        mem, _ = common_utils.parse_memory_gb(resources.memory)
+        if mem is not None:
+            out['memory_gb'] = mem
+        tpu = resources.tpu
+        if tpu is not None:
+            out.update({
+                'tpu_generation': tpu.generation,
+                'tpu_topology': tpu.topology_str,
+                # Sub-host slices (e.g. v5e-4) expose only their chips.
+                'chips_per_host': min(tpu.chips, tpu.chips_per_host),
+            })
+        return out

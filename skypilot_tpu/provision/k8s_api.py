@@ -1,0 +1,240 @@
+"""Thin Kubernetes REST client (pods/services/events) with a test seam.
+
+Counterpart of the reference's kubernetes adaptor + utils
+(sky/adaptors/kubernetes.py, sky/provision/kubernetes/utils.py) — but a
+direct REST transport instead of the official client, mirroring
+``provision/gcp_api.py``: one ``set_transport`` seam lets tests fake the
+whole API server (pod state machines, FailedScheduling stockouts) with no
+cluster.
+
+Auth resolution order (real transport):
+1. In-cluster service account (/var/run/secrets/kubernetes.io/...).
+2. ``$KUBECONFIG`` / ``~/.kube/config``: current-context's server +
+   bearer token or client cert (the two most common GKE shapes).
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+
+_SA_DIR = '/var/run/secrets/kubernetes.io/serviceaccount'
+
+# FailedScheduling markers that mean "no capacity for this shape now" →
+# zone-level failover (analog of gcp_api's capacity classification).
+_CAPACITY_MARKERS = (
+    'insufficient google.com/tpu',
+    'insufficient nvidia.com/gpu',
+    'insufficient cpu',
+    'insufficient memory',
+    'no nodes available',
+    "didn't match pod's node affinity",
+)
+
+
+def classify_scheduling_error(message: str) -> Optional[Exception]:
+    low = (message or '').lower()
+    for marker in _CAPACITY_MARKERS:
+        if marker in low:
+            return exceptions.InsufficientCapacityError(
+                f'kubernetes: {message}')
+    return None
+
+
+class KubeConfigError(exceptions.CloudError):
+    pass
+
+
+class HttpTransport:
+    """requests-based transport with kubeconfig/in-cluster auth."""
+
+    MAX_ATTEMPTS = 4
+    _RETRY_STATUSES = (429, 500, 502, 503, 504)
+
+    def __init__(self):
+        self._server: Optional[str] = None
+        self._headers: Dict[str, str] = {}
+        self._verify: Any = True
+        self._cert: Any = None
+        self._session = None
+
+    # -- auth ---------------------------------------------------------------
+    def _load_in_cluster(self) -> bool:
+        token_path = os.path.join(_SA_DIR, 'token')
+        if not os.path.exists(token_path):
+            return False
+        host = os.environ.get('KUBERNETES_SERVICE_HOST')
+        port = os.environ.get('KUBERNETES_SERVICE_PORT', '443')
+        if not host:
+            return False
+        with open(token_path) as f:
+            self._headers = {'Authorization': f'Bearer {f.read().strip()}'}
+        ca = os.path.join(_SA_DIR, 'ca.crt')
+        self._verify = ca if os.path.exists(ca) else True
+        self._server = f'https://{host}:{port}'
+        return True
+
+    def _load_kubeconfig(self) -> bool:
+        import base64
+        import tempfile
+
+        import yaml
+        path = os.environ.get('KUBECONFIG',
+                              os.path.expanduser('~/.kube/config'))
+        if not os.path.exists(path):
+            return False
+        with open(path) as f:
+            cfg = yaml.safe_load(f) or {}
+        ctx_name = cfg.get('current-context')
+        contexts = {c['name']: c['context']
+                    for c in cfg.get('contexts', [])}
+        clusters = {c['name']: c['cluster']
+                    for c in cfg.get('clusters', [])}
+        users = {u['name']: u.get('user', {}) for u in cfg.get('users', [])}
+        if ctx_name not in contexts:
+            raise KubeConfigError(
+                f'kubeconfig {path}: current-context {ctx_name!r} missing')
+        ctx = contexts[ctx_name]
+        cluster = clusters.get(ctx.get('cluster'))
+        user = users.get(ctx.get('user'), {})
+        if cluster is None:
+            raise KubeConfigError(f'kubeconfig {path}: cluster not found')
+        self._server = cluster['server'].rstrip('/')
+
+        def _materialize(data_key: str, file_key: str,
+                         src: Dict[str, Any]) -> Optional[str]:
+            if src.get(file_key):
+                return src[file_key]
+            if src.get(data_key):
+                tmp = tempfile.NamedTemporaryFile(delete=False)
+                tmp.write(base64.b64decode(src[data_key]))
+                tmp.close()
+                return tmp.name
+            return None
+
+        ca = _materialize('certificate-authority-data',
+                          'certificate-authority', cluster)
+        self._verify = ca if ca else not cluster.get(
+            'insecure-skip-tls-verify', False)
+        token = user.get('token')
+        if token:
+            self._headers = {'Authorization': f'Bearer {token}'}
+        cert = _materialize('client-certificate-data', 'client-certificate',
+                            user)
+        key = _materialize('client-key-data', 'client-key', user)
+        if cert and key:
+            self._cert = (cert, key)
+        return True
+
+    def _ensure(self):
+        import requests
+        if self._session is None:
+            self._session = requests.Session()
+            if not (self._load_in_cluster() or self._load_kubeconfig()):
+                raise KubeConfigError(
+                    'No Kubernetes credentials: not in-cluster and no '
+                    'kubeconfig found')
+
+    # -- request ------------------------------------------------------------
+    def request(self, method: str, path: str,
+                json_body: Optional[Dict[str, Any]] = None,
+                params: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        import requests
+        self._ensure()
+        url = f'{self._server}{path}'
+        last: Optional[Exception] = None
+        for attempt in range(self.MAX_ATTEMPTS):
+            if attempt:
+                time.sleep(min(1.0 * 2**(attempt - 1), 15))
+            try:
+                resp = self._session.request(
+                    method, url, json=json_body, params=params,
+                    headers=self._headers, verify=self._verify,
+                    cert=self._cert, timeout=60)
+            except (requests.ConnectionError, requests.Timeout) as e:
+                last = e
+                continue
+            if resp.status_code < 400:
+                return resp.json() if resp.content else {}
+            if resp.status_code in self._RETRY_STATUSES:
+                last = exceptions.CloudError(
+                    f'kubernetes {resp.status_code}: {resp.text[:200]}')
+                continue
+            if resp.status_code == 404:
+                raise KeyError(path)
+            raise exceptions.CloudError(
+                f'kubernetes {method} {path}: {resp.status_code} '
+                f'{resp.text[:300]}')
+        raise (last if isinstance(last, exceptions.CloudError)
+               else exceptions.CloudError(f'kubernetes transport: {last!r}'))
+
+
+_transport: Any = None
+
+
+def get_transport() -> Any:
+    global _transport
+    if _transport is None:
+        _transport = HttpTransport()
+    return _transport
+
+
+def set_transport(transport: Any) -> None:
+    """Test seam: install a fake API server."""
+    global _transport
+    _transport = transport
+
+
+class PodClient:
+    """Namespaced pod/service/event operations."""
+
+    def __init__(self, namespace: str = 'default',
+                 transport: Optional[Any] = None):
+        self.namespace = namespace
+        self._t = transport or get_transport()
+
+    def _ns(self, kind: str, name: str = '') -> str:
+        suffix = f'/{name}' if name else ''
+        return f'/api/v1/namespaces/{self.namespace}/{kind}{suffix}'
+
+    def create_pod(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        return self._t.request('POST', self._ns('pods'), json_body=body)
+
+    def get_pod(self, name: str) -> Optional[Dict[str, Any]]:
+        try:
+            return self._t.request('GET', self._ns('pods', name))
+        except KeyError:
+            return None
+
+    def list_pods(self, label_selector: str) -> List[Dict[str, Any]]:
+        resp = self._t.request('GET', self._ns('pods'),
+                               params={'labelSelector': label_selector})
+        return resp.get('items', [])
+
+    def delete_pod(self, name: str) -> None:
+        try:
+            self._t.request('DELETE', self._ns('pods', name),
+                            params={'gracePeriodSeconds': '5'})
+        except KeyError:
+            pass
+
+    def pod_events(self, name: str) -> List[Dict[str, Any]]:
+        resp = self._t.request(
+            'GET', self._ns('events'),
+            params={'fieldSelector': f'involvedObject.name={name}'})
+        return resp.get('items', [])
+
+    def create_service(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        return self._t.request('POST', self._ns('services'),
+                               json_body=body)
+
+    def delete_service(self, name: str) -> None:
+        try:
+            self._t.request('DELETE', self._ns('services', name))
+        except KeyError:
+            pass
+
+    def version(self) -> Dict[str, Any]:
+        return self._t.request('GET', '/version')

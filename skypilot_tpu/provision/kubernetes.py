@@ -1,0 +1,259 @@
+"""Kubernetes provisioner: pods as hosts, GKE TPU slices as node selectors.
+
+Counterpart of reference ``sky/provision/kubernetes/instance.py`` (+ the
+GKE-TPU label logic in ``utils.py`` — is_tpu_on_gke, TPU accelerator/
+topology selectors). TPU-native shape: a multi-host TPU slice maps to one
+pod per TPU-VM worker, all carrying the generation's GKE podslice node
+selector + topology, so GKE's TPU webhook injects the right device
+plumbing; ranks are stable via a ``skytpu/rank`` label.
+
+Pods are the whole lifecycle: no STOP (pods don't stop — the cloud ABC
+excludes the feature), terminate deletes by label selector.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import provision as provision_lib
+from skypilot_tpu.provision import k8s_api
+from skypilot_tpu.utils import command_runner as runner_lib
+
+_CLUSTER_LABEL = 'skytpu/cluster'
+_RANK_LABEL = 'skytpu/rank'
+
+# GKE TPU podslice accelerator labels per generation (reference
+# sky/provision/kubernetes/utils.py GKELabelFormatter).
+GKE_TPU_ACCELERATOR = {
+    'v4': 'tpu-v4-podslice',
+    'v5e': 'tpu-v5-lite-podslice',
+    'v5p': 'tpu-v5p-slice',
+    'v6e': 'tpu-v6e-slice',
+}
+
+_DEFAULT_IMAGE = 'python:3.11-slim'
+
+
+def _namespace(deploy_vars: Dict[str, Any]) -> str:
+    return deploy_vars.get('namespace') or 'default'
+
+
+def _client(deploy_vars: Dict[str, Any]) -> k8s_api.PodClient:
+    return k8s_api.PodClient(namespace=_namespace(deploy_vars))
+
+
+def _pod_name(cluster_name: str, rank: int) -> str:
+    return f'{cluster_name}-{rank}'
+
+
+def _pod_body(cluster_name: str, rank: int,
+              deploy_vars: Dict[str, Any]) -> Dict[str, Any]:
+    tpu_gen = deploy_vars.get('tpu_generation')
+    chips_per_host = int(deploy_vars.get('chips_per_host') or 0)
+    container: Dict[str, Any] = {
+        'name': 'skytpu',
+        'image': deploy_vars.get('image') or _DEFAULT_IMAGE,
+        # The runtime drives pods through exec; the container just stays up.
+        'command': ['/bin/sh', '-c', 'sleep infinity'],
+        'resources': {'requests': {}, 'limits': {}},
+    }
+    if deploy_vars.get('cpus'):
+        container['resources']['requests']['cpu'] = str(
+            deploy_vars['cpus'])
+    if deploy_vars.get('memory_gb'):
+        container['resources']['requests']['memory'] = (
+            f"{deploy_vars['memory_gb']}Gi")
+    spec: Dict[str, Any] = {
+        'restartPolicy': 'Never',
+        'containers': [container],
+    }
+    if tpu_gen:
+        accelerator = GKE_TPU_ACCELERATOR.get(tpu_gen)
+        if accelerator is None:
+            raise exceptions.InvalidResourcesError(
+                f'TPU generation {tpu_gen!r} has no GKE podslice mapping')
+        spec['nodeSelector'] = {
+            'cloud.google.com/gke-tpu-accelerator': accelerator,
+            'cloud.google.com/gke-tpu-topology':
+                deploy_vars.get('tpu_topology', ''),
+        }
+        # google.com/tpu counts CHIPS visible to this pod (one worker's).
+        container['resources']['requests']['google.com/tpu'] = \
+            str(chips_per_host)
+        container['resources']['limits']['google.com/tpu'] = \
+            str(chips_per_host)
+    return {
+        'apiVersion': 'v1',
+        'kind': 'Pod',
+        'metadata': {
+            'name': _pod_name(cluster_name, rank),
+            'labels': {
+                _CLUSTER_LABEL: cluster_name,
+                _RANK_LABEL: str(rank),
+            },
+        },
+        'spec': spec,
+    }
+
+
+# ---- provision API ---------------------------------------------------------
+def run_instances(cluster_name: str, region: str, zone: Optional[str],
+                  num_hosts: int, deploy_vars: Dict[str, Any]) -> None:
+    import json
+    client = _client(deploy_vars)
+    # Kubernetes object names/labels must be DNS-1123: use the sanitized
+    # on-cloud name (display names may carry e.g. underscores).
+    name = deploy_vars.get('cluster_name_on_cloud') or cluster_name
+    existing = {p['metadata']['name']
+                for p in client.list_pods(f'{_CLUSTER_LABEL}={name}')}
+    for rank in range(num_hosts):
+        if _pod_name(name, rank) in existing:
+            continue  # idempotent re-run
+        client.create_pod(_pod_body(name, rank, deploy_vars))
+    # Persist what later calls need (they only receive cluster + region).
+    from skypilot_tpu import global_user_state
+    global_user_state.set_kv(
+        f'k8s_deploy:{cluster_name}',
+        json.dumps({'namespace': _namespace(deploy_vars),
+                    'name_on_cloud': name, 'num_hosts': num_hosts}))
+
+
+def _stored(cluster_name: str) -> Dict[str, Any]:
+    import json
+    from skypilot_tpu import global_user_state
+    raw = global_user_state.get_kv(f'k8s_deploy:{cluster_name}')
+    if not raw:
+        return {'namespace': 'default', 'name_on_cloud': cluster_name,
+                'num_hosts': 0}
+    return json.loads(raw)
+
+
+def wait_instances(cluster_name: str, region: str, state: str = 'running',
+                   timeout: float = 1800) -> None:
+    if state != 'running':
+        raise exceptions.NotSupportedError(
+            'kubernetes pods only wait for running')
+    stored = _stored(cluster_name)
+    name = stored['name_on_cloud']
+    want = stored['num_hosts']
+    client = k8s_api.PodClient(namespace=stored['namespace'])
+    deadline = time.time() + timeout
+    poll = 2.0
+    while True:
+        pods = client.list_pods(f'{_CLUSTER_LABEL}={name}')
+        phases = {p['metadata']['name']: p.get('status', {}).get('phase')
+                  for p in pods}
+        if (pods and (not want or len(pods) == want)
+                and all(ph == 'Running' for ph in phases.values())):
+            return
+        # Terminal pod phases never heal (restartPolicy=Never): waiting
+        # out the timeout would only delay failover.
+        dead = [n for n, ph in phases.items()
+                if ph in ('Failed', 'Succeeded')]
+        if dead:
+            raise exceptions.ProvisionError(
+                f'kubernetes pods for {cluster_name!r} terminated during '
+                f'bring-up: {dead}')
+        # Surface scheduling stockouts immediately: they drive failover.
+        for p in pods:
+            if p.get('status', {}).get('phase') != 'Pending':
+                continue
+            for evt in client.pod_events(p['metadata']['name']):
+                if evt.get('reason') == 'FailedScheduling':
+                    err = k8s_api.classify_scheduling_error(
+                        evt.get('message', ''))
+                    if err is not None:
+                        raise err
+        if time.time() > deadline:
+            raise exceptions.ProvisionError(
+                f'kubernetes pods for {cluster_name!r} not Running within '
+                f'{timeout}s: {phases}')
+        time.sleep(poll)
+
+
+def stop_instances(cluster_name: str, region: str) -> None:
+    raise exceptions.NotSupportedError('kubernetes pods cannot be stopped')
+
+
+def terminate_instances(cluster_name: str, region: str) -> None:
+    stored = _stored(cluster_name)
+    client = k8s_api.PodClient(namespace=stored['namespace'])
+    for pod in client.list_pods(
+            f'{_CLUSTER_LABEL}={stored["name_on_cloud"]}'):
+        client.delete_pod(pod['metadata']['name'])
+    client.delete_service(f'{stored["name_on_cloud"]}-ports')
+    from skypilot_tpu import global_user_state
+    global_user_state.set_kv(f'k8s_deploy:{cluster_name}', None)
+
+
+_PHASE_MAP = {'Pending': 'starting', 'Running': 'running',
+              'Succeeded': 'terminated', 'Failed': 'terminated',
+              'Unknown': 'unknown'}
+
+
+def query_instances(cluster_name: str, region: str) -> Dict[str, str]:
+    stored = _stored(cluster_name)
+    client = k8s_api.PodClient(namespace=stored['namespace'])
+    out = {}
+    for pod in client.list_pods(
+            f'{_CLUSTER_LABEL}={stored["name_on_cloud"]}'):
+        phase = pod.get('status', {}).get('phase', 'Unknown')
+        out[pod['metadata']['name']] = _PHASE_MAP.get(phase, 'unknown')
+    return out
+
+
+def get_cluster_info(cluster_name: str, region: str
+                     ) -> provision_lib.ClusterInfo:
+    stored = _stored(cluster_name)
+    namespace = stored['namespace']
+    client = k8s_api.PodClient(namespace=namespace)
+    pods = client.list_pods(f'{_CLUSTER_LABEL}={stored["name_on_cloud"]}')
+    if not pods:
+        raise exceptions.ClusterError(
+            f'kubernetes cluster {cluster_name!r} has no pods')
+    pods.sort(key=lambda p: int(p['metadata']['labels'].get(_RANK_LABEL,
+                                                            '0')))
+    hosts = []
+    for pod in pods:
+        rank = int(pod['metadata']['labels'].get(_RANK_LABEL, '0'))
+        hosts.append(provision_lib.HostInfo(
+            host_id=pod['metadata']['name'],
+            rank=rank,
+            internal_ip=pod.get('status', {}).get('podIP', ''),
+            external_ip=None,
+            extra={'namespace': namespace,
+                   'pod_name': pod['metadata']['name']}))
+    return provision_lib.ClusterInfo(
+        cluster_name=cluster_name, cloud='kubernetes', region=region,
+        zone=None, hosts=hosts,
+        deploy_vars={'namespace': namespace})
+
+
+def open_ports(cluster_name: str, region: str, ports: List[str]) -> None:
+    """NodePort service targeting the head pod (rank 0)."""
+    stored = _stored(cluster_name)
+    name = stored['name_on_cloud']
+    client = k8s_api.PodClient(namespace=stored['namespace'])
+    client.create_service({
+        'apiVersion': 'v1',
+        'kind': 'Service',
+        'metadata': {'name': f'{name}-ports',
+                     'labels': {_CLUSTER_LABEL: name}},
+        'spec': {
+            'type': 'NodePort',
+            'selector': {_CLUSTER_LABEL: name, _RANK_LABEL: '0'},
+            'ports': [{'name': f'p{p}', 'port': int(p),
+                       'targetPort': int(p)} for p in ports],
+        },
+    })
+
+
+def get_command_runners(cluster_info: provision_lib.ClusterInfo,
+                        ssh_credentials: Optional[Dict[str, str]] = None
+                        ) -> List[runner_lib.CommandRunner]:
+    return [
+        runner_lib.KubernetesCommandRunner(
+            namespace=h.extra['namespace'], pod_name=h.extra['pod_name'])
+        for h in cluster_info.hosts
+    ]

@@ -240,9 +240,13 @@ class Resources:
             self._cloud = 'gcp'
 
     def _validate(self) -> None:
-        if self._tpu is not None and self._cloud not in (None, 'gcp', 'local'):
+        # TPU slices live on GCP TPU-VMs or GKE podslices (reference
+        # sky/resources.py:599 is_tpu_on_gke); 'local' emulates them.
+        if self._tpu is not None and self._cloud not in (
+                None, 'gcp', 'kubernetes', 'local'):
             raise exceptions.InvalidResourcesError(
-                f'TPU slices require cloud=gcp, got {self._cloud!r}')
+                f'TPU slices require cloud=gcp or kubernetes, '
+                f'got {self._cloud!r}')
         if self._zone is not None and self._region is None:
             # Infer region from zone name (GCP convention: strip '-x').
             self._region = self._zone.rsplit('-', 1)[0]

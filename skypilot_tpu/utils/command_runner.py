@@ -1,12 +1,13 @@
 """Command runners: uniform exec/rsync to cluster hosts.
 
 Counterpart of reference ``sky/utils/command_runner.py`` (CommandRunner:167,
-SSHCommandRunner:437). Two impls:
+SSHCommandRunner:437, KubernetesCommandRunner:713). Three impls:
 
 - ``SSHCommandRunner``: ControlMaster-pooled ssh + rsync (TPU VM hosts).
 - ``LocalProcessRunner``: subprocess against a host *directory* (the local
   cloud's emulated hosts) — the permanent test backend, so every
   orchestration path exercises the same runner interface.
+- ``KubernetesCommandRunner``: kubectl-exec against pod hosts.
 """
 from __future__ import annotations
 
@@ -39,6 +40,31 @@ class CommandResult:
     returncode: int
     stdout: str
     stderr: str
+
+
+def _tar_pipe_upload(remote_argv_fn, source: str, target: str,
+                     transport_name: str) -> None:
+    """Upload ``source`` by piping a local tar stream into a remote
+    extract command. ``remote_argv_fn(remote_cmd)`` wraps the remote shell
+    command into the transport's argv (ssh / kubectl exec)."""
+    src = source.rstrip('/')
+    src_dir = os.path.isdir(src)
+    tar_src = f'-C {shlex.quote(src)} .' if src_dir else (
+        f'-C {shlex.quote(os.path.dirname(src) or ".")} '
+        f'{shlex.quote(os.path.basename(src))}')
+    if src_dir and not source.endswith('/'):
+        target = os.path.join(target, os.path.basename(src))
+    remote_cmd = (f'mkdir -p {shlex.quote(target)} && '
+                  f'tar -x -C {shlex.quote(target)}')
+    argv = remote_argv_fn(remote_cmd)
+    tar = subprocess.Popen(['bash', '-c', f'tar -c {tar_src}'],
+                           stdout=subprocess.PIPE)
+    res = subprocess.run(argv, stdin=tar.stdout, capture_output=True,
+                         text=True)
+    tar.wait()
+    if res.returncode != 0 or tar.returncode != 0:
+        raise RuntimeError(
+            f'tar-over-{transport_name} failed: {res.stderr.strip()}')
 
 
 class CommandRunner:
@@ -182,20 +208,51 @@ class SSHCommandRunner(CommandRunner):
         # Fallback: tar over ssh (no rsync binary on the client).
         if not up:
             raise RuntimeError('rsync-down requires the rsync binary')
-        src = source.rstrip('/')
-        src_dir = os.path.isdir(src)
-        tar_src = f'-C {shlex.quote(src)} .' if src_dir else (
-            f'-C {shlex.quote(os.path.dirname(src) or ".")} '
-            f'{shlex.quote(os.path.basename(src))}')
-        if src_dir and not source.endswith('/'):
-            target = os.path.join(target, os.path.basename(src))
-        remote_cmd = (f'mkdir -p {shlex.quote(target)} && '
-                      f'tar -x -C {shlex.quote(target)}')
-        argv = self._ssh_base() + [f'bash -lc {shlex.quote(remote_cmd)}']
-        tar = subprocess.Popen(['bash', '-c', f'tar -c {tar_src}'],
-                               stdout=subprocess.PIPE)
-        res = subprocess.run(argv, stdin=tar.stdout, capture_output=True,
-                             text=True)
-        tar.wait()
-        if res.returncode != 0 or tar.returncode != 0:
-            raise RuntimeError(f'tar-over-ssh failed: {res.stderr.strip()}')
+        _tar_pipe_upload(
+            lambda rc: self._ssh_base() + [f'bash -lc {shlex.quote(rc)}'],
+            source, target, 'ssh')
+
+
+class KubernetesCommandRunner(CommandRunner):
+    """kubectl-exec runner for pod hosts (reference
+    sky/utils/command_runner.py:713 KubernetesCommandRunner).
+
+    Shells out to kubectl (present wherever a kubeconfig is) instead of
+    streaming exec over SPDY ourselves; rsync uses tar piped through
+    `kubectl exec -i`.
+    """
+
+    def __init__(self, namespace: str, pod_name: str,
+                 container: str = 'skytpu'):
+        self.namespace = namespace
+        self.pod_name = pod_name
+        self.container = container
+
+    def _base(self, interactive: bool = False) -> List[str]:
+        argv = ['kubectl', 'exec']
+        if interactive:
+            argv.append('-i')
+        argv += ['-n', self.namespace, self.pod_name,
+                 '-c', self.container, '--']
+        return argv
+
+    def run(self, cmd, env=None, timeout=None, stream_to=None):
+        if not isinstance(cmd, str):
+            cmd = ' '.join(shlex.quote(c) for c in cmd)
+        if env:
+            exports = ' '.join(f'export {k}={shlex.quote(v)};'
+                               for k, v in env.items())
+            cmd = exports + ' ' + cmd
+        argv = self._base() + ['bash', '-c', cmd]
+        if stream_to is not None:
+            return self._run_with_stream(argv, stream_to, timeout=timeout)
+        proc = subprocess.run(argv, capture_output=True, text=True,
+                              timeout=timeout)
+        return CommandResult(proc.returncode, proc.stdout, proc.stderr)
+
+    def rsync(self, source: str, target: str, up: bool = True) -> None:
+        if not up:
+            raise RuntimeError('kubectl runner supports upload only')
+        _tar_pipe_upload(
+            lambda rc: self._base(interactive=True) + ['bash', '-c', rc],
+            source, target, 'kubectl')

@@ -122,6 +122,37 @@ def test_missing_mountpoint_errors(binaries, server, tmp_path):
     assert 'cannot resolve mountpoint' in rc.stderr
 
 
+def test_symlink_cannot_escape_allow_prefix(binaries, tmp_path):
+    """A symlink inside the allowed prefix pointing outside it must be
+    rejected: the server canonicalizes server-side (a raw-protocol client
+    skips the shim's realpath entirely)."""
+    allowed = tmp_path / 'data'
+    allowed.mkdir()
+    outside = tmp_path / 'outside'
+    outside.mkdir()
+    (allowed / 'evil').symlink_to(outside)
+    sock = str(tmp_path / 'p.sock')
+    proc = subprocess.Popen(
+        [binaries['server'], '--socket', sock, '--fake', '--fake-log',
+         str(tmp_path / 'l.log'), '--allow-prefix', str(allowed)])
+    try:
+        deadline = time.time() + 10
+        while not os.path.exists(sock):
+            assert time.time() < deadline
+            time.sleep(0.05)
+        # Speak the protocol directly (no shim, no client-side realpath).
+        c = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        c.connect(sock)
+        c.sendall(f'MOUNT\nOPTS rw\nPATH {allowed}/evil\nEND\n'.encode())
+        resp = c.recv(256).decode()
+        assert resp.startswith('ERR'), resp
+        assert 'allowed prefix' in resp
+        c.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
 def test_server_rejects_outside_allow_prefix(binaries, tmp_path):
     sock = str(tmp_path / 'p.sock')
     log = str(tmp_path / 'l.log')

@@ -1,0 +1,202 @@
+"""Kubernetes provisioner tests against an in-process fake API server.
+
+Same pattern as test_gcp_provision.py: the fake implements the REST
+surface the transport hits (pods/events/services), including a
+Pending->Running state machine and FailedScheduling TPU stockouts, so
+lifecycle + failover logic run for real with no cluster.
+"""
+import re
+from urllib.parse import parse_qs, urlparse
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import exceptions
+from skypilot_tpu.clouds.kubernetes import Kubernetes
+from skypilot_tpu.provision import k8s_api
+from skypilot_tpu.provision import kubernetes as k8s_provision
+
+
+class FakeKubeApi:
+    """In-memory pods/events/services keyed by namespace."""
+
+    def __init__(self):
+        self.pods = {}       # (ns, name) -> pod dict
+        self.events = {}     # (ns, pod_name) -> [event]
+        self.services = {}   # (ns, name) -> svc
+        self.fail_tpu_scheduling = False
+        self.pending_rounds = 1  # list calls before pods go Running
+
+    def request(self, method, path, json_body=None, params=None):
+        params = params or {}
+        m = re.match(r'/api/v1/namespaces/([^/]+)/(pods|events|services)'
+                     r'(?:/([^/]+))?$', path)
+        if path == '/version':
+            return {'major': '1', 'minor': '29'}
+        assert m, path
+        ns, kind, name = m.group(1), m.group(2), m.group(3)
+        if kind == 'pods':
+            return self._pods(method, ns, name, json_body, params)
+        if kind == 'events':
+            sel = params.get('fieldSelector', '')
+            pod = sel.split('=', 1)[1] if '=' in sel else None
+            return {'items': self.events.get((ns, pod), [])}
+        if kind == 'services':
+            if method == 'POST':
+                self.services[(ns, json_body['metadata']['name'])] = \
+                    json_body
+                return json_body
+            if method == 'DELETE':
+                if (ns, name) not in self.services:
+                    raise KeyError(path)
+                del self.services[(ns, name)]
+                return {}
+        raise AssertionError(f'{method} {path}')
+
+    def _pods(self, method, ns, name, body, params):
+        if method == 'POST':
+            pod_name = body['metadata']['name']
+            pod = dict(body)
+            pod['status'] = {'phase': 'Pending'}
+            self.pods[(ns, pod_name)] = pod
+            if self.fail_tpu_scheduling and 'nodeSelector' in body['spec']:
+                self.events[(ns, pod_name)] = [{
+                    'reason': 'FailedScheduling',
+                    'message': '0/5 nodes are available: 5 Insufficient '
+                               'google.com/tpu.',
+                }]
+            return pod
+        if method == 'GET' and name:
+            if (ns, name) not in self.pods:
+                raise KeyError(name)
+            return self.pods[(ns, name)]
+        if method == 'GET':
+            sel = params.get('labelSelector', '')
+            key, _, val = sel.partition('=')
+            items = [p for p in self.pods.values()
+                     if p['metadata'].get('labels', {}).get(key) == val
+                     and p['metadata']['namespace_key'][0] == ns]
+            self._tick(ns)
+            return {'items': items}
+        if method == 'DELETE':
+            if (ns, name) not in self.pods:
+                raise KeyError(name)
+            del self.pods[(ns, name)]
+            return {}
+        raise AssertionError(method)
+
+    def _tick(self, ns):
+        """Advance Pending pods toward Running on each list call."""
+        if self.pending_rounds > 0:
+            self.pending_rounds -= 1
+            return
+        i = 0
+        for (pns, pname), pod in self.pods.items():
+            if pns != ns:
+                continue
+            if pod['status']['phase'] == 'Pending' and not self.events.get(
+                    (pns, pname)):
+                pod['status'] = {'phase': 'Running',
+                                 'podIP': f'10.0.0.{10 + i}'}
+            i += 1
+
+
+@pytest.fixture
+def fake_kube(monkeypatch):
+    fake = FakeKubeApi()
+
+    class Transport:
+        def request(self, method, path, json_body=None, params=None):
+            # Tag created pods with their namespace (the fake stores a
+            # flat dict; the real API scopes by URL).
+            if method == 'POST' and path.endswith('/pods'):
+                json_body['metadata']['namespace_key'] = (
+                    path.split('/')[4], None)
+            return fake.request(method, path, json_body, params)
+
+    k8s_api.set_transport(Transport())
+    yield fake
+    k8s_api.set_transport(None)
+    k8s_api._transport = None
+
+
+def _deploy_vars(tpu=None):
+    cloud = Kubernetes()
+    res = sky.Resources(cloud='kubernetes', accelerators=tpu)
+    return cloud.make_deploy_variables(res, 'kube-test', 'in-cluster', None)
+
+
+class TestDeployVars:
+
+    def test_tpu_slice_maps_to_gke_labels(self):
+        dv = _deploy_vars('tpu-v5e-16')
+        assert dv['tpu_generation'] == 'v5e'
+        assert dv['tpu_topology'] == '4x4'
+        assert dv['chips_per_host'] == 8
+        assert dv['num_hosts'] == 2
+
+    def test_subhost_slice_chip_count(self):
+        dv = _deploy_vars('tpu-v5e-4')
+        assert dv['chips_per_host'] == 4
+        assert dv['num_hosts'] == 1
+
+    def test_feasibility_rejects_unsupported(self):
+        cloud = Kubernetes()
+        res = sky.Resources(cloud='kubernetes', accelerators='tpu-v2-8')
+        out = cloud.get_feasible_resources(res)
+        assert out.resources == []
+        assert 'GKE' in out.hint
+
+
+class TestLifecycle:
+
+    def test_run_wait_info_terminate(self, fake_kube):
+        dv = _deploy_vars('tpu-v5e-16')
+        k8s_provision.run_instances('kube-test', 'in-cluster', None,
+                                    dv['num_hosts'], dv)
+        assert len(fake_kube.pods) == 2
+        pod = fake_kube.pods[('default', 'kube-test-0')]
+        sel = pod['spec']['nodeSelector']
+        assert sel['cloud.google.com/gke-tpu-accelerator'] == \
+            'tpu-v5-lite-podslice'
+        assert sel['cloud.google.com/gke-tpu-topology'] == '4x4'
+        limits = pod['spec']['containers'][0]['resources']['limits']
+        assert limits['google.com/tpu'] == '8'
+
+        k8s_provision.wait_instances('kube-test', 'in-cluster',
+                                     timeout=30)
+        info = k8s_provision.get_cluster_info('kube-test', 'in-cluster')
+        assert info.num_hosts == 2
+        assert [h.rank for h in info.hosts] == [0, 1]
+        assert all(h.internal_ip.startswith('10.0.0.') for h in info.hosts)
+
+        assert k8s_provision.query_instances(
+            'kube-test', 'in-cluster') == {
+                'kube-test-0': 'running', 'kube-test-1': 'running'}
+
+        k8s_provision.open_ports('kube-test', 'in-cluster', ['9000'])
+        assert ('default', 'kube-test-ports') in fake_kube.services
+
+        k8s_provision.terminate_instances('kube-test', 'in-cluster')
+        assert fake_kube.pods == {}
+        assert fake_kube.services == {}
+
+    def test_idempotent_run(self, fake_kube):
+        dv = _deploy_vars()
+        k8s_provision.run_instances('kube-test', 'in-cluster', None, 1, dv)
+        k8s_provision.run_instances('kube-test', 'in-cluster', None, 1, dv)
+        assert len(fake_kube.pods) == 1
+
+    def test_tpu_stockout_classified_for_failover(self, fake_kube):
+        fake_kube.fail_tpu_scheduling = True
+        dv = _deploy_vars('tpu-v5e-8')
+        k8s_provision.run_instances('kube-test', 'in-cluster', None,
+                                    dv['num_hosts'], dv)
+        with pytest.raises(exceptions.InsufficientCapacityError,
+                           match='google.com/tpu'):
+            k8s_provision.wait_instances('kube-test', 'in-cluster',
+                                         timeout=30)
+
+    def test_stop_not_supported(self, fake_kube):
+        with pytest.raises(exceptions.NotSupportedError):
+            k8s_provision.stop_instances('kube-test', 'in-cluster')

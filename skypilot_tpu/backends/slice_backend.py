@@ -113,6 +113,15 @@ class RetryingProvisioner:
                 return launched, info
             except exceptions.InsufficientCapacityError as e:
                 history.append(e)   # capacity: blocklist zone, try next
+                # Some providers (k8s) learn about the stockout only
+                # AFTER objects exist (Pending pods + FailedScheduling):
+                # tear the attempt down or those pods schedule later and
+                # hold quota with no record tracking them.
+                try:
+                    provision_lib.terminate_instances(
+                        cloud.NAME, cluster_name, region)
+                except Exception:
+                    pass
                 continue
             except exceptions.ProvisionError as e:
                 # Partial creation (operation timeout, half-created group):
@@ -259,10 +268,30 @@ class SliceBackend(backend_lib.Backend):
                     f'skypilot_tpu.runtime.agent --runtime-dir {rtdir} '
                     f'--tick {tick} >> {rtdir}/{rt_constants.AGENT_LOG_FILE} '
                     f'2>&1 < /dev/null &) ')
+                # Drop any stale heartbeat (stopped-cluster restart) so
+                # the barrier below waits for a FRESH pulse.
+                runner.run(
+                    f'rm -f {rtdir}/{rt_constants.HEARTBEAT_FILE}',
+                    timeout=30)
                 res = runner.run(start, timeout=60)
                 if res.returncode != 0:
                     raise exceptions.ProvisionError(
                         f'agent start failed: {res.stderr or res.stdout}')
+                # Barrier on the agent's first heartbeat (reference waits
+                # for `ray status` health, provisioner.py:643): without
+                # it, a status refresh can probe before the agent booted
+                # and misread the fresh cluster as runtime-down.
+                hb = f'{rtdir}/{rt_constants.HEARTBEAT_FILE}'
+                deadline = time.time() + 90
+                while True:
+                    probe = runner.run(f'test -f {hb}', timeout=30)
+                    if probe.returncode == 0:
+                        break
+                    if time.time() > deadline:
+                        raise exceptions.ProvisionError(
+                            'agent produced no heartbeat within 90s '
+                            f'(see {rtdir}/{rt_constants.AGENT_LOG_FILE})')
+                    time.sleep(0.3)
 
         def bring_up_checked(rank: int, runner) -> None:
             try:
@@ -281,6 +310,9 @@ class SliceBackend(backend_lib.Backend):
                 'runtime bring-up failed on '
                 f'{len(errors)}/{len(runners)} host(s): '
                 + ' | '.join(errors[:4]))
+        # Fresh runtime: drop any cached "agent down" verdict so the next
+        # status refresh doesn't report INIT off stale data.
+        global_user_state.set_kv(f'agent_probe:{handle.cluster_name}', None)
 
     def _sync_runtime_code(self, runners: List[Any]) -> None:
         """Ship our package to non-local hosts (analog of reference wheel

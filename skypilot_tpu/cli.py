@@ -68,9 +68,12 @@ def cli():
 @click.option('--down', is_flag=True,
               help='Autostop tears down instead of stopping.')
 @click.option('--dryrun', is_flag=True)
+@click.option('--fast', is_flag=True,
+              help='Skip file mounts + setup when the cluster is UP and '
+                   'the setup config is unchanged.')
 def launch(entrypoint, cluster, name, workdir, cloud, accelerators,
            num_nodes, env, cmd, detach_run, retry_until_up,
-           idle_minutes_to_autostop, down, dryrun):
+           idle_minutes_to_autostop, down, dryrun, fast):
     """Launch a task (YAML file or inline command) on a new/existing
     cluster."""
     from skypilot_tpu import execution
@@ -80,7 +83,7 @@ def launch(entrypoint, cluster, name, workdir, cloud, accelerators,
     job_id, _ = execution.launch(
         task, cluster_name=cluster, retry_until_up=retry_until_up,
         idle_minutes_to_autostop=idle_minutes_to_autostop, down=down,
-        detach_run=detach_run, dryrun=dryrun)
+        detach_run=detach_run, dryrun=dryrun, fast=fast)
     if dryrun:
         click.echo('Dry run complete (optimizer table above).')
     elif job_id is not None and detach_run:

@@ -42,8 +42,65 @@ def _refresh_record(record: Dict[str, Any]) -> Optional[Dict[str, Any]]:
         return record  # lifecycle op in flight: keep the cached record
 
 
+_AGENT_STALE_S = 60.0       # heartbeat older than this => runtime down
+_AGENT_PROBE_TTL_S = 15.0   # probe at most this often per cluster
+
+
+def _agent_healthy(handle: Any) -> bool:
+    """Is the head agent alive? (reference health-checks `ray status` on
+    refresh, backend_utils.py:912; here the agent heartbeat file is the
+    runtime's pulse). Probes are TTL-cached: job-status pollers call
+    refresh frequently and must not pay an SSH round-trip each time."""
+    import time as time_lib
+
+    from skypilot_tpu.runtime import constants as rt_constants
+    cache_key = f'agent_probe:{handle.cluster_name}'
+    cached = global_user_state.get_kv(cache_key)
+    now = time_lib.time()
+    if cached:
+        ts, _, verdict = cached.partition(':')
+        if now - float(ts) < _AGENT_PROBE_TTL_S:
+            return verdict == 'ok'
+    try:
+        info = provision_lib.get_cluster_info(handle.cloud,
+                                              handle.cluster_name,
+                                              handle.region)
+        head = provision_lib.get_command_runners(handle.cloud, info)[0]
+        hb = (f'{rt_constants.RUNTIME_DIR}/'
+              f'{rt_constants.HEARTBEAT_FILE}')
+        # Age computed host-side: heartbeats carry the HOST's clock.
+        res = head.run(
+            f't=$(cat {hb} 2>/dev/null) || exit 9; '
+            'echo $(( $(date +%s) - ${t%.*} ))', timeout=30)
+        ok = (res.returncode == 0
+              and res.stdout.strip().lstrip('-').isdigit()
+              and int(res.stdout.strip()) < _AGENT_STALE_S)
+    except Exception:  # noqa: BLE001 — unreachable host = unhealthy
+        ok = False
+    global_user_state.set_kv(cache_key,
+                             f'{now}:{"ok" if ok else "down"}')
+    return ok
+
+
 def _refresh_record_locked(record: Dict[str, Any]
                            ) -> Optional[Dict[str, Any]]:
+    """The reconciliation machine (reference _update_cluster_status:1769):
+
+    cloud says                                  -> record becomes
+    ------------------------------------------------------------------
+    nothing (terminated / autostop --down)      -> record removed
+    any 'preempted'/'terminated' host           -> slice cleaned up,
+                                                   record removed (spot
+                                                   slices die whole;
+                                                   reference gcp.py:981)
+    all 'running' + agent heartbeat fresh       -> UP
+    all 'running' + agent dead/stale            -> INIT (hosts up,
+                                                   runtime down)
+    all 'stopped'                               -> STOPPED (+ autostop
+                                                   disarmed: a stopped
+                                                   cluster can't idle)
+    anything else (pending/stopping/mixed)      -> INIT (transitional)
+    """
     handle = record['handle']
     name = record['name']
     try:
@@ -56,10 +113,26 @@ def _refresh_record_locked(record: Dict[str, Any]
         global_user_state.remove_cluster(name, terminate=True)
         return None
     values = set(states.values())
+    if 'preempted' in values or 'terminated' in values:
+        # A spot TPU slice lost capacity: the carcass still holds the
+        # name/quota — delete it, then drop the record so managed jobs
+        # see a clean "cluster gone" preemption signal.
+        try:
+            provision_lib.terminate_instances(handle.cloud, name,
+                                              handle.region)
+        except exceptions.SkyTpuError:
+            pass
+        global_user_state.remove_cluster(name, terminate=True)
+        return None
     if values == {'running'}:
-        new_status = ClusterStatus.UP
+        new_status = (ClusterStatus.UP if _agent_healthy(handle)
+                      else ClusterStatus.INIT)
     elif values == {'stopped'}:
         new_status = ClusterStatus.STOPPED
+        if record.get('autostop', -1) is not None and \
+                record.get('autostop', -1) >= 0:
+            global_user_state.set_cluster_autostop(name, -1, False)
+            record = dict(record, autostop=-1, to_down=False)
     else:
         new_status = ClusterStatus.INIT  # partial/transitional
     if new_status != record['status']:

@@ -37,6 +37,21 @@ ALL_STAGES = [Stage.OPTIMIZE, Stage.PROVISION, Stage.SYNC_WORKDIR,
               Stage.SYNC_FILE_MOUNTS, Stage.SETUP, Stage.EXEC]
 
 
+def _setup_config_hash(task: task_lib.Task) -> str:
+    """Deterministic hash of everything SYNC_FILE_MOUNTS/SETUP depend on
+    (reference _deterministic_cluster_yaml_hash, backend_utils.py:962):
+    same hash on an UP cluster => re-running setup is a no-op, so
+    ``--fast`` can skip straight to EXEC."""
+    import hashlib
+    import json
+    config = task.to_yaml_config()
+    relevant = {k: config.get(k) for k in
+                ('setup', 'envs', 'secrets', 'file_mounts',
+                 'storage_mounts', 'resources', 'num_nodes')}
+    blob = json.dumps(relevant, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
 def _to_task(dag_or_task) -> task_lib.Task:
     if isinstance(dag_or_task, dag_lib.Dag):
         if len(dag_or_task.tasks) != 1:
@@ -103,7 +118,12 @@ def _execute(task: task_lib.Task,
                     f'Task requests {list(task.resources)} but cluster '
                     f'{cluster_name!r} has {launched}.')
 
-    assert handle is not None
+    if handle is None:
+        # stages without PROVISION (--fast / exec path) raced a teardown:
+        # the cluster existed at the pre-check but is gone under the lock.
+        raise exceptions.ClusterNotUpError(
+            f'Cluster {cluster_name!r} disappeared before execution '
+            '(torn down concurrently?). Re-run without --fast.')
 
     if Stage.SYNC_WORKDIR in stages and task.workdir:
         backend.sync_workdir(handle, task.workdir)
@@ -133,13 +153,17 @@ def launch(task, cluster_name: str,
            optimize_target=None,
            dryrun: bool = False,
            stream_logs: bool = True,
-           policy_operation: str = 'launch'
-           ) -> Tuple[Optional[int], Optional[Any]]:
+           policy_operation: str = 'launch',
+           fast: bool = False) -> Tuple[Optional[int], Optional[Any]]:
     """Provision (or reuse) a cluster and run the task on it.
 
     ``policy_operation`` names this request to the admin policy
     (controller bring-up passes 'controller_launch' so org policies can
     distinguish infrastructure from user workloads).
+
+    ``fast`` skips file mounts + setup when the cluster is UP and the
+    task's setup-relevant config hash matches the last full launch
+    (reference --fast, execution.py fast path + config-hash skip).
     """
     task = _to_task(task)
     from skypilot_tpu import admin_policy
@@ -147,11 +171,37 @@ def launch(task, cluster_name: str,
     task = admin_policy.apply(task, cluster_name=cluster_name,
                               operation=policy_operation, dryrun=dryrun)
     common_utils.check_cluster_name_is_valid(cluster_name)
+
+    if idle_minutes_to_autostop is not None \
+            and idle_minutes_to_autostop >= 0 and not down:
+        # Autostop-without-down needs STOP. Refuse BEFORE provisioning
+        # when every explicitly-named candidate cloud lacks it — failing
+        # in set_autostop after the job ran would leak a running cluster.
+        named = [r.cloud for r in task.resources if r.cloud is not None]
+        if named:
+            from skypilot_tpu import clouds as clouds_lib
+            if all(not clouds_lib.get_cloud(c).supports(
+                    clouds_lib.CloudFeature.STOP) for c in named):
+                raise exceptions.NotSupportedError(
+                    f'autostop (without --down) needs a cloud that can '
+                    f'stop hosts; {sorted(set(named))} cannot. '
+                    'Use --down.')
+
+    config_hash = _setup_config_hash(task)
+    hash_key = f'cluster_config_hash:{cluster_name}'
+    stages = ALL_STAGES
+    if fast and not dryrun:
+        if (_existing_up_handle(cluster_name) is not None
+                and global_user_state.get_kv(hash_key) == config_hash):
+            stages = [Stage.SYNC_WORKDIR, Stage.EXEC]
+
     job_id, handle = _execute(
-        task, cluster_name, ALL_STAGES, backend=backend,
+        task, cluster_name, stages, backend=backend,
         detach_run=detach_run, retry_until_up=retry_until_up,
         optimize_target=optimize_target, dryrun=dryrun,
         stream_logs=stream_logs)
+    if handle is not None and not dryrun and Stage.SETUP in stages:
+        global_user_state.set_kv(hash_key, config_hash)
     if handle is not None and idle_minutes_to_autostop is not None:
         b = backend or backends.SliceBackend()
         b.set_autostop(handle, idle_minutes_to_autostop, down)

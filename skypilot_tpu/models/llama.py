@@ -69,6 +69,30 @@ class LlamaConfig:
         head = 0 if self.tie_embeddings else e * v
         return v * e + l * per_layer + e + head
 
+    @property
+    def flops_params(self) -> int:
+        """Param count for FLOPs accounting (MoE subclasses override with
+        the per-token ACTIVE count)."""
+        return self.num_params
+
+    def train_flops_per_token(self, seq_len: int,
+                              causal: bool = True) -> float:
+        """Training FLOPs per token: 6N plus the self-attention term.
+
+        The standard MFU accounting (PaLM appendix B): matmul FLOPs/token
+        = 6N + 12·L·H·Q·T, where the second term is the QK^T and PV
+        matmuls (fwd+bwd). Causal attention executes only half the score
+        matrix (flash kernels skip masked tiles), so the term halves.
+        At long sequence this is NOT a correction term — e.g. a 0.89B
+        model at seq 8192 spends ~23% of its matmul FLOPs in attention —
+        omitting it understates utilization of work the MXU really runs.
+        """
+        attn = 12 * self.num_layers * self.num_heads * self.head_dim \
+            * seq_len
+        if causal:
+            attn //= 2
+        return 6 * self.flops_params + attn
+
 
 PRESETS: Dict[str, LlamaConfig] = {
     # Tiny config for unit tests / dryruns (dims stay multiples of 2 so tp/sp

@@ -43,6 +43,10 @@ class MixtralConfig(LlamaConfig):
         return v * e + l * per_layer + e + head
 
     @property
+    def flops_params(self) -> int:
+        return self.active_params
+
+    @property
     def active_params(self) -> int:
         """Params touched per token (top-k experts) — the FLOPs-relevant
         count for MFU/throughput accounting."""

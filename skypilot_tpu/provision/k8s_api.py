@@ -209,8 +209,11 @@ class PodClient:
             return None
 
     def list_pods(self, label_selector: str) -> List[Dict[str, Any]]:
-        resp = self._t.request('GET', self._ns('pods'),
-                               params={'labelSelector': label_selector})
+        try:
+            resp = self._t.request('GET', self._ns('pods'),
+                                   params={'labelSelector': label_selector})
+        except KeyError:  # namespace gone: nothing listed, not a crash
+            return []
         return resp.get('items', [])
 
     def delete_pod(self, name: str) -> None:
@@ -221,9 +224,12 @@ class PodClient:
             pass
 
     def pod_events(self, name: str) -> List[Dict[str, Any]]:
-        resp = self._t.request(
-            'GET', self._ns('events'),
-            params={'fieldSelector': f'involvedObject.name={name}'})
+        try:
+            resp = self._t.request(
+                'GET', self._ns('events'),
+                params={'fieldSelector': f'involvedObject.name={name}'})
+        except KeyError:
+            return []
         return resp.get('items', [])
 
     def create_service(self, body: Dict[str, Any]) -> Dict[str, Any]:

@@ -105,18 +105,19 @@ def run_instances(cluster_name: str, region: str, zone: Optional[str],
     # Kubernetes object names/labels must be DNS-1123: use the sanitized
     # on-cloud name (display names may carry e.g. underscores).
     name = deploy_vars.get('cluster_name_on_cloud') or cluster_name
+    # Persist BEFORE creating pods: a mid-loop create failure must leave
+    # terminate_instances able to find the partial set by its real label.
+    from skypilot_tpu import global_user_state
+    global_user_state.set_kv(
+        f'k8s_deploy:{cluster_name}',
+        json.dumps({'namespace': _namespace(deploy_vars),
+                    'name_on_cloud': name, 'num_hosts': num_hosts}))
     existing = {p['metadata']['name']
                 for p in client.list_pods(f'{_CLUSTER_LABEL}={name}')}
     for rank in range(num_hosts):
         if _pod_name(name, rank) in existing:
             continue  # idempotent re-run
         client.create_pod(_pod_body(name, rank, deploy_vars))
-    # Persist what later calls need (they only receive cluster + region).
-    from skypilot_tpu import global_user_state
-    global_user_state.set_kv(
-        f'k8s_deploy:{cluster_name}',
-        json.dumps({'namespace': _namespace(deploy_vars),
-                    'name_on_cloud': name, 'num_hosts': num_hosts}))
 
 
 def _stored(cluster_name: str) -> Dict[str, Any]:

@@ -127,7 +127,8 @@ def main(argv=None) -> None:
 
         step = trainer.step_fn()
         tokens_per_step = args.batch * args.seq
-        flops_per_step = 6 * config.num_params * tokens_per_step
+        flops_per_step = config.train_flops_per_token(args.seq) \
+            * tokens_per_step
         from skypilot_tpu import callbacks as skytpu_callback
         skytpu_callback.init(total_steps=args.steps)  # no-op outside bench
         t_window = time.perf_counter()

@@ -1,0 +1,94 @@
+"""Cluster status reconciliation machine + --fast config-hash path."""
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import core
+from skypilot_tpu import execution
+from skypilot_tpu import global_user_state
+from skypilot_tpu import provision as provision_lib
+
+ClusterStatus = global_user_state.ClusterStatus
+
+
+def _launch(name, setup=None):
+    task = sky.Task(run='echo hi', setup=setup)
+    task.set_resources([sky.Resources(cloud='local')])
+    job_id, handle = execution.launch(task, cluster_name=name,
+                                      detach_run=True, stream_logs=False)
+    return task, handle
+
+
+class TestReconcile:
+
+    def test_up_with_live_agent(self):
+        _launch('rec-up')
+        records = core.status(['rec-up'])
+        assert records[0]['status'] == ClusterStatus.UP
+        core.down('rec-up')
+
+    def test_running_hosts_dead_agent_is_init(self):
+        import os
+        import signal
+        _, handle = _launch('rec-agent')
+        # Kill the head agent out-of-band.
+        info = provision_lib.get_cluster_info('local', 'rec-agent', 'local')
+        head_dir = info.hosts[0].extra['host_dir']
+        from skypilot_tpu.runtime import constants as rt
+        with open(f'{head_dir}/{rt.RUNTIME_DIR}/{rt.AGENT_PID_FILE}') as f:
+            os.kill(int(f.read()), signal.SIGKILL)
+        # Stale the heartbeat beyond the threshold and expire the cache.
+        hb = f'{head_dir}/{rt.RUNTIME_DIR}/{rt.HEARTBEAT_FILE}'
+        with open(hb, 'w') as f:
+            f.write(str(time.time() - 3600))
+        global_user_state.set_kv('agent_probe:rec-agent', None)
+        records = core.status(['rec-agent'])
+        assert records[0]['status'] == ClusterStatus.INIT
+        core.down('rec-agent')
+
+    def test_preempted_slice_is_cleaned_up(self, monkeypatch):
+        _launch('rec-preempt')
+        monkeypatch.setattr(
+            provision_lib, 'query_instances',
+            lambda cloud, name, region: {'host0': 'preempted'})
+        records = core.status(['rec-preempt'])
+        assert records == []
+        assert global_user_state.get_cluster_from_name('rec-preempt') is None
+
+    def test_stopped_disarms_autostop(self):
+        _, handle = _launch('rec-stop')
+        from skypilot_tpu import backends
+        backends.SliceBackend().set_autostop(handle, 30, down=False)
+        core.stop('rec-stop')
+        records = core.status(['rec-stop'])
+        assert records[0]['status'] == ClusterStatus.STOPPED
+        assert records[0]['autostop'] == -1
+        core.down('rec-stop')
+
+
+class TestFastPath:
+
+    def test_fast_skips_setup_when_hash_matches(self, tmp_path):
+        marker = tmp_path / 'setup_count'
+        setup = f'echo x >> {marker}'
+        task = sky.Task(run='echo hi', setup=setup)
+        task.set_resources([sky.Resources(cloud='local')])
+        execution.launch(task, cluster_name='fast-t', detach_run=True,
+                         stream_logs=False)
+        assert len(marker.read_text().splitlines()) == 1
+        # Same config + fast => setup skipped.
+        execution.launch(task, cluster_name='fast-t', detach_run=True,
+                         stream_logs=False, fast=True)
+        assert len(marker.read_text().splitlines()) == 1
+        # Changed setup + fast => hash mismatch => setup reruns.
+        task2 = sky.Task(run='echo hi', setup=setup + ' # changed')
+        task2.set_resources([sky.Resources(cloud='local')])
+        execution.launch(task2, cluster_name='fast-t', detach_run=True,
+                         stream_logs=False, fast=True)
+        assert len(marker.read_text().splitlines()) == 2
+        # Without fast, setup always reruns.
+        execution.launch(task2, cluster_name='fast-t', detach_run=True,
+                         stream_logs=False)
+        assert len(marker.read_text().splitlines()) == 3
+        core.down('fast-t')

@@ -26,33 +26,20 @@ ManagedJobStatus = state.ManagedJobStatus
 
 
 # ---- client side -----------------------------------------------------------
-def _controller_backend_and_handle(launch_if_missing: bool = True):
-    from skypilot_tpu import backends
-    from skypilot_tpu.utils import controller_utils
-    spec = controller_utils.JOBS_CONTROLLER
-    handle = controller_utils.get_controller_handle(spec)
-    if handle is None:
-        if not launch_if_missing:
-            return None, None
-        handle = controller_utils.ensure_controller_cluster(spec)
-    return backends.SliceBackend(), handle
-
-
 def _run_jobcli(args_str: str, stream_to=None,
                 timeout: Optional[float] = 120,
                 launch_if_missing: bool = True) -> Optional[Any]:
-    backend, handle = _controller_backend_and_handle(launch_if_missing)
-    if handle is None:
-        return None
-    return backend.run_module(handle, 'skypilot_tpu.jobs.jobcli', args_str,
-                              stream_to=stream_to, timeout=timeout)
+    from skypilot_tpu.utils import controller_utils
+    res, _ = controller_utils.controller_rpc(
+        controller_utils.JOBS_CONTROLLER, 'skypilot_tpu.jobs.jobcli',
+        args_str, stream_to=stream_to, timeout=timeout,
+        launch_if_missing=launch_if_missing)
+    return res
 
 
 def _parse_json_line(res, op: str) -> Dict[str, Any]:
-    if res.returncode != 0:
-        raise exceptions.CommandError(res.returncode, f'jobs jobcli {op}',
-                                      res.stderr or res.stdout)
-    return json.loads(res.stdout.strip().splitlines()[-1])
+    from skypilot_tpu.utils import controller_utils
+    return controller_utils.parse_rpc_json(res, f'jobs {op}')
 
 
 def launch(task: task_lib.Task, name: Optional[str] = None) -> int:

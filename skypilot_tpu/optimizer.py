@@ -186,6 +186,8 @@ def optimize(
 
     if len(dag.tasks) > 1 and dag.is_chain():
         _assign_chain_dp(dag, per_task, minimize)
+    elif len(dag.tasks) > 1:
+        _assign_general_bnb(dag, per_task, minimize)
     else:
         for task, cands in per_task.items():
             if cands:
@@ -243,25 +245,15 @@ def _assign_chain_dp(dag: 'dag_lib.Dag',
                 continue
             prev_task = order[i - 1]
             best: Tuple[float, Optional[int]] = (float('inf'), None)
-            out_gb = getattr(prev_task, 'estimated_output_gb', 0.0) or 0.0
             for pj, prev_cand in enumerate(per_task[prev_task]):
-                egress = 0.0
-                if out_gb:
-                    src = prev_cand.resources
-                    dst = cand.resources
-                    cloud = clouds_lib.get_cloud(src.cloud)
-                    egress_usd = out_gb * cloud.egress_cost_per_gb(
-                        dst.cloud, dst.region or '', src.region)
-                    # Edge weight in the objective's unit: total dollars for
-                    # COST (only when node weights are total dollars too),
-                    # transfer seconds for TIME. PERF_PER_DOLLAR (an hourly
-                    # ratio) admits no coherent one-shot conversion, so its
-                    # edges stay unweighted.
-                    if use_total_cost:
-                        egress = egress_usd
-                    elif target == OptimizeTarget.TIME:
-                        if egress_usd > 0:
-                            egress = out_gb * 8 / _EGRESS_BANDWIDTH_GBPS
+                # Edge weight in the objective's unit: total dollars for
+                # COST (only when node weights are total dollars too),
+                # transfer seconds for TIME. PERF_PER_DOLLAR (an hourly
+                # ratio) admits no coherent one-shot conversion, so its
+                # edges stay unweighted. (_edge_weight is shared with the
+                # general-DAG solver.)
+                egress = _edge_weight(prev_task, prev_cand, cand, target,
+                                      use_total_cost)
                 total = dp[i - 1][pj][0] + own + egress
                 if total < best[0]:
                     best = (total, pj)
@@ -278,3 +270,112 @@ def _assign_chain_dp(dag: 'dag_lib.Dag',
         parent = dp[i][choice][1]
         if parent is not None:
             choice = parent
+
+
+def _edge_weight(prev_task: task_lib.Task, prev_cand: Candidate,
+                 cand: Candidate, target: OptimizeTarget,
+                 use_total_cost: bool) -> float:
+    """Egress weight of one DAG edge, in the objective's unit (same
+    semantics as the chain DP's inline computation)."""
+    out_gb = getattr(prev_task, 'estimated_output_gb', 0.0) or 0.0
+    if not out_gb:
+        return 0.0
+    src = prev_cand.resources
+    dst = cand.resources
+    cloud = clouds_lib.get_cloud(src.cloud)
+    egress_usd = out_gb * cloud.egress_cost_per_gb(
+        dst.cloud, dst.region or '', src.region)
+    if use_total_cost:
+        return egress_usd
+    if target == OptimizeTarget.TIME and egress_usd > 0:
+        return out_gb * 8 / _EGRESS_BANDWIDTH_GBPS
+    return 0.0
+
+
+def _assign_general_bnb(dag: 'dag_lib.Dag',
+                        per_task: Dict[task_lib.Task, List[Candidate]],
+                        target: OptimizeTarget) -> None:
+    """Exact assignment for general (non-chain) DAGs.
+
+    Where the reference reaches for a PuLP ILP (sky/optimizer.py:471),
+    this uses dependency-free branch-and-bound over the topological order:
+    node weights + egress edge weights decompose per choice, and the
+    admissible bound (sum of per-task minima for unassigned tasks) prunes
+    aggressively. Real task DAGs are small (<=10 tasks, tens of
+    candidates), so this is exact; pathological sizes fall back to greedy.
+    """
+    order = dag.topological_order()
+    if any(not per_task[t] for t in order):
+        for task in order:
+            if per_task[task]:
+                task.best_resources = per_task[task][0].resources
+                task.estimated_cost_per_hour = per_task[task][0].cost_per_hour
+        return
+    size_product = 1.0
+    for t in order:
+        size_product *= max(1, len(per_task[t]))
+    if size_product > 5e7:  # genuinely huge: greedy beats an exact stall
+        for task in order:
+            task.best_resources = per_task[task][0].resources
+            task.estimated_cost_per_hour = per_task[task][0].cost_per_hour
+        return
+
+    use_total_cost = (target == OptimizeTarget.COST and all(
+        c.est_time_s is not None for t in order for c in per_task[t]))
+
+    def node_weight(cand: Candidate) -> float:
+        own = cand.sort_key(target)[0]
+        if use_total_cost:
+            own = cand.cost_per_hour * cand.est_time_s / 3600.0
+        return own
+
+    idx = {t: i for i, t in enumerate(order)}
+    parents = [[p for p in order if t in dag._edges[p]] for t in order]
+    # Admissible remainder bound: best node weight per remaining task
+    # (edges are nonnegative).
+    min_node = [min(node_weight(c) for c in per_task[t]) for t in order]
+    suffix_min = [0.0] * (len(order) + 1)
+    for i in range(len(order) - 1, -1, -1):
+        suffix_min[i] = suffix_min[i + 1] + min_node[i]
+
+    # Seed with the greedy assignment: guarantees a valid answer even when
+    # every weight is inf (e.g. TIME objective with missing estimates —
+    # the bound would otherwise prune the entire search).
+    best_choice: List[int] = [0] * len(order)
+    best_cost = 0.0
+    for i, task in enumerate(order):
+        cand = per_task[task][0]
+        w = node_weight(cand)
+        for p in parents[i]:
+            w += _edge_weight(p, per_task[p][0], cand, target,
+                              use_total_cost)
+        best_cost += w
+    choice: List[int] = []
+
+    def dfs(i: int, acc: float) -> None:
+        nonlocal best_cost, best_choice
+        if acc + suffix_min[i] >= best_cost:
+            return
+        if i == len(order):
+            best_cost = acc
+            best_choice = list(choice)
+            return
+        task = order[i]
+        scored = []
+        for j, cand in enumerate(per_task[task]):
+            w = node_weight(cand)
+            for p in parents[i]:
+                w += _edge_weight(p, per_task[p][choice[idx[p]]], cand,
+                                  target, use_total_cost)
+            scored.append((w, j))
+        scored.sort()  # try promising branches first for tight bounds
+        for w, j in scored:
+            choice.append(j)
+            dfs(i + 1, acc + w)
+            choice.pop()
+
+    dfs(0, 0.0)
+    for i, task in enumerate(order):
+        cand = per_task[task][best_choice[i]]
+        task.best_resources = cand.resources
+        task.estimated_cost_per_hour = cand.cost_per_hour

@@ -137,6 +137,14 @@ TASK_SCHEMA: Dict[str, Any] = {
         'service': SERVICE_SCHEMA,
         'config_overrides': {'type': 'object'},
         'experimental': {'type': 'object'},
+        'estimated': {
+            'type': 'object',
+            'properties': {
+                'total_flops': {'type': ['number', 'string']},
+                'output_gb': {'type': ['number', 'string']},
+            },
+            'additionalProperties': False,
+        },
     },
     'additionalProperties': False,
 }

@@ -1,8 +1,11 @@
-"""Serve client ops: up / status / down / logs.
+"""Serve ops: client routing + on-controller implementations.
 
 Counterpart of reference ``sky/serve/server/core.py`` + ``service.py:_start``
-(:139 forks controller + LB). ``up`` records the service and spawns the two
-detached processes; ``down`` flips the row to SHUTTING_DOWN and the
+(:139 forks controller + LB on a dedicated controller cluster,
+sky-serve-controller.yaml.j2). ``up`` ensures the serve-controller cluster
+is UP and runs ``serve.servecli`` on its head, which records the service
+and forks the controller + load-balancer there; the LB endpoint is the
+controller head's IP. ``down`` flips the row to SHUTTING_DOWN and the
 controller tears the fleet down (falling back to inline cleanup if the
 controller died).
 """
@@ -41,13 +44,9 @@ def _spawn(module: str, service_name: str, log_name: str) -> int:
     return proc.pid
 
 
-def up(task: task_lib.Task, service_name: str) -> Dict[str, Any]:
+def up_on_controller(task: task_lib.Task,
+                     service_name: str) -> Dict[str, Any]:
     """Start a service; returns {'name', 'endpoint'} immediately."""
-    if task.service is None:
-        raise exceptions.InvalidTaskError(
-            "Task has no 'service:' section; add one to use serve.")
-    from skypilot_tpu import admin_policy
-    task = admin_policy.apply(task, operation='serve_up')
     from skypilot_tpu.utils import common_utils
     common_utils.check_cluster_name_is_valid(service_name)
     created = serve_state.add_service(
@@ -79,13 +78,13 @@ def up(task: task_lib.Task, service_name: str) -> Dict[str, Any]:
                 f'Service {service_name!r} processes died during startup; '
                 f'see {_serve_dir(service_name)}/controller.log')
         time.sleep(0.2)
-    return {'name': service_name,
+    return {'name': service_name, 'lb_port': lb_port,
             'endpoint': (f'http://127.0.0.1:{lb_port}'
                          if lb_port else None)}
 
 
-def status(service_names: Optional[List[str]] = None
-           ) -> List[Dict[str, Any]]:
+def status_on_controller(service_names: Optional[List[str]] = None
+                         ) -> List[Dict[str, Any]]:
     rows = serve_state.list_services(names=service_names)
     out = []
     for row in rows:
@@ -95,6 +94,7 @@ def status(service_names: Optional[List[str]] = None
             'status': row['status'],
             'endpoint': (f'http://127.0.0.1:{row["lb_port"]}'
                          if row['lb_port'] else None),
+            'lb_port': row['lb_port'],
             'requested_replicas': row['requested_replicas'],
             'replicas': replicas,
         })
@@ -113,7 +113,8 @@ def _pid_alive(pid: Optional[int]) -> bool:
         return False
 
 
-def down(service_name: str, timeout: float = 180.0) -> None:
+def down_on_controller(service_name: str,
+                       timeout: float = 180.0) -> None:
     row = serve_state.get_service(service_name)
     if row is None:
         raise exceptions.ClusterDoesNotExist(
@@ -149,10 +150,103 @@ def down(service_name: str, timeout: float = 180.0) -> None:
             pass
 
 
-def controller_logs(service_name: str) -> str:
+def controller_logs_on_controller(service_name: str) -> str:
     try:
         with open(os.path.join(_serve_dir(service_name),
                                'controller.log')) as f:
             return f.read()
     except FileNotFoundError:
         return ''
+
+
+# ---- client side -----------------------------------------------------------
+def _servecli(args_str: str, timeout: Optional[float] = 240,
+              launch_if_missing: bool = True) -> tuple:
+    """(result, controller handle) via the shared controller RPC."""
+    from skypilot_tpu.utils import controller_utils
+    return controller_utils.controller_rpc(
+        controller_utils.SERVE_CONTROLLER, 'skypilot_tpu.serve.servecli',
+        args_str, timeout=timeout, launch_if_missing=launch_if_missing)
+
+
+def _head_host(handle) -> str:
+    from skypilot_tpu import provision as provision_lib
+    info = provision_lib.get_cluster_info(handle.cloud,
+                                          handle.cluster_name,
+                                          handle.region)
+    return info.head.external_ip or info.head.internal_ip
+
+
+def _parse(res, op: str) -> Dict[str, Any]:
+    from skypilot_tpu.utils import controller_utils
+    return controller_utils.parse_rpc_json(res, f'serve {op}')
+
+
+def up(task: task_lib.Task, service_name: str) -> Dict[str, Any]:
+    """Start a service on the serve-controller cluster."""
+    import json
+    import shlex
+    if task.service is None:
+        raise exceptions.InvalidTaskError(
+            "Task has no 'service:' section; add one to use serve.")
+    from skypilot_tpu import admin_policy
+    task = admin_policy.apply(task, operation='serve_up')
+    task_json = json.dumps(task.to_yaml_config())
+    res, handle = _servecli(
+        f'up --service-name {shlex.quote(service_name)} '
+        f'--task-json {shlex.quote(task_json)}')
+    payload = _parse(res, 'up')
+    lb_port = payload.get('lb_port')
+    endpoint = (f'http://{_head_host(handle)}:{lb_port}'
+                if lb_port else None)
+    return {'name': payload['name'], 'endpoint': endpoint,
+            'lb_port': lb_port}
+
+
+def status(service_names: Optional[List[str]] = None
+           ) -> List[Dict[str, Any]]:
+    import shlex
+    args = 'status'
+    if service_names:
+        args += ' --names ' + ' '.join(
+            shlex.quote(n) for n in service_names)
+    res, handle = _servecli(args, launch_if_missing=False)
+    if res is None:
+        return []
+    rows = _parse(res, 'status')['services']
+    host = _head_host(handle) if handle is not None else '127.0.0.1'
+    for row in rows:
+        row['status'] = ServiceStatus(row['status'])
+        row['endpoint'] = (f'http://{host}:{row["lb_port"]}'
+                           if row.get('lb_port') else None)
+        for rep in row['replicas']:
+            rep['status'] = serve_state.ReplicaStatus(rep['status'])
+    return rows
+
+
+def down(service_name: str, timeout: float = 180.0) -> None:
+    import shlex
+    res, _ = _servecli(
+        f'down --service-name {shlex.quote(service_name)} '
+        f'--timeout {timeout}', timeout=timeout + 60,
+        launch_if_missing=False)
+    if res is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Service {service_name!r} does not exist '
+            '(no serve controller cluster).')
+    _parse(res, 'down')
+
+
+def controller_logs(service_name: str) -> str:
+    import shlex
+    from skypilot_tpu.utils import controller_utils
+    handle = controller_utils.get_controller_handle(
+        controller_utils.SERVE_CONTROLLER)
+    if handle is None or handle.cloud == 'local':
+        return controller_logs_on_controller(service_name)
+    res, _ = _servecli(
+        f'controller-log --service-name {shlex.quote(service_name)}',
+        launch_if_missing=False)
+    if res is None or res.returncode != 0:
+        return ''
+    return res.stdout

@@ -1,0 +1,92 @@
+"""On-controller serve CLI: the client<->serve-controller protocol.
+
+Same shape as ``jobs.jobcli``: the client runs this module on the serve
+controller cluster's head host; machine commands print ONE JSON line.
+Errors are serialized into the JSON payload (exit 0) so the client can
+re-raise the typed exception instead of parsing stderr.
+
+Import-light: implementation modules load inside handlers.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _emit_error(e: Exception) -> int:
+    from skypilot_tpu import exceptions
+    print(json.dumps({'error': exceptions.serialize_exception(e)}))
+    return 0
+
+
+def _cmd_up(args) -> int:
+    from skypilot_tpu import exceptions
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.serve import core
+    try:
+        task = task_lib.Task.from_yaml_config(json.loads(args.task_json))
+        result = core.up_on_controller(task, args.service_name)
+    except exceptions.SkyTpuError as e:
+        return _emit_error(e)
+    print(json.dumps({'name': result['name'],
+                      'lb_port': result['lb_port']}))
+    return 0
+
+
+def _cmd_status(args) -> int:
+    from skypilot_tpu.serve import core
+    rows = core.status_on_controller(args.names or None)
+    for row in rows:
+        row['status'] = row['status'].value
+        for rep in row['replicas']:
+            rep['status'] = rep['status'].value
+    print(json.dumps({'services': rows}))
+    return 0
+
+
+def _cmd_down(args) -> int:
+    from skypilot_tpu import exceptions
+    from skypilot_tpu.serve import core
+    try:
+        core.down_on_controller(args.service_name, timeout=args.timeout)
+    except exceptions.SkyTpuError as e:
+        return _emit_error(e)
+    print(json.dumps({'down': args.service_name}))
+    return 0
+
+
+def _cmd_controller_log(args) -> int:
+    from skypilot_tpu.serve import core
+    sys.stdout.write(core.controller_logs_on_controller(args.service_name))
+    return 0
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(prog='skytpu-servecli')
+    sub = parser.add_subparsers(dest='cmd', required=True)
+
+    p = sub.add_parser('up')
+    p.add_argument('--service-name', required=True)
+    p.add_argument('--task-json', required=True)
+    p.set_defaults(fn=_cmd_up)
+
+    p = sub.add_parser('status')
+    p.add_argument('--names', nargs='*', default=[])
+    p.set_defaults(fn=_cmd_status)
+
+    p = sub.add_parser('down')
+    p.add_argument('--service-name', required=True)
+    p.add_argument('--timeout', type=float, default=180.0)
+    p.set_defaults(fn=_cmd_down)
+
+    p = sub.add_parser('controller-log')
+    p.add_argument('--service-name', required=True)
+    p.set_defaults(fn=_cmd_controller_log)
+
+    args = parser.parse_args()
+    sys.exit(args.fn(args))
+
+
+if __name__ == '__main__':
+    main()

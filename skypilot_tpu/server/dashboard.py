@@ -1,0 +1,133 @@
+"""HTML dashboard for the API server: clusters, managed jobs, services.
+
+Role of the reference's jobs Flask dashboard (sky/jobs/dashboard/) and the
+API-server HTML pages (sky/server/html/) in one dependency-free page at
+``GET /dashboard`` (auto-refreshing; read-only).
+"""
+from __future__ import annotations
+
+import html
+import time
+from typing import Any, List
+
+_PAGE = """<!doctype html>
+<html><head><title>skypilot_tpu dashboard</title>
+<meta http-equiv="refresh" content="10">
+<style>
+ body {{ font-family: system-ui, sans-serif; margin: 2rem; color: #222; }}
+ h1 {{ font-size: 1.3rem; }} h2 {{ font-size: 1.05rem; margin-top: 1.6rem; }}
+ table {{ border-collapse: collapse; min-width: 46rem; }}
+ th, td {{ text-align: left; padding: .3rem .8rem;
+           border-bottom: 1px solid #ddd; font-size: .9rem; }}
+ th {{ background: #f5f5f5; }}
+ .ok {{ color: #0a7a2f; font-weight: 600; }}
+ .warn {{ color: #b58900; font-weight: 600; }}
+ .bad {{ color: #c0392b; font-weight: 600; }}
+ .muted {{ color: #888; }}
+</style></head>
+<body>
+<h1>skypilot_tpu</h1>
+<p class="muted">refreshed {now}</p>
+<h2>Clusters</h2>{clusters}
+<h2>Managed jobs</h2>{jobs}
+<h2>Services</h2>{services}
+<h2>Recent API requests</h2>{requests}
+</body></html>"""
+
+_STATUS_CLASS = {
+    'UP': 'ok', 'RUNNING': 'ok', 'SUCCEEDED': 'ok', 'READY': 'ok',
+    'INIT': 'warn', 'PENDING': 'warn', 'STARTING': 'warn',
+    'RECOVERING': 'warn', 'STOPPED': 'warn',
+    'FAILED': 'bad', 'FAILED_SETUP': 'bad', 'FAILED_NO_RESOURCE': 'bad',
+    'FAILED_CONTROLLER': 'bad', 'CANCELLED': 'bad', 'SHUTTING_DOWN': 'bad',
+}
+
+
+def _status_cell(value: str) -> str:
+    cls = _STATUS_CLASS.get(value, 'muted')
+    return f'<span class="{cls}">{html.escape(value)}</span>'
+
+
+def _table(headers: List[str], rows: List[List[Any]]) -> str:
+    if not rows:
+        return '<p class="muted">none</p>'
+    head = ''.join(f'<th>{html.escape(h)}</th>' for h in headers)
+    body = ''
+    for row in rows:
+        cells = ''.join(f'<td>{c}</td>' for c in row)
+        body += f'<tr>{cells}</tr>'
+    return f'<table><tr>{head}</tr>{body}</table>'
+
+
+def _esc(v: Any) -> str:
+    return html.escape(str(v if v is not None else '-'))
+
+
+def render() -> str:
+    from skypilot_tpu import global_user_state
+
+    cluster_rows = []
+    for r in global_user_state.get_clusters():
+        handle = r['handle']
+        res = str(handle.launched_resources) if handle else '-'
+        cluster_rows.append([
+            _esc(r['name']), _status_cell(r['status'].value), _esc(res),
+            _esc(handle.num_hosts if handle else '-'),
+            _esc(f"{r['autostop']}m" if r['autostop'] >= 0 else '-'),
+        ])
+
+    job_rows = []
+    try:
+        from skypilot_tpu.jobs import state as jobs_state
+        for j in jobs_state.list_jobs():
+            job_rows.append([
+                _esc(j['job_id']), _esc(j['name']),
+                _status_cell(j['status'].value),
+                _esc(j['schedule_state'].value),
+                _esc(j['recovery_count']), _esc(j['cluster_name']),
+            ])
+    except Exception:  # jobs db absent on a fresh install
+        pass
+
+    service_rows = []
+    try:
+        from skypilot_tpu.serve import serve_state
+        for s in serve_state.list_services():
+            replicas = serve_state.list_replicas(s['name'])
+            ready = sum(1 for rep in replicas
+                        if rep['status'].value == 'READY')
+            service_rows.append([
+                _esc(s['name']), _status_cell(s['status'].value),
+                f'{ready}/{len(replicas)}',
+                _esc(s['lb_port'] or '-'),
+            ])
+    except Exception:
+        pass
+
+    request_rows = []
+    try:
+        from skypilot_tpu.server import requests_store
+        for req in requests_store.list_requests()[:20]:
+            created = req.get('created_at')
+            request_rows.append([
+                _esc(req.get('request_id', '')[:12]),
+                _esc(req.get('name')),
+                _status_cell(str(req.get('status')).upper()),
+                _esc(time.strftime('%H:%M:%S', time.localtime(created))
+                     if created else '-'),
+            ])
+    except Exception:
+        pass
+
+    return _PAGE.format(
+        now=html.escape(time.strftime('%Y-%m-%d %H:%M:%S')),
+        clusters=_table(
+            ['name', 'status', 'resources', 'hosts', 'autostop'],
+            cluster_rows),
+        jobs=_table(
+            ['id', 'name', 'status', 'schedule', 'recoveries', 'cluster'],
+            job_rows),
+        services=_table(['name', 'status', 'ready', 'lb port'],
+                        service_rows),
+        requests=_table(['id', 'op', 'status', 'created'], request_rows),
+    )

@@ -64,6 +64,14 @@ class _Handler(BaseHTTPRequestHandler):
         path, q = self._query()
         if path == '/healthz':
             self._json(200, {'status': 'healthy', 'version': 1})
+        elif path in ('/', '/dashboard'):
+            from skypilot_tpu.server import dashboard
+            page = dashboard.render().encode()
+            self.send_response(200)
+            self.send_header('Content-Type', 'text/html; charset=utf-8')
+            self.send_header('Content-Length', str(len(page)))
+            self.end_headers()
+            self.wfile.write(page)
         elif path == f'{API_PREFIX}/get':
             self._get_request(q)
         elif path == f'{API_PREFIX}/stream':

@@ -75,6 +75,11 @@ class Task:
         self._resources_ordered = False
         self.service: Optional[Any] = None  # serve.ServiceSpec
         self.config_overrides: Optional[Dict[str, Any]] = None
+        # Optimizer inputs (YAML `estimated:` section): the TIME objective
+        # and DP egress edges are inert without them (reference relies on
+        # time_estimator callbacks, sky/optimizer.py:237).
+        self.estimated_total_flops: Optional[float] = None
+        self.estimated_output_gb: Optional[float] = None
         # Set by the optimizer:
         self.best_resources: Optional[resources_lib.Resources] = None
         self.estimated_cost_per_hour: Optional[float] = None
@@ -253,6 +258,16 @@ class Task:
             task.set_service(
                 service_spec.ServiceSpec.from_yaml_config(config['service']))
         task.config_overrides = config.get('config_overrides')
+        est = config.get('estimated') or {}
+        for field, attr in (('total_flops', 'estimated_total_flops'),
+                            ('output_gb', 'estimated_output_gb')):
+            if est.get(field) is not None:
+                try:
+                    setattr(task, attr, float(est[field]))
+                except (TypeError, ValueError) as e:
+                    raise exceptions.InvalidTaskError(
+                        f'estimated.{field}: {est[field]!r} is not a '
+                        'number') from e
         return task
 
     @classmethod
@@ -298,6 +313,12 @@ class Task:
         if self.service is not None:
             cfg['service'] = self.service.to_yaml_config()
         add('config_overrides', self.config_overrides)
+        est = {}
+        if self.estimated_total_flops is not None:
+            est['total_flops'] = self.estimated_total_flops
+        if self.estimated_output_gb is not None:
+            est['output_gb'] = self.estimated_output_gb
+        add('estimated', est or None)
         return cfg
 
     # ---- misc -------------------------------------------------------------

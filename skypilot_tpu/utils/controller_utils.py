@@ -82,3 +82,45 @@ def get_controller_handle(spec: ControllerSpec) -> Optional['Any']:
     if record['status'] != global_user_state.ClusterStatus.UP:
         return None
     return record['handle']
+
+
+def controller_rpc(spec: ControllerSpec, module: str, args_str: str,
+                   stream_to: Any = None,
+                   timeout: Optional[float] = 120,
+                   launch_if_missing: bool = True
+                   ) -> tuple:
+    """Run a protocol module (jobs.jobcli / serve.servecli) on the
+    controller cluster's head. Returns (result, handle); both None when
+    the controller cluster does not exist and launch_if_missing is False.
+
+    The single client implementation of the controller protocol — jobs
+    and serve both speak through here so transport behavior can't
+    diverge.
+    """
+    from skypilot_tpu import backends
+    handle = get_controller_handle(spec)
+    if handle is None:
+        if not launch_if_missing:
+            return None, None
+        handle = ensure_controller_cluster(spec)
+    backend = backends.SliceBackend()
+    res = backend.run_module(handle, module, args_str,
+                             stream_to=stream_to, timeout=timeout)
+    return res, handle
+
+
+def parse_rpc_json(res: Any, op: str) -> Dict[str, Any]:
+    """Last-stdout-line JSON payload of a controller RPC; raises the
+    typed error carried in an ``error`` payload or a CommandError on a
+    nonzero exit."""
+    import json
+
+    from skypilot_tpu import exceptions
+    if res is None or res.returncode != 0:
+        raise exceptions.CommandError(
+            getattr(res, 'returncode', 1), f'controller rpc {op}',
+            getattr(res, 'stderr', '') or getattr(res, 'stdout', ''))
+    payload = json.loads(res.stdout.strip().splitlines()[-1])
+    if 'error' in payload:
+        raise exceptions.deserialize_exception(payload['error'])
+    return payload

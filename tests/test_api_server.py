@@ -40,6 +40,15 @@ class TestApiServer:
     def test_health(self, api_server):
         assert sdk.api_status()['status'] == 'healthy'
 
+    def test_dashboard_renders(self, api_server):
+        import urllib.request
+        from skypilot_tpu.client.sdk import server_url
+        page = urllib.request.urlopen(
+            server_url() + '/dashboard', timeout=30).read().decode()
+        assert 'Clusters' in page
+        assert 'Managed jobs' in page
+        assert 'Services' in page
+
     def test_launch_get_status_down(self, api_server):
         rid = sdk.launch(_local_task(), 'api-c1', detach_run=True)
         assert isinstance(rid, str) and len(rid) == 16

@@ -143,7 +143,7 @@ class TestControllerCluster:
             'skytpu-jobs-controller')
         assert record is not None
         assert record['status'] == global_user_state.ClusterStatus.UP
-        deadline = time.time() + 90
+        deadline = time.time() + 180  # generous: suite runs under load
         while time.time() < deadline:
             row = jobs_state.get(job_id)
             if row['status'].is_terminal():

@@ -124,3 +124,71 @@ def test_local_cloud_free():
     _optimize(t)
     assert t.best_resources.cloud == 'local'
     assert t.estimated_cost_per_hour == 0.0
+
+
+def test_general_dag_bnb_prefers_colocation():
+    """Diamond DAG (not a chain): exact branch-and-bound must colocate
+    downstream stages with a region-pinned source when egress dominates
+    the (higher) EU price the free stages would otherwise avoid."""
+    def build(output_gb):
+        with Dag('diamond') as dag:
+            a = Task('src', run='x')
+            b = Task('left', run='x')
+            c = Task('right', run='x')
+            d = Task('sink', run='x')
+            # Source pinned to the pricier EU region; the rest are free.
+            a.set_resources(Resources(accelerators='tpu-v5e-8',
+                                      region='europe-west4'))
+            for t in (b, c, d):
+                t.set_resources(Resources(accelerators='tpu-v5e-8'))
+            for t in (a, b, c, d):
+                # Time estimates make COST use total dollars, which is
+                # what egress fees are comparable against.
+                t.estimated_total_flops = 1e20
+                t.estimated_output_gb = output_gb
+            dag.add_edge(a, b)
+            dag.add_edge(a, c)
+            dag.add_edge(b, d)
+            dag.add_edge(c, d)
+        assert not dag.is_chain()
+        optimizer.optimize(dag, quiet=True)
+        return a, b, c, d
+
+    # Heavy egress: everything colocates with the pinned EU source.
+    a, b, c, d = build(output_gb=100000.0)
+    assert {t.best_resources.region for t in (a, b, c, d)} == \
+        {'europe-west4'}
+    # Negligible egress: free stages take the cheaper US price instead.
+    a2, b2, c2, d2 = build(output_gb=0.0)
+    assert b2.best_resources.region.startswith('us-')
+    assert d2.best_resources.region.startswith('us-')
+
+
+def test_time_objective_prefers_bigger_slice():
+    """With estimated FLOPs, TIME picks the biggest/fastest slice even
+    though it costs more."""
+    t = Task('big', run='x')
+    t.set_resources([Resources(accelerators='tpu-v5e-8'),
+                     Resources(accelerators='tpu-v5e-64')])
+    t.estimated_total_flops = 1e21
+    _optimize(t, minimize=OptimizeTarget.TIME)
+    assert t.best_resources.tpu.chips == 64
+    # COST picks the small slice.
+    t2 = Task('small', run='x')
+    t2.set_resources([Resources(accelerators='tpu-v5e-8'),
+                      Resources(accelerators='tpu-v5e-64')])
+    _optimize(t2, minimize=OptimizeTarget.COST)
+    assert t2.best_resources.tpu.chips == 8
+
+
+def test_estimated_fields_yaml_roundtrip():
+    t = Task.from_yaml_config({
+        'name': 'est',
+        'run': 'x',
+        'resources': {'accelerators': 'tpu-v5e-8'},
+        'estimated': {'total_flops': '8.4e21', 'output_gb': 12.5},
+    })
+    assert t.estimated_total_flops == pytest.approx(8.4e21)
+    assert t.estimated_output_gb == pytest.approx(12.5)
+    cfg = t.to_yaml_config()
+    assert cfg['estimated']['total_flops'] == pytest.approx(8.4e21)

@@ -201,6 +201,28 @@ def optimize(
     return dag
 
 
+def _use_total_cost(order: List[task_lib.Task],
+                    per_task: Dict[task_lib.Task, List[Candidate]],
+                    target: OptimizeTarget) -> bool:
+    """COST node weights can be total dollars (est_hours * $/h) only when
+    EVERY candidate has a time estimate — mixing total-$ and $/h weights
+    in one min() would favor whichever is numerically smaller, not
+    cheaper. Shared by the chain DP and the general-DAG solver."""
+    return (target == OptimizeTarget.COST and all(
+        c.est_time_s is not None for t in order for c in per_task[t]))
+
+
+def _greedy_assign(order: List[task_lib.Task],
+                   per_task: Dict[task_lib.Task, List[Candidate]]) -> None:
+    """Per-task first-candidate assignment (the fallback when joint
+    optimization is impossible or not worth it)."""
+    for task in order:
+        cands = per_task[task]
+        if cands:
+            task.best_resources = cands[0].resources
+            task.estimated_cost_per_hour = cands[0].cost_per_hour
+
+
 def _assign_chain_dp(dag: 'dag_lib.Dag',
                      per_task: Dict[task_lib.Task, List[Candidate]],
                      target: OptimizeTarget) -> None:
@@ -210,21 +232,12 @@ def _assign_chain_dp(dag: 'dag_lib.Dag',
     $/GB between (cloud, region) pairs with task.estimated_output_gb.
     """
     order = dag.topological_order()
-    # COST node weights can be total dollars (est_hours * $/h) only when
-    # EVERY candidate in the DP has a time estimate — mixing total-$ and
-    # $/h weights in one min() would favor whichever is numerically
-    # smaller, not cheaper.
-    use_total_cost = (target == OptimizeTarget.COST and all(
-        c.est_time_s is not None for t in order for c in per_task[t]))
+    use_total_cost = _use_total_cost(order, per_task, target)
     if any(not per_task[t] for t in order):
         # raise_error=False path: a task with zero candidates makes the chain
         # unassignable — fall back to greedy per-task assignment for the
         # tasks that do have candidates instead of crashing.
-        for task in order:
-            cands = per_task[task]
-            if cands:
-                task.best_resources = cands[0].resources
-                task.estimated_cost_per_hour = cands[0].cost_per_hour
+        _greedy_assign(order, per_task)
         return
     # dp[i][j] = (score, parent_index) for candidate j of task i.
     dp: List[List[Tuple[float, Optional[int]]]] = []
@@ -306,50 +319,64 @@ def _assign_general_bnb(dag: 'dag_lib.Dag',
     """
     order = dag.topological_order()
     if any(not per_task[t] for t in order):
-        for task in order:
-            if per_task[task]:
-                task.best_resources = per_task[task][0].resources
-                task.estimated_cost_per_hour = per_task[task][0].cost_per_hour
+        _greedy_assign(order, per_task)
         return
+    idx = {t: i for i, t in enumerate(order)}
+    parents = [[p for p in order if t in dag._edges[p]] for t in order]
     size_product = 1.0
     for t in order:
         size_product *= max(1, len(per_task[t]))
-    if size_product > 5e7:  # genuinely huge: greedy beats an exact stall
-        for task in order:
-            task.best_resources = per_task[task][0].resources
-            task.estimated_cost_per_hour = per_task[task][0].cost_per_hour
+    edge_entries = sum(
+        len(per_task[p]) * len(per_task[t])
+        for i, t in enumerate(order) for p in parents[i])
+    if size_product > 5e7 or edge_entries > 5e6:
+        # Genuinely huge: greedy beats an exact stall (and a multi-GB
+        # edge-weight table).
+        _greedy_assign(order, per_task)
         return
 
-    use_total_cost = (target == OptimizeTarget.COST and all(
-        c.est_time_s is not None for t in order for c in per_task[t]))
+    use_total_cost = _use_total_cost(order, per_task, target)
 
-    def node_weight(cand: Candidate) -> float:
-        own = cand.sort_key(target)[0]
-        if use_total_cost:
-            own = cand.cost_per_hour * cand.est_time_s / 3600.0
-        return own
+    # Precompute every weight once: the DFS revisits (task, candidate)
+    # and (parent-cand, cand) pairs many times, and each edge weight does
+    # catalog lookups — recomputing inside the search turns a weakly
+    # pruned instance into an optimizer stall.
+    node_w: List[List[float]] = []
+    for t in order:
+        row = []
+        for c in per_task[t]:
+            own = c.sort_key(target)[0]
+            if use_total_cost:
+                own = c.cost_per_hour * c.est_time_s / 3600.0
+            row.append(own)
+        node_w.append(row)
+    # edge_w[i][p_local][pj][j]: parent p (local index among parents[i]),
+    # parent candidate pj, own candidate j.
+    edge_w: List[List[List[List[float]]]] = []
+    for i, t in enumerate(order):
+        per_parent = []
+        for p in parents[i]:
+            per_parent.append([
+                [_edge_weight(p, pc, c, target, use_total_cost)
+                 for c in per_task[t]]
+                for pc in per_task[p]
+            ])
+        edge_w.append(per_parent)
 
-    idx = {t: i for i, t in enumerate(order)}
-    parents = [[p for p in order if t in dag._edges[p]] for t in order]
     # Admissible remainder bound: best node weight per remaining task
     # (edges are nonnegative).
-    min_node = [min(node_weight(c) for c in per_task[t]) for t in order]
     suffix_min = [0.0] * (len(order) + 1)
     for i in range(len(order) - 1, -1, -1):
-        suffix_min[i] = suffix_min[i + 1] + min_node[i]
+        suffix_min[i] = suffix_min[i + 1] + min(node_w[i])
 
     # Seed with the greedy assignment: guarantees a valid answer even when
     # every weight is inf (e.g. TIME objective with missing estimates —
     # the bound would otherwise prune the entire search).
     best_choice: List[int] = [0] * len(order)
     best_cost = 0.0
-    for i, task in enumerate(order):
-        cand = per_task[task][0]
-        w = node_weight(cand)
-        for p in parents[i]:
-            w += _edge_weight(p, per_task[p][0], cand, target,
-                              use_total_cost)
-        best_cost += w
+    for i in range(len(order)):
+        best_cost += node_w[i][0] + sum(
+            edge_w[i][pl][0][0] for pl in range(len(parents[i])))
     choice: List[int] = []
 
     def dfs(i: int, acc: float) -> None:
@@ -360,13 +387,12 @@ def _assign_general_bnb(dag: 'dag_lib.Dag',
             best_cost = acc
             best_choice = list(choice)
             return
-        task = order[i]
+        parent_choices = [choice[idx[p]] for p in parents[i]]
         scored = []
-        for j, cand in enumerate(per_task[task]):
-            w = node_weight(cand)
-            for p in parents[i]:
-                w += _edge_weight(p, per_task[p][choice[idx[p]]], cand,
-                                  target, use_total_cost)
+        for j in range(len(per_task[order[i]])):
+            w = node_w[i][j]
+            for pl, pj in enumerate(parent_choices):
+                w += edge_w[i][pl][pj][j]
             scored.append((w, j))
         scored.sort()  # try promising branches first for tight bounds
         for w, j in scored:

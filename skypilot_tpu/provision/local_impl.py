@@ -92,15 +92,44 @@ def wait_instances(cluster_name: str, region: str, state: str = 'running',
             f'(meta={meta})')
 
 
+def _kill_agent(cluster_name: str) -> None:
+    """Stop the head agent process ("power off" the emulated host).
+
+    Real clouds get this for free when the instance dies; locally the
+    agent is a subprocess of nothing and must be killed explicitly or it
+    outlives its cluster forever.
+    """
+    import signal
+
+    from skypilot_tpu.runtime import constants as rt_constants
+    pid_path = os.path.join(_cluster_dir(cluster_name), 'host0',
+                            rt_constants.RUNTIME_DIR,
+                            rt_constants.AGENT_PID_FILE)
+    try:
+        with open(pid_path) as f:
+            pid = int(f.read().strip())
+        # A crashed agent leaves a stale pid file and the OS may reuse
+        # the PID: only kill a process that really is our agent.
+        with open(f'/proc/{pid}/cmdline', 'rb') as f:
+            if b'skypilot_tpu.runtime.agent' not in f.read():
+                return
+        os.kill(pid, signal.SIGTERM)
+    except (FileNotFoundError, ValueError, ProcessLookupError,
+            PermissionError):
+        pass
+
+
 def stop_instances(cluster_name: str, region: str) -> None:
     meta = _read_metadata(cluster_name)
     if meta is None:
         return
+    _kill_agent(cluster_name)
     meta['status'] = 'stopped'
     _write_metadata(cluster_name, meta)
 
 
 def terminate_instances(cluster_name: str, region: str) -> None:
+    _kill_agent(cluster_name)
     shutil.rmtree(_cluster_dir(cluster_name), ignore_errors=True)
 
 

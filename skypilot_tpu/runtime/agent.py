@@ -221,6 +221,12 @@ class Agent:
                                constants.AGENT_PID_FILE), 'w') as f:
             f.write(str(os.getpid()))
         while True:
+            if not os.path.isdir(self.runtime_dir):
+                # The cluster was torn down underneath us (local-cloud
+                # terminate rmtree's the host dirs; on VMs the host dies
+                # with the instance). Without this exit, every teardown
+                # leaks an agent that ticks forever.
+                return
             try:
                 self._schedule_jobs()
                 self._autostop_check()

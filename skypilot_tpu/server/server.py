@@ -66,8 +66,17 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(200, {'status': 'healthy', 'version': 1})
         elif path in ('/', '/dashboard'):
             from skypilot_tpu.server import dashboard
-            page = dashboard.render().encode()
-            self.send_response(200)
+            try:
+                page = dashboard.render().encode()
+                code = 200
+            except Exception as e:  # noqa: BLE001 — a bad row must not
+                # drop the connection responseless
+                import html as html_lib
+                page = (f'<html><body><h1>dashboard error</h1>'
+                        f'<pre>{html_lib.escape(repr(e))}</pre>'
+                        '</body></html>').encode()
+                code = 500
+            self.send_response(code)
             self.send_header('Content-Type', 'text/html; charset=utf-8')
             self.send_header('Content-Length', str(len(page)))
             self.end_headers()

@@ -1,0 +1,98 @@
+"""CLI surface coverage beyond the core launch cycle: show-tpus,
+cost-report, optimize, bench group, jobs guards, api group parses."""
+from click.testing import CliRunner
+
+from skypilot_tpu import cli
+
+
+def _invoke(*args, **kwargs):
+    runner = CliRunner()
+    return runner.invoke(cli.cli, list(args), **kwargs)
+
+
+class TestInformational:
+
+    def test_show_tpus_lists_slices(self):
+        res = _invoke('show-tpus', '--generation', 'v5e')
+        assert res.exit_code == 0, res.output
+        assert 'tpu-v5e-8' in res.output
+        assert 'TFLOPS_PER_$HR' in res.output
+
+    def test_show_tpus_refresh_offline(self):
+        res = _invoke('show-tpus', '--refresh', '--generation', 'v6e')
+        assert res.exit_code == 0, res.output
+        assert 'Catalog refreshed' in res.output
+        assert 'tpu-v6e-8' in res.output
+
+    def test_cost_report_empty(self):
+        res = _invoke('cost-report')
+        assert res.exit_code == 0
+        assert 'No cluster history' in res.output
+
+    def test_check_probes_all_clouds(self):
+        res = _invoke('check')
+        assert res.exit_code == 0
+        for cloud in ('gcp', 'kubernetes', 'local'):
+            assert cloud in res.output
+        # Each cloud printed exactly once.
+        assert res.output.count(' local') == 1
+
+    def test_optimize_dryrun_table(self, tmp_path):
+        yaml = tmp_path / 't.yaml'
+        yaml.write_text('run: echo x\n'
+                        'resources: {accelerators: tpu-v5e-8}\n')
+        import pytest
+        monkey = pytest.MonkeyPatch()
+        monkey.setenv('SKYTPU_FAKE_GCP_CREDENTIALS', '1')
+        try:
+            res = _invoke('optimize', str(yaml))
+            assert res.exit_code == 0, res.output
+            assert 'TFLOPS/$' in res.output
+        finally:
+            monkey.undo()
+
+
+class TestGuards:
+
+    def test_jobs_cancel_requires_ids_or_all(self):
+        res = _invoke('jobs', 'cancel')
+        assert res.exit_code != 0
+        assert 'Specify job ids or --all' in res.output
+
+    def test_down_unknown_cluster_errors(self):
+        res = _invoke('down', 'no-such-cluster', '--yes')
+        assert res.exit_code != 0
+
+    def test_launch_rejects_bad_accelerator(self):
+        res = _invoke('launch', '--tpus', 'tpu-v99-8', '--cmd', 'x')
+        assert res.exit_code != 0
+
+
+class TestBenchCli:
+
+    def test_bench_ls_empty(self):
+        res = _invoke('bench', 'ls')
+        assert res.exit_code == 0
+        assert 'No benchmarks' in res.output
+
+    def test_bench_show_unknown(self):
+        res = _invoke('bench', 'show', 'nope')
+        assert res.exit_code == 0
+        assert 'No results' in res.output
+
+    def test_bench_launch_requires_candidates(self):
+        res = _invoke('bench', 'launch', 'x.yaml', '-b', 'b1')
+        assert res.exit_code != 0  # --candidates required
+
+
+class TestHelpSurface:
+
+    def test_groups_exist(self):
+        res = _invoke('--help')
+        for group in ('jobs', 'serve', 'storage', 'bench', 'api'):
+            assert group in res.output
+
+    def test_fast_flag_documented(self):
+        res = _invoke('launch', '--help')
+        assert '--fast' in res.output
+        assert '--retry-until-up' in res.output

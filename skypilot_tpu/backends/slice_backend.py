@@ -400,6 +400,10 @@ class SliceBackend(backend_lib.Backend):
             'env': dict(task.envs_and_secrets),
             'num_hosts': handle.num_hosts,
             'workdir': rt_constants.WORKDIR,
+            # TPU slices are exclusively owned by one JAX process group;
+            # CPU clusters (controllers etc.) run jobs concurrently
+            # (runtime/job_lib.next_pending_job scheduling rules).
+            'exclusive': handle.launched_resources.tpu is not None,
         }
         name = task.name or handle.cluster_name
         args = (f'add --name {shlex.quote(name)} '

@@ -92,44 +92,77 @@ def wait_instances(cluster_name: str, region: str, state: str = 'running',
             f'(meta={meta})')
 
 
-def _kill_agent(cluster_name: str) -> None:
-    """Stop the head agent process ("power off" the emulated host).
+def _kill_host_processes(cluster_name: str) -> None:
+    """"Power off" the emulated hosts: kill the head agent and every
+    job's process group.
 
-    Real clouds get this for free when the instance dies; locally the
-    agent is a subprocess of nothing and must be killed explicitly or it
-    outlives its cluster forever.
+    Real clouds get this for free when the instance dies; locally these
+    are plain processes that outlive their cluster unless killed.
     """
+    import glob as glob_lib
     import signal
 
     from skypilot_tpu.runtime import constants as rt_constants
-    pid_path = os.path.join(_cluster_dir(cluster_name), 'host0',
-                            rt_constants.RUNTIME_DIR,
+    root = _cluster_dir(cluster_name)
+    pid_path = os.path.join(root, 'host0', rt_constants.RUNTIME_DIR,
                             rt_constants.AGENT_PID_FILE)
     try:
         with open(pid_path) as f:
             pid = int(f.read().strip())
         # A crashed agent leaves a stale pid file and the OS may reuse
-        # the PID: only kill a process that really is our agent.
-        with open(f'/proc/{pid}/cmdline', 'rb') as f:
-            if b'skypilot_tpu.runtime.agent' not in f.read():
-                return
-        os.kill(pid, signal.SIGTERM)
+        # the PID: only kill a process that really is our agent. Without
+        # /proc (macOS) the identity check is unavailable — kill anyway:
+        # the pid came from our own fresh pid file.
+        try:
+            with open(f'/proc/{pid}/cmdline', 'rb') as f:
+                verified = b'skypilot_tpu.runtime.agent' in f.read()
+        except FileNotFoundError:
+            verified = not os.path.isdir('/proc')
+        if verified:
+            os.kill(pid, signal.SIGTERM)
+            # Wait for the death: an immediate restart's is-agent-alive
+            # check must not race a still-dying process (it would skip
+            # spawning a fresh agent and the bring-up barrier then waits
+            # on a heartbeat nobody writes).
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                try:
+                    os.kill(pid, 0)
+                except ProcessLookupError:
+                    break
+                time.sleep(0.05)
+            else:
+                os.kill(pid, signal.SIGKILL)
     except (FileNotFoundError, ValueError, ProcessLookupError,
             PermissionError):
         pass
+    # The "host" is off: no agent pid is valid anymore.
+    try:
+        os.remove(pid_path)
+    except FileNotFoundError:
+        pass
+    # Job leaders run setsid'd (their pgid == the pid in the file).
+    for job_pid_file in glob_lib.glob(
+            os.path.join(root, 'host*', '.skytpu_job_*.pid')):
+        try:
+            with open(job_pid_file) as f:
+                os.killpg(int(f.read().strip()), signal.SIGTERM)
+        except (FileNotFoundError, ValueError, ProcessLookupError,
+                PermissionError):
+            pass
 
 
 def stop_instances(cluster_name: str, region: str) -> None:
     meta = _read_metadata(cluster_name)
     if meta is None:
         return
-    _kill_agent(cluster_name)
+    _kill_host_processes(cluster_name)
     meta['status'] = 'stopped'
     _write_metadata(cluster_name, meta)
 
 
 def terminate_instances(cluster_name: str, region: str) -> None:
-    _kill_agent(cluster_name)
+    _kill_host_processes(cluster_name)
     shutil.rmtree(_cluster_dir(cluster_name), ignore_errors=True)
 
 

@@ -171,17 +171,21 @@ class Agent:
 
     # -- events --------------------------------------------------------------
     def _schedule_jobs(self) -> None:
-        job = job_lib.next_pending_job(self.runtime_dir)
-        if job is None or job['job_id'] in self.drivers:
-            return
-        # Mark SETTING_UP synchronously BEFORE the driver thread starts:
-        # otherwise the next tick can re-pop the same PENDING job and run it
-        # twice (the driver's RUNNING update races the tick).
-        job_lib.set_status(self.runtime_dir, job['job_id'],
-                           job_lib.JobStatus.SETTING_UP)
-        driver = JobDriver(self, job)
-        self.drivers[job['job_id']] = driver
-        driver.start()
+        # Keep popping: concurrent (non-exclusive) jobs may admit several
+        # starts per tick; next_pending_job returns None when the
+        # scheduling rules (exclusivity, concurrency cap) say stop.
+        while True:
+            job = job_lib.next_pending_job(self.runtime_dir)
+            if job is None or job['job_id'] in self.drivers:
+                return
+            # Mark SETTING_UP synchronously BEFORE the driver thread
+            # starts: otherwise the next pop re-selects the same PENDING
+            # job and runs it twice (the driver's RUNNING update races).
+            job_lib.set_status(self.runtime_dir, job['job_id'],
+                               job_lib.JobStatus.SETTING_UP)
+            driver = JobDriver(self, job)
+            self.drivers[job['job_id']] = driver
+            driver.start()
 
     def _autostop_check(self) -> None:
         if self._autostop_fired:
@@ -220,12 +224,23 @@ class Agent:
         with open(os.path.join(self.runtime_dir,
                                constants.AGENT_PID_FILE), 'w') as f:
             f.write(str(os.getpid()))
+        # A previous agent (stop/crash) may have left SETTING_UP/RUNNING
+        # rows it can no longer drive; an exclusive orphan would block
+        # the FIFO forever.
+        orphans = job_lib.fail_orphaned_jobs(self.runtime_dir)
+        if orphans:
+            with open(os.path.join(self.runtime_dir,
+                                   constants.AGENT_LOG_FILE), 'a') as f:
+                f.write(f'[agent] failed orphaned jobs: {orphans}\n')
+        info_path = os.path.join(self.runtime_dir,
+                                 constants.CLUSTER_INFO_FILE)
         while True:
-            if not os.path.isdir(self.runtime_dir):
+            if not os.path.exists(info_path):
                 # The cluster was torn down underneath us (local-cloud
                 # terminate rmtree's the host dirs; on VMs the host dies
-                # with the instance). Without this exit, every teardown
-                # leaks an agent that ticks forever.
+                # with the instance). Keyed on cluster_info.json, not the
+                # dir: a concurrent sqlite open can resurrect the bare
+                # dir mid-teardown, but nothing recreates the info file.
                 return
             try:
                 self._schedule_jobs()

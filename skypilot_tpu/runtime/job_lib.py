@@ -154,16 +154,51 @@ def list_jobs(runtime_dir: str,
         conn.close()
 
 
+def _max_concurrent_jobs() -> int:
+    override = os.environ.get('SKYTPU_MAX_CONCURRENT_JOBS')
+    if override:
+        try:
+            return max(1, int(override))
+        except ValueError:
+            pass  # malformed override must not wedge the scheduler
+    return max(1, (os.cpu_count() or 8) // 2)
+
+
+def fail_orphaned_jobs(runtime_dir: str) -> List[int]:
+    """Mark SETTING_UP/RUNNING rows FAILED: called at agent startup, when
+    any such row is an orphan of a previous agent (stop/crash killed the
+    agent mid-job; nothing else ever updates those rows, and an exclusive
+    orphan would block the scheduler forever)."""
+    orphans = [j['job_id'] for j in list_jobs(
+        runtime_dir, statuses=[JobStatus.SETTING_UP, JobStatus.RUNNING])]
+    for job_id in orphans:
+        set_status(runtime_dir, job_id, JobStatus.FAILED)
+    return orphans
+
+
 def next_pending_job(runtime_dir: str) -> Optional[Dict[str, Any]]:
-    """FIFO: oldest PENDING job, but only when nothing is active (one job at
-    a time per cluster keeps TPU chips exclusively owned, matching the
-    all-chips-visible JAX process model)."""
+    """Strict-FIFO scheduler with TPU exclusivity (reference FIFOScheduler,
+    sky/skylet/job_lib.py:282, adapted to chips):
+
+    - An ``exclusive`` job (the backend marks TPU-slice tasks so — chips
+      are owned by ONE JAX process group) runs alone: it waits for the
+      cluster to drain and blocks everything behind it while running.
+    - Non-exclusive (CPU) jobs run concurrently up to a CPU-derived cap.
+    - FIFO is strict: a blocked head-of-line job is never skipped.
+    """
     active = list_jobs(runtime_dir, statuses=[JobStatus.SETTING_UP,
                                               JobStatus.RUNNING])
-    if active:
-        return None
     pending = list_jobs(runtime_dir, statuses=[JobStatus.PENDING])
-    return pending[-1] if pending else None
+    if not pending:
+        return None
+    job = pending[-1]  # oldest first
+    if any(j['spec'].get('exclusive', True) for j in active):
+        return None
+    if job['spec'].get('exclusive', True):
+        return job if not active else None
+    if len(active) >= _max_concurrent_jobs():
+        return None
+    return job
 
 
 def cancel_jobs(runtime_dir: str,

@@ -14,6 +14,7 @@ import pytest
 import skypilot_tpu as sky
 from skypilot_tpu import core
 from skypilot_tpu import exceptions
+from skypilot_tpu import backends
 from skypilot_tpu import execution
 from skypilot_tpu import global_user_state
 from skypilot_tpu.runtime import job_lib
@@ -228,3 +229,73 @@ class TestFailover:
         assert any('capacity' in str(e) for e in ei.value.failover_history)
         # State record cleaned up after total failure.
         assert global_user_state.get_cluster_from_name('t-cap') is None
+
+
+class TestJobConcurrency:
+    """CPU jobs run concurrently; TPU-slice jobs stay exclusive
+    (runtime/job_lib.next_pending_job scheduling rules)."""
+
+    def test_cpu_jobs_run_concurrently(self, tmp_path, monkeypatch):
+        monkeypatch.setenv('SKYTPU_MAX_CONCURRENT_JOBS', '4')
+        script = 'sleep 3'
+        task = _local_task(script)
+        _, handle = execution.launch(task, cluster_name='t-conc',
+                                     detach_run=True)
+        backend = backends.SliceBackend()
+        ids = [1] + [backend.execute(handle, _local_task(script),
+                                     detach_run=True) for _ in range(2)]
+        # Observe >1 job simultaneously RUNNING (serial execution never
+        # shows that).
+        max_parallel = 0
+        deadline = time.time() + 60
+        done = set()
+        while time.time() < deadline and len(done) < len(ids):
+            running = 0
+            for jid in ids:
+                s = core.job_status('t-conc', jid)
+                if s and job_lib.JobStatus(s).is_terminal():
+                    done.add(jid)
+                elif s in ('SETTING_UP', 'RUNNING'):
+                    running += 1
+            max_parallel = max(max_parallel, running)
+            time.sleep(0.1)
+        assert len(done) == len(ids)
+        assert max_parallel >= 2, \
+            f'jobs never overlapped (max parallel {max_parallel})'
+        core.down('t-conc')
+
+    def test_tpu_slice_jobs_stay_exclusive(self, tmp_path):
+        import skypilot_tpu as sky
+        marker = tmp_path / 'serial'
+        script = (f'prev=$(cat {marker} 2>/dev/null || echo 0); '
+                  f'echo started >> {marker}.log; '
+                  'sleep 1; '
+                  f'echo done-$prev >> {marker}.done')
+        task = sky.Task(run=script)
+        task.set_resources([sky.Resources(cloud='local',
+                                          accelerators='tpu-v5e-8')])
+        _, handle = execution.launch(task, cluster_name='t-excl',
+                                     detach_run=True)
+        backend = backends.SliceBackend()
+        jid2 = backend.execute(handle, task, detach_run=True)
+        # While job 1 runs (1s sleep), job 2 must not be RUNNING.
+        import time as time_lib
+        from skypilot_tpu.runtime import job_lib
+        overlap = False
+        deadline = time_lib.time() + 60
+        done = set()
+        while time_lib.time() < deadline and len(done) < 2:
+            statuses = {}
+            for jid in (1, jid2):
+                s = core.job_status('t-excl', jid)
+                statuses[jid] = s
+                if s and job_lib.JobStatus(s).is_terminal():
+                    done.add(jid)
+            running = [j for j, s in statuses.items()
+                       if s in ('SETTING_UP', 'RUNNING')]
+            if len(running) > 1:
+                overlap = True
+            time_lib.sleep(0.1)
+        assert not overlap, 'exclusive jobs overlapped'
+        assert len(done) == 2
+        core.down('t-excl')

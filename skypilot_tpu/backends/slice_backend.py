@@ -100,6 +100,12 @@ class RetryingProvisioner:
         for zone in zones:
             deploy_vars = cloud.make_deploy_variables(
                 resources, name_on_cloud, region, zone)
+            # num_nodes: N with a TPU slice = N slices ganged into one job
+            # over DCN (multi-slice); providers provision N atomic slices
+            # and the agent emits slice-aware rank env (MEGASCALE_*).
+            # Plain CPU clusters use num_nodes as ordinary host count.
+            deploy_vars['num_slices'] = (max(1, task.num_nodes)
+                                         if resources.tpu is not None else 1)
             try:
                 provision_lib.run_instances(
                     cloud.NAME, cluster_name, region, zone,

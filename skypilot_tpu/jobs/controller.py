@@ -71,15 +71,29 @@ class JobsController:
         except exceptions.SkyTpuError:
             pass
 
+    def _finish(self, status: ManagedJobStatus,
+                failure_reason: Optional[str] = None) -> None:
+        """Terminalize: teardown -> release schedule slot -> publish status.
+
+        Publishing the terminal status LAST keeps the invariant that a
+        terminal row implies the ephemeral cluster is gone and the
+        schedule slot is DONE (reference cleanup discipline,
+        sky/jobs/controller.py:508; scheduler sky/jobs/scheduler.py:86).
+        If this process dies mid-sequence the row is still non-terminal
+        with a dead pid, so the reconciler retires it and frees the slot.
+        """
+        self._down_cluster()
+        scheduler.job_done(self.job_id)
+        state.set_status(self.job_id, status, failure_reason=failure_reason)
+
     def _fail_no_resource(self, reason: str) -> None:
         """Terminalize a failed provision — as CANCELLED if a cancel
         arrived while the provision was in flight (user intent wins)."""
         if state.cancel_requested(self.job_id):
-            self._down_cluster()
-            state.set_status(self.job_id, ManagedJobStatus.CANCELLED)
+            self._finish(ManagedJobStatus.CANCELLED)
             return
-        state.set_status(self.job_id, ManagedJobStatus.FAILED_NO_RESOURCE,
-                         failure_reason=reason)
+        self._finish(ManagedJobStatus.FAILED_NO_RESOURCE,
+                     failure_reason=reason)
 
     def _handle_cancel(self, cluster_job_id: Optional[int]) -> None:
         if cluster_job_id is not None:
@@ -87,8 +101,7 @@ class JobsController:
                 core.cancel(self.cluster_name, [cluster_job_id])
             except exceptions.SkyTpuError:
                 pass
-        self._down_cluster()
-        state.set_status(self.job_id, ManagedJobStatus.CANCELLED)
+        self._finish(ManagedJobStatus.CANCELLED)
 
     # -- main ----------------------------------------------------------------
     def run(self) -> None:
@@ -126,13 +139,11 @@ class JobsController:
                 state.set_status(job_id, ManagedJobStatus.RUNNING,
                                  respect_cancelling=True)
             elif status == cluster_job_lib.JobStatus.SUCCEEDED:
-                state.set_status(job_id, ManagedJobStatus.SUCCEEDED)
-                self._down_cluster()
+                self._finish(ManagedJobStatus.SUCCEEDED)
                 return
             elif status == cluster_job_lib.JobStatus.FAILED_SETUP:
-                state.set_status(job_id, ManagedJobStatus.FAILED_SETUP,
-                                 failure_reason='task setup failed')
-                self._down_cluster()
+                self._finish(ManagedJobStatus.FAILED_SETUP,
+                             failure_reason='task setup failed')
                 return
             elif status == cluster_job_lib.JobStatus.FAILED:
                 # User-code failure on a healthy cluster.
@@ -151,14 +162,11 @@ class JobsController:
                     state.set_status(job_id, ManagedJobStatus.RUNNING,
                                      respect_cancelling=True)
                 else:
-                    state.set_status(
-                        job_id, ManagedJobStatus.FAILED,
-                        failure_reason='task run: non-zero exit')
-                    self._down_cluster()
+                    self._finish(ManagedJobStatus.FAILED,
+                                 failure_reason='task run: non-zero exit')
                     return
             elif status == cluster_job_lib.JobStatus.CANCELLED:
-                state.set_status(job_id, ManagedJobStatus.CANCELLED)
-                self._down_cluster()
+                self._finish(ManagedJobStatus.CANCELLED)
                 return
             time.sleep(_poll_interval())
 
@@ -171,10 +179,12 @@ def main() -> None:
         JobsController(args.job_id).run()
     except Exception as e:  # noqa: BLE001 — controller itself failed
         traceback.print_exc()
+        # Same ordering as _finish: free the slot, then publish terminal.
+        scheduler.job_done(args.job_id)
         state.set_status(args.job_id, ManagedJobStatus.FAILED_CONTROLLER,
                          failure_reason=f'{type(e).__name__}: {e}')
     finally:
-        scheduler.job_done(args.job_id)
+        scheduler.job_done(args.job_id)  # idempotent backstop
 
 
 if __name__ == '__main__':

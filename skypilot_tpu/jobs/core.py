@@ -163,6 +163,18 @@ def queue_on_controller(reconcile: bool = True) -> List[Dict[str, Any]]:
                 row['status'] = ManagedJobStatus.FAILED_CONTROLLER
                 row['schedule_state'] = state.ScheduleState.DONE
                 reconciled = True
+            elif (row['status'].is_terminal()
+                    and row['schedule_state'] != state.ScheduleState.DONE
+                    and not _controller_alive(row['controller_pid'])):
+                # Terminal but its slot was never freed (controller died
+                # between publishing terminal status and job_done under a
+                # pre-fix ordering, or the DB was written externally).
+                # Without this, a ghost ALIVE row permanently consumes
+                # the parallelism cap.
+                state.set_schedule_state(row['job_id'],
+                                         state.ScheduleState.DONE)
+                row['schedule_state'] = state.ScheduleState.DONE
+                reconciled = True
     if reconciled:
         scheduler.maybe_schedule_next_jobs()  # freed slots
     return rows

@@ -293,7 +293,9 @@ class LlamaModel:
             positions = jnp.arange(tokens.shape[1])
         cos, sin = precompute_rotary(c.head_dim, c.max_seq_len, c.rope_theta)
 
-        x = params['embed'][tokens].astype(c.dtype)
+        from skypilot_tpu.ops.embedding import embed_lookup
+        x = embed_lookup(params['embed'], tokens, self.mesh,
+                         self.rules).astype(c.dtype)
         x = self._constrain(x, 'batch', 'seq', 'act_embed')
 
         pp = self.mesh.shape.get('pp', 1) if self.mesh is not None else 1

@@ -14,10 +14,12 @@ Axes (any subset may be size 1):
   - ``sp``   sequence/context parallel (ring attention over the seq axis)
   - ``ep``   expert parallel (MoE experts spread over devices)
   - ``pp``   pipeline parallel (stage-sharded layers)
+  - ``dcn``  cross-slice data parallel (multi-slice over data-center network)
 """
 from skypilot_tpu.parallel.mesh import (MESH_AXES, MeshSpec, make_mesh)
 from skypilot_tpu.parallel.sharding import (LogicalRules, NamedSharding,
                                             logical_sharding,
+                                            multislice_rules,
                                             shard_constraint)
 from skypilot_tpu.parallel.pipeline import pipeline, split_stages
 from skypilot_tpu.parallel.ring_attention import ring_attention
@@ -31,6 +33,7 @@ __all__ = [
     'LogicalRules',
     'NamedSharding',
     'logical_sharding',
+    'multislice_rules',
     'shard_constraint',
     'ring_attention',
 ]

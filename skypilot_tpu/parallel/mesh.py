@@ -20,7 +20,7 @@ from typing import Dict, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
-MESH_AXES: Tuple[str, ...] = ('pp', 'dp', 'fsdp', 'ep', 'sp', 'tp')
+MESH_AXES: Tuple[str, ...] = ('dcn', 'pp', 'dp', 'fsdp', 'ep', 'sp', 'tp')
 
 
 @dataclasses.dataclass(frozen=True)
@@ -29,9 +29,16 @@ class MeshSpec:
 
     Axis order is fixed (``MESH_AXES``) with ``tp`` innermost: tensor
     parallelism has the highest communication volume per step so it must map
-    to the fastest (most-contiguous) ICI neighbors; ``pp`` is outermost since
-    pipeline stages communicate the least (activations at stage edges only).
+    to the fastest (most-contiguous) ICI neighbors; ``pp`` is outermost
+    within a slice since pipeline stages communicate the least (activations
+    at stage edges only). ``dcn`` is the outermost axis of all: it spans
+    *slices* connected by data-center network, orders of magnitude slower
+    than ICI, so only the lowest-volume collective of the step (the data-
+    parallel gradient all-reduce) may cross it (multi-slice training,
+    SURVEY.md §2.8; the reference's analog is multi-node NCCL over DCN,
+    examples/nccl_test.yaml:12-14).
     """
+    dcn: int = 1
     pp: int = 1
     dp: int = 1
     fsdp: int = 1
@@ -60,17 +67,20 @@ class MeshSpec:
                     sp: int = 1,
                     pp: int = 1,
                     ep: int = 1,
+                    dcn: int = 1,
                     fsdp: Optional[int] = None) -> 'MeshSpec':
         """Fill the leftover device factor into fsdp (or dp if fsdp given)."""
-        used = tp * sp * pp * ep
+        used = tp * sp * pp * ep * dcn
         if n % used:
-            raise ValueError(f'{n} devices not divisible by tp*sp*pp*ep={used}')
+            raise ValueError(
+                f'{n} devices not divisible by dcn*tp*sp*pp*ep={used}')
         rest = n // used
         if fsdp is None:
-            return cls(pp=pp, fsdp=rest, ep=ep, sp=sp, tp=tp)
+            return cls(dcn=dcn, pp=pp, fsdp=rest, ep=ep, sp=sp, tp=tp)
         if rest % fsdp:
             raise ValueError(f'residual {rest} not divisible by fsdp={fsdp}')
-        return cls(pp=pp, dp=rest // fsdp, fsdp=fsdp, ep=ep, sp=sp, tp=tp)
+        return cls(dcn=dcn, pp=pp, dp=rest // fsdp, fsdp=fsdp, ep=ep,
+                   sp=sp, tp=tp)
 
 
 def make_mesh(spec: MeshSpec,
@@ -88,9 +98,30 @@ def make_mesh(spec: MeshSpec,
         raise ValueError(
             f'MeshSpec wants {spec.num_devices} devices '
             f'({spec.sizes}), got {len(devices)}')
+    # Real multi-slice hardware exposes device.slice_index; there the dcn
+    # axis MUST come from create_hybrid_device_mesh (a naive reshape would
+    # route ICI-axis collectives over DCN — silently, orders of magnitude
+    # slower), so failures must propagate rather than fall back.
+    real_slices = spec.dcn > 1 and len(
+        {getattr(d, 'slice_index', None) for d in devices} - {None}) > 1
     try:
         from jax.experimental import mesh_utils
-        dev_array = mesh_utils.create_device_mesh(shape, devices=list(devices))
-    except Exception:  # virtual/CPU devices without topology info
+        if spec.dcn > 1:
+            # Multi-slice: the dcn axis must map onto device.slice_index so
+            # that only the dcn-axis collectives cross the data-center
+            # network; create_hybrid_device_mesh does exactly that
+            # (ICI-optimized per-slice mesh x slice-major dcn axis).
+            per_slice = tuple(1 if a == 'dcn' else spec.sizes[a]
+                              for a in MESH_AXES)
+            dcn_shape = tuple(spec.dcn if a == 'dcn' else 1
+                              for a in MESH_AXES)
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                per_slice, dcn_shape, devices=list(devices))
+        else:
+            dev_array = mesh_utils.create_device_mesh(
+                shape, devices=list(devices))
+    except Exception:  # virtual/CPU devices without topology/slice info
+        if real_slices:
+            raise
         dev_array = np.asarray(list(devices)).reshape(shape)
     return jax.sharding.Mesh(dev_array, MESH_AXES)

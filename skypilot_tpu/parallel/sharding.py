@@ -58,6 +58,22 @@ DEFAULT_RULES = LogicalRules({
 })
 
 
+def multislice_rules(base: Optional[LogicalRules] = None) -> LogicalRules:
+    """Rules for a mesh with a ``dcn`` (cross-slice) axis.
+
+    Only the batch shards over dcn: data parallelism's gradient all-reduce
+    is the one per-step collective whose volume (one gradient-sized buffer,
+    overlappable with the backward pass) tolerates DCN latency/bandwidth;
+    weights, sequence, and expert shardings stay within a slice on ICI
+    (scaling-book recipe: DP across slices, everything else within).
+    """
+    base = base or DEFAULT_RULES
+    current = base.rules.get('batch') or ()
+    if isinstance(current, str):
+        current = (current,)
+    return base.with_overrides(batch=('dcn',) + tuple(current))
+
+
 def logical_sharding(mesh: Mesh, rules: LogicalRules,
                      *logical_axes: Optional[str]) -> NamedSharding:
     return NamedSharding(mesh, rules.spec(*logical_axes))

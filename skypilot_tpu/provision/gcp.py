@@ -56,22 +56,32 @@ def _delete_record(cluster_name: str) -> None:
 
 
 # ---- provision API ---------------------------------------------------------
+def _slice_names(name: str, num_slices: int) -> List[str]:
+    """Per-slice TPU node names: bare name single-slice (back-compat),
+    ``{name}-s{i}`` for a multi-slice gang."""
+    if num_slices <= 1:
+        return [name]
+    return [f'{name}-s{i}' for i in range(num_slices)]
+
+
 def run_instances(cluster_name: str, region: str, zone: Optional[str],
                   num_hosts: int, deploy_vars: Dict[str, Any]) -> None:
     assert zone is not None, 'GCP provisioning is zonal'
     project = deploy_vars['project_id']
     mode = deploy_vars.get('mode', 'tpu_vm')
     name = deploy_vars['cluster_name_on_cloud']
+    num_slices = int(deploy_vars.get('num_slices') or 1) \
+        if mode == 'tpu_vm' else 1
     record = {'project': project, 'zone': zone, 'mode': mode,
               'name_on_cloud': name, 'num_hosts': num_hosts,
-              'deploy_vars': deploy_vars}
+              'num_slices': num_slices, 'deploy_vars': deploy_vars}
     # Record BEFORE the create calls: if creation partially succeeds and
     # then raises (operation timeout, second GCE insert failing), the
     # billing resources must remain reachable by terminate_instances.
     _save_record(cluster_name, record)
     try:
         if mode == 'tpu_vm':
-            _run_tpu_node(project, zone, name, deploy_vars)
+            _run_tpu_slices(project, zone, name, num_slices, deploy_vars)
         else:
             _run_gce_instances(project, zone, name, num_hosts, deploy_vars)
     except exceptions.InsufficientCapacityError:
@@ -98,27 +108,67 @@ def _tpu_node_body(name: str, deploy_vars: Dict[str, Any]) -> Dict[str, Any]:
     return body
 
 
-def _run_tpu_node(project: str, zone: str, name: str,
-                  deploy_vars: Dict[str, Any]) -> None:
+def _run_tpu_slices(project: str, zone: str, name: str, num_slices: int,
+                    deploy_vars: Dict[str, Any]) -> None:
+    """Create the cluster's TPU slice node(s).
+
+    Multi-slice (num_slices > 1) uses ONE queued resource carrying N
+    nodeSpecs — the TPU API's atomic multi-slice grant: capacity for the
+    whole gang is allocated together or not at all, so there is never a
+    half-provisioned gang holding quota (the reference has no analog; its
+    closest is per-VM ray-up retries, sky/provision/gcp/instance.py).
+    """
     tpu = gcp_api.TpuClient(project)
-    node = tpu.get_node(zone, name)
-    if node is not None:
+    slice_names = _slice_names(name, num_slices)
+    nodes = {n: tpu.get_node(zone, n) for n in slice_names}
+    missing = [n for n, node in nodes.items() if node is None]
+    pending_ops = []
+    for n, node in nodes.items():
+        if node is None:
+            continue
         state = node.get('state')
         if state in ('READY', 'CREATING', 'STARTING', 'RESTARTING'):
-            return  # idempotent
+            continue  # idempotent
         if state == 'STOPPED':
-            op = tpu.start_node(zone, name)
-            tpu.wait_operation(op)
-            return
+            pending_ops.append(tpu.start_node(zone, n))
+            continue
         raise exceptions.CloudError(
-            f'TPU node {name} in unexpected state {state}')
+            f'TPU node {n} in unexpected state {state}')
+    for op in pending_ops:
+        tpu.wait_operation(op)
+    if not missing:
+        return
     if deploy_vars.get('use_queued_resources'):
+        # A stale QR under the cluster's id (nodes later preempted/deleted
+        # while the grant object lived on) would 409 the re-request.
+        qr = tpu.get_queued_resource(zone, name)
+        if qr is not None:
+            qr_state = (qr.get('state') or {}).get('state')
+            if (len(missing) == len(slice_names)
+                    or qr_state in ('FAILED', 'SUSPENDED')):
+                # No healthy node outlives the grant (all missing, or the
+                # API already marked its resources deleted): force-delete
+                # is safe. Wait for the delete LRO — re-requesting the
+                # same queuedResourceId mid-delete 409s.
+                op = tpu.delete_queued_resource(zone, name)
+                if op is not None:
+                    tpu.wait_operation(op)
+            else:
+                # ACTIVE grant with healthy slices still running: deleting
+                # it (force) would kill them, so recreate the missing
+                # node(s) directly instead of via a queued resource.
+                ops = [tpu.create_node(zone, n,
+                                       _tpu_node_body(n, deploy_vars))
+                       for n in missing]
+                for op in ops:
+                    tpu.wait_operation(op)
+                return
         qr_body = {
             'tpu': {'nodeSpec': [{
                 'parent': f'projects/{project}/locations/{zone}',
-                'nodeId': name,
-                'node': _tpu_node_body(name, deploy_vars),
-            }]},
+                'nodeId': n,
+                'node': _tpu_node_body(n, deploy_vars),
+            } for n in missing]},
         }
         if deploy_vars.get('use_spot'):
             qr_body['spot'] = {}
@@ -127,8 +177,12 @@ def _run_tpu_node(project: str, zone: str, name: str,
         tpu.create_queued_resource(zone, name, qr_body)
         _wait_queued_resource(tpu, zone, name)
     else:
-        op = tpu.create_node(zone, name, _tpu_node_body(name, deploy_vars))
-        tpu.wait_operation(op)
+        # Parallel inserts; wait all. A failure raises ProvisionError from
+        # wait_operation and the backend tears the attempt down.
+        ops = [tpu.create_node(zone, n, _tpu_node_body(n, deploy_vars))
+               for n in missing]
+        for op in ops:
+            tpu.wait_operation(op)
 
 
 def _wait_queued_resource(tpu: gcp_api.TpuClient, zone: str, qr_id: str,
@@ -232,12 +286,26 @@ def query_instances(cluster_name: str, region: str) -> Dict[str, str]:
     project, zone = record['project'], record['zone']
     name = record['name_on_cloud']
     if record['mode'] == 'tpu_vm':
-        node = gcp_api.TpuClient(project).get_node(zone, name)
-        if node is None:
-            return {}
-        mapped = _TPU_STATE_MAP.get(node.get('state', ''), 'unknown')
-        n = record['num_hosts']
-        return {f'{name}-w{r}': mapped for r in range(n)}
+        tpu = gcp_api.TpuClient(project)
+        num_slices = int(record.get('num_slices') or 1)
+        hosts_per_slice = record['num_hosts'] // num_slices
+        out: Dict[str, str] = {}
+        any_alive = False
+        for sname in _slice_names(name, num_slices):
+            node = tpu.get_node(zone, sname)
+            # A missing slice of a live gang must read as terminated hosts
+            # (not be silently omitted): a half-dead multi-slice cluster
+            # would otherwise report fully healthy and managed-job
+            # preemption recovery would never fire.
+            mapped = ('terminated' if node is None
+                      else _TPU_STATE_MAP.get(node.get('state', ''),
+                                              'unknown'))
+            any_alive = any_alive or node is not None
+            out.update({f'{sname}-w{r}': mapped
+                        for r in range(hosts_per_slice)})
+        # Whole cluster gone -> {} (the pre-existing "terminated cluster"
+        # contract core.py relies on).
+        return out if any_alive else {}
     gce = gcp_api.GceClient(project)
     out = {}
     for inst in gce.list_instances(zone,
@@ -253,8 +321,10 @@ def stop_instances(cluster_name: str, region: str) -> None:
     name = record['name_on_cloud']
     if record['mode'] == 'tpu_vm':
         tpu = gcp_api.TpuClient(project)
-        op = tpu.stop_node(zone, name)
-        tpu.wait_operation(op)
+        ops = [tpu.stop_node(zone, sname) for sname in
+               _slice_names(name, int(record.get('num_slices') or 1))]
+        for op in ops:
+            tpu.wait_operation(op)
     else:
         gce = gcp_api.GceClient(project)
         ops = [gce.stop(zone, f'{name}-{rank}')
@@ -273,8 +343,10 @@ def terminate_instances(cluster_name: str, region: str) -> None:
         tpu = gcp_api.TpuClient(project)
         if record['deploy_vars'].get('use_queued_resources'):
             tpu.delete_queued_resource(zone, name)
-        op = tpu.delete_node(zone, name)
-        tpu.wait_operation(op)
+        ops = [tpu.delete_node(zone, sname) for sname in
+               _slice_names(name, int(record.get('num_slices') or 1))]
+        for op in ops:
+            tpu.wait_operation(op)
     else:
         gce = gcp_api.GceClient(project)
         ops = [gce.delete(zone, f'{name}-{rank}')
@@ -291,17 +363,23 @@ def get_cluster_info(cluster_name: str, region: str
     name = record['name_on_cloud']
     hosts: List[provision_lib.HostInfo] = []
     if record['mode'] == 'tpu_vm':
-        node = gcp_api.TpuClient(project).get_node(zone, name)
-        if node is None:
-            raise exceptions.ClusterError(f'TPU node {name} not found')
-        # networkEndpoints is in worker order: index == SKYTPU_HOST_RANK.
-        for rank, ep in enumerate(node.get('networkEndpoints', [])):
-            hosts.append(provision_lib.HostInfo(
-                host_id=f'{name}-w{rank}', rank=rank,
-                internal_ip=ep.get('ipAddress', ''),
-                external_ip=(ep.get('accessConfig') or {}).get(
-                    'externalIp'),
-                extra={'node': name}))
+        tpu = gcp_api.TpuClient(project)
+        num_slices = int(record.get('num_slices') or 1)
+        # Ranks are slice-major: rank = slice_id * hosts_per_slice + worker
+        # (networkEndpoints is in worker order within a slice).
+        for slice_id, sname in enumerate(_slice_names(name, num_slices)):
+            node = tpu.get_node(zone, sname)
+            if node is None:
+                raise exceptions.ClusterError(
+                    f'TPU node {sname} not found')
+            base = len(hosts)
+            for worker, ep in enumerate(node.get('networkEndpoints', [])):
+                hosts.append(provision_lib.HostInfo(
+                    host_id=f'{sname}-w{worker}', rank=base + worker,
+                    internal_ip=ep.get('ipAddress', ''),
+                    external_ip=(ep.get('accessConfig') or {}).get(
+                        'externalIp'),
+                    extra={'node': sname, 'slice_id': slice_id}))
     else:
         insts = gcp_api.GceClient(project).list_instances(
             zone, label_filter=f'labels.{_LABEL}={name}')

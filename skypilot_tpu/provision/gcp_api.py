@@ -168,8 +168,15 @@ class TpuClient:
             raise
 
     def stop_node(self, zone: str, node_id: str) -> Dict[str, Any]:
-        return get_transport().request(
-            'POST', f'{self._loc(zone)}/nodes/{node_id}:stop')
+        try:
+            return get_transport().request(
+                'POST', f'{self._loc(zone)}/nodes/{node_id}:stop')
+        except exceptions.CloudError as e:
+            if e.code == 404:
+                # Node already gone (preempted/reaped slice of a gang):
+                # stopping the rest must proceed, same as delete_node.
+                return {}
+            raise
 
     def start_node(self, zone: str, node_id: str) -> Dict[str, Any]:
         return get_transport().request(
@@ -193,14 +200,19 @@ class TpuClient:
                 return None
             raise
 
-    def delete_queued_resource(self, zone: str, qr_id: str) -> None:
+    def delete_queued_resource(self, zone: str,
+                               qr_id: str) -> Optional[Dict[str, Any]]:
+        """Returns the delete LRO (None if the QR was already gone) so
+        callers re-using the same queuedResourceId can wait_operation it —
+        creating before the delete completes would 409 ALREADY_EXISTS."""
         try:
-            get_transport().request(
+            return get_transport().request(
                 'DELETE', f'{self._loc(zone)}/queuedResources/{qr_id}',
                 params={'force': 'true'})
         except exceptions.CloudError as e:
             if e.code != 404:
                 raise
+            return None
 
     # -- operations ----------------------------------------------------------
     def wait_operation(self, op: Dict[str, Any],

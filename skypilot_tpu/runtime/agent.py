@@ -108,11 +108,24 @@ class JobDriver(threading.Thread):
         job_lib.set_status(rtdir, job_id, job_lib.JobStatus.RUNNING)
         results: List[Optional[int]] = [None] * num_hosts
         threads = []
+        num_slices = int(info.deploy_vars.get('num_slices') or 1)
+        if num_slices > 1:
+            # Gang narrower than the full multi-slice cluster: ranks are
+            # slice-major, so the gang covers whole slices only when its
+            # host count divides by the cluster's PHYSICAL hosts-per-slice
+            # — otherwise treat as single-slice (never emit MEGASCALE
+            # coordinates that disagree with the physical slice layout).
+            phys_hps = info.num_hosts // num_slices
+            if phys_hps and num_hosts % phys_hps == 0:
+                num_slices = num_hosts // phys_hps
+            else:
+                num_slices = 1
         for rank, runner in enumerate(runners):
             env = constants.rank_env(
                 num_hosts, rank, ips, job_id, info.cluster_name,
                 chips_per_host=int(
-                    info.deploy_vars.get('chips_per_host') or 0))
+                    info.deploy_vars.get('chips_per_host') or 0),
+                num_slices=num_slices)
             env.update(spec.get('env') or {})
             t = threading.Thread(
                 target=self._run_rank,

@@ -19,6 +19,13 @@ ENV_PROCESS_ID = 'SKYTPU_PROCESS_ID'
 ENV_JOB_ID = 'SKYTPU_JOB_ID'
 ENV_CLUSTER_NAME = 'SKYTPU_CLUSTER_NAME'
 
+# Multi-slice contract: a job may gang N slices over data-center network
+# (task ``num_nodes: N`` with a TPU slice). Host ranks are slice-major:
+# rank = slice_id * hosts_per_slice + worker_index.
+ENV_NUM_SLICES = 'SKYTPU_NUM_SLICES'
+ENV_SLICE_ID = 'SKYTPU_SLICE_ID'
+ENV_HOSTS_PER_SLICE = 'SKYTPU_HOSTS_PER_SLICE'
+
 # Back-compat with reference task YAMLs (sky/skylet/constants.py:320-323).
 ENV_COMPAT_NUM_NODES = 'SKYPILOT_NUM_NODES'
 ENV_COMPAT_NODE_RANK = 'SKYPILOT_NODE_RANK'
@@ -26,6 +33,8 @@ ENV_COMPAT_NODE_IPS = 'SKYPILOT_NODE_IPS'
 ENV_COMPAT_NUM_GPUS = 'SKYPILOT_NUM_GPUS_PER_NODE'
 
 COORDINATOR_PORT = 8476
+# libtpu's DCN transport rendezvous port for multi-slice (MEGASCALE_*).
+MEGASCALE_PORT = 8080
 
 # -- on-host layout ----------------------------------------------------------
 # Relative to the host's home/root dir (local cloud: the host directory).
@@ -75,8 +84,18 @@ def control_plane_prefix() -> str:
 
 
 def rank_env(num_hosts: int, rank: int, ips: list, job_id: int,
-             cluster_name: str, chips_per_host: int = 0) -> dict:
-    """The per-host environment exported to every job process."""
+             cluster_name: str, chips_per_host: int = 0,
+             num_slices: int = 1) -> dict:
+    """The per-host environment exported to every job process.
+
+    For a multi-slice gang (``num_slices > 1``), also exports the
+    MEGASCALE_* variables libtpu reads to bring up its DCN transport
+    between slices, plus SKYTPU slice coordinates. jax.distributed still
+    uses ONE global coordinator (slice 0 / worker 0) across all hosts —
+    the DCN mesh axis is a compile-time sharding concern, not a separate
+    process group (contrast the reference's per-group NCCL communicators,
+    examples/nccl_test.yaml:12-14).
+    """
     coord = f'{ips[0]}:{COORDINATOR_PORT}'
     env = {
         ENV_NUM_HOSTS: str(num_hosts),
@@ -93,6 +112,22 @@ def rank_env(num_hosts: int, rank: int, ips: list, job_id: int,
     }
     if chips_per_host:
         env[ENV_COMPAT_NUM_GPUS] = str(chips_per_host)
+    if num_slices > 1:
+        assert num_hosts % num_slices == 0, (
+            f'{num_hosts} hosts not divisible into {num_slices} slices')
+        hosts_per_slice = num_hosts // num_slices
+        slice_id = rank // hosts_per_slice
+        env.update({
+            ENV_NUM_SLICES: str(num_slices),
+            ENV_SLICE_ID: str(slice_id),
+            ENV_HOSTS_PER_SLICE: str(hosts_per_slice),
+            # libtpu DCN transport rendezvous: slice 0 / worker 0.
+            'MEGASCALE_COORDINATOR_ADDRESS':
+                f'{ips[0]}:{MEGASCALE_PORT}',
+            'MEGASCALE_NUM_SLICES': str(num_slices),
+            'MEGASCALE_SLICE_ID': str(slice_id),
+            'MEGASCALE_PORT': str(MEGASCALE_PORT),
+        })
     # The agent itself runs with AXON_ENV cleared (control-plane startup
     # optimization above); user jobs must get the original back.
     stash = os.environ.get(AXON_STASH_ENV, '')

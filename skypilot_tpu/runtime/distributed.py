@@ -83,6 +83,16 @@ def init(coordinator_address: Optional[str] = None,
     return True
 
 
+def num_slices() -> int:
+    """Number of TPU slices ganged into this job (1 = single slice)."""
+    return int(os.environ.get(constants.ENV_NUM_SLICES) or 1)
+
+
+def slice_id() -> int:
+    """This host's slice index in a multi-slice gang (0 on single slice)."""
+    return int(os.environ.get(constants.ENV_SLICE_ID) or 0)
+
+
 def shutdown() -> None:
     global _INITIALIZED
     if not _INITIALIZED:

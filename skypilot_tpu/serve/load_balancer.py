@@ -108,72 +108,106 @@ class LoadBalancer:
 
             def _proxy(self):
                 lb.record_request()
-                url = lb.policy.select()
-                if url is None:
-                    body = json.dumps({
-                        'error': 'no ready replicas',
-                        'detail': 'service is starting or scaled to zero',
-                    }).encode()
-                    self.send_response(503)
-                    self.send_header('Content-Type', 'application/json')
-                    self.send_header('Content-Length', str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
-                    return
                 length = int(self.headers.get('Content-Length', 0))
                 body = self.rfile.read(length) if length else None
                 headers = {k: v for k, v in self.headers.items()
                            if k.lower() not in _HOP_HEADERS}
-                upstream = url.rstrip('/') + self.path
-                req = urllib.request.Request(upstream, data=body,
-                                             headers=headers,
-                                             method=self.command)
-                lb.policy.on_request_start(url)
-                try:
-                    with urllib.request.urlopen(req, timeout=600) as resp:
-                        self.send_response(resp.status)
-                        for k, v in resp.headers.items():
-                            if k.lower() not in _HOP_HEADERS:
-                                self.send_header(k, v)
-                        self.send_header('X-Skytpu-Replica', url)
-                        chunked = (resp.headers.get('Content-Length')
-                                   is None)
-                        if chunked:
-                            self.send_header('Transfer-Encoding', 'chunked')
-                        else:
-                            self.send_header(
-                                'Content-Length',
-                                resp.headers['Content-Length'])
-                        self.end_headers()
-                        # Stream through: tokens reach the client as the
-                        # replica emits them.
-                        while True:
-                            chunk = resp.read(16384)
-                            if not chunk:
-                                break
+                last_err = None
+                for _ in range(3):
+                    url = lb.policy.select()
+                    if url is None:
+                        break
+                    upstream = url.rstrip('/') + self.path
+                    req = urllib.request.Request(upstream, data=body,
+                                                 headers=headers,
+                                                 method=self.command)
+                    lb.policy.on_request_start(url)
+                    try:
+                        resp = urllib.request.urlopen(req, timeout=600)
+                    except urllib.error.HTTPError as e:
+                        # The replica answered: forward its error verbatim,
+                        # no retry (it may be non-idempotent app logic).
+                        try:
+                            payload = e.read()
+                            self.send_response(e.code)
+                            self.send_header('Content-Length',
+                                             str(len(payload)))
+                            self.end_headers()
+                            self.wfile.write(payload)
+                        except OSError:
+                            pass  # client went away mid-error-response
+                        finally:
+                            lb.policy.on_request_end(url)
+                        return
+                    except (urllib.error.URLError, OSError) as e:
+                        lb.policy.on_request_end(url)
+                        last_err = e
+                        reason = getattr(e, 'reason', e)
+                        if isinstance(reason, ConnectionRefusedError):
+                            # Connect refused: nothing reached the replica,
+                            # so retrying another one is safe even for
+                            # non-idempotent requests. Happens while the
+                            # replica list is stale for up to one sync
+                            # interval after a scale-down/preemption.
+                            continue
+                        # Anything else (read timeout, reset mid-response)
+                        # may have reached the replica — do not resend.
+                        break
+                    try:
+                        with resp:
+                            self.send_response(resp.status)
+                            for k, v in resp.headers.items():
+                                if k.lower() not in _HOP_HEADERS:
+                                    self.send_header(k, v)
+                            self.send_header('X-Skytpu-Replica', url)
+                            chunked = (resp.headers.get('Content-Length')
+                                       is None)
                             if chunked:
-                                self.wfile.write(
-                                    f'{len(chunk):x}\r\n'.encode())
-                                self.wfile.write(chunk + b'\r\n')
+                                self.send_header('Transfer-Encoding',
+                                                 'chunked')
                             else:
-                                self.wfile.write(chunk)
-                        if chunked:
-                            self.wfile.write(b'0\r\n\r\n')
-                except urllib.error.HTTPError as e:
-                    payload = e.read()
-                    self.send_response(e.code)
-                    self.send_header('Content-Length', str(len(payload)))
-                    self.end_headers()
-                    self.wfile.write(payload)
-                except (urllib.error.URLError, OSError) as e:
+                                self.send_header(
+                                    'Content-Length',
+                                    resp.headers['Content-Length'])
+                            self.end_headers()
+                            # Stream through: tokens reach the client as
+                            # the replica emits them.
+                            while True:
+                                chunk = resp.read(16384)
+                                if not chunk:
+                                    break
+                                if chunked:
+                                    self.wfile.write(
+                                        f'{len(chunk):x}\r\n'.encode())
+                                    self.wfile.write(chunk + b'\r\n')
+                                else:
+                                    self.wfile.write(chunk)
+                            if chunked:
+                                self.wfile.write(b'0\r\n\r\n')
+                    except (urllib.error.URLError, OSError):
+                        # Mid-stream failure: headers already went out, so
+                        # a retry or error response would corrupt the
+                        # stream — drop the connection.
+                        pass
+                    finally:
+                        lb.policy.on_request_end(url)
+                    return
+                if last_err is not None:
                     payload = json.dumps(
-                        {'error': f'replica unreachable: {e}'}).encode()
-                    self.send_response(502)
-                    self.send_header('Content-Length', str(len(payload)))
-                    self.end_headers()
-                    self.wfile.write(payload)
-                finally:
-                    lb.policy.on_request_end(url)
+                        {'error': f'replica unreachable: {last_err}'}
+                    ).encode()
+                    code = 502
+                else:
+                    payload = json.dumps({
+                        'error': 'no ready replicas',
+                        'detail': 'service is starting or scaled to zero',
+                    }).encode()
+                    code = 503
+                self.send_response(code)
+                self.send_header('Content-Type', 'application/json')
+                self.send_header('Content-Length', str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
 
             do_GET = do_POST = do_PUT = do_DELETE = do_PATCH = _proxy
 

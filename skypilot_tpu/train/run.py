@@ -39,6 +39,9 @@ def parse_args(argv=None):
     p.add_argument('--sp', type=int, default=1)
     p.add_argument('--pp', type=int, default=1)
     p.add_argument('--ep', type=int, default=1)
+    p.add_argument('--dcn', default='auto',
+                   help="cross-slice data-parallel degree; 'auto' = the "
+                        'number of ganged slices (SKYTPU_NUM_SLICES)')
     p.add_argument('--remat', default=None,
                    help="remat policy override ('none'/'dots'/'full')")
     p.add_argument('--ckpt-dir', default=None)
@@ -63,21 +66,28 @@ def main(argv=None) -> None:
     from skypilot_tpu.train import Trainer
 
     n = jax.device_count()
-    used = args.tp * args.sp * args.pp * args.ep * args.dp
+    dcn = (distributed.num_slices() if args.dcn == 'auto'
+           else max(1, int(args.dcn)))
+    used = dcn * args.tp * args.sp * args.pp * args.ep * args.dp
     if args.fsdp == 'auto':
         if n % used:
             raise SystemExit(
-                f'{n} devices not divisible by tp*sp*pp*ep*dp={used}')
+                f'{n} devices not divisible by dcn*tp*sp*pp*ep*dp={used}')
         fsdp = n // used
     else:
         fsdp = int(args.fsdp)
         if used * fsdp != n:
             raise SystemExit(
-                f'mesh {args.tp}tp*{args.sp}sp*{args.pp}pp*{args.ep}ep*'
-                f'{args.dp}dp*{fsdp}fsdp = {used * fsdp} != {n} devices')
-    spec = MeshSpec(pp=args.pp, dp=args.dp, fsdp=fsdp, ep=args.ep,
+                f'mesh {dcn}dcn*{args.tp}tp*{args.sp}sp*{args.pp}pp*'
+                f'{args.ep}ep*{args.dp}dp*{fsdp}fsdp = {used * fsdp} '
+                f'!= {n} devices')
+    spec = MeshSpec(dcn=dcn, pp=args.pp, dp=args.dp, fsdp=fsdp, ep=args.ep,
                     sp=args.sp, tp=args.tp)
     mesh = make_mesh(spec)
+    model_kwargs = {}
+    if dcn > 1:
+        from skypilot_tpu.parallel import multislice_rules
+        model_kwargs['rules'] = multislice_rules()
 
     import dataclasses
     if args.model == 'llama':
@@ -87,7 +97,7 @@ def main(argv=None) -> None:
             config = (dataclasses.replace(config, remat=False)
                       if args.remat == 'none' else dataclasses.replace(
                           config, remat=True, remat_policy=args.remat))
-        model = LlamaModel(config, mesh=mesh)
+        model = LlamaModel(config, mesh=mesh, **model_kwargs)
     else:
         from skypilot_tpu.models.mixtral import (PRESETS as MOE_PRESETS,
                                                  MixtralModel)
@@ -96,7 +106,7 @@ def main(argv=None) -> None:
             config = (dataclasses.replace(config, remat=False)
                       if args.remat == 'none' else dataclasses.replace(
                           config, remat=True, remat_policy=args.remat))
-        model = MixtralModel(config, mesh=mesh)
+        model = MixtralModel(config, mesh=mesh, **model_kwargs)
 
     trainer = Trainer(model, learning_rate=args.lr, accum_steps=args.accum)
     proc_id = jax.process_index()

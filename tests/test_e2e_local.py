@@ -265,12 +265,15 @@ class TestJobConcurrency:
         core.down('t-conc')
 
     def test_tpu_slice_jobs_stay_exclusive(self, tmp_path):
+        # The jobs themselves record their run intervals; asserting on
+        # those (not on two sequential status polls, which can misread a
+        # finish/start handoff as overlap under suite load) makes the
+        # check exact: exclusive TPU jobs must have disjoint intervals.
         import skypilot_tpu as sky
-        marker = tmp_path / 'serial'
-        script = (f'prev=$(cat {marker} 2>/dev/null || echo 0); '
-                  f'echo started >> {marker}.log; '
+        spans = tmp_path / 'spans'
+        script = (f'echo $SKYTPU_JOB_ID start $(date +%s.%N) >> {spans}; '
                   'sleep 1; '
-                  f'echo done-$prev >> {marker}.done')
+                  f'echo $SKYTPU_JOB_ID end $(date +%s.%N) >> {spans}')
         task = sky.Task(run=script)
         task.set_resources([sky.Resources(cloud='local',
                                           accelerators='tpu-v5e-8')])
@@ -278,24 +281,23 @@ class TestJobConcurrency:
                                      detach_run=True)
         backend = backends.SliceBackend()
         jid2 = backend.execute(handle, task, detach_run=True)
-        # While job 1 runs (1s sleep), job 2 must not be RUNNING.
         import time as time_lib
         from skypilot_tpu.runtime import job_lib
-        overlap = False
         deadline = time_lib.time() + 60
         done = set()
         while time_lib.time() < deadline and len(done) < 2:
-            statuses = {}
             for jid in (1, jid2):
                 s = core.job_status('t-excl', jid)
-                statuses[jid] = s
                 if s and job_lib.JobStatus(s).is_terminal():
                     done.add(jid)
-            running = [j for j, s in statuses.items()
-                       if s in ('SETTING_UP', 'RUNNING')]
-            if len(running) > 1:
-                overlap = True
             time_lib.sleep(0.1)
-        assert not overlap, 'exclusive jobs overlapped'
         assert len(done) == 2
+        intervals = {}
+        for line in spans.read_text().splitlines():
+            jid, kind, ts = line.split()
+            intervals.setdefault(int(jid), {})[kind] = float(ts)
+        assert set(intervals) == {1, jid2}, intervals
+        a, b = intervals[1], intervals[jid2]
+        disjoint = (a['end'] <= b['start']) or (b['end'] <= a['start'])
+        assert disjoint, f'exclusive jobs overlapped: {intervals}'
         core.down('t-excl')

@@ -206,6 +206,19 @@ def _get(url, timeout=10):
         return resp.status, resp.read(), dict(resp.headers)
 
 
+def _get_retry(url, timeout=10, attempts=30, interval=1.0):
+    """GET with retries: under full-suite load the LB/replica may need a
+    few seconds to start accepting connections even after READY."""
+    last = None
+    for _ in range(attempts):
+        try:
+            return _get(url, timeout=timeout)
+        except (urllib.error.URLError, OSError) as e:
+            last = e
+            time.sleep(interval)
+    raise AssertionError(f'GET {url} never succeeded: {last}')
+
+
 def _wait(predicate, timeout, what, interval=0.3):
     deadline = time.time() + timeout
     while time.time() < deadline:
@@ -268,7 +281,7 @@ class TestServeE2E:
             assert svc['status'] == ServiceStatus.READY
 
             # LB proxies to the replica.
-            status_code, body, headers = _get(endpoint + '/whoami')
+            status_code, body, headers = _get_retry(endpoint + '/whoami')
             assert status_code == 200
             payload = json.loads(body)
             assert payload['path'] == '/whoami'

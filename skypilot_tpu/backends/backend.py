@@ -53,7 +53,8 @@ class Backend:
 
     def provision(self, task: task_lib.Task, cluster_name: str,
                   retry_until_up: bool = False,
-                  dryrun: bool = False) -> Optional[ResourceHandle]:
+                  dryrun: bool = False,
+                  blocked_resources=None) -> Optional[ResourceHandle]:
         raise NotImplementedError
 
     def sync_workdir(self, handle: ResourceHandle, workdir: str) -> None:

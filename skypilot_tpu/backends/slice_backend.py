@@ -52,10 +52,15 @@ class RetryingProvisioner:
     cloud_vm_ray_backend.py:2009-2184)."""
 
     def __init__(self, retry_until_up: bool = False,
-                 max_rounds: int = 3, backoff_s: float = 5.0):
+                 max_rounds: int = 3, backoff_s: float = 5.0,
+                 blocked_resources=None):
         self.retry_until_up = retry_until_up
         self.max_rounds = max_rounds
         self.backoff_s = backoff_s
+        # Partial-Resources blocklist (e.g. Resources(zone=...)): zones or
+        # regions the caller wants avoided — the serve spot placer feeds
+        # recently-preempting zones here.
+        self.blocked_resources = list(blocked_resources or [])
 
     def provision(
         self, task: task_lib.Task, cluster_name: str
@@ -97,6 +102,10 @@ class RetryingProvisioner:
         name_on_cloud = common_utils.make_cluster_name_on_cloud(cluster_name)
         zones = ([resources.zone] if resources.zone is not None
                  else cloud.zones_for(resources, region))
+        if self.blocked_resources:
+            zones = [z for z in zones if not any(
+                resources.copy(region=region, zone=z).should_be_blocked_by(b)
+                for b in self.blocked_resources)]
         for zone in zones:
             deploy_vars = cloud.make_deploy_variables(
                 resources, name_on_cloud, region, zone)
@@ -196,10 +205,13 @@ class SliceBackend(backend_lib.Backend):
     @timeline.event
     def provision(self, task: task_lib.Task, cluster_name: str,
                   retry_until_up: bool = False,
-                  dryrun: bool = False) -> Optional[backend_lib.ResourceHandle]:
+                  dryrun: bool = False,
+                  blocked_resources=None
+                  ) -> Optional[backend_lib.ResourceHandle]:
         if dryrun:
             return None
-        provisioner = RetryingProvisioner(retry_until_up=retry_until_up)
+        provisioner = RetryingProvisioner(retry_until_up=retry_until_up,
+                                          blocked_resources=blocked_resources)
         from skypilot_tpu.utils import locks
         # Reentrant under execution._execute's lock (same-thread filelock);
         # also guards direct backend.provision callers (jobs/serve).

@@ -418,6 +418,21 @@ def serve_up(entrypoint, service_name):
     click.echo(f'Watch: skytpu serve status {service_name}')
 
 
+@serve.command('update')
+@click.argument('service_name')
+@click.argument('entrypoint')
+def serve_update(service_name, entrypoint):
+    """Rolling-update a running service to a new task YAML (zero
+    downtime: old replicas drain only as new ones turn READY)."""
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.serve import core as serve_core
+    task = task_lib.Task.from_yaml(entrypoint)
+    result = serve_core.update(task, service_name)
+    click.echo(f'Service {result["name"]!r} rolling to '
+               f'version {result["version"]}.')
+    click.echo(f'Watch: skytpu serve status {service_name}')
+
+
 @serve.command('status')
 @click.argument('service_names', nargs=-1)
 def serve_status(service_names):

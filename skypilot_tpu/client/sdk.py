@@ -171,6 +171,11 @@ def serve_down(service_name: str) -> str:
     return submit('serve_down', {'service_name': service_name})
 
 
+def serve_update(task, service_name: str) -> str:
+    return submit('serve_update', {'task': task.to_yaml_config(),
+                                   'service_name': service_name})
+
+
 def check() -> str:
     return submit('check', {})
 

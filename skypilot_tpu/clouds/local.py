@@ -20,6 +20,9 @@ from skypilot_tpu.clouds import cloud as cloud_lib
 
 LOCAL_REGION = 'local'
 LOCAL_ZONE = 'local-a'
+# Two zones so zone-level behaviors (capacity failover, spot-placer
+# preemption avoidance) are testable hermetically.
+LOCAL_ZONES = ['local-a', 'local-b']
 
 
 @cloud_lib.CLOUD_REGISTRY.register(name='local')
@@ -30,7 +33,9 @@ class Local(cloud_lib.Cloud):
         cloud_lib.CloudFeature.STOP,
         cloud_lib.CloudFeature.MULTI_HOST,
         cloud_lib.CloudFeature.OPEN_PORTS,
-        # SPOT intentionally excluded; tests inject preemption directly.
+        # SPOT accepted so spot-serving paths run hermetically; actual
+        # preemption is still injected by tests (nothing preempts here).
+        cloud_lib.CloudFeature.SPOT,
     })
 
     @classmethod
@@ -47,9 +52,10 @@ class Local(cloud_lib.Cloud):
         return [LOCAL_REGION]
 
     def zones_for(self, resources, region: str) -> List[Optional[str]]:
-        if resources.zone not in (None, LOCAL_ZONE):
-            return []
-        return [LOCAL_ZONE]
+        if resources.zone is not None:
+            return ([resources.zone] if resources.zone in LOCAL_ZONES
+                    else [])
+        return list(LOCAL_ZONES)
 
     def hourly_cost(self, resources, region=None, zone=None) -> float:
         return 0.0

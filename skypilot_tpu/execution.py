@@ -80,8 +80,9 @@ def _execute(task: task_lib.Task,
              retry_until_up: bool = False,
              optimize_target=None,
              dryrun: bool = False,
-             stream_logs: bool = True) -> Tuple[Optional[int],
-                                                Optional[Any]]:
+             stream_logs: bool = True,
+             blocked_resources=None) -> Tuple[Optional[int],
+                                              Optional[Any]]:
     """Returns (job_id, handle)."""
     backend = backend or backends.SliceBackend()
     optimize_target = (optimize_target
@@ -98,12 +99,14 @@ def _execute(task: task_lib.Task,
         if handle is None:
             if Stage.OPTIMIZE in stages:
                 optimizer_lib.optimize(task, minimize=optimize_target,
+                                       blocked_resources=blocked_resources,
                                        quiet=dryrun)
             if dryrun:
                 return None, None
             if Stage.PROVISION in stages:
-                handle = backend.provision(task, cluster_name,
-                                           retry_until_up=retry_until_up)
+                handle = backend.provision(
+                    task, cluster_name, retry_until_up=retry_until_up,
+                    blocked_resources=blocked_resources)
         else:
             if dryrun:
                 return None, handle
@@ -154,7 +157,8 @@ def launch(task, cluster_name: str,
            dryrun: bool = False,
            stream_logs: bool = True,
            policy_operation: str = 'launch',
-           fast: bool = False) -> Tuple[Optional[int], Optional[Any]]:
+           fast: bool = False,
+           blocked_resources=None) -> Tuple[Optional[int], Optional[Any]]:
     """Provision (or reuse) a cluster and run the task on it.
 
     ``policy_operation`` names this request to the admin policy
@@ -164,6 +168,10 @@ def launch(task, cluster_name: str,
     ``fast`` skips file mounts + setup when the cluster is UP and the
     task's setup-relevant config hash matches the last full launch
     (reference --fast, execution.py fast path + config-hash skip).
+
+    ``blocked_resources`` filters optimizer candidates (partial Resources
+    match, e.g. ``Resources(zone='us-east5-a')``) — used by the serve
+    spot placer to steer relaunches away from preempting zones.
     """
     task = _to_task(task)
     from skypilot_tpu import admin_policy
@@ -199,7 +207,7 @@ def launch(task, cluster_name: str,
         task, cluster_name, stages, backend=backend,
         detach_run=detach_run, retry_until_up=retry_until_up,
         optimize_target=optimize_target, dryrun=dryrun,
-        stream_logs=stream_logs)
+        stream_logs=stream_logs, blocked_resources=blocked_resources)
     if handle is not None and not dryrun and Stage.SETUP in stages:
         global_user_state.set_kv(hash_key, config_hash)
     if handle is not None and idle_minutes_to_autostop is not None:

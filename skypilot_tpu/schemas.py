@@ -106,6 +106,7 @@ SERVICE_SCHEMA: Dict[str, Any] = {
                 'downscale_delay_seconds': {'type': 'number'},
                 'base_ondemand_fallback_replicas': {'type': 'integer'},
                 'dynamic_ondemand_fallback': {'type': 'boolean'},
+                'spot_placer': {'enum': ['dynamic_fallback', None]},
             },
             'additionalProperties': False,
         },

@@ -41,6 +41,16 @@ class RequestRateAutoscaler:
         self._downscale_counter = 0
         self.target_num_replicas = self.policy.min_replicas
 
+    def update_spec(self, spec: spec_lib.ServiceSpec) -> None:
+        """Adopt a new replica policy (rolling update) without losing the
+        request history or hysteresis counters."""
+        self.policy = spec.replica_policy
+        self._upscale_needed = max(
+            1, int(self.policy.upscale_delay_seconds / self.interval))
+        self._downscale_needed = max(
+            1, int(self.policy.downscale_delay_seconds / self.interval))
+        self.target_num_replicas = self._clip(self.target_num_replicas)
+
     # -- request accounting ---------------------------------------------------
     def collect_requests(self, timestamps: List[float],
                          now: Optional[float] = None) -> None:
@@ -90,3 +100,34 @@ class RequestRateAutoscaler:
             self._upscale_counter = 0
             self._downscale_counter = 0
         return self.target_num_replicas
+
+    def evaluate_mixed(self, num_ready_primary: int,
+                       now: Optional[float] = None) -> 'MixedTarget':
+        """One tick for spot serving: (primary target, on-demand fallback).
+
+        Counterpart of reference FallbackRequestRateAutoscaler
+        (sky/serve/autoscalers.py:557): the primary pool runs the task as
+        written (typically spot); the fallback pool is on-demand —
+        ``base_ondemand_fallback_replicas`` always-on, plus (with
+        ``dynamic_ondemand_fallback``) enough to cover the gap between the
+        target and the currently-READY primary fleet, so preemptions never
+        drop serving capacity below target while spot relaunches.
+        """
+        target = self.evaluate(now)
+        p = self.policy
+        fallback = p.base_ondemand_fallback_replicas
+        if p.dynamic_ondemand_fallback:
+            fallback += max(0, target - max(0, num_ready_primary))
+        return MixedTarget(primary=target, ondemand_fallback=fallback)
+
+
+class MixedTarget:
+    """(primary, on-demand fallback) replica targets."""
+
+    def __init__(self, primary: int, ondemand_fallback: int):
+        self.primary = primary
+        self.ondemand_fallback = ondemand_fallback
+
+    def __repr__(self) -> str:
+        return (f'MixedTarget(primary={self.primary}, '
+                f'ondemand_fallback={self.ondemand_fallback})')

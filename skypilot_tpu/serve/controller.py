@@ -85,13 +85,26 @@ class ServeController:
         row = serve_state.get_service(service_name)
         assert row is not None, f'service {service_name} missing'
         self.spec = spec_lib.ServiceSpec.from_yaml_config(row['spec'])
+        self.version = row['version']
         self.autoscaler = autoscaler_lib.RequestRateAutoscaler(
             self.spec, decision_interval_seconds=_tick())
         self.manager = rm_lib.ReplicaManager(
             service_name, self.spec, row['task_yaml'],
-            log=self._log)
+            log=self._log, version=self.version)
         self.controller_port: int = 0  # assigned at bind time
         self._http: ThreadingHTTPServer = None
+
+    def _maybe_adopt_update(self, row) -> None:
+        """`serve update` bumped the row's version: reload spec/task and
+        let the manager roll the fleet (reference controller version
+        adoption, sky/serve/serve_utils.py version plumbing)."""
+        if row['version'] == self.version:
+            return
+        self.version = row['version']
+        self.spec = spec_lib.ServiceSpec.from_yaml_config(row['spec'])
+        self.autoscaler.update_spec(self.spec)
+        self.manager.update_version(self.version, self.spec,
+                                    row['task_yaml'])
 
     def _log(self, msg: str) -> None:
         print(f'[{self.name}] {msg}', flush=True)
@@ -101,11 +114,13 @@ class ServeController:
         return {
             'name': self.name,
             'status': row['status'].value if row else 'UNKNOWN',
+            'version': self.version,
             'target_replicas': self.autoscaler.target_num_replicas,
             'qps': self.autoscaler.observed_qps(),
             'replicas': [
                 {'replica_id': r['replica_id'], 'status': r['status'].value,
-                 'url': r['url'], 'cluster_name': r['cluster_name']}
+                 'url': r['url'], 'cluster_name': r['cluster_name'],
+                 'version': r['version']}
                 for r in self.manager.replicas()
             ],
         }
@@ -152,8 +167,11 @@ class ServeController:
                 self._http.shutdown()
                 return
             try:
-                target = self.autoscaler.evaluate()
-                self.manager.reconcile(target)
+                self._maybe_adopt_update(row)
+                mixed = self.autoscaler.evaluate_mixed(
+                    self.manager.num_ready_primary())
+                self.manager.reconcile(mixed.primary,
+                                       mixed.ondemand_fallback)
                 self.manager.probe_all()
                 self._refresh_service_status()
             except Exception as e:  # noqa: BLE001

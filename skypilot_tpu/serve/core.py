@@ -83,6 +83,29 @@ def up_on_controller(task: task_lib.Task,
                          if lb_port else None)}
 
 
+def update_on_controller(task: task_lib.Task,
+                         service_name: str) -> Dict[str, Any]:
+    """Rolling update: record the new spec/task under version+1.
+
+    The running controller adopts the bump on its next tick, launches
+    new-version replicas, and drains old ones only as new turn READY —
+    no teardown, no downtime (reference `sky serve update`,
+    sky/serve/replica_managers.py:1243).
+    """
+    row = serve_state.get_service(service_name)
+    if row is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Service {service_name!r} does not exist.')
+    if not _pid_alive(row['controller_pid']):
+        raise exceptions.ClusterError(
+            f'Service {service_name!r} has no live controller; '
+            "tear it down and 'serve up' again.")
+    version = serve_state.bump_service_version(
+        service_name, spec=task.service.to_yaml_config(),
+        task_yaml=task.to_yaml_config())
+    return {'name': service_name, 'version': version}
+
+
 def status_on_controller(service_names: Optional[List[str]] = None
                          ) -> List[Dict[str, Any]]:
     rows = serve_state.list_services(names=service_names)
@@ -96,6 +119,7 @@ def status_on_controller(service_names: Optional[List[str]] = None
                          if row['lb_port'] else None),
             'lb_port': row['lb_port'],
             'requested_replicas': row['requested_replicas'],
+            'version': row['version'],
             'replicas': replicas,
         })
     return out
@@ -201,6 +225,26 @@ def up(task: task_lib.Task, service_name: str) -> Dict[str, Any]:
                 if lb_port else None)
     return {'name': payload['name'], 'endpoint': endpoint,
             'lb_port': lb_port}
+
+
+def update(task: task_lib.Task, service_name: str) -> Dict[str, Any]:
+    """Rolling-update a running service to this task's spec."""
+    import json
+    import shlex
+    if task.service is None:
+        raise exceptions.InvalidTaskError(
+            "Task has no 'service:' section; add one to use serve.")
+    from skypilot_tpu import admin_policy
+    task = admin_policy.apply(task, operation='serve_update')
+    task_json = json.dumps(task.to_yaml_config())
+    res, _ = _servecli(
+        f'update --service-name {shlex.quote(service_name)} '
+        f'--task-json {shlex.quote(task_json)}', launch_if_missing=False)
+    if res is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Service {service_name!r} does not exist '
+            '(no serve controller cluster).')
+    return _parse(res, 'update')
 
 
 def status(service_names: Optional[List[str]] = None

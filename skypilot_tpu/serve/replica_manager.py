@@ -21,6 +21,7 @@ manager converges the fleet:
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 import urllib.error
@@ -44,18 +45,43 @@ _PROBE_POOL = 8
 class ReplicaManager:
 
     def __init__(self, service_name: str, spec: spec_lib.ServiceSpec,
-                 task_yaml: Dict, log=print):
+                 task_yaml: Dict, log=print, version: int = 1):
+        from skypilot_tpu.serve import spot_placer as spot_placer_lib
         self.service = service_name
-        self.spec = spec
-        self.task_yaml = {k: v for k, v in task_yaml.items()
-                          if k != 'service'}
         self.log = log
+        self.version = version
+        self._set_task(spec, task_yaml)
+        # Preemption placement memory survives rolling updates (the zones
+        # that preempted v1 replicas are just as bad for v2).
+        self.placer = spot_placer_lib.make(spec.replica_policy.spot_placer)
         self._inflight: Dict[int, threading.Thread] = {}
         self._lock = threading.Lock()
         self._probe_pool = ThreadPoolExecutor(
             max_workers=_PROBE_POOL, thread_name_prefix='probe')
+
+    def _set_task(self, spec: spec_lib.ServiceSpec, task_yaml: Dict) -> None:
+        self.spec = spec
+        self.task_yaml = {k: v for k, v in task_yaml.items()
+                          if k != 'service'}
         self._is_local = (
             (self.task_yaml.get('resources') or {}).get('cloud') == 'local')
+
+    def update_version(self, version: int, spec: spec_lib.ServiceSpec,
+                       task_yaml: Dict) -> None:
+        """Adopt a new service version (rolling update): subsequent
+        launches use the new spec/task; reconcile() drains old-version
+        replicas as new-version ones turn READY (reference
+        sky/serve/replica_managers.py:1243 update_version)."""
+        from skypilot_tpu.serve import spot_placer as spot_placer_lib
+        old_placer_cfg = self.spec.replica_policy.spot_placer
+        self.version = version
+        self._set_task(spec, task_yaml)
+        if spec.replica_policy.spot_placer != old_placer_cfg:
+            # Placer CONFIG changed: rebuild. An unchanged config keeps
+            # the existing instance so preemption memory survives updates.
+            self.placer = spot_placer_lib.make(
+                spec.replica_policy.spot_placer)
+        self.log(f'rolling update to version {version}')
 
     # -- fleet accounting -----------------------------------------------------
     def replicas(self) -> List[Dict]:
@@ -68,19 +94,77 @@ class ReplicaManager:
         return [r['url'] for r in self.replicas()
                 if r['status'] == ReplicaStatus.READY and r['url']]
 
+    def num_ready_primary(self) -> int:
+        """Primary replicas the dynamic on-demand fallback may rely on.
+
+        NOT_READY (a READY replica with a failing probe) still counts: a
+        single probe blip must not churn a whole on-demand cluster
+        launch/teardown — the probe-failure budget (PROBE_FAILURE_LIMIT)
+        decides when such a replica is really lost, at which point it
+        leaves this count and fallback fires. Preemption drops it from
+        the count immediately (status PREEMPTED).
+        """
+        return sum(1 for r in self.replicas()
+                   if r['spot'] and r['status'] in (ReplicaStatus.READY,
+                                                    ReplicaStatus.NOT_READY))
+
     # -- reconcile ------------------------------------------------------------
-    def reconcile(self, target: int) -> None:
+    def reconcile(self, target: int, ondemand_fallback: int = 0) -> None:
+        """Converge both pools toward their targets.
+
+        ``target`` sizes the PRIMARY pool (the task as written — spot for
+        spot serving); ``ondemand_fallback`` sizes the FALLBACK pool (the
+        task with use_spot forced off; reference
+        FallbackRequestRateAutoscaler, sky/serve/autoscalers.py:557).
+        """
         self._reap_finished_threads()
         live = self.nonterminal_replicas()
-        if len(live) < target:
-            for _ in range(target - len(live)):
-                self._launch_one()
-        elif len(live) > target:
+        self._reconcile_pool([r for r in live if r['spot']], target,
+                             primary=True)
+        self._reconcile_pool([r for r in live if not r['spot']],
+                             ondemand_fallback, primary=False)
+
+    def _reconcile_pool(self, pool: List[Dict], target: int,
+                        primary: bool) -> None:
+        """Converge one pool toward ``target`` CURRENT-version replicas.
+
+        During a rolling update old-version replicas keep serving until
+        new-version ones are READY: old capacity is only drained
+        one-for-one as new capacity comes up, so a healthy service never
+        drops below target READY replicas (zero-5xx rollout; reference
+        old-version drain, sky/serve/replica_managers.py:1243).
+        Outside an update ``old`` is empty and this reduces to plain
+        scale-to-target.
+        """
+        new = [r for r in pool if r['version'] >= self.version]
+        old = [r for r in pool if r['version'] < self.version]
+        if len(new) < target:
+            for _ in range(target - len(new)):
+                self._launch_one(primary=primary)
+        elif len(new) > target:
             victims = sorted(
-                live, key=lambda r: (r['status'].scale_down_priority,
-                                     -r['replica_id']))
-            for victim in victims[:len(live) - target]:
+                new, key=lambda r: (r['status'].scale_down_priority,
+                                    -r['replica_id']))
+            for victim in victims[:len(new) - target]:
                 self._terminate_one(victim['replica_id'], reason='scale down')
+        # A new replica only "covers" an old one after the LB has had time
+        # to sync its URL into the routing pool — terminating the old
+        # replica the instant the new turns READY would leave a stale-pool
+        # window where the only routable URL is the one being killed.
+        grace = 2 * float(os.environ.get('SKYTPU_SERVE_LB_SYNC', '5'))
+        now = time.time()
+        ready_new = sum(
+            1 for r in new if r['status'] == ReplicaStatus.READY
+            and (r['first_ready_at'] or now) <= now - grace)
+        allowed_old = max(0, target - ready_new)
+        if len(old) > allowed_old:
+            victims = sorted(
+                old, key=lambda r: (r['status'].scale_down_priority,
+                                    -r['replica_id']))
+            for victim in victims[:len(old) - allowed_old]:
+                self._terminate_one(
+                    victim['replica_id'],
+                    reason=f'rolling update to v{self.version}')
 
     def _reap_finished_threads(self) -> None:
         with self._lock:
@@ -90,35 +174,66 @@ class ReplicaManager:
                 del self._inflight[rid]
 
     # -- launch ---------------------------------------------------------------
-    def _launch_one(self) -> None:
+    def _launch_one(self, primary: bool = True) -> None:
         replica_id = serve_state.next_replica_id(self.service)
         cluster = f'{self.service}-rep{replica_id}'
         # Local replicas share one machine: every replica needs its own port.
         port = (common_utils.find_free_port() if self._is_local
                 else self.spec.replica_port)
-        serve_state.add_replica(self.service, replica_id, cluster, port)
+        serve_state.add_replica(self.service, replica_id, cluster, port,
+                                version=self.version, spot=primary)
+        # Snapshot the task NOW: an update adopted mid-launch must not
+        # retroactively change what this (old-version-recorded) replica runs.
+        task_yaml = dict(self.task_yaml)
+        if not primary:
+            # Fallback pool: same task, on-demand capacity.
+            resources = dict(task_yaml.get('resources') or {})
+            resources['use_spot'] = False
+            task_yaml['resources'] = resources
         t = threading.Thread(target=self._launch_replica,
-                             args=(replica_id, cluster, port),
+                             args=(replica_id, cluster, port, task_yaml,
+                                   primary),
                              name=f'launch-rep{replica_id}', daemon=True)
         with self._lock:
             self._inflight[replica_id] = t
         t.start()
 
     def _launch_replica(self, replica_id: int, cluster: str,
-                        port: int) -> None:
+                        port: int, task_yaml: Dict, primary: bool) -> None:
         from skypilot_tpu import execution
+        from skypilot_tpu import resources as resources_lib
         from skypilot_tpu import task as task_lib
         serve_state.update_replica(self.service, replica_id,
                                    status=ReplicaStatus.PROVISIONING)
         try:
-            task = task_lib.Task.from_yaml_config(dict(self.task_yaml))
+            task = task_lib.Task.from_yaml_config(task_yaml)
             task.update_envs({'SKYTPU_SERVE_REPLICA_PORT': str(port),
                               'SKYTPU_SERVE_REPLICA_ID': str(replica_id)})
+            # Placement memory: avoid zones that recently preempted spot
+            # replicas (reference DynamicFallbackSpotPlacer,
+            # sky/serve/spot_placer.py:167). If every zone is blocked the
+            # launch fails over to an unconstrained retry below.
+            blocked = []
+            if primary and self.placer is not None:
+                blocked = [resources_lib.Resources(zone=z)
+                           for z in self.placer.blocked_zones()]
             # Policy already admitted the service task at `serve up`; keep
             # the operation name for replica (re)launches.
-            _, handle = execution.launch(task, cluster_name=cluster,
-                                         detach_run=True, stream_logs=False,
-                                         policy_operation='serve_up')
+            try:
+                _, handle = execution.launch(
+                    task, cluster_name=cluster, detach_run=True,
+                    stream_logs=False, policy_operation='serve_up',
+                    blocked_resources=blocked or None)
+            except exceptions.ResourcesUnavailableError:
+                if not blocked:
+                    raise
+                self.log(f'replica {replica_id}: all placer-preferred '
+                         'zones unavailable; retrying unconstrained')
+                _, handle = execution.launch(
+                    task, cluster_name=cluster, detach_run=True,
+                    stream_logs=False, policy_operation='serve_up')
+            serve_state.update_replica(self.service, replica_id,
+                                       zone=handle.zone)
             from skypilot_tpu import provision as provision_lib
             # Probes and LB traffic come from outside the replica's network:
             # the serving port must be reachable (reference opens ports via
@@ -239,6 +354,8 @@ class ReplicaManager:
                                        status=ReplicaStatus.PREEMPTED,
                                        failure_reason='cluster preempted')
             self.log(f'replica {rid}: PREEMPTED')
+            if self.placer is not None and replica['spot']:
+                self.placer.record_preemption(replica['zone'])
             self._terminate_one(rid, reason='preempted cleanup',
                                 final_status=ReplicaStatus.PREEMPTED)
             return

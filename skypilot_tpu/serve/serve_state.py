@@ -104,7 +104,8 @@ def _db() -> sqlite3.Connection:
                 controller_port INTEGER,
                 lb_port INTEGER,
                 requested_replicas INTEGER,
-                created_at REAL
+                created_at REAL,
+                version INTEGER DEFAULT 1
             )""")
         conn.execute("""
             CREATE TABLE IF NOT EXISTS replicas (
@@ -118,8 +119,20 @@ def _db() -> sqlite3.Connection:
                 first_ready_at REAL,
                 consecutive_probe_failures INTEGER DEFAULT 0,
                 failure_reason TEXT,
+                version INTEGER DEFAULT 1,
+                spot INTEGER DEFAULT 1,
+                zone TEXT,
                 PRIMARY KEY (service, replica_id)
             )""")
+        # Columns added after the original schema (older databases).
+        for table, coldef in (('services', 'version INTEGER DEFAULT 1'),
+                              ('replicas', 'version INTEGER DEFAULT 1'),
+                              ('replicas', 'spot INTEGER DEFAULT 1'),
+                              ('replicas', 'zone TEXT')):
+            try:
+                conn.execute(f'ALTER TABLE {table} ADD COLUMN {coldef}')
+            except sqlite3.OperationalError:
+                pass  # column already exists
         conn.commit()
         conns[path] = conn
     return conn
@@ -164,6 +177,30 @@ def set_status_unless_shutting_down(name: str,
     conn.commit()
 
 
+def bump_service_version(name: str, spec: Dict[str, Any],
+                         task_yaml: Dict[str, Any]) -> int:
+    """Record a new service spec/task under version+1 (rolling update).
+
+    The controller notices the version change on its next tick, launches
+    new-version replicas, and drains old ones as the new turn READY
+    (reference version plumbing, sky/serve/serve_utils.py +
+    replica_managers.py:1243 update_version).
+    """
+    conn = _db()
+    # Atomic increment: two racing `serve update`s must produce two
+    # distinct versions (the later spec wins, as the later version).
+    cur = conn.execute(
+        'UPDATE services SET spec=?, task_yaml=?, '
+        'version=COALESCE(version, 1) + 1 WHERE name=?',
+        (json.dumps(spec), json.dumps(task_yaml), name))
+    conn.commit()
+    if cur.rowcount == 0:
+        raise KeyError(f'service {name!r} does not exist')
+    row = conn.execute('SELECT version FROM services WHERE name=?',
+                       (name,)).fetchone()
+    return int(row[0])
+
+
 def remove_service(name: str) -> None:
     conn = _db()
     conn.execute('DELETE FROM replicas WHERE service=?', (name,))
@@ -178,8 +215,8 @@ def get_service(name: str) -> Optional[Dict[str, Any]]:
 
 def list_services(names: Optional[List[str]] = None) -> List[Dict[str, Any]]:
     q = ('SELECT name, spec, task_yaml, status, controller_pid, lb_pid, '
-         'controller_port, lb_port, requested_replicas, created_at '
-         'FROM services')
+         'controller_port, lb_port, requested_replicas, created_at, '
+         'version FROM services')
     args: List[Any] = []
     if names:
         q += f' WHERE name IN ({",".join("?" * len(names))})'
@@ -194,19 +231,21 @@ def list_services(names: Optional[List[str]] = None) -> List[Dict[str, Any]]:
             'controller_pid': row[4], 'lb_pid': row[5],
             'controller_port': row[6], 'lb_port': row[7],
             'requested_replicas': row[8], 'created_at': row[9],
+            'version': int(row[10] or 1),
         })
     return out
 
 
 # ---- replicas ---------------------------------------------------------------
 def add_replica(service: str, replica_id: int, cluster_name: str,
-                port: int) -> None:
+                port: int, version: int = 1, spot: bool = True) -> None:
     conn = _db()
     conn.execute(
         'INSERT OR REPLACE INTO replicas (service, replica_id, cluster_name,'
-        ' status, port, launched_at) VALUES (?,?,?,?,?,?)',
+        ' status, port, launched_at, version, spot) '
+        'VALUES (?,?,?,?,?,?,?,?)',
         (service, replica_id, cluster_name, ReplicaStatus.PENDING.value,
-         port, time.time()))
+         port, time.time(), version, int(spot)))
     conn.commit()
 
 
@@ -233,13 +272,16 @@ def list_replicas(service: str) -> List[Dict[str, Any]]:
     for row in _db().execute(
             'SELECT replica_id, cluster_name, status, url, port, '
             'launched_at, first_ready_at, consecutive_probe_failures, '
-            'failure_reason FROM replicas WHERE service=? '
-            'ORDER BY replica_id', (service,)):
+            'failure_reason, version, spot, zone FROM replicas '
+            'WHERE service=? ORDER BY replica_id', (service,)):
         out.append({
             'replica_id': row[0], 'cluster_name': row[1],
             'status': ReplicaStatus(row[2]), 'url': row[3], 'port': row[4],
             'launched_at': row[5], 'first_ready_at': row[6],
             'consecutive_probe_failures': row[7], 'failure_reason': row[8],
+            'version': int(row[9] or 1),
+            'spot': bool(row[10] if row[10] is not None else 1),
+            'zone': row[11],
         })
     return out
 

@@ -34,6 +34,19 @@ def _cmd_up(args) -> int:
     return 0
 
 
+def _cmd_update(args) -> int:
+    from skypilot_tpu import exceptions
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.serve import core
+    try:
+        task = task_lib.Task.from_yaml_config(json.loads(args.task_json))
+        result = core.update_on_controller(task, args.service_name)
+    except exceptions.SkyTpuError as e:
+        return _emit_error(e)
+    print(json.dumps(result))
+    return 0
+
+
 def _cmd_status(args) -> int:
     from skypilot_tpu.serve import core
     rows = core.status_on_controller(args.names or None)
@@ -70,6 +83,11 @@ def main() -> None:
     p.add_argument('--service-name', required=True)
     p.add_argument('--task-json', required=True)
     p.set_defaults(fn=_cmd_up)
+
+    p = sub.add_parser('update')
+    p.add_argument('--service-name', required=True)
+    p.add_argument('--task-json', required=True)
+    p.set_defaults(fn=_cmd_update)
 
     p = sub.add_parser('status')
     p.add_argument('--names', nargs='*', default=[])

@@ -48,6 +48,13 @@ class ReplicaPolicy:
     qps_window_seconds: float = DEFAULT_QPS_WINDOW_SECONDS
     upscale_delay_seconds: float = DEFAULT_UPSCALE_DELAY_SECONDS
     downscale_delay_seconds: float = DEFAULT_DOWNSCALE_DELAY_SECONDS
+    # Spot serving (reference sky/serve/autoscalers.py:557
+    # FallbackRequestRateAutoscaler + spot_placer.py:167): the primary
+    # fleet runs the task as written (typically use_spot: true); the
+    # fallback pool runs it with use_spot forced off.
+    base_ondemand_fallback_replicas: int = 0    # always-on on-demand floor
+    dynamic_ondemand_fallback: bool = False     # cover spot gaps on demand
+    spot_placer: Optional[str] = None           # 'dynamic_fallback'
 
     def __post_init__(self):
         if self.min_replicas < 0:
@@ -117,6 +124,11 @@ class ServiceSpec:
             downscale_delay_seconds=float(
                 rp.get('downscale_delay_seconds',
                        DEFAULT_DOWNSCALE_DELAY_SECONDS)),
+            base_ondemand_fallback_replicas=int(
+                rp.get('base_ondemand_fallback_replicas', 0)),
+            dynamic_ondemand_fallback=bool(
+                rp.get('dynamic_ondemand_fallback', False)),
+            spot_placer=rp.get('spot_placer'),
         )
         return cls(
             readiness_probe=probe,
@@ -149,6 +161,13 @@ class ServiceSpec:
         if self.replica_policy.target_qps_per_replica is not None:
             rp['target_qps_per_replica'] = \
                 self.replica_policy.target_qps_per_replica
+        if self.replica_policy.base_ondemand_fallback_replicas:
+            rp['base_ondemand_fallback_replicas'] = \
+                self.replica_policy.base_ondemand_fallback_replicas
+        if self.replica_policy.dynamic_ondemand_fallback:
+            rp['dynamic_ondemand_fallback'] = True
+        if self.replica_policy.spot_placer is not None:
+            rp['spot_placer'] = self.replica_policy.spot_placer
         return {
             'readiness_probe': probe,
             'replica_policy': rp,

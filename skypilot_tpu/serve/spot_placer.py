@@ -1,0 +1,61 @@
+"""Spot placement memory: avoid zones that recently preempted replicas.
+
+Counterpart of reference ``sky/serve/spot_placer.py`` (:167
+``DynamicFallbackSpotPlacer``): the reference tracks per-location
+ACTIVE/PREEMPTED history and prefers unpreempted locations when launching
+spot replicas. Here the memory is a per-zone preemption timestamp list with
+a TTL; the replica manager turns ``blocked_zones()`` into optimizer
+blocklist entries, so a relaunch walks the catalog's remaining zones first.
+Entries age out (spot capacity comes back), and a launch that fails with
+every zone blocked is retried unblocked — availability beats placement.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+# A zone that preempted a replica is avoided for this long.
+DEFAULT_TTL_SECONDS = 20 * 60.0
+
+
+class DynamicFallbackSpotPlacer:
+
+    def __init__(self, ttl_seconds: float = DEFAULT_TTL_SECONDS):
+        self.ttl = ttl_seconds
+        self._preemptions: Dict[str, List[float]] = {}
+        self._lock = threading.Lock()
+
+    def record_preemption(self, zone: Optional[str],
+                          now: Optional[float] = None) -> None:
+        if not zone:
+            return
+        now = time.time() if now is None else now
+        with self._lock:
+            self._preemptions.setdefault(zone, []).append(now)
+
+    def blocked_zones(self, now: Optional[float] = None) -> List[str]:
+        now = time.time() if now is None else now
+        cutoff = now - self.ttl
+        out = []
+        with self._lock:
+            for zone, stamps in list(self._preemptions.items()):
+                stamps[:] = [t for t in stamps if t >= cutoff]
+                if stamps:
+                    out.append(zone)
+                else:
+                    del self._preemptions[zone]
+        return sorted(out)
+
+    def preemption_counts(self) -> Dict[str, int]:
+        with self._lock:
+            return {z: len(ts) for z, ts in self._preemptions.items()}
+
+
+def make(name: Optional[str]) -> Optional[DynamicFallbackSpotPlacer]:
+    if name is None:
+        return None
+    if name == 'dynamic_fallback':
+        return DynamicFallbackSpotPlacer()
+    raise ValueError(f'Unknown spot_placer {name!r}; '
+                     "supported: 'dynamic_fallback'")

@@ -143,6 +143,12 @@ def _ep_serve_down(payload: Dict[str, Any]) -> Any:
     return {'name': payload['service_name'], 'down': True}
 
 
+def _ep_serve_update(payload: Dict[str, Any]) -> Any:
+    from skypilot_tpu.serve import core as serve_core
+    task = _task_from_payload(payload)
+    return serve_core.update(task, payload['service_name'])
+
+
 ENTRYPOINTS: Dict[str, Callable[[Dict[str, Any]], Any]] = {
     'launch': _ep_launch,
     'exec': _ep_exec,
@@ -161,10 +167,13 @@ ENTRYPOINTS: Dict[str, Callable[[Dict[str, Any]], Any]] = {
     'serve_up': _ep_serve_up,
     'serve_status': _ep_serve_status,
     'serve_down': _ep_serve_down,
+    'serve_update': _ep_serve_update,
 }
 
-# serve_down blocks on the controller draining the whole replica fleet.
-LONG_OPS = {'launch', 'exec', 'tail_logs', 'serve_up', 'serve_down'}
+# serve_down blocks on the controller draining the whole replica fleet;
+# serve_up/serve_update block on the controller-cluster RPC.
+LONG_OPS = {'launch', 'exec', 'tail_logs', 'serve_up', 'serve_down',
+            'serve_update'}
 
 
 def schedule_type_for(op: str) -> store.ScheduleType:

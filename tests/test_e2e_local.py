@@ -208,21 +208,30 @@ class TestLifecycle:
 class TestFailover:
 
     def test_capacity_failover_across_zones(self, monkeypatch):
-        # Make zone local-a fail with capacity errors; provisioner should...
-        # local cloud has one zone, so failure surfaces as
-        # ResourcesUnavailableError with history.
         from skypilot_tpu.clouds import local as local_cloud
 
-        task = _local_task('echo x')
         orig = local_cloud.Local.make_deploy_variables
 
-        def inject(self, resources, name, region, zone):
-            out = orig(self, resources, name, region, zone)
-            out['fail_in_zones'] = ['local-a']
-            return out
+        def inject_zones(zones):
+            def inject(self, resources, name, region, zone):
+                out = orig(self, resources, name, region, zone)
+                out['fail_in_zones'] = zones
+                return out
+            return inject
 
+        # First zone stocks out -> provisioner fails over to local-b.
         monkeypatch.setattr(local_cloud.Local, 'make_deploy_variables',
-                            inject)
+                            inject_zones(['local-a']))
+        task = _local_task('echo x')
+        _, handle = execution.launch(task, cluster_name='t-cap-ok',
+                                     detach_run=True)
+        assert handle.zone == 'local-b'
+        core.down('t-cap-ok')
+
+        # Every zone stocks out -> total failure with capacity history.
+        monkeypatch.setattr(local_cloud.Local, 'make_deploy_variables',
+                            inject_zones(['local-a', 'local-b']))
+        task = _local_task('echo x')
         with pytest.raises(exceptions.ResourcesUnavailableError) as ei:
             execution.launch(task, cluster_name='t-cap', detach_run=True)
         assert ei.value.failover_history

@@ -152,6 +152,54 @@ class TestAutoscaler:
         a.collect_requests([now - i * 0.01 for i in range(1000)], now=now)
         assert a.evaluate(now=now) == 2  # clipped at max
 
+    def test_mixed_targets_base_fallback(self):
+        spec = spec_lib.ServiceSpec(
+            replica_policy=spec_lib.ReplicaPolicy(
+                min_replicas=2, base_ondemand_fallback_replicas=1))
+        a = autoscaler_lib.RequestRateAutoscaler(spec, 20.0)
+        mixed = a.evaluate_mixed(num_ready_primary=2)
+        assert (mixed.primary, mixed.ondemand_fallback) == (2, 1)
+
+    def test_mixed_targets_dynamic_fallback_covers_gap(self):
+        spec = spec_lib.ServiceSpec(
+            replica_policy=spec_lib.ReplicaPolicy(
+                min_replicas=2, dynamic_ondemand_fallback=True))
+        a = autoscaler_lib.RequestRateAutoscaler(spec, 20.0)
+        # All spot READY: no on-demand needed.
+        m = a.evaluate_mixed(num_ready_primary=2)
+        assert (m.primary, m.ondemand_fallback) == (2, 0)
+        # Both spot replicas preempted: on-demand covers the whole gap.
+        m = a.evaluate_mixed(num_ready_primary=0)
+        assert (m.primary, m.ondemand_fallback) == (2, 2)
+
+    def test_no_fallback_config_means_zero_ondemand(self):
+        spec = spec_lib.ServiceSpec(
+            replica_policy=spec_lib.ReplicaPolicy(min_replicas=3))
+        a = autoscaler_lib.RequestRateAutoscaler(spec, 20.0)
+        m = a.evaluate_mixed(num_ready_primary=0)
+        assert (m.primary, m.ondemand_fallback) == (3, 0)
+
+
+class TestSpotPlacer:
+
+    def test_blocked_zones_with_ttl(self):
+        from skypilot_tpu.serve import spot_placer
+        p = spot_placer.DynamicFallbackSpotPlacer(ttl_seconds=100)
+        p.record_preemption('zone-a', now=1000.0)
+        p.record_preemption('zone-b', now=1050.0)
+        assert p.blocked_zones(now=1060.0) == ['zone-a', 'zone-b']
+        # zone-a's preemption ages out.
+        assert p.blocked_zones(now=1120.0) == ['zone-b']
+        assert p.blocked_zones(now=1200.0) == []
+
+    def test_make(self):
+        from skypilot_tpu.serve import spot_placer
+        assert spot_placer.make(None) is None
+        assert isinstance(spot_placer.make('dynamic_fallback'),
+                          spot_placer.DynamicFallbackSpotPlacer)
+        with pytest.raises(ValueError):
+            spot_placer.make('nope')
+
 
 # ---- LB policies ------------------------------------------------------------
 class TestPolicies:
@@ -191,7 +239,9 @@ class H(http.server.BaseHTTPRequestHandler):
     def log_message(self, *a):
         pass
     def do_GET(self):
-        body = json.dumps({'replica': RID, 'path': self.path}).encode()
+        body = json.dumps({'replica': RID, 'path': self.path,
+                           'marker': os.environ.get('SKYTPU_TEST_MARKER',
+                                                    '')}).encode()
         self.send_response(200)
         self.send_header('Content-Length', str(len(body)))
         self.end_headers()
@@ -314,6 +364,73 @@ class TestServeE2E:
                      if r['name'].startswith('svc-e2e-rep')]
         assert not leftovers, leftovers
 
+    def test_rolling_update_zero_downtime(self, fast_serve_env):
+        """`serve update` rolls the fleet to a new version with no failed
+        request: old replicas drain only as new ones turn READY
+        (reference sky/serve/replica_managers.py:1243 update_version)."""
+        import threading
+        from skypilot_tpu.serve import core as serve_core
+
+        def make_task(marker):
+            task = _service_task(fast_serve_env, min_replicas=1)
+            task.update_envs({'SKYTPU_TEST_MARKER': marker})
+            return task
+
+        result = serve_core.up(make_task('v1'), 'svc-roll')
+        endpoint = result['endpoint']
+        try:
+            _wait(lambda: len(_ready_replicas('svc-roll')) == 1, 120,
+                  'v1 replica READY')
+            assert json.loads(_get_retry(endpoint + '/m')[1])['marker'] \
+                == 'v1'
+
+            # Continuous traffic through the rollout; every response must
+            # be a 200 (zero-downtime requirement).
+            codes = []
+            markers = set()
+            stop = threading.Event()
+
+            def traffic():
+                while not stop.is_set():
+                    try:
+                        status_code, body, _ = _get(endpoint + '/t',
+                                                    timeout=10)
+                        codes.append(status_code)
+                        markers.add(json.loads(body)['marker'])
+                    except (urllib.error.HTTPError,) as e:
+                        codes.append(e.code)
+                    except (urllib.error.URLError, OSError) as e:
+                        codes.append(f'conn:{e}')
+                    time.sleep(0.05)
+
+            t = threading.Thread(target=traffic, daemon=True)
+            t.start()
+
+            serve_core.update(make_task('v2'), 'svc-roll')
+
+            def rolled():
+                rows = serve_state.list_replicas('svc-roll')
+                ready_v2 = [r for r in rows if r['version'] == 2
+                            and r['status'] == ReplicaStatus.READY]
+                live_v1 = [r for r in rows if r['version'] == 1
+                           and (r['status'].is_live() or r['status']
+                                == ReplicaStatus.SHUTTING_DOWN)]
+                return ready_v2 and not live_v1
+
+            _wait(rolled, 120, 'rollout to v2 complete')
+            # Let traffic observe the post-rollout fleet for a moment.
+            time.sleep(1.0)
+            stop.set()
+            t.join(timeout=10)
+
+            bad = [c for c in codes if c != 200]
+            assert not bad, f'non-200s during rollout: {bad[:10]}'
+            assert 'v2' in markers, markers
+            svc_rows = serve_core.status(['svc-roll'])
+            assert svc_rows[0]['version'] == 2
+        finally:
+            serve_core.down('svc-roll')
+
     def test_replica_preemption_recovery(self, fast_serve_env):
         """Kill a replica's cluster out-of-band: the controller must mark
         it PREEMPTED and top the fleet back up (reference
@@ -373,6 +490,71 @@ class TestServeE2E:
             assert sdk.get(sdk.serve_status(None)) == []
         finally:
             httpd.shutdown()
+
+    def test_spot_fallback_and_placer(self, fast_serve_env):
+        """Spot serving (reference FallbackRequestRateAutoscaler
+        sky/serve/autoscalers.py:557 + DynamicFallbackSpotPlacer
+        spot_placer.py:167): preempting the spot replica leaves the
+        on-demand backstop serving, and the spot relaunch avoids the
+        preempting zone."""
+        import skypilot_tpu as sky
+        from skypilot_tpu import global_user_state
+        from skypilot_tpu.provision import local_impl
+        from skypilot_tpu.serve import core as serve_core
+
+        task = sky.Task(run=f'{sys.executable} {fast_serve_env}')
+        task.set_resources([sky.Resources(cloud='local', use_spot=True)])
+        task.set_service(spec_lib.ServiceSpec.from_yaml_config({
+            'readiness_probe': {'path': '/health',
+                                'initial_delay_seconds': 60,
+                                'timeout_seconds': 2},
+            'replica_policy': {
+                'min_replicas': 1,
+                'base_ondemand_fallback_replicas': 1,
+                'spot_placer': 'dynamic_fallback',
+            },
+        }))
+        serve_core.up(task, 'svc-spot')
+        try:
+            def both_pools_ready():
+                rows = serve_state.list_replicas('svc-spot')
+                spot_ready = [r for r in rows if r['spot']
+                              and r['status'] == ReplicaStatus.READY]
+                od_ready = [r for r in rows if not r['spot']
+                            and r['status'] == ReplicaStatus.READY]
+                return spot_ready and od_ready
+            _wait(both_pools_ready, 120, 'spot + on-demand replicas READY')
+
+            rows = serve_state.list_replicas('svc-spot')
+            spot_rep = [r for r in rows if r['spot']
+                        and r['status'] == ReplicaStatus.READY][0]
+            od_rep = [r for r in rows if not r['spot']][0]
+            preempted_zone = spot_rep['zone']
+            assert preempted_zone in ('local-a', 'local-b')
+
+            # Preempt the spot replica's cluster out-of-band.
+            local_impl.terminate_instances(spot_rep['cluster_name'],
+                                          'local')
+            global_user_state.remove_cluster(spot_rep['cluster_name'],
+                                            terminate=True)
+
+            def spot_recovered():
+                rows = serve_state.list_replicas('svc-spot')
+                # On-demand backstop must stay READY the whole time.
+                od = [r for r in rows
+                      if r['replica_id'] == od_rep['replica_id']][0]
+                assert od['status'] == ReplicaStatus.READY, od['status']
+                fresh = [r for r in rows if r['spot']
+                         and r['replica_id'] != spot_rep['replica_id']
+                         and r['status'] == ReplicaStatus.READY]
+                return fresh[0] if fresh else None
+
+            fresh = _wait(spot_recovered, 120, 'spot replica relaunched')
+            # Placer memory: the relaunch avoided the preempting zone.
+            assert fresh['zone'] != preempted_zone, \
+                (fresh['zone'], preempted_zone)
+        finally:
+            serve_core.down('svc-spot')
 
     def test_lb_503_with_no_replicas(self, fast_serve_env):
         from skypilot_tpu.serve import core as serve_core

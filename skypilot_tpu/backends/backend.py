@@ -57,7 +57,8 @@ class Backend:
                   blocked_resources=None) -> Optional[ResourceHandle]:
         raise NotImplementedError
 
-    def sync_workdir(self, handle: ResourceHandle, workdir: str) -> None:
+    def sync_workdir(self, handle: ResourceHandle, workdir: str,
+                     cached: bool = False) -> None:
         raise NotImplementedError
 
     def sync_file_mounts(self, handle: ResourceHandle,

@@ -156,6 +156,26 @@ class RetryingProvisioner:
         return None
 
 
+def _fan_out_hosts(runners: List[Any], fn) -> List[str]:
+    """Run ``fn(rank, runner)`` on every host concurrently; returns the
+    per-host error strings (empty = all succeeded)."""
+    errors: List[str] = []
+
+    def wrapped(rank: int, runner) -> None:
+        try:
+            fn(rank, runner)
+        except Exception as e:  # noqa: BLE001 — surface per-host
+            errors.append(f'rank {rank}: {e}')
+
+    threads = [threading.Thread(target=wrapped, args=(i, r))
+               for i, r in enumerate(runners)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return errors
+
+
 class SliceBackend(backend_lib.Backend):
 
     NAME = 'slice'
@@ -237,6 +257,12 @@ class SliceBackend(backend_lib.Backend):
                 cluster_name, handle=handle,
                 requested_resources=task.resources, ready=False)
             self._post_provision_setup(handle, info)
+            # resources.ports (task YAML `ports:`) open at provision time
+            # (reference opens resources ports via provision/instance.py).
+            ports = [str(p) for p in (launched.ports or ())]
+            if ports:
+                provision_lib.open_ports(handle.cloud, cluster_name,
+                                         handle.region, ports)
             global_user_state.add_or_update_cluster(
                 cluster_name, handle=handle,
                 requested_resources=task.resources, ready=True)
@@ -260,7 +286,6 @@ class SliceBackend(backend_lib.Backend):
 
         if handle.cloud != 'local':
             self._sync_runtime_code(runners)
-        errors: List[str] = []
 
         def bring_up(rank: int, runner) -> None:
             cmds = [
@@ -311,18 +336,7 @@ class SliceBackend(backend_lib.Backend):
                             f'(see {rtdir}/{rt_constants.AGENT_LOG_FILE})')
                     time.sleep(0.3)
 
-        def bring_up_checked(rank: int, runner) -> None:
-            try:
-                bring_up(rank, runner)
-            except Exception as e:  # surface thread failures to the caller
-                errors.append(f'rank {rank}: {e}')
-
-        threads = [threading.Thread(target=bring_up_checked, args=(i, r))
-                   for i, r in enumerate(runners)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
+        errors = _fan_out_hosts(runners, bring_up)
         if errors:
             raise exceptions.ProvisionError(
                 'runtime bring-up failed on '
@@ -332,23 +346,87 @@ class SliceBackend(backend_lib.Backend):
         # status refresh doesn't report INIT off stale data.
         global_user_state.set_kv(f'agent_probe:{handle.cluster_name}', None)
 
+    @staticmethod
+    def _tree_hash(path: str) -> str:
+        """Content hash of a directory tree (path + size + mtime per file;
+        reference hashes its wheel dir the same cheap way,
+        sky/backends/wheel_utils.py). Cache key only — a stale hit just
+        means one redundant rsync was skipped on the SAME client machine.
+        """
+        import hashlib
+        h = hashlib.sha256()
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(d for d in dirs
+                             if d != '__pycache__' and not d.startswith('.'))
+            for fname in sorted(files):
+                if fname.endswith(('.pyc', '.pyo')):
+                    continue
+                fp = os.path.join(root, fname)
+                try:
+                    st = os.stat(fp)
+                except OSError:
+                    continue
+                h.update(os.path.relpath(fp, path).encode())
+                h.update(f'{st.st_size}:{st.st_mtime_ns}'.encode())
+        return h.hexdigest()
+
+    def _sync_tree_cached(self, runners: List[Any], src: str, dst: str,
+                          marker: str, what: str,
+                          skip_if_unchanged: bool = True) -> None:
+        """Fan a directory out to every host in parallel, skipping hosts
+        whose content-hash marker already matches (reference parallel
+        setup with per-node cache, sky/provision/instance_setup.py:137).
+        Bring-up cost is O(slowest host), not O(sum), and a re-launch
+        with unchanged content does zero rsync work.
+
+        ``skip_if_unchanged=False`` still fans out and writes the marker
+        but always rsyncs — a full (non --fast) launch must restore any
+        host-side mutations a previous job made to the tree.
+        """
+        if not src.endswith('/'):
+            src += '/'
+        tree_hash = self._tree_hash(src)
+
+        def ship(rank: int, runner) -> None:
+            if skip_if_unchanged:
+                probe = runner.run(f'cat {shlex.quote(marker)} 2>/dev/null',
+                                   timeout=30)
+                if probe.returncode == 0 and \
+                        probe.stdout.strip() == tree_hash:
+                    return  # up to date
+            runner.run(f'mkdir -p {_quote_path(dst)}', timeout=60)
+            runner.rsync(src, dst if dst.endswith('/') else dst + '/',
+                         up=True)
+            res = runner.run(_heredoc_write(marker, tree_hash),
+                             timeout=30)
+            if res.returncode != 0:
+                raise exceptions.CommandError(
+                    res.returncode, 'sync marker',
+                    res.stderr or res.stdout)
+
+        errors = _fan_out_hosts(runners, ship)
+        if errors:
+            raise exceptions.CommandError(
+                1, f'sync {what}',
+                f'{what} sync failed on {len(errors)}/{len(runners)} '
+                'host(s): ' + ' | '.join(errors[:4]))
+
     def _sync_runtime_code(self, runners: List[Any]) -> None:
         """Ship our package to non-local hosts (analog of reference wheel
         shipping, sky/backends/wheel_utils.py)."""
         pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        for runner in runners:
-            runner.run('mkdir -p .skytpu/code', timeout=60)
-            runner.rsync(pkg_dir, '.skytpu/code/', up=True)
+        self._sync_tree_cached(runners, pkg_dir, '.skytpu/code/skypilot_tpu',
+                               marker='.skytpu/code/.sync_hash',
+                               what='runtime code')
 
     # ---- sync / setup ------------------------------------------------------
     def sync_workdir(self, handle: backend_lib.ResourceHandle,
-                     workdir: str) -> None:
+                     workdir: str, cached: bool = False) -> None:
         workdir = os.path.expanduser(workdir)
-        if not workdir.endswith('/'):
-            workdir += '/'
-        for runner in self._runners(handle):
-            runner.run(f'mkdir -p {rt_constants.WORKDIR}', timeout=60)
-            runner.rsync(workdir, rt_constants.WORKDIR + '/', up=True)
+        self._sync_tree_cached(
+            self._runners(handle), workdir, rt_constants.WORKDIR,
+            marker=f'{rt_constants.RUNTIME_DIR}/workdir.hash',
+            what='workdir', skip_if_unchanged=cached)
 
     def sync_file_mounts(self, handle: backend_lib.ResourceHandle,
                          file_mounts: Optional[Dict[str, str]],
@@ -357,17 +435,20 @@ class SliceBackend(backend_lib.Backend):
         if not file_mounts and not storage_mounts:
             return
         from skypilot_tpu.data import storage as storage_lib
-        for runner in self._runners(handle):
+
+        def mount_host(rank: int, runner) -> None:
             for dst, src in (file_mounts or {}).items():
                 src = os.path.expanduser(src)
                 if src.endswith('/') and not dst.endswith('/'):
                     dst += '/'
                 parent = os.path.dirname(dst.rstrip('/')) or '.'
-                runner.run(f'mkdir -p {_quote_path(parent)}', timeout=60)
+                runner.run(f'mkdir -p {_quote_path(parent)}',
+                           timeout=60)
                 runner.rsync(src, dst, up=True)
-            # Bucket-backed mounts: the host pulls (COPY) or FUSE-mounts
-            # (MOUNT) directly from the store — data never proxies through
-            # the client (reference sky/data COPY/MOUNT split).
+            # Bucket-backed mounts: the host pulls (COPY) or
+            # FUSE-mounts (MOUNT) directly from the store — data never
+            # proxies through the client (reference sky/data
+            # COPY/MOUNT split).
             for dst, storage in (storage_mounts or {}).items():
                 assert isinstance(storage, storage_lib.Storage), storage
                 if storage.mode is storage_lib.StorageMode.MOUNT:
@@ -377,31 +458,32 @@ class SliceBackend(backend_lib.Backend):
                 result = runner.run(cmd, timeout=600)
                 if result.returncode != 0:
                     raise exceptions.StorageError(
-                        f'{storage.mode.value} of {storage.url} at {dst} '
-                        f'failed (rc={result.returncode}): '
+                        f'{storage.mode.value} of {storage.url} at '
+                        f'{dst} failed (rc={result.returncode}): '
                         f'{result.stderr[-500:] or result.stdout[-500:]}')
+
+        errors = _fan_out_hosts(self._runners(handle), mount_host)
+        if errors:
+            raise exceptions.StorageError(
+                f'file/storage mounts failed on {len(errors)} host(s): '
+                + ' | '.join(errors[:4]))
 
     def setup(self, handle: backend_lib.ResourceHandle,
               task: task_lib.Task) -> None:
         if not task.setup:
             return
         env = dict(task.envs_and_secrets)
-        errors: List[str] = []
 
         def run_setup(rank: int, runner) -> None:
             script = (f'cd {rt_constants.WORKDIR} 2>/dev/null || true; '
                       + task.setup)
             res = runner.run(script, env=env, timeout=3600)
             if res.returncode != 0:
-                errors.append(
-                    f'rank {rank}: {res.stderr.strip() or res.stdout.strip()}')
+                raise exceptions.CommandError(
+                    res.returncode, 'setup',
+                    res.stderr.strip() or res.stdout.strip())
 
-        threads = [threading.Thread(target=run_setup, args=(i, r))
-                   for i, r in enumerate(self._runners(handle))]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
+        errors = _fan_out_hosts(self._runners(handle), run_setup)
         if errors:
             raise exceptions.CommandError(
                 1, 'setup', f'setup failed on {len(errors)} host(s): ' +

@@ -317,6 +317,20 @@ def storage_ls():
         click.echo(fmt.format(r['name'][:24], r['url'][:40], r['mode'], ts))
 
 
+@storage.command('transfer')
+@click.argument('src_url')
+@click.argument('dst_url')
+def storage_transfer(src_url, dst_url):
+    """Copy a bucket tree between stores (e.g. s3://data gs://data).
+
+    S3->GCS rides a provider-side path (no client transit); other pairs
+    relay through this machine.
+    """
+    from skypilot_tpu.data import data_transfer
+    data_transfer.transfer_url(src_url, dst_url)
+    click.echo(f'Transferred {src_url} -> {dst_url}.')
+
+
 @storage.command('delete')
 @click.argument('names', nargs=-1, required=True)
 @click.option('--yes', '-y', is_flag=True)

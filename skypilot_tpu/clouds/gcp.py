@@ -177,6 +177,14 @@ class GCP(cloud_lib.Cloud):
             'disk_size_gb': resources.disk_size,
             'labels': dict(resources.labels or {}),
             'ports': list(resources.ports or ()),
+            # VPC from config (~/.skytpu/config.yaml gcp.vpc_name);
+            # provisioner + open_ports firewall rules live on it. A
+            # custom-mode VPC additionally needs gcp.subnetwork (GCP
+            # rejects instance creation on custom VPCs without one).
+            'network': config_lib.get_nested(('gcp', 'vpc_name'), None)
+            or 'default',
+            'subnetwork': config_lib.get_nested(('gcp', 'subnetwork'),
+                                                None),
         }
         if resources.tpu is not None:
             s = resources.tpu

@@ -148,12 +148,19 @@ def status(cluster_names: Optional[List[str]] = None,
         records = [r for r in records if r['name'] in cluster_names]
     if not refresh:
         return records
-    out = []
-    for record in records:
-        refreshed = _refresh_record(record)
-        if refreshed is not None:
-            out.append(refreshed)
-    return out
+    if len(records) > 1:
+        # Each refresh is an independent cloud query + possible SSH probe
+        # behind its own per-cluster lock: run them concurrently so
+        # `status --refresh` over N clusters is O(slowest), not O(sum)
+        # (reference batches refresh with a process pool,
+        # sky/backends/backend_utils.py:2084).
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(
+                max_workers=min(16, len(records))) as pool:
+            refreshed_all = list(pool.map(_refresh_record, records))
+    else:
+        refreshed_all = [_refresh_record(r) for r in records]
+    return [r for r in refreshed_all if r is not None]
 
 
 def _get_handle(cluster_name: str, need_up: bool = False

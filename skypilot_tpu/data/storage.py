@@ -69,8 +69,19 @@ class AbstractStore:
         """Upload a local file/dir tree into the bucket (client machine)."""
         raise NotImplementedError
 
+    def download_local(self, local_path: str) -> None:
+        """Materialize the bucket path into a local dir (client machine);
+        the generic inter-cloud transfer relay uses this."""
+        raise NotImplementedError
+
     def exists(self) -> bool:
         raise NotImplementedError
+
+    def bucket_exists(self) -> bool:
+        """Does the BUCKET exist (ignoring sub_path)? Validation uses
+        this: an empty/not-yet-written prefix of a real bucket is fine
+        (output/checkpoint mounts create their path on first write)."""
+        return type(self)(self.bucket).exists()
 
 
 class GcsStore(AbstractStore):
@@ -111,6 +122,16 @@ class GcsStore(AbstractStore):
             raise exceptions.StorageError(
                 f'upload to {self.url} failed: {proc.stderr[-500:]}')
 
+    def download_local(self, local_path: str) -> None:
+        os.makedirs(local_path, exist_ok=True)
+        cmd = ['gsutil', '-m', 'rsync', '-r', self.url, local_path]
+        if shutil.which('gcloud'):
+            cmd = ['gcloud', 'storage', 'rsync', '-r', self.url, local_path]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise exceptions.StorageError(
+                f'download from {self.url} failed: {proc.stderr[-500:]}')
+
     def exists(self) -> bool:
         tool = 'gcloud' if shutil.which('gcloud') else 'gsutil'
         if tool == 'gcloud':
@@ -118,6 +139,63 @@ class GcsStore(AbstractStore):
         else:
             cmd = ['gsutil', 'ls', self.url]
         return subprocess.run(cmd, capture_output=True).returncode == 0
+
+
+class S3Store(AbstractStore):
+    """Amazon S3 via the aws CLI.
+
+    Reference counterpart: sky/data/storage.py S3Store (:118-211 family).
+    The realistic TPU story is S3 as a *source* (datasets produced on AWS)
+    that COPY-materializes onto GCP hosts or transfers to GCS
+    (data/data_transfer.py); FUSE-mounting S3 on TPU-VMs is deliberately
+    unsupported — cross-cloud FUSE latency makes training input pipelines
+    stall, so the framework forces an explicit COPY/transfer decision.
+    """
+
+    SCHEME = 's3'
+
+    # GCP TPU-VM images ship gcloud but not the aws CLI: bootstrap it on
+    # first use (reference installs cloud CLIs in its setup commands,
+    # sky/setup_files). ~/.local/bin covers pip --user installs.
+    _ENSURE_AWS = ('export PATH=$PATH:$HOME/.local/bin; '
+                   'command -v aws >/dev/null || '
+                   'python3 -m pip install --user --quiet awscli; ')
+
+    def download_command(self, dst: str) -> str:
+        q = shlex.quote
+        return (f'mkdir -p {q(dst)} && '
+                f'{self._ENSURE_AWS}'
+                f'aws s3 sync {q(self.url)} {q(dst)}')
+
+    def upload_command(self, src: str) -> str:
+        q = shlex.quote
+        return f'{self._ENSURE_AWS}aws s3 sync {q(src)} {q(self.url)}'
+
+    def mount_command(self, mount_point: str) -> str:
+        raise exceptions.StorageError(
+            'MOUNT is not supported for s3:// on TPU hosts; use COPY, or '
+            'transfer the bucket to GCS first '
+            '(skypilot_tpu.data.data_transfer).')
+
+    def upload_local(self, local_path: str) -> None:
+        local_path = os.path.expanduser(local_path)
+        proc = subprocess.run(['aws', 's3', 'sync', local_path, self.url],
+                              capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise exceptions.StorageError(
+                f'upload to {self.url} failed: {proc.stderr[-500:]}')
+
+    def download_local(self, local_path: str) -> None:
+        os.makedirs(local_path, exist_ok=True)
+        proc = subprocess.run(['aws', 's3', 'sync', self.url, local_path],
+                              capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise exceptions.StorageError(
+                f'download from {self.url} failed: {proc.stderr[-500:]}')
+
+    def exists(self) -> bool:
+        return subprocess.run(['aws', 's3', 'ls', self.url],
+                              capture_output=True).returncode == 0
 
 
 class LocalStore(AbstractStore):
@@ -164,6 +242,11 @@ class LocalStore(AbstractStore):
         else:
             shutil.copy2(local_path, self.root)
 
+    def download_local(self, local_path: str) -> None:
+        if not os.path.isdir(self.root):
+            raise exceptions.StorageError(f'{self.url} does not exist')
+        shutil.copytree(self.root, local_path, dirs_exist_ok=True)
+
     def exists(self) -> bool:
         return os.path.isdir(self.root)
 
@@ -177,6 +260,7 @@ def register_store(cls: Type[AbstractStore]) -> Type[AbstractStore]:
 
 
 register_store(GcsStore)
+register_store(S3Store)
 register_store(LocalStore)
 
 
@@ -230,9 +314,11 @@ class Storage:
                 'Storage needs a name or a source')
         self.mode = mode
         self.local_source: Optional[str] = None
+        self._from_url = False
 
         if source is not None and is_store_url(source):
             self.store: AbstractStore = parse_store_url(source)
+            self._from_url = True
         elif source is not None:
             # Local path to be uploaded into a named bucket.
             expanded = os.path.expanduser(source)
@@ -253,6 +339,27 @@ class Storage:
     @property
     def url(self) -> str:
         return self.store.url
+
+    def validate(self) -> None:
+        """Early existence check at task submission (reference
+        sky/data/storage.py source-bucket validation): a task pointing at
+        a nonexistent source bucket must fail NOW with a clear error, not
+        minutes later on a provisioned (billing) cluster.
+
+        Bucket-level only (an empty prefix the task will write into is
+        legitimate), and advisory when the cloud CLI is absent on the
+        client — the hosts surface the error at COPY/MOUNT time then.
+        """
+        if not self._from_url:
+            return
+        try:
+            ok = self.store.bucket_exists()
+        except FileNotFoundError:
+            return  # no cloud CLI on this client: cannot check here
+        if not ok:
+            raise exceptions.StorageError(
+                f'storage source {self.url} does not exist or is not '
+                'accessible with the current credentials')
 
     def sync_local_source(self) -> None:
         """Upload the local source into the bucket (no-op otherwise)."""
@@ -286,7 +393,8 @@ class Storage:
 
 
 def _normalize_scheme(store: str) -> str:
-    aliases = {'gcs': 'gs', 'gs': 'gs', 'file': 'file', 'local': 'file'}
+    aliases = {'gcs': 'gs', 'gs': 'gs', 's3': 's3', 'aws': 's3',
+               'file': 'file', 'local': 'file'}
     try:
         return aliases[store.lower()]
     except KeyError:

@@ -129,7 +129,11 @@ def _execute(task: task_lib.Task,
             '(torn down concurrently?). Re-run without --fast.')
 
     if Stage.SYNC_WORKDIR in stages and task.workdir:
-        backend.sync_workdir(handle, task.workdir)
+        # --fast path (no SETUP stage): skip hosts whose content hash
+        # already matches. Full launches always rsync so host-side
+        # mutations from previous jobs are restored.
+        backend.sync_workdir(handle, task.workdir,
+                             cached=Stage.SETUP not in stages)
     if Stage.SYNC_FILE_MOUNTS in stages:
         task.sync_storage_mounts()  # client-side: local sources -> buckets
         backend.sync_file_mounts(handle, task.file_mounts,

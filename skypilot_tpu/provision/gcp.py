@@ -25,6 +25,16 @@ from skypilot_tpu.utils import command_runner as runner_lib
 
 _LABEL = 'skytpu-cluster'
 
+
+def _firewall_tag(name_on_cloud: str) -> str:
+    """Network tag carried by every host of a cluster; firewall rules for
+    ``open_ports`` target it."""
+    return f'skytpu-{name_on_cloud}'
+
+
+def _firewall_rule_name(name_on_cloud: str) -> str:
+    return f'skytpu-{name_on_cloud}-ports'
+
 _TPU_STATE_MAP = {
     'CREATING': 'pending', 'STARTING': 'pending', 'RESTARTING': 'pending',
     'REPAIRING': 'pending', 'READY': 'running', 'STOPPING': 'stopping',
@@ -94,10 +104,20 @@ def run_instances(cluster_name: str, region: str, zone: Optional[str],
 def _tpu_node_body(name: str, deploy_vars: Dict[str, Any]) -> Dict[str, Any]:
     labels = dict(deploy_vars.get('labels') or {})
     labels[_LABEL] = name
+    network = deploy_vars.get('network') or 'default'
+    network_config: Dict[str, Any] = {'enableExternalIps': True,
+                                      'network': network}
+    if deploy_vars.get('subnetwork'):
+        # Custom-mode VPCs reject creation without an explicit subnetwork.
+        network_config['subnetwork'] = deploy_vars['subnetwork']
     body: Dict[str, Any] = {
         'acceleratorType': deploy_vars['accelerator_type'],
         'runtimeVersion': deploy_vars['runtime_version'],
-        'networkConfig': {'enableExternalIps': True},
+        'networkConfig': network_config,
+        # Network tag keyed on the CLUSTER (not the per-slice node name):
+        # open_ports firewall rules target it (reference tags clusters the
+        # same way, sky/provision/gcp/instance.py open_ports).
+        'tags': [_firewall_tag(deploy_vars['cluster_name_on_cloud'])],
         'labels': labels,
         'metadata': {'ssh-keys': authentication.gcp_ssh_keys_metadata()},
         'schedulingConfig': {
@@ -237,10 +257,15 @@ def _run_gce_instances(project: str, zone: str, name: str, num_hosts: int,
                 },
                 'autoDelete': True,
             }],
-            'networkInterfaces': [{
-                'network': 'global/networks/default',
-                'accessConfigs': [{'type': 'ONE_TO_ONE_NAT'}],
-            }],
+            'tags': {'items': [_firewall_tag(name)]},
+            'networkInterfaces': [dict(
+                {'network': 'global/networks/'
+                            f"{deploy_vars.get('network') or 'default'}",
+                 'accessConfigs': [{'type': 'ONE_TO_ONE_NAT'}]},
+                **({'subnetwork': f'regions/{deploy_vars["region"]}/'
+                                  f'subnetworks/'
+                                  f'{deploy_vars["subnetwork"]}'}
+                   if deploy_vars.get('subnetwork') else {}))],
             'metadata': {'items': [{
                 'key': 'ssh-keys',
                 'value': authentication.gcp_ssh_keys_metadata(),
@@ -353,6 +378,11 @@ def terminate_instances(cluster_name: str, region: str) -> None:
                for rank in range(record['num_hosts'])]
         for op in ops:
             gce.wait_zone_operation(zone, op)
+    # open_ports firewall rule is keyed on the cluster: it dies with it.
+    try:
+        gcp_api.GceClient(project).delete_firewall(_firewall_rule_name(name))
+    except exceptions.CloudError:
+        pass  # rule cleanup must never block teardown
     _delete_record(cluster_name)
 
 
@@ -399,10 +429,39 @@ def get_cluster_info(cluster_name: str, region: str
 
 
 def open_ports(cluster_name: str, region: str, ports: List[str]) -> None:
-    # Firewall-rule management arrives with the serving layer; default VPC
-    # already allows SSH (reference provision/gcp/config.py handles full
-    # VPC bootstrap).
-    return
+    """Expose ports: one firewall rule per cluster, targeting its network
+    tag (reference sky/provision/gcp/instance.py open_ports +
+    config.py firewall bootstrap). Idempotent: re-opening merges ports
+    into the existing rule."""
+    if not ports:
+        return
+    record = _require_record(cluster_name)
+    project = record['project']
+    name = record['name_on_cloud']
+    network = (record['deploy_vars'].get('network') or 'default')
+    gce = gcp_api.GceClient(project)
+    rule_name = _firewall_rule_name(name)
+    want = sorted({str(p) for p in ports})
+    existing = gce.get_firewall(rule_name)
+    if existing is not None:
+        have = set()
+        for allowed in existing.get('allowed', []):
+            have.update(allowed.get('ports', []))
+        merged = sorted(have | set(want))
+        if merged == sorted(have):
+            return  # already open
+        gce.wait_global_operation(gce.patch_firewall(rule_name, {
+            'allowed': [{'IPProtocol': 'tcp', 'ports': merged}],
+        }))
+        return
+    gce.wait_global_operation(gce.insert_firewall({
+        'name': rule_name,
+        'network': f'global/networks/{network}',
+        'direction': 'INGRESS',
+        'sourceRanges': ['0.0.0.0/0'],
+        'targetTags': [_firewall_tag(name)],
+        'allowed': [{'IPProtocol': 'tcp', 'ports': want}],
+    }))
 
 
 def get_command_runners(cluster_info: provision_lib.ClusterInfo,

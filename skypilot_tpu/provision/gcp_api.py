@@ -289,3 +289,52 @@ class GceClient:
                 return
             time.sleep(2)
         raise exceptions.ProvisionError('GCE operation timed out')
+
+    # -- firewalls (global resources; serving-port exposure) -----------------
+    def _global_url(self) -> str:
+        return f'{GCE_BASE}/projects/{self.project}/global'
+
+    def get_firewall(self, name: str) -> Optional[Dict[str, Any]]:
+        try:
+            return get_transport().request(
+                'GET', f'{self._global_url()}/firewalls/{name}')
+        except exceptions.CloudError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def insert_firewall(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        return get_transport().request(
+            'POST', f'{self._global_url()}/firewalls', json_body=body)
+
+    def patch_firewall(self, name: str,
+                       body: Dict[str, Any]) -> Dict[str, Any]:
+        return get_transport().request(
+            'PATCH', f'{self._global_url()}/firewalls/{name}',
+            json_body=body)
+
+    def delete_firewall(self, name: str) -> Dict[str, Any]:
+        try:
+            return get_transport().request(
+                'DELETE', f'{self._global_url()}/firewalls/{name}')
+        except exceptions.CloudError as e:
+            if e.code == 404:
+                return {}
+            raise
+
+    def wait_global_operation(self, op: Dict[str, Any],
+                              timeout: float = 600) -> None:
+        if not op or op.get('status') == 'DONE' or 'name' not in op:
+            return
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            cur = get_transport().request(
+                'GET', f'{self._global_url()}/operations/{op["name"]}')
+            if cur.get('status') == 'DONE':
+                if cur.get('error'):
+                    errs = cur['error'].get('errors', [])
+                    msg = '; '.join(e.get('message', '') for e in errs)
+                    raise classify_error(500, msg)
+                return
+            time.sleep(2)
+        raise exceptions.ProvisionError('GCE global operation timed out')

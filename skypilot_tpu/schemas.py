@@ -159,6 +159,7 @@ CONFIG_SCHEMA: Dict[str, Any] = {
                 'project_id': {'type': ['string', 'null']},
                 'service_account': {'type': ['string', 'null']},
                 'vpc_name': {'type': ['string', 'null']},
+                'subnetwork': {'type': ['string', 'null']},
                 'use_internal_ips': {'type': 'boolean'},
                 'specific_reservations': {'type': 'array'},
                 'labels': {'type': 'object'},

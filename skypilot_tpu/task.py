@@ -123,9 +123,15 @@ class Task:
         return self
 
     def sync_storage_mounts(self) -> None:
-        """Client-side phase: upload local sources into their buckets."""
+        """Client-side phase: validate buckets, upload local sources."""
         from skypilot_tpu import global_user_state
-        for storage in self.storage_mounts.values():
+        from skypilot_tpu import exceptions
+        for dst, storage in self.storage_mounts.items():
+            try:
+                storage.validate()
+            except exceptions.StorageError as e:
+                raise exceptions.StorageError(
+                    f'file_mounts[{dst!r}]: {e}') from None
             storage.sync_local_source()
             global_user_state.add_or_update_storage(
                 storage.store.bucket, storage.url, storage.mode.value)

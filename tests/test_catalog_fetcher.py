@@ -1,5 +1,7 @@
 """Billing-catalog fetcher: SKU parsing, pagination, live-price override,
 offline fallback — against a fake Billing API transport."""
+import os
+
 import pytest
 
 from skypilot_tpu.catalog.fetchers import fetch_gcp
@@ -105,3 +107,24 @@ def test_refresh_online(tmp_path, monkeypatch):
         rows = {(r['slice'], r['zone']): r for r in csv.DictReader(f)}
     assert float(rows[('tpu-v5e-8', 'us-west4-a')]['price']) == \
         pytest.approx(1.56 * 8)
+
+
+def test_committed_catalog_matches_regeneration(tmp_path, monkeypatch):
+    """Drift guard: the committed CSVs must be exactly what the fetcher's
+    offline (static-table) path regenerates. Catches silent staleness when
+    prices/zones change in fetch_gcp but the committed catalog is not
+    refreshed (reference keeps catalogs hosted + TTL'd instead,
+    sky/clouds/service_catalog/common.py:130-238 — here the catalog is
+    vendored, so drift must be caught in CI)."""
+    import skypilot_tpu.catalog as catalog_pkg
+    committed_dir = os.path.join(
+        os.path.dirname(os.path.abspath(catalog_pkg.__file__)), 'data')
+    monkeypatch.setattr(fetch_gcp, 'DATA_DIR', str(tmp_path))
+    fetch_gcp.refresh(online=False)
+    for fname in ('gcp_tpus.csv', 'gcp_vms.csv'):
+        with open(os.path.join(committed_dir, fname)) as f:
+            committed = f.read()
+        regenerated = (tmp_path / fname).read_text()
+        assert committed == regenerated, (
+            f'{fname} drifted from the fetcher: run '
+            'python -m skypilot_tpu.catalog.fetchers.fetch_gcp and commit')

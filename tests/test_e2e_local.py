@@ -205,6 +205,94 @@ class TestLifecycle:
         core.down('t-mismatch')
 
 
+class TestLaunchRace:
+
+    def test_two_processes_racing_same_cluster_name(self, tmp_path):
+        """Two OS processes `launch` one cluster name concurrently: the
+        per-cluster file lock must let exactly one provision and attach
+        the other to the same cluster (reference atomic existence-check +
+        provision, sky/execution.py:510-523)."""
+        import subprocess
+        import sys as sys_lib
+        script = (
+            'import json, sys\n'
+            'import skypilot_tpu as sky\n'
+            'from skypilot_tpu import execution\n'
+            "task = sky.Task(run='sleep 1')\n"
+            "task.set_resources([sky.Resources(cloud='local')])\n"
+            "job_id, handle = execution.launch(task, cluster_name='t-race',"
+            ' detach_run=True)\n'
+            'print(json.dumps({"job_id": job_id}))\n')
+        env = dict(os.environ)
+        procs = [subprocess.Popen([sys_lib.executable, '-c', script],
+                                  stdout=subprocess.PIPE,
+                                  stderr=subprocess.PIPE, text=True,
+                                  env=env)
+                 for _ in range(2)]
+        outs = [p.communicate(timeout=120) for p in procs]
+        for p, (out, err) in zip(procs, outs):
+            assert p.returncode == 0, err[-2000:]
+        job_ids = sorted(json.loads(out.strip().splitlines()[-1])['job_id']
+                         for out, _ in outs)
+        # Both jobs landed on ONE cluster's queue: distinct sequential ids.
+        assert job_ids == [1, 2], job_ids
+        records = [r for r in global_user_state.get_clusters()
+                   if r['name'] == 't-race']
+        assert len(records) == 1
+        # Exactly one provision happened: one metadata file, one agent.
+        from skypilot_tpu.provision import local_impl
+        info = local_impl.get_cluster_info('t-race', 'local')
+        assert len(info.hosts) == 1
+        for jid in job_ids:
+            assert _wait_job('t-race', jid, timeout=60) == 'SUCCEEDED'
+        core.down('t-race')
+
+
+class TestCachedShipping:
+
+    def test_fast_relaunch_does_zero_rsync(self, tmp_path, monkeypatch):
+        """Content-hash-cached workdir shipping: a second `launch --fast`
+        with an unchanged workdir touches no host (reference per-node
+        setup cache, sky/provision/instance_setup.py:137)."""
+        from skypilot_tpu.utils import command_runner
+        workdir = tmp_path / 'wd'
+        workdir.mkdir()
+        (workdir / 'train.py').write_text('print("hi")\n')
+
+        rsync_calls = []
+        orig_rsync = command_runner.LocalProcessRunner.rsync
+
+        def counting_rsync(self, source, target, up=True):
+            rsync_calls.append((source, target))
+            return orig_rsync(self, source, target, up=up)
+
+        monkeypatch.setattr(command_runner.LocalProcessRunner, 'rsync',
+                            counting_rsync)
+        task = _local_task('cat train.py', num_nodes=8)
+        task.workdir = str(workdir)
+        job_id, _ = execution.launch(task, cluster_name='t-ship',
+                                     detach_run=True)
+        assert _wait_job('t-ship', job_id) == 'SUCCEEDED'
+        first_count = len(rsync_calls)
+        assert first_count == 8  # one shipment per host, in parallel
+
+        rsync_calls.clear()
+        job2, _ = execution.launch(task, cluster_name='t-ship',
+                                   detach_run=True, fast=True)
+        assert _wait_job('t-ship', job2) == 'SUCCEEDED'
+        assert rsync_calls == []  # every host hash-matched: zero rsync
+
+        # Changing the workdir re-ships it.
+        (workdir / 'train.py').write_text('print("v2")\n')
+        job3, _ = execution.launch(task, cluster_name='t-ship',
+                                   detach_run=True, fast=True)
+        assert _wait_job('t-ship', job3) == 'SUCCEEDED'
+        assert len(rsync_calls) == 8
+        text = _logs_text('t-ship', job3)
+        assert 'v2' in text
+        core.down('t-ship')
+
+
 class TestFailover:
 
     def test_capacity_failover_across_zones(self, monkeypatch):

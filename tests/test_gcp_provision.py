@@ -24,6 +24,7 @@ class FakeGcpCloud:
         self.tpu_nodes = {}       # (zone, id) -> node dict
         self.queued = {}          # (zone, id) -> qr dict
         self.gce = {}             # (zone, name) -> instance dict
+        self.firewalls = {}       # name -> rule dict
         self.fail_zones = set()   # zones with no TPU capacity
         self.create_calls = []
 
@@ -46,7 +47,34 @@ class FakeGcpCloud:
         if m:
             return self._gce(method, m.group(1), m.group(2), m.group(3),
                              json_body, params)
+        m = re.search(r'/global/firewalls(?:/([^/]+))?$', path)
+        if m:
+            return self._firewalls(method, m.group(1), json_body)
         raise AssertionError(f'fake: unhandled {method} {url}')
+
+    # -- firewalls -----------------------------------------------------------
+    def _firewalls(self, method, name, body):
+        if method == 'POST':
+            self.firewalls[body['name']] = dict(body)
+            return {'status': 'DONE'}
+        if name is None:
+            raise AssertionError('fake firewalls: list not supported')
+        if method == 'GET':
+            rule = self.firewalls.get(name)
+            if rule is None:
+                raise gcp_api.classify_error(404, 'not found')
+            return rule
+        if method == 'PATCH':
+            if name not in self.firewalls:
+                raise gcp_api.classify_error(404, 'not found')
+            self.firewalls[name].update(body)
+            return {'status': 'DONE'}
+        if method == 'DELETE':
+            if name not in self.firewalls:
+                raise gcp_api.classify_error(404, 'not found')
+            del self.firewalls[name]
+            return {'status': 'DONE'}
+        raise AssertionError(f'fake firewalls: {method}')
 
     # -- TPU nodes -----------------------------------------------------------
     def _make_node(self, zone, node_id, body):
@@ -89,11 +117,11 @@ class FakeGcpCloud:
                 raise gcp_api.classify_error(404, 'not found')
             del self.tpu_nodes[key]
             return {'done': True}
-        if verb == 'stop':
-            self.tpu_nodes[key]['state'] = 'STOPPED'
-            return {'done': True}
-        if verb == 'start':
-            self.tpu_nodes[key]['state'] = 'READY'
+        if verb in ('stop', 'start'):
+            if key not in self.tpu_nodes:
+                raise gcp_api.classify_error(404, 'not found')
+            self.tpu_nodes[key]['state'] = ('STOPPED' if verb == 'stop'
+                                            else 'READY')
             return {'done': True}
         raise AssertionError(f'fake nodes: {method} {verb}')
 
@@ -104,8 +132,8 @@ class FakeGcpCloud:
             if zone in self.fail_zones:
                 qr = {'state': {'state': 'FAILED'}}
             else:
-                spec = body['tpu']['nodeSpec'][0]
-                self._make_node(zone, spec['nodeId'], spec['node'])
+                for spec in body['tpu']['nodeSpec']:
+                    self._make_node(zone, spec['nodeId'], spec['node'])
                 qr = {'state': {'state': 'ACTIVE'}}
             self.queued[(zone, qr_id)] = qr
             return qr
@@ -243,6 +271,88 @@ class TestTpuLifecycle:
         assert info.hosts[0].external_ip.startswith('35.')
         gcp_provision.terminate_instances('ctrl', 'us-central1')
         assert gcp_provision.query_instances('ctrl', 'us-central1') == {}
+
+
+class TestOpenPorts:
+    """Firewall-rule CRUD for serving exposure (reference
+    sky/provision/gcp/instance.py open_ports + config.py firewall)."""
+
+    def test_open_ports_creates_targeted_rule(self, fake_gcp):
+        gcp_provision.run_instances('c1', 'us-west4', 'us-west4-a', 2,
+                                    _deploy_vars())
+        gcp_provision.open_ports('c1', 'us-west4', ['8080'])
+        rule = fake_gcp.firewalls['skytpu-c-abc123-ports']
+        assert rule['targetTags'] == ['skytpu-c-abc123']
+        assert rule['allowed'] == [{'IPProtocol': 'tcp', 'ports': ['8080']}]
+        assert rule['direction'] == 'INGRESS'
+        # The node carries the matching network tag.
+        node = fake_gcp.tpu_nodes[('us-west4-a', 'c-abc123')]
+        assert node['tags'] == ['skytpu-c-abc123']
+
+    def test_open_ports_idempotent_and_merging(self, fake_gcp):
+        gcp_provision.run_instances('c1', 'us-west4', 'us-west4-a', 2,
+                                    _deploy_vars())
+        gcp_provision.open_ports('c1', 'us-west4', ['8080'])
+        gcp_provision.open_ports('c1', 'us-west4', ['8080'])  # no-op
+        gcp_provision.open_ports('c1', 'us-west4', ['9000'])  # merge
+        rule = fake_gcp.firewalls['skytpu-c-abc123-ports']
+        assert rule['allowed'][0]['ports'] == ['8080', '9000']
+
+    def test_terminate_deletes_rule(self, fake_gcp):
+        gcp_provision.run_instances('c1', 'us-west4', 'us-west4-a', 2,
+                                    _deploy_vars())
+        gcp_provision.open_ports('c1', 'us-west4', ['8080'])
+        gcp_provision.terminate_instances('c1', 'us-west4')
+        assert fake_gcp.firewalls == {}
+
+    def test_gce_instances_tagged(self, fake_gcp):
+        dv = {'cloud': 'gcp', 'project_id': 'test-proj',
+              'cluster_name_on_cloud': 'ctrl-1', 'mode': 'gce',
+              'instance_type': 'n2-standard-8', 'use_spot': False,
+              'labels': {}}
+        gcp_provision.run_instances('ctrl', 'us-central1', 'us-central1-a',
+                                    1, dv)
+        inst = fake_gcp.gce[('us-central1-a', 'ctrl-1-0')]
+        assert inst['tags'] == {'items': ['skytpu-ctrl-1']}
+        gcp_provision.open_ports('ctrl', 'us-central1', ['8000'])
+        assert 'skytpu-ctrl-1-ports' in fake_gcp.firewalls
+
+
+class TestMultiSliceProvision:
+
+    def test_two_slices_create_and_info(self, fake_gcp):
+        dv = _deploy_vars(num_slices=2)
+        gcp_provision.run_instances('ms', 'us-west4', 'us-west4-a', 4, dv)
+        assert ('us-west4-a', 'c-abc123-s0') in fake_gcp.tpu_nodes
+        assert ('us-west4-a', 'c-abc123-s1') in fake_gcp.tpu_nodes
+        info = gcp_provision.get_cluster_info('ms', 'us-west4')
+        assert info.num_hosts == 4
+        assert [h.rank for h in info.hosts] == [0, 1, 2, 3]
+        assert [h.extra['slice_id'] for h in info.hosts] == [0, 0, 1, 1]
+
+    def test_qr_multislice_atomic(self, fake_gcp):
+        dv = _deploy_vars(use_qr=True, num_slices=2)
+        gcp_provision.run_instances('ms2', 'us-west4', 'us-west4-a', 4, dv)
+        # One QR carried both nodeSpecs (atomic gang grant).
+        assert ('us-west4-a', 'c-abc123') in fake_gcp.queued
+        assert len(fake_gcp.tpu_nodes) == 2
+
+    def test_missing_slice_reports_terminated(self, fake_gcp):
+        dv = _deploy_vars(num_slices=2)
+        gcp_provision.run_instances('ms3', 'us-west4', 'us-west4-a', 4, dv)
+        del fake_gcp.tpu_nodes[('us-west4-a', 'c-abc123-s1')]
+        states = gcp_provision.query_instances('ms3', 'us-west4')
+        assert len(states) == 4
+        vals = sorted(states.values())
+        assert vals == ['running', 'running', 'terminated', 'terminated']
+
+    def test_stop_tolerates_missing_slice(self, fake_gcp):
+        dv = _deploy_vars(num_slices=2)
+        gcp_provision.run_instances('ms4', 'us-west4', 'us-west4-a', 4, dv)
+        del fake_gcp.tpu_nodes[('us-west4-a', 'c-abc123-s0')]
+        gcp_provision.stop_instances('ms4', 'us-west4')  # must not raise
+        assert fake_gcp.tpu_nodes[('us-west4-a', 'c-abc123-s1')]['state'] \
+            == 'STOPPED'
 
 
 class TestFailover:

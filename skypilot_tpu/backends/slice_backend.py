@@ -192,14 +192,22 @@ class SliceBackend(backend_lib.Backend):
         return provision_lib.get_command_runners(handle.cloud, info)
 
     def _python(self, handle: backend_lib.ResourceHandle) -> Tuple[str, str]:
-        """(python executable, env-prefix) for running our code on hosts."""
+        """(python executable, env-prefix) for running our code on hosts.
+
+        PYTHONPATH is APPENDED to, not replaced: the host environment may
+        carry its own entries (e.g. a sitecustomize dir that registers the
+        TPU backend) that job processes must keep seeing.
+        """
         if handle.cloud == 'local':
             # parent of the skypilot_tpu package dir (e.g. the repo root)
             pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(
                 __file__)))
             pkg_parent = os.path.dirname(pkg_dir)
-            return sys.executable, f'PYTHONPATH={shlex.quote(pkg_parent)}'
-        return 'python3', 'PYTHONPATH=$HOME/.skytpu/code'
+            return sys.executable, (
+                f'PYTHONPATH={shlex.quote(pkg_parent)}'
+                '${PYTHONPATH:+:$PYTHONPATH}')
+        return 'python3', 'PYTHONPATH=$HOME/.skytpu/code' \
+                          '${PYTHONPATH:+:$PYTHONPATH}'
 
     def run_module(self, handle: backend_lib.ResourceHandle, module: str,
                    args_str: str, stream_to: Optional[str] = None,

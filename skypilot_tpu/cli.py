@@ -456,17 +456,21 @@ def serve_status(service_names):
     if not rows:
         click.echo('No services.')
         return
-    fmt = '{:<20} {:<16} {:<28} {:<8}'
-    click.echo(fmt.format('NAME', 'STATUS', 'ENDPOINT', 'REPLICAS'))
+    fmt = '{:<20} {:<16} {:<28} {:<8} {:<4}'
+    click.echo(fmt.format('NAME', 'STATUS', 'ENDPOINT', 'REPLICAS', 'VER'))
     for r in rows:
         n_ready = sum(1 for rep in r['replicas']
                       if rep['status'].value == 'READY')
         n_live = sum(1 for rep in r['replicas'] if rep['status'].is_live())
         click.echo(fmt.format(r['name'], r['status'].value,
-                              r['endpoint'] or '-', f'{n_ready}/{n_live}'))
+                              r['endpoint'] or '-', f'{n_ready}/{n_live}',
+                              f'v{r.get("version", 1)}'))
         for rep in r['replicas']:
+            spot = '' if rep.get('spot', True) else ' [on-demand]'
             click.echo(f'  rep{rep["replica_id"]:<4} '
-                       f'{rep["status"].value:<22} {rep["url"] or "-"}')
+                       f'{rep["status"].value:<22} '
+                       f'v{rep.get("version", 1)} '
+                       f'{rep["url"] or "-"}{spot}')
 
 
 @serve.command('down')

@@ -25,6 +25,29 @@ def server_url() -> str:
     return os.environ.get('SKYTPU_API_SERVER_URL', DEFAULT_SERVER_URL)
 
 
+def is_remote_server() -> bool:
+    """A server NOT on this machine: workdirs must be uploaded, not
+    referenced by local path."""
+    host = urlparse(server_url()).hostname or ''
+    return host not in ('127.0.0.1', 'localhost', '::1')
+
+
+def _headers() -> Dict[str, str]:
+    headers: Dict[str, str] = {}
+    token = os.environ.get('SKYTPU_API_TOKEN')
+    if token:
+        headers['Authorization'] = f'Bearer {token}'
+    user = os.environ.get('SKYTPU_USER')
+    if not user:
+        import getpass
+        try:
+            user = getpass.getuser()
+        except (KeyError, OSError):
+            user = 'anonymous'
+    headers['X-Skytpu-User'] = user
+    return headers
+
+
 def _conn() -> http.client.HTTPConnection:
     parsed = urlparse(server_url())
     return http.client.HTTPConnection(parsed.hostname,
@@ -36,7 +59,9 @@ def _call(method: str, path: str,
     conn = _conn()
     try:
         payload = json.dumps(body).encode() if body is not None else None
-        headers = {'Content-Type': 'application/json'} if payload else {}
+        headers = dict(_headers())
+        if payload:
+            headers['Content-Type'] = 'application/json'
         conn.request(method, path, body=payload, headers=headers)
         resp = conn.getresponse()
         data = json.loads(resp.read() or b'{}')
@@ -78,8 +103,13 @@ def stream(request_id: str, out=None) -> None:
     out = out or sys.stdout
     conn = _conn()
     try:
-        conn.request('GET', f'/api/v1/stream?request_id={request_id}')
+        conn.request('GET', f'/api/v1/stream?request_id={request_id}',
+                     headers=_headers())
         resp = conn.getresponse()
+        if resp.status >= 400:
+            data = resp.read().decode(errors='replace')
+            raise exceptions.ApiServerConnectionError(
+                f'stream {request_id}: {resp.status} {data[:300]}')
         while True:
             data = resp.read(4096)
             if not data:
@@ -104,15 +134,54 @@ def api_requests() -> List[Dict[str, Any]]:
     return _call('GET', '/api/v1/requests')['requests']
 
 
+def upload_workdir(workdir: str) -> str:
+    """Zip + upload a local workdir; returns the SERVER-side path
+    (reference workdir zip upload, sky/server/server.py:313-425)."""
+    import io
+    import zipfile
+    workdir = os.path.expanduser(workdir)
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, 'w', zipfile.ZIP_DEFLATED) as zf:
+        for root, dirs, files in os.walk(workdir):
+            # Exactly '.git' (not .github/ etc., which tasks may need).
+            dirs[:] = [d for d in dirs if d not in ('__pycache__', '.git')]
+            for fname in files:
+                full = os.path.join(root, fname)
+                zf.write(full, os.path.relpath(full, workdir))
+    conn = _conn()
+    try:
+        headers = dict(_headers())
+        headers['Content-Type'] = 'application/zip'
+        conn.request('POST', '/api/v1/upload', body=buf.getvalue(),
+                     headers=headers)
+        resp = conn.getresponse()
+        data = json.loads(resp.read() or b'{}')
+        if resp.status >= 400:
+            raise exceptions.ApiServerConnectionError(
+                f'upload: {resp.status} {data.get("error")}')
+        return data['workdir']
+    finally:
+        conn.close()
+
+
+def _task_payload(task) -> Dict[str, Any]:
+    """Task config for the wire; local workdirs upload to remote servers
+    (a client path means nothing on the server's filesystem)."""
+    cfg = task.to_yaml_config()
+    if cfg.get('workdir') and is_remote_server():
+        cfg = dict(cfg, workdir=upload_workdir(cfg['workdir']))
+    return cfg
+
+
 # ---- op wrappers (async: return request ids) -------------------------------
 def launch(task, cluster_name: str, **kwargs) -> str:
-    payload = {'task': task.to_yaml_config(), 'cluster_name': cluster_name}
+    payload = {'task': _task_payload(task), 'cluster_name': cluster_name}
     payload.update(kwargs)
     return submit('launch', payload)
 
 
 def exec_(task, cluster_name: str, **kwargs) -> str:
-    payload = {'task': task.to_yaml_config(), 'cluster_name': cluster_name}
+    payload = {'task': _task_payload(task), 'cluster_name': cluster_name}
     payload.update(kwargs)
     return submit('exec', payload)
 

@@ -70,7 +70,7 @@ def _agent_healthy(handle: Any) -> bool:
               f'{rt_constants.HEARTBEAT_FILE}')
         # Age computed host-side: heartbeats carry the HOST's clock.
         res = head.run(
-            f't=$(cat {hb} 2>/dev/null) || exit 9; '
+            f't=$(cat {hb} 2>/dev/null); [ -n "$t" ] || exit 9; '
             'echo $(( $(date +%s) - ${t%.*} ))', timeout=30)
         ok = (res.returncode == 0
               and res.stdout.strip().lstrip('-').isdigit()

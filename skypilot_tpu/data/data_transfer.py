@@ -26,11 +26,10 @@ def _direct_command(src: storage_lib.AbstractStore,
     """A provider-side command for this pair, or None for the relay."""
     pair = (src.SCHEME, dst.SCHEME)
     if pair in (('s3', 'gs'), ('gs', 'gs')):
-        # Both tools speak both schemes; prefer the modern gcloud when
-        # present (gsutil is absent from newer google-cloud-cli installs).
-        if shutil.which('gcloud'):
-            return ['gcloud', 'storage', 'rsync', '-r', src.url, dst.url]
-        return ['gsutil', '-m', 'rsync', '-r', src.url, dst.url]
+        # Both tools speak both schemes (storage_lib.gcs_cli picks).
+        return storage_lib.gcs_cli(
+            ['rsync', '-r', src.url, dst.url],
+            ['-m', 'rsync', '-r', src.url, dst.url])
     if pair == ('s3', 's3'):
         return ['aws', 's3', 'sync', src.url, dst.url]
     return None
@@ -52,9 +51,14 @@ def transfer(src: storage_lib.AbstractStore,
         return
     # Generic relay: materialize locally, then upload. Universal, but the
     # data transits the client — only for pairs without a direct path.
-    with tempfile.TemporaryDirectory(prefix='skytpu-transfer-') as tmp:
-        src.download_local(tmp)
-        dst.upload_local(tmp)
+    try:
+        with tempfile.TemporaryDirectory(prefix='skytpu-transfer-') as tmp:
+            src.download_local(tmp)
+            dst.upload_local(tmp)
+    except FileNotFoundError as e:
+        raise exceptions.StorageError(
+            f'transfer {src.url} -> {dst.url} needs the cloud CLI for '
+            f'both stores on this machine: {e}') from None
 
 
 def transfer_url(src_url: str, dst_url: str) -> None:

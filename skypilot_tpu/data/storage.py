@@ -84,6 +84,15 @@ class AbstractStore:
         return type(self)(self.bucket).exists()
 
 
+def gcs_cli(gcloud_args: list, gsutil_args: list) -> list:
+    """One place to pick the GCS client CLI: prefer modern ``gcloud
+    storage`` (newer google-cloud-cli installs drop standalone gsutil),
+    fall back to ``gsutil``."""
+    if shutil.which('gcloud'):
+        return ['gcloud', 'storage'] + gcloud_args
+    return ['gsutil'] + gsutil_args
+
+
 class GcsStore(AbstractStore):
     """Google Cloud Storage via the gcloud CLI (remote hosts have it: they
     are GCP VMs/TPU-VMs) and gcsfuse for MOUNT.
@@ -114,9 +123,8 @@ class GcsStore(AbstractStore):
 
     def upload_local(self, local_path: str) -> None:
         local_path = os.path.expanduser(local_path)
-        cmd = ['gsutil', '-m', 'rsync', '-r', local_path, self.url]
-        if shutil.which('gcloud'):
-            cmd = ['gcloud', 'storage', 'rsync', '-r', local_path, self.url]
+        cmd = gcs_cli(['rsync', '-r', local_path, self.url],
+                      ['-m', 'rsync', '-r', local_path, self.url])
         proc = subprocess.run(cmd, capture_output=True, text=True)
         if proc.returncode != 0:
             raise exceptions.StorageError(
@@ -124,20 +132,15 @@ class GcsStore(AbstractStore):
 
     def download_local(self, local_path: str) -> None:
         os.makedirs(local_path, exist_ok=True)
-        cmd = ['gsutil', '-m', 'rsync', '-r', self.url, local_path]
-        if shutil.which('gcloud'):
-            cmd = ['gcloud', 'storage', 'rsync', '-r', self.url, local_path]
+        cmd = gcs_cli(['rsync', '-r', self.url, local_path],
+                      ['-m', 'rsync', '-r', self.url, local_path])
         proc = subprocess.run(cmd, capture_output=True, text=True)
         if proc.returncode != 0:
             raise exceptions.StorageError(
                 f'download from {self.url} failed: {proc.stderr[-500:]}')
 
     def exists(self) -> bool:
-        tool = 'gcloud' if shutil.which('gcloud') else 'gsutil'
-        if tool == 'gcloud':
-            cmd = ['gcloud', 'storage', 'ls', self.url]
-        else:
-            cmd = ['gsutil', 'ls', self.url]
+        cmd = gcs_cli(['ls', self.url], ['ls', self.url])
         return subprocess.run(cmd, capture_output=True).returncode == 0
 
 

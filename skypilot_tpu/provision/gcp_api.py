@@ -273,19 +273,28 @@ class GceClient:
         return get_transport().request(
             'POST', f'{self._zone_url(zone)}/instances/{name}/start')
 
+    @staticmethod
+    def _check_op_error(op: Dict[str, Any]) -> None:
+        """A DONE GCE operation may still carry an error payload
+        (synchronous failures) — it must raise, not pass silently."""
+        if op.get('error'):
+            errs = op['error'].get('errors', [])
+            msg = '; '.join(e.get('message', '') for e in errs) \
+                or str(op['error'])
+            raise classify_error(500, msg)
+
     def wait_zone_operation(self, zone: str, op: Dict[str, Any],
                             timeout: float = 600) -> None:
-        if not op or op.get('status') == 'DONE' or 'name' not in op:
+        if not op or 'name' not in op or op.get('status') == 'DONE':
+            if op:
+                self._check_op_error(op)
             return
         deadline = time.time() + timeout
         while time.time() < deadline:
             cur = get_transport().request(
                 'GET', f'{self._zone_url(zone)}/operations/{op["name"]}')
             if cur.get('status') == 'DONE':
-                if cur.get('error'):
-                    errs = cur['error'].get('errors', [])
-                    msg = '; '.join(e.get('message', '') for e in errs)
-                    raise classify_error(500, msg)
+                self._check_op_error(cur)
                 return
             time.sleep(2)
         raise exceptions.ProvisionError('GCE operation timed out')
@@ -324,17 +333,16 @@ class GceClient:
 
     def wait_global_operation(self, op: Dict[str, Any],
                               timeout: float = 600) -> None:
-        if not op or op.get('status') == 'DONE' or 'name' not in op:
+        if not op or 'name' not in op or op.get('status') == 'DONE':
+            if op:
+                self._check_op_error(op)
             return
         deadline = time.time() + timeout
         while time.time() < deadline:
             cur = get_transport().request(
                 'GET', f'{self._global_url()}/operations/{op["name"]}')
             if cur.get('status') == 'DONE':
-                if cur.get('error'):
-                    errs = cur['error'].get('errors', [])
-                    msg = '; '.join(e.get('message', '') for e in errs)
-                    raise classify_error(500, msg)
+                self._check_op_error(cur)
                 return
             time.sleep(2)
         raise exceptions.ProvisionError('GCE global operation timed out')

@@ -229,9 +229,14 @@ class Agent:
                              start_new_session=True)
 
     def _heartbeat(self) -> None:
+        # Atomic replace: a truncate-then-write would expose an EMPTY file
+        # to a concurrently-reading health probe (core._agent_healthy),
+        # which would misread the runtime as down and cache the verdict.
         path = os.path.join(self.runtime_dir, constants.HEARTBEAT_FILE)
-        with open(path, 'w') as f:
+        tmp = path + '.tmp'
+        with open(tmp, 'w') as f:
             f.write(str(time.time()))
+        os.replace(tmp, path)
 
     def run_forever(self) -> None:
         with open(os.path.join(self.runtime_dir,

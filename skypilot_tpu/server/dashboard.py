@@ -112,6 +112,7 @@ def render() -> str:
             request_rows.append([
                 _esc(req.get('request_id', '')[:12]),
                 _esc(req.get('name')),
+                _esc(req.get('user') or '-'),
                 _status_cell(str(req.get('status')).upper()),
                 _esc(time.strftime('%H:%M:%S', time.localtime(created))
                      if created else '-'),
@@ -129,5 +130,6 @@ def render() -> str:
             job_rows),
         services=_table(['name', 'status', 'ready', 'lb port'],
                         service_rows),
-        requests=_table(['id', 'op', 'status', 'created'], request_rows),
+        requests=_table(['id', 'op', 'user', 'status', 'created'],
+                        request_rows),
     )

@@ -65,8 +65,13 @@ def _db() -> sqlite3.Connection:
                 error TEXT,
                 pid INTEGER,
                 created_at REAL,
-                finished_at REAL
+                finished_at REAL,
+                user TEXT
             )""")
+        try:  # pre-multi-user databases
+            conn.execute('ALTER TABLE requests ADD COLUMN user TEXT')
+        except sqlite3.OperationalError:
+            pass
         conn.commit()
         conns[path] = conn
     return conn
@@ -77,14 +82,15 @@ def log_path(request_id: str) -> str:
 
 
 def create(name: str, payload: Dict[str, Any],
-           schedule_type: ScheduleType) -> str:
+           schedule_type: ScheduleType,
+           user: Optional[str] = None) -> str:
     request_id = uuid.uuid4().hex[:16]
     conn = _db()
     conn.execute(
         'INSERT INTO requests (request_id, name, schedule_type, status, '
-        'payload, created_at) VALUES (?,?,?,?,?,?)',
+        'payload, created_at, user) VALUES (?,?,?,?,?,?,?)',
         (request_id, name, schedule_type.value, RequestStatus.PENDING.value,
-         json.dumps(payload), time.time()))
+         json.dumps(payload), time.time(), user))
     conn.commit()
     return request_id
 
@@ -108,11 +114,12 @@ def get(request_id: str) -> Optional[Dict[str, Any]]:
 
 def list_requests(limit: int = 100) -> List[Dict[str, Any]]:
     rows = _db().execute(
-        'SELECT request_id, name, status, created_at, finished_at '
+        'SELECT request_id, name, status, created_at, finished_at, user '
         'FROM requests ORDER BY created_at DESC LIMIT ?',
         (limit,)).fetchall()
     return [{'request_id': r[0], 'name': r[1], 'status': r[2],
-             'created_at': r[3], 'finished_at': r[4]} for r in rows]
+             'created_at': r[3], 'finished_at': r[4], 'user': r[5]}
+            for r in rows]
 
 
 def set_running(request_id: str, pid: int) -> None:

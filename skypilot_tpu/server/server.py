@@ -34,10 +34,26 @@ API_PREFIX = '/api/v1'
 class _Handler(BaseHTTPRequestHandler):
     server_version = 'skytpu-api'
     executor: executor_lib.Executor = None  # type: ignore  # set by serve()
+    auth_token: Optional[str] = None        # set by serve(); None = open
 
     # quiet default request logging
     def log_message(self, fmt, *args):  # noqa: A003
         pass
+
+    def _authorized(self) -> bool:
+        """Bearer-token auth for shared/remote servers (reference
+        multi-user server auth, sky/server/server.py). /healthz stays
+        open so load balancers / `skytpu api status` can probe."""
+        if self.auth_token is None:
+            return True
+        import hmac
+        header = self.headers.get('Authorization', '')
+        # Constant-time compare: string == short-circuits on the first
+        # mismatching byte, leaking token-prefix timing on open hosts.
+        return hmac.compare_digest(header, f'Bearer {self.auth_token}')
+
+    def _request_user(self) -> str:
+        return self.headers.get('X-Skytpu-User') or 'anonymous'
 
     # -- helpers -------------------------------------------------------------
     def _json(self, code: int, payload: Any) -> None:
@@ -64,6 +80,9 @@ class _Handler(BaseHTTPRequestHandler):
         path, q = self._query()
         if path == '/healthz':
             self._json(200, {'status': 'healthy', 'version': 1})
+        elif not self._authorized():
+            self._json(401, {'error': 'missing/invalid Authorization '
+                                      '(Bearer token required)'})
         elif path in ('/', '/dashboard'):
             from skypilot_tpu.server import dashboard
             try:
@@ -91,11 +110,18 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(404, {'error': f'unknown path {path}'})
 
     def do_POST(self) -> None:  # noqa: N802
-        path, _ = self._query()
+        path, q = self._query()
+        if not self._authorized():
+            self._json(401, {'error': 'missing/invalid Authorization '
+                                      '(Bearer token required)'})
+            return
         if path == f'{API_PREFIX}/requests/cancel':
             body = self._read_body()
             ok = self.executor.cancel(body.get('request_id', ''))
             self._json(200, {'cancelled': ok})
+            return
+        if path == f'{API_PREFIX}/upload':
+            self._upload(q)
             return
         if not path.startswith(API_PREFIX + '/'):
             self._json(404, {'error': f'unknown path {path}'})
@@ -106,10 +132,44 @@ class _Handler(BaseHTTPRequestHandler):
             return
         payload = self._read_body()
         stype = executor_lib.schedule_type_for(op)
-        request_id = store.create(op, payload, stype)
+        request_id = store.create(op, payload, stype,
+                                  user=self._request_user())
         open(store.log_path(request_id), 'a').close()
         self.executor.submit(request_id, stype)
         self._json(200, {'request_id': request_id})
+
+    def _upload(self, q: Dict[str, str]) -> None:
+        """Workdir zip upload for remote clients (reference
+        sky/server/server.py:313-425 zip upload): the body is a zip of
+        the client's workdir; it lands under <state>/uploads/<sha>/ and
+        the returned server-side path replaces the task's workdir."""
+        import hashlib
+        import io
+        import zipfile
+
+        from skypilot_tpu import global_user_state
+        length = int(self.headers.get('Content-Length', 0))
+        if not length or length > 2 * 1024**3:
+            self._json(400, {'error': 'upload body required (<=2GB)'})
+            return
+        blob = self.rfile.read(length)
+        digest = hashlib.sha256(blob).hexdigest()[:16]
+        dest = os.path.join(global_user_state.get_state_dir(), 'uploads',
+                            digest)
+        try:
+            with zipfile.ZipFile(io.BytesIO(blob)) as zf:
+                for member in zf.namelist():
+                    # zip-slip guard: no absolute paths, no traversal.
+                    if member.startswith('/') or '..' in member.split('/'):
+                        self._json(400, {'error':
+                                         f'unsafe zip member {member!r}'})
+                        return
+                os.makedirs(dest, exist_ok=True)
+                zf.extractall(dest)
+        except zipfile.BadZipFile:
+            self._json(400, {'error': 'body is not a zip archive'})
+            return
+        self._json(200, {'workdir': dest})
 
     # -- get/stream ----------------------------------------------------------
     def _get_request(self, q: Dict[str, str]) -> None:
@@ -183,8 +243,11 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 def serve(host: str = '127.0.0.1', port: int = DEFAULT_PORT,
-          background: bool = False) -> ThreadingHTTPServer:
+          background: bool = False,
+          auth_token: Optional[str] = None) -> ThreadingHTTPServer:
     _Handler.executor = executor_lib.Executor()
+    _Handler.auth_token = (auth_token
+                           or os.environ.get('SKYTPU_API_TOKEN') or None)
     httpd = ThreadingHTTPServer((host, port), _Handler)
     if background:
         t = threading.Thread(target=httpd.serve_forever, daemon=True)
@@ -196,10 +259,14 @@ def serve(host: str = '127.0.0.1', port: int = DEFAULT_PORT,
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument('--host', default='127.0.0.1')
+    parser.add_argument('--host', default='127.0.0.1',
+                        help='bind address; 0.0.0.0 for a shared server')
     parser.add_argument('--port', type=int, default=DEFAULT_PORT)
+    parser.add_argument('--auth-token', default=None,
+                        help='require Bearer-token auth (or set '
+                             'SKYTPU_API_TOKEN)')
     args = parser.parse_args()
-    serve(args.host, args.port)
+    serve(args.host, args.port, auth_token=args.auth_token)
 
 
 if __name__ == '__main__':

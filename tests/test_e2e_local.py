@@ -26,10 +26,15 @@ def _local_task(run='echo hello-skytpu', num_nodes=1, **task_kwargs):
     return task
 
 
-def _wait_job(cluster, job_id, timeout=30):
+def _wait_job(cluster, job_id, timeout=90):
     deadline = time.time() + timeout
+    status = None
     while time.time() < deadline:
-        status = core.job_status(cluster, job_id)
+        try:
+            status = core.job_status(cluster, job_id)
+        except exceptions.ClusterNotUpError:
+            # Transient under load (health-probe TTL window): keep polling.
+            status = None
         if status and job_lib.JobStatus(status).is_terminal():
             return status
         time.sleep(0.2)

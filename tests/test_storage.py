@@ -118,6 +118,18 @@ class TestStorageE2E:
                              detach_run=True)
         core.down('t-storage-bad')
 
+    def test_host_side_copy_failure_surfaces(self, tmp_path, monkeypatch):
+        # A bucket that vanishes AFTER validation (or can't be checked
+        # client-side) still fails cleanly at the host-side COPY.
+        from skypilot_tpu.data.storage import Storage
+        monkeypatch.setattr(Storage, 'validate', lambda self: None)
+        task = _local_task('true', file_mounts={
+            './data': f'file://{tmp_path}/vanished'})
+        with pytest.raises(exceptions.StorageError, match='COPY'):
+            execution.launch(task, cluster_name='t-storage-host',
+                             detach_run=True)
+        core.down('t-storage-host')
+
 
 class TestCheckpointResume:
 

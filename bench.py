@@ -150,6 +150,52 @@ def run_launched(preset: str, batch: int, seq: int, steps: int,
     return out
 
 
+def run_decode(config, params) -> dict:
+    """Serving-side numbers from the in-tree continuous-batching engine
+    (BASELINE.md serving anchors are Llama-2-7B on EIGHT v6e chips — not
+    reproducible on one v5e — so these ride as context, not vs_baseline):
+    steady-state decode tok/s with full slots, and prefill TTFT.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.models.decode import DecodeEngine, prefill_bucket
+
+    slots, max_len, prompt_len = 16, 1024, 128
+    engine = DecodeEngine(config, batch_slots=slots, max_len=max_len)
+    state = engine.init_state()
+    prompt = jax.random.randint(jax.random.key(7), (prompt_len,), 0,
+                                config.vocab_size)
+    bucket = prefill_bucket(prompt_len, engine.max_len)
+    padded = jnp.pad(prompt, (0, bucket - prompt_len))
+    k, v, logits = engine.prefill(params, padded, prompt_len)
+    first = int(jnp.argmax(logits))  # compile + sync
+    ttfts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        k, v, logits = engine.prefill(params, padded, prompt_len)
+        first = int(jnp.argmax(logits))
+        ttfts.append(time.perf_counter() - t0)
+    for s in range(slots):
+        state = engine.insert(state, k, v, prompt_len, first, s)
+    for i in range(4):  # warmup (compile)
+        state, sampled = engine.step(params, state, jax.random.key(i))
+    int(sampled[0])
+    n = 64
+    t0 = time.perf_counter()
+    for i in range(n):
+        state, sampled = engine.step(params, state,
+                                     jax.random.key(100 + i))
+    int(sampled[0])  # sync
+    dt = time.perf_counter() - t0
+    return {
+        'decode_tokens_per_sec_per_chip': round(slots * n / dt, 1),
+        'decode_batch_slots': slots,
+        'decode_ttft_ms': round(sorted(ttfts)[1] * 1e3, 1),
+        'decode_prompt_len': prompt_len,
+    }
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -243,6 +289,16 @@ def main():
         record['launched_vs_inprocess'] = round(
             launched['launched_tokens_per_sec_per_chip']
             / tok_per_s_per_chip, 3)
+    # Phase 3: serving-side decode throughput (free the optimizer state
+    # first — train state + KV cache together would not fit HBM).
+    try:
+        params = state.params
+        del state, step, batches
+        decode = run_decode(config, params)
+    except Exception as e:  # noqa: BLE001 — context, not the metric
+        decode = {'decode_error': f'{type(e).__name__}: {e}'}
+    print(f'bench decode: {decode}', file=sys.stderr)
+    record.update(decode)
     print(json.dumps(record))
 
 

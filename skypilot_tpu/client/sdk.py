@@ -228,7 +228,7 @@ def tail_logs(cluster_name: str, job_id: Optional[int] = None,
 
 
 def serve_up(task, service_name: str) -> str:
-    return submit('serve_up', {'task': task.to_yaml_config(),
+    return submit('serve_up', {'task': _task_payload(task),
                                'service_name': service_name})
 
 
@@ -241,7 +241,7 @@ def serve_down(service_name: str) -> str:
 
 
 def serve_update(task, service_name: str) -> str:
-    return submit('serve_update', {'task': task.to_yaml_config(),
+    return submit('serve_update', {'task': _task_payload(task),
                                    'service_name': service_name})
 
 

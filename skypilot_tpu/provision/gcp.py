@@ -442,23 +442,33 @@ def open_ports(cluster_name: str, region: str, ports: List[str]) -> None:
     gce = gcp_api.GceClient(project)
     rule_name = _firewall_rule_name(name)
     want = sorted({str(p) for p in ports})
+    # Public by default (matches the reference's exposure for task/serve
+    # ports); narrow with `gcp.firewall_source_ranges` in
+    # ~/.skytpu/config.yaml for private deployments. Applied on create AND
+    # patch so tightening the config takes effect on existing rules too.
+    from skypilot_tpu import config as config_lib
+    source_ranges = sorted(config_lib.get_nested(
+        ('gcp', 'firewall_source_ranges'), ['0.0.0.0/0']))
     existing = gce.get_firewall(rule_name)
     if existing is not None:
         have = set()
         for allowed in existing.get('allowed', []):
             have.update(allowed.get('ports', []))
         merged = sorted(have | set(want))
-        if merged == sorted(have):
-            return  # already open
+        if (merged == sorted(have)
+                and sorted(existing.get('sourceRanges', [])) ==
+                source_ranges):
+            return  # already open with the right exposure
         gce.wait_global_operation(gce.patch_firewall(rule_name, {
             'allowed': [{'IPProtocol': 'tcp', 'ports': merged}],
+            'sourceRanges': source_ranges,
         }))
         return
     gce.wait_global_operation(gce.insert_firewall({
         'name': rule_name,
         'network': f'global/networks/{network}',
         'direction': 'INGRESS',
-        'sourceRanges': ['0.0.0.0/0'],
+        'sourceRanges': source_ranges,
         'targetTags': [_firewall_tag(name)],
         'allowed': [{'IPProtocol': 'tcp', 'ports': want}],
     }))

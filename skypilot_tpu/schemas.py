@@ -163,6 +163,8 @@ CONFIG_SCHEMA: Dict[str, Any] = {
                 'use_internal_ips': {'type': 'boolean'},
                 'specific_reservations': {'type': 'array'},
                 'labels': {'type': 'object'},
+                'firewall_source_ranges': {
+                    'type': 'array', 'items': {'type': 'string'}},
             },
             'additionalProperties': True,
         },

@@ -113,8 +113,9 @@ class LoadBalancer:
                 headers = {k: v for k, v in self.headers.items()
                            if k.lower() not in _HOP_HEADERS}
                 last_err = None
+                refused: set = set()
                 for _ in range(3):
-                    url = lb.policy.select()
+                    url = lb.policy.select(exclude=refused)
                     if url is None:
                         break
                     upstream = url.rstrip('/') + self.path
@@ -148,7 +149,10 @@ class LoadBalancer:
                             # so retrying another one is safe even for
                             # non-idempotent requests. Happens while the
                             # replica list is stale for up to one sync
-                            # interval after a scale-down/preemption.
+                            # interval after a scale-down/preemption. Skip
+                            # this URL on re-select so a single dead READY
+                            # replica can't absorb all attempts.
+                            refused.add(url)
                             continue
                         # Anything else (read timeout, reset mid-response)
                         # may have reached the replica — do not resend.

@@ -9,7 +9,7 @@ generation can occupy a replica for seconds.
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Set
 
 _POLICIES = {}
 
@@ -45,7 +45,9 @@ class LoadBalancingPolicy:
         with self._lock:
             return list(self._urls)
 
-    def select(self) -> Optional[str]:
+    def select(self, exclude: Optional[Set[str]] = None) -> Optional[str]:
+        """Pick a replica URL, skipping ``exclude`` (URLs that already
+        refused a connection within the current request's retry loop)."""
         raise NotImplementedError
 
     # In-flight accounting hooks (no-ops unless the policy cares).
@@ -63,11 +65,13 @@ class RoundRobinPolicy(LoadBalancingPolicy):
         super().__init__()
         self._index = 0
 
-    def select(self) -> Optional[str]:
+    def select(self, exclude: Optional[Set[str]] = None) -> Optional[str]:
         with self._lock:
-            if not self._urls:
+            candidates = [u for u in self._urls
+                          if not exclude or u not in exclude]
+            if not candidates:
                 return None
-            url = self._urls[self._index % len(self._urls)]
+            url = candidates[self._index % len(candidates)]
             self._index += 1
             return url
 
@@ -84,11 +88,13 @@ class LeastLoadPolicy(LoadBalancingPolicy):
             self._urls = list(urls)
             self._inflight = {u: self._inflight.get(u, 0) for u in urls}
 
-    def select(self) -> Optional[str]:
+    def select(self, exclude: Optional[Set[str]] = None) -> Optional[str]:
         with self._lock:
-            if not self._urls:
+            candidates = [u for u in self._urls
+                          if not exclude or u not in exclude]
+            if not candidates:
                 return None
-            return min(self._urls, key=lambda u: self._inflight.get(u, 0))
+            return min(candidates, key=lambda u: self._inflight.get(u, 0))
 
     def on_request_start(self, url: str) -> None:
         with self._lock:

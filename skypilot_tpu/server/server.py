@@ -144,31 +144,65 @@ class _Handler(BaseHTTPRequestHandler):
         the client's workdir; it lands under <state>/uploads/<sha>/ and
         the returned server-side path replaces the task's workdir."""
         import hashlib
-        import io
+        import tempfile
         import zipfile
 
         from skypilot_tpu import global_user_state
         length = int(self.headers.get('Content-Length', 0))
-        if not length or length > 2 * 1024**3:
-            self._json(400, {'error': 'upload body required (<=2GB)'})
+        max_len = int(os.environ.get('SKYTPU_UPLOAD_MAX_BYTES',
+                                     512 * 1024**2))
+        if not length or length > max_len:
+            self._json(400, {'error': f'upload body required '
+                                      f'(<= {max_len} bytes)'})
             return
-        blob = self.rfile.read(length)
-        digest = hashlib.sha256(blob).hexdigest()[:16]
-        dest = os.path.join(global_user_state.get_state_dir(), 'uploads',
-                            digest)
+        # Stream the body to disk in chunks: N concurrent large uploads on
+        # a ThreadingHTTPServer must not hold N bodies in memory.
+        digest = hashlib.sha256()
+        tmp = tempfile.NamedTemporaryFile(
+            dir=global_user_state.get_state_dir(), suffix='.zip',
+            delete=False)
         try:
-            with zipfile.ZipFile(io.BytesIO(blob)) as zf:
-                for member in zf.namelist():
-                    # zip-slip guard: no absolute paths, no traversal.
-                    if member.startswith('/') or '..' in member.split('/'):
-                        self._json(400, {'error':
-                                         f'unsafe zip member {member!r}'})
-                        return
-                os.makedirs(dest, exist_ok=True)
-                zf.extractall(dest)
-        except zipfile.BadZipFile:
-            self._json(400, {'error': 'body is not a zip archive'})
-            return
+            try:
+                remaining = length
+                while remaining:
+                    chunk = self.rfile.read(min(1 << 20, remaining))
+                    if not chunk:
+                        break
+                    digest.update(chunk)
+                    tmp.write(chunk)
+                    remaining -= len(chunk)
+            finally:
+                tmp.close()  # flush before zipfile re-opens by name
+            dest = os.path.join(global_user_state.get_state_dir(),
+                                'uploads', digest.hexdigest()[:16])
+            try:
+                with zipfile.ZipFile(tmp.name) as zf:
+                    total_uncompressed = 0
+                    for info in zf.infolist():
+                        member = info.filename
+                        # zip-slip guard: no absolute paths, no traversal.
+                        if (member.startswith('/')
+                                or '..' in member.split('/')):
+                            self._json(400, {'error':
+                                             f'unsafe zip member '
+                                             f'{member!r}'})
+                            return
+                        total_uncompressed += info.file_size
+                        if total_uncompressed > 4 * max_len:
+                            self._json(400, {'error':
+                                             'zip expands past limit '
+                                             '(possible zip bomb)'})
+                            return
+                    os.makedirs(dest, exist_ok=True)
+                    zf.extractall(dest)
+            except zipfile.BadZipFile:
+                self._json(400, {'error': 'body is not a zip archive'})
+                return
+        finally:
+            try:
+                os.unlink(tmp.name)
+            except OSError:
+                pass
         self._json(200, {'workdir': dest})
 
     # -- get/stream ----------------------------------------------------------

@@ -178,14 +178,14 @@ def run_decode(config, params) -> dict:
         ttfts.append(time.perf_counter() - t0)
     for s in range(slots):
         state = engine.insert(state, k, v, prompt_len, first, s)
+    rng = jax.random.key(11)
     for i in range(4):  # warmup (compile)
-        state, sampled = engine.step(params, state, jax.random.key(i))
+        state, sampled, rng = engine.step(params, state, rng)
     int(sampled[0])
     n = 64
     t0 = time.perf_counter()
     for i in range(n):
-        state, sampled = engine.step(params, state,
-                                     jax.random.key(100 + i))
+        state, sampled, rng = engine.step(params, state, rng)
     int(sampled[0])  # sync
     dt = time.perf_counter() - t0
     return {
@@ -194,6 +194,25 @@ def run_decode(config, params) -> dict:
         'decode_ttft_ms': round(sorted(ttfts)[1] * 1e3, 1),
         'decode_prompt_len': prompt_len,
     }
+
+
+def run_serve(on_tpu: bool) -> dict:
+    """Serve-path phase (BASELINE north-star: SkyServe req/s + TTFT +
+    TPOT): full serve stack on the local cloud — controller + LB +
+    generation replica subprocess (which owns the chip) — driven with the
+    anchor workload shape (~2500 input / ~150 output tokens). Runs before
+    the in-process phase for the same chip-ownership reason as
+    run_launched."""
+    from skypilot_tpu.benchmark import serve_bench
+    if on_tpu:
+        return serve_bench.run(
+            preset='llama-1b', batch_slots=32, max_len=4096,
+            prompt_len=2500, output_len=150, concurrencies=(24, 48),
+            window_s=75.0, warmup_requests=2)
+    return serve_bench.run(
+        preset='test-tiny', batch_slots=2, max_len=128, prompt_len=24,
+        output_len=8, concurrencies=(2,), window_s=6.0,
+        warmup_requests=1, ready_timeout_s=240)
 
 
 def main():
@@ -213,6 +232,13 @@ def main():
     except Exception as e:  # noqa: BLE001 — the in-process number must
         launched = {'launched_error': f'{type(e).__name__}: {e}'}  # survive
     print(f'bench launched-path: {launched}', file=sys.stderr)
+
+    # Phase 1.5: serve path (LB -> replica), also subprocess-based.
+    try:
+        serve = run_serve(on_tpu=backend in ('tpu', 'axon'))
+    except Exception as e:  # noqa: BLE001
+        serve = {'serve_error': f'{type(e).__name__}: {e}'}
+    print(f'bench serve-path: {serve}', file=sys.stderr)
 
     n_chips = jax.device_count()
     mesh = None
@@ -289,6 +315,13 @@ def main():
         record['launched_vs_inprocess'] = round(
             launched['launched_tokens_per_sec_per_chip']
             / tok_per_s_per_chip, 3)
+    record.update(serve)
+    if serve.get('serve_req_per_s'):
+        from skypilot_tpu.benchmark import serve_bench as serve_bench_lib
+        record.update(serve_bench_lib.equivalence_estimate(
+            serve['serve_req_per_s'],
+            model_params=serve['serve_model_params'],
+            chip_kind=device.device_kind))
     # Phase 3: serving-side decode throughput (free the optimizer state
     # first — train state + KV cache together would not fit HBM).
     try:

@@ -175,9 +175,15 @@ class LoadBalancer:
                                     resp.headers['Content-Length'])
                             self.end_headers()
                             # Stream through: tokens reach the client as
-                            # the replica emits them.
+                            # the replica emits them. read1 returns as
+                            # soon as ANY data is available — plain
+                            # read(n) on a chunked response blocks until
+                            # n bytes/EOF, which would buffer the whole
+                            # generation and destroy TTFT/TPOT.
+                            read1 = getattr(resp, 'read1', None)
                             while True:
-                                chunk = resp.read(16384)
+                                chunk = (read1(16384) if read1 is not None
+                                         else resp.read(16384))
                                 if not chunk:
                                     break
                                 if chunked:

@@ -50,7 +50,7 @@ def engine_greedy(engine, params, prompt, n_steps, slot=0, state=None):
     state = engine.insert(state, k, v, len(prompt), first, slot)
     rng = jax.random.key(0)
     for _ in range(n_steps - 1):
-        state, sampled = engine.step(params, state, rng)
+        state, sampled, rng = engine.step(params, state, rng)
         out.append(int(sampled[slot]))
     return out, state
 
@@ -91,7 +91,7 @@ def test_continuous_batching_interleaved(model_and_params):
     rng = jax.random.key(0)
     # Two solo steps for slot 0.
     for _ in range(2):
-        state, sampled = engine.step(params, state, rng)
+        state, sampled, rng = engine.step(params, state, rng)
         out0.append(int(sampled[0]))
     # Admit slot 1 mid-flight.
     b1 = prefill_bucket(len(p1), 64)
@@ -100,7 +100,7 @@ def test_continuous_batching_interleaved(model_and_params):
     out1 = [int(jnp.argmax(logits))]
     state = engine.insert(state, k, v, len(p1), out1[0], 1)
     for _ in range(3):
-        state, sampled = engine.step(params, state, rng)
+        state, sampled, rng = engine.step(params, state, rng)
         out0.append(int(sampled[0]))
         out1.append(int(sampled[1]))
 
@@ -184,12 +184,13 @@ def test_per_slot_sampling_no_recompile(model_and_params):
     engine = DecodeEngine(CFG, batch_slots=2, max_len=64)
     state = engine.init_state()
     rng = jax.random.key(0)
-    state, _ = engine.step(params, state, rng, temperature=0.0, top_k=0)
+    state, _, rng = engine.step(params, state, rng, temperature=0.0,
+                            top_k=0)
     compiles_before = engine._step._cache_size()
     for temp, tk in [(0.7, 5), (1.3, 40), ([0.1, 0.9], [3, 7]),
                      (2.0, 10**9)]:  # huge top_k is clamped, not a crash
-        state, sampled = engine.step(params, state, rng, temperature=temp,
-                                     top_k=tk)
+        state, sampled, rng = engine.step(params, state, rng,
+                                          temperature=temp, top_k=tk)
         assert sampled.shape == (2,)
     assert engine._step._cache_size() == compiles_before
 
@@ -231,3 +232,47 @@ def test_server_survives_bad_requests(model_and_params):
         assert result['tokens'] == naive_greedy(model, params, prompt, 3)
     finally:
         server.shutdown()
+
+
+def test_fused_admit_matches_naive_greedy(model_and_params):
+    """The serving hot path — fused admit (prefill+sample+insert in one
+    dispatch) followed by steps — must equal the naive-greedy oracle."""
+    model, params = model_and_params
+    engine = DecodeEngine(CFG, batch_slots=2, max_len=64)
+    prompt = [1, 9, 77, 123]
+    bucket = prefill_bucket(len(prompt), engine.max_len)
+    padded = jnp.asarray(prompt + [0] * (bucket - len(prompt)), jnp.int32)
+    state = engine.init_state()
+    state, first, rng = engine.admit(params, state, padded, len(prompt),
+                                     1, jax.random.key(0))
+    out = [int(first)]
+    for _ in range(7):
+        state, sampled, rng = engine.step(params, state, rng)
+        out.append(int(sampled[1]))
+    assert out == naive_greedy(model, params, prompt, 8)
+
+
+def test_fused_admit_then_release_reuses_slot(model_and_params):
+    """admit -> jitted release -> admit a different prompt in the same
+    slot: the second request must be clean (no KV bleed-through)."""
+    model, params = model_and_params
+    engine = DecodeEngine(CFG, batch_slots=2, max_len=64)
+
+    def run(prompt, state, rng):
+        bucket = prefill_bucket(len(prompt), engine.max_len)
+        padded = jnp.asarray(prompt + [0] * (bucket - len(prompt)),
+                             jnp.int32)
+        state, first, rng = engine.admit(params, state, padded,
+                                         len(prompt), 0, rng)
+        out = [int(first)]
+        for _ in range(3):
+            state, sampled, rng = engine.step(params, state, rng)
+            out.append(int(sampled[0]))
+        return out, state, rng
+
+    rng = jax.random.key(0)
+    out_a, state, rng = run([10, 20, 30], engine.init_state(), rng)
+    state = engine.release(state, 0)
+    assert not bool(state.active[0])
+    out_b, _, _ = run([7, 7, 7, 7, 7], state, rng)
+    assert out_b == naive_greedy(model, params, [7, 7, 7, 7, 7], 4)

@@ -1,0 +1,60 @@
+"""Serve-path benchmark harness: end-to-end on the local cloud.
+
+The same harness bench.py uses for BENCH_r* serving numbers (BASELINE.md
+north-star: req/s + TTFT + TPOT through LB -> replica), exercised here
+with the tiny CPU preset so the suite validates the whole measurement
+path: serve up -> replica READY -> warmup through the LB -> timed
+closed-loop window -> stats -> teardown.
+"""
+import pytest
+
+from skypilot_tpu.benchmark import serve_bench
+
+
+class TestPercentile:
+
+    def test_nearest_rank(self):
+        vals = [10.0, 20.0, 30.0, 40.0]
+        assert serve_bench._percentile(vals, 0) == 10.0
+        assert serve_bench._percentile(vals, 100) == 40.0
+        assert serve_bench._percentile(vals, 50) == 30.0
+        assert serve_bench._percentile([5.0], 99) == 5.0
+
+
+class TestEquivalenceEstimate:
+
+    def test_scales_by_bandwidth_and_params(self):
+        est = serve_bench.equivalence_estimate(
+            2.0, model_params=0.89e9, chip_kind='TPU v5e')
+        # (8*1640/819) * (0.89/6.74) ~ 2.115 -> ~4.23 req/s
+        assert 3.5 < est['serve_7b_v6e8_equiv_req_per_s'] < 5.0
+        assert 'estimate' in est['serve_equiv_note']
+
+    def test_unknown_chip_defaults_conservative(self):
+        est = serve_bench.equivalence_estimate(
+            1.0, model_params=6.74e9, chip_kind='weird')
+        assert est['serve_7b_v6e8_equiv_req_per_s'] == pytest.approx(
+            8 * 1640 / 819, rel=0.01)
+
+
+@pytest.mark.slow
+class TestServeBenchE2E:
+
+    def test_tiny_preset_end_to_end(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_SERVE_TICK', '0.2')
+        monkeypatch.setenv('SKYTPU_SERVE_LB_SYNC', '0.2')
+        out = serve_bench.run(
+            preset='test-tiny', batch_slots=2, max_len=128,
+            prompt_len=24, output_len=8, concurrencies=(2,),
+            window_s=6.0, warmup_requests=1, ready_timeout_s=240,
+            service_name='bench-serve-test')
+        assert out['serve_model_params_b'] >= 0  # tiny preset rounds to 0
+        sweep = out['serve_sweep']
+        assert len(sweep) == 1
+        assert sweep[0]['completed'] > 0, sweep
+        assert out['serve_req_per_s'] > 0
+        assert out['serve_ttft_p50_ms'] > 0
+        assert out['serve_tpot_p50_ms'] > 0
+        # teardown happened
+        from skypilot_tpu.serve import serve_state
+        assert serve_state.get_service('bench-serve-test') is None

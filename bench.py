@@ -82,6 +82,28 @@ def _workload():
     return backend, n_devices, preset, batch, seq, steps, config
 
 
+def _overhead_breakdown(summary: dict, t_submit: float,
+                        prefix: str = '') -> dict:
+    """Split submit->first-step into phases from the callback's marks:
+    control plane (provision/ship/queue), runtime startup (python+jax/PJRT
+    incl. tunnel), param init, first-step compile."""
+    marks = summary.get('marks') or {}
+    ps = marks.get('proc_start')
+    jr = marks.get('jax_ready')
+    idn = marks.get('init_done')
+    fse = summary.get('first_step_end_ts')
+    out = {}
+    if ps:
+        out[f'{prefix}control_plane_s'] = round(ps - t_submit, 2)
+    if ps and jr:
+        out[f'{prefix}runtime_startup_s'] = round(jr - ps, 2)
+    if jr and idn:
+        out[f'{prefix}param_init_s'] = round(idn - jr, 2)
+    if idn and fse:
+        out[f'{prefix}first_step_s'] = round(fse - idn, 2)
+    return out
+
+
 def run_launched(preset: str, batch: int, seq: int, steps: int,
                  config, n_devices: int = 1) -> dict:
     """Benchmark THROUGH the product's own control plane (VERDICT r2 weak
@@ -103,44 +125,70 @@ def run_launched(preset: str, batch: int, seq: int, steps: int,
 
     os.environ.setdefault('SKYTPU_STATE_DIR',
                           tempfile.mkdtemp(prefix='skytpu-bench-state-'))
-    log_dir = tempfile.mkdtemp(prefix='skytpu-bench-cb-')
     remat = getattr(config, 'remat_policy', 'full')
     # Global batch scales with chips (train.run shards over fsdp=auto),
     # mirroring the in-process phase's scaling so the per-chip rates are
     # directly comparable.
     global_batch = batch * n_devices
-    task = sky.Task(
-        run=(f'python3 -m skypilot_tpu.train.run --preset {preset} '
-             f'--batch {global_batch} --seq {seq} --steps {steps + 2} '
-             f'--remat {remat} --log-every {steps + 2}'),
-        envs={'SKYTPU_BENCHMARK_LOG_DIR': log_dir})
-    task.set_resources([sky.Resources(cloud='local')])
-    t_submit = time_lib.time()
-    job_id, _ = execution.launch(task, cluster_name='bench-launched',
-                                 detach_run=True, stream_logs=False)
+
     from skypilot_tpu import exceptions as skytpu_exceptions
-    deadline = time_lib.time() + 3600
-    status = None
-    while time_lib.time() < deadline:
+
+    def one_launch(fast: bool) -> tuple:
+        """Launch the training task; returns (status, summary|None,
+        t_submit)."""
+        log_dir = tempfile.mkdtemp(prefix='skytpu-bench-cb-')
+        task = sky.Task(
+            run=(f'python3 -m skypilot_tpu.train.run --preset {preset} '
+                 f'--batch {global_batch} --seq {seq} --steps {steps + 2} '
+                 f'--remat {remat} --log-every {steps + 2}'),
+            envs={'SKYTPU_BENCHMARK_LOG_DIR': log_dir})
+        task.set_resources([sky.Resources(cloud='local')])
+        t_submit = time_lib.time()
+        job_id, _ = execution.launch(task, cluster_name='bench-launched',
+                                     detach_run=True, stream_logs=False,
+                                     fast=fast)
+        deadline = time_lib.time() + 3600
+        status = None
+        while time_lib.time() < deadline:
+            try:
+                status = core.job_status('bench-launched', job_id)
+            except skytpu_exceptions.SkyTpuError:
+                status = None  # transient (agent heartbeat lag): keep going
+            if status and job_lib.JobStatus(status).is_terminal():
+                break
+            time_lib.sleep(1.0)
         try:
-            status = core.job_status('bench-launched', job_id)
-        except skytpu_exceptions.SkyTpuError:
-            status = None  # transient (agent heartbeat lag): keep polling
-        if status and job_lib.JobStatus(status).is_terminal():
-            break
-        time_lib.sleep(1.0)
-    summary_path = os.path.join(log_dir, SUMMARY_FILE)
-    out = {'launched_job_status': status}
+            with open(os.path.join(log_dir, SUMMARY_FILE)) as f:
+                summary = json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            summary = None
+        return status, summary, t_submit
+
+    out = {}
     try:
-        with open(summary_path) as f:
-            summary = json.load(f)
+        # Cold: fresh cluster, empty compilation cache.
+        status, summary, t_submit = one_launch(fast=False)
+        out['launched_job_status'] = status
+        if summary is None or not summary.get('first_step_end_ts'):
+            out['launched_error'] = 'no benchmark summary from cold launch'
+            return out
         out['launch_overhead_s'] = round(
             summary['first_step_end_ts'] - t_submit, 2)
+        out.update(_overhead_breakdown(summary, t_submit))
         if summary.get('seconds_per_step'):
             tok = (global_batch * seq / summary['seconds_per_step']
                    / n_devices)
             out['launched_tokens_per_sec_per_chip'] = round(tok, 2)
-    except (FileNotFoundError, json.JSONDecodeError, KeyError) as e:
+        # Warm: same cluster, --fast (skip setup/mounts), persistent XLA
+        # compilation cache already populated by the cold run.
+        status_w, summary_w, t_submit_w = one_launch(fast=True)
+        out['warm_launched_job_status'] = status_w
+        if summary_w and summary_w.get('first_step_end_ts'):
+            out['warm_launch_overhead_s'] = round(
+                summary_w['first_step_end_ts'] - t_submit_w, 2)
+            out.update(_overhead_breakdown(summary_w, t_submit_w,
+                                           prefix='warm_'))
+    except Exception as e:  # noqa: BLE001 — phases below must survive
         out['launched_error'] = f'{type(e).__name__}: {e}'
     finally:
         try:

@@ -69,6 +69,17 @@ def _write() -> None:
     os.replace(tmp, path)
 
 
+def mark(name: str) -> None:
+    """Record a named phase timestamp (e.g. 'proc_start', 'jax_ready',
+    'init_done') into the summary — the launch-overhead decomposition the
+    bench reads (submit -> control plane -> runtime startup -> param init
+    -> first-step compile)."""
+    if _state is None:
+        return
+    _state.setdefault('marks', {})[name] = time.time()
+    _write()
+
+
 def step_begin() -> None:
     pass  # kept for API symmetry; timing anchors on step ends
 

@@ -61,10 +61,18 @@ def make_job_command(spec: Dict[str, Any], rank: int, env: Dict[str, str],
     exports = ' '.join(f'export {k}={shlex.quote(v)};'
                        for k, v in env.items())
     script = spec['run_script']
+    # Persistent XLA compilation cache, host-local ($PWD here is the
+    # runner's start dir: the host home). Warm relaunches then skip
+    # recompiles entirely — the compile half of the reference's --fast
+    # story (backend_utils.py:962 is the config-hash half). Task env can
+    # override the path (exports run after and win).
+    cache = ('export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE'
+             f'_DIR:-$PWD/{constants.RUNTIME_DIR}/jax_cache}}"; ')
     # setsid: new process group whose pgid == the leader pid written to the
     # pidfile, so cancellation can kill the whole tree without touching the
     # agent's own group (local runners share the agent's session).
-    inner = (f'echo $$ > {shlex.quote(pid_file)}; {exports} '
+    inner = (f'echo $$ > {shlex.quote(pid_file)}; {cache}{exports} '
+             'mkdir -p "$JAX_COMPILATION_CACHE_DIR"; '
              f'cd {shlex.quote(workdir)} 2>/dev/null || cd ~; '
              + script)
     return f'mkdir -p {shlex.quote(workdir)}; setsid bash -c {shlex.quote(inner)}'

@@ -263,11 +263,22 @@ class GenerationScheduler:
                 import traceback
                 traceback.print_exc()
                 err = 'generation scheduler error (request aborted)'
+                # Fail every request in flight: slot holders AND requests
+                # only present in queued emission items (e.g. a
+                # max_tokens<=1 request that never takes a slot) — any
+                # request left without a sentinel hangs its HTTP client.
                 with self._emit_lock:
-                    self._emit_q.clear()
+                    dropped, self._emit_q = self._emit_q, []
+                for item in dropped:
+                    reqs = ([item[2]] if item[0] == 'first'
+                            else [r for r in item[2] if r is not None])
+                    for req in reqs:
+                        if not req.done:
+                            req.fail(err)
                 for slot, req in enumerate(self._slots):
                     if req is not None:
-                        req.fail(err)
+                        if not req.done:
+                            req.fail(err)
                         self._slots[slot] = None
                 while not self._releases.empty():
                     try:

@@ -55,6 +55,12 @@ def parse_args(argv=None):
 def main(argv=None) -> None:
     args = parse_args(argv)
 
+    # Arm the benchmark callback FIRST: its phase marks decompose launch
+    # overhead (control plane vs runtime startup vs compile) for bench.py.
+    from skypilot_tpu import callbacks as skytpu_callback
+    cb_armed = skytpu_callback.init(total_steps=args.steps)
+    skytpu_callback.mark('proc_start')
+
     from skypilot_tpu.runtime import distributed
     distributed.init()  # no-op single-process
 
@@ -64,6 +70,7 @@ def main(argv=None) -> None:
     from skypilot_tpu import accelerators
     from skypilot_tpu.parallel import MeshSpec, make_mesh
     from skypilot_tpu.train import Trainer
+    skytpu_callback.mark('jax_ready')
 
     n = jax.device_count()
     dcn = (distributed.num_slices() if args.dcn == 'auto'
@@ -134,16 +141,16 @@ def main(argv=None) -> None:
         else:
             state = trainer.init_fn()(rng)
             start_step = 0
+        if cb_armed:
+            # Scalar fetch: force param-init compile+run to finish so the
+            # 'init_done' mark separates init from first-step compile.
+            int(jax.device_get(state.step))
+            skytpu_callback.mark('init_done')
 
         step = trainer.step_fn()
         tokens_per_step = args.batch * args.seq
         flops_per_step = config.train_flops_per_token(args.seq) \
             * tokens_per_step
-        from skypilot_tpu import callbacks as skytpu_callback
-        # no-op outside bench; armed => per-step sync below so the
-        # callback's step timings measure real step completion (steps
-        # dispatch asynchronously; a scalar fetch is the reliable sync).
-        cb_armed = skytpu_callback.init(total_steps=args.steps)
         t_window = time.perf_counter()
         for i in range(start_step, args.steps):
             skytpu_callback.step_begin()

@@ -27,10 +27,15 @@ _DATA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), 'data')
 @functools.lru_cache(maxsize=None)
 def _read(name: str) -> pd.DataFrame:
     path = os.path.join(_DATA_DIR, name)
-    if not os.path.exists(path):
-        # Regenerate on first use (e.g. fresh checkout).
+    if not os.path.exists(path) and name.startswith('gcp_'):
+        # Regenerate on first use (e.g. fresh checkout). Only the GCP
+        # fetcher exists; other catalogs ship as committed CSVs.
         from skypilot_tpu.catalog.fetchers import fetch_gcp
         fetch_gcp.refresh()
+    if not os.path.exists(path):
+        return pd.DataFrame(columns=[
+            'instance_type', 'vcpus', 'memory_gb', 'region', 'price',
+            'spot_price'])
     return pd.read_csv(path)
 
 
@@ -46,8 +51,8 @@ def _tpus() -> pd.DataFrame:
     return _read('gcp_tpus.csv')
 
 
-def _vms() -> pd.DataFrame:
-    return _read('gcp_vms.csv')
+def _vms(cloud: str = 'gcp') -> pd.DataFrame:
+    return _read(f'{cloud}_vms.csv')
 
 
 # ---- TPU slice queries -----------------------------------------------------
@@ -103,10 +108,11 @@ def perf_per_dollar(slice_: accel_lib.TpuSlice, use_spot: bool,
     return slice_.total_bf16_tflops / cost
 
 
-# ---- GCE VM queries --------------------------------------------------------
+# ---- VM queries (per cloud: gcp_vms.csv / aws_vms.csv) ---------------------
 def get_instance_hourly_cost(instance_type: str, use_spot: bool,
-                             region: Optional[str] = None) -> float:
-    df = _vms()
+                             region: Optional[str] = None,
+                             cloud: str = 'gcp') -> float:
+    df = _vms(cloud)
     df = df[df['instance_type'] == instance_type]
     if region is not None:
         df = df[df['region'] == region]
@@ -118,9 +124,10 @@ def get_instance_hourly_cost(instance_type: str, use_spot: bool,
     return float(df[col].min())
 
 
-def get_instance_info(instance_type: str) -> Tuple[int, float]:
+def get_instance_info(instance_type: str,
+                      cloud: str = 'gcp') -> Tuple[int, float]:
     """(vcpus, memory_gb) for an instance type."""
-    df = _vms()
+    df = _vms(cloud)
     df = df[df['instance_type'] == instance_type]
     if df.empty:
         raise exceptions.ResourcesUnavailableError(
@@ -133,9 +140,10 @@ def get_default_instance_type(cpus: Optional[float] = None,
                               cpus_plus: bool = True,
                               memory: Optional[float] = None,
                               memory_plus: bool = True,
-                              region: Optional[str] = None) -> Optional[str]:
+                              region: Optional[str] = None,
+                              cloud: str = 'gcp') -> Optional[str]:
     """Cheapest instance satisfying the cpu/memory constraints."""
-    df = _vms()
+    df = _vms(cloud)
     if region is not None:
         df = df[df['region'] == region]
     if cpus is None and memory is None:
@@ -152,8 +160,8 @@ def get_default_instance_type(cpus: Optional[float] = None,
     return str(df.iloc[0]['instance_type'])
 
 
-def get_vm_regions(instance_type: str) -> List[str]:
-    df = _vms()
+def get_vm_regions(instance_type: str, cloud: str = 'gcp') -> List[str]:
+    df = _vms(cloud)
     return sorted(df[df['instance_type'] == instance_type]['region'].unique())
 
 
@@ -171,7 +179,12 @@ def validate_region_zone(
         return
     tpus, vms = _tpus(), _vms()
     regions = set(tpus['region']).union(vms['region'])
+    aws_regions = set(_vms('aws')['region'].unique())
+    regions.update(aws_regions)
     zones = set(tpus['zone'])
+    # AWS AZs: region + single-letter suffix; regions carry up to six
+    # (us-east-1a..f), so accept any letter on a known region.
+    zones.update(f'{r}{s}' for r in aws_regions for s in 'abcdef')
     if zone is not None and zone not in zones:
         # GCE zones are region+suffix; accept unknown-but-wellformed.
         if zone.rsplit('-', 1)[0] not in regions:
@@ -181,6 +194,10 @@ def validate_region_zone(
         if region not in regions:
             raise exceptions.InvalidResourcesError(
                 f'Unknown region {region!r} (known: {sorted(regions)})')
-        if zone is not None and zone.rsplit('-', 1)[0] != region:
+        if zone is not None and zone.rsplit('-', 1)[0] != region \
+                and not (zone.startswith(region)
+                         and len(zone) == len(region) + 1):
+            # GCP: region-suffix (us-central1-a); AWS: region+letter
+            # (us-east-1a).
             raise exceptions.InvalidResourcesError(
                 f'Zone {zone!r} is not in region {region!r}')

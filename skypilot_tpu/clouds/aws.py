@@ -1,0 +1,137 @@
+"""AWS: EC2 VMs (controllers, CPU tasks, storage egress).
+
+Counterpart of reference ``sky/clouds/aws.py`` (feasibility, pricing,
+deploy vars, credential checks :1). This TPU-native stack has no AWS
+accelerators — AWS is the second VM cloud proving the multi-cloud
+abstraction: optimizer cross-cloud choice, egress edges, failover
+blocklists, and S3-side storage placement.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu.clouds import cloud as cloud_lib
+
+_CREDENTIAL_PATHS = [
+    '~/.aws/credentials',
+    '~/.aws/config',
+]
+
+
+@cloud_lib.CLOUD_REGISTRY.register(name='aws')
+class AWS(cloud_lib.Cloud):
+    NAME = 'aws'
+    _FEATURES = frozenset({
+        cloud_lib.CloudFeature.STOP,
+        cloud_lib.CloudFeature.AUTOSTOP,
+        cloud_lib.CloudFeature.SPOT,
+        cloud_lib.CloudFeature.MULTI_HOST,
+        cloud_lib.CloudFeature.STORAGE_MOUNTS,
+        cloud_lib.CloudFeature.OPEN_PORTS,
+        cloud_lib.CloudFeature.CUSTOM_IMAGES,
+    })
+
+    # ---- credentials ------------------------------------------------------
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        if os.environ.get('SKYTPU_FAKE_AWS_CREDENTIALS'):
+            return True, None
+        if os.environ.get('AWS_ACCESS_KEY_ID'):
+            return True, None
+        for p in _CREDENTIAL_PATHS:
+            if os.path.exists(os.path.expanduser(p)):
+                return True, None
+        return False, ('AWS credentials not found. Run `aws configure` or '
+                       'set AWS_ACCESS_KEY_ID/AWS_SECRET_ACCESS_KEY.')
+
+    @classmethod
+    def get_active_user_identity(cls) -> Optional[List[str]]:
+        if os.environ.get('SKYTPU_FAKE_AWS_CREDENTIALS'):
+            return ['fake-identity@aws.test']
+        try:
+            import boto3  # type: ignore
+            ident = boto3.client('sts').get_caller_identity()
+            return [ident['Arn']]
+        except Exception:  # noqa: BLE001 — identity is best-effort
+            return None
+
+    # ---- topology ---------------------------------------------------------
+    def regions_for(self, resources) -> List[str]:
+        if resources.tpu is not None:
+            return []  # no TPUs on AWS
+        itype = resources.instance_type or 'm6i.large'
+        regions = catalog.get_vm_regions(itype, cloud=self.NAME)
+        if resources.region is not None:
+            regions = [r for r in regions if r == resources.region]
+        return regions
+
+    def zones_for(self, resources, region: str) -> List[Optional[str]]:
+        if resources.zone is not None:
+            # A user-pinned AZ is taken verbatim (regions have up to six
+            # AZs, d/e/f included; a generated list must not filter a
+            # valid pin away).
+            return ([resources.zone]
+                    if resources.zone.startswith(region) else [])
+        # Default probe order; failover walks them.
+        return [f'{region}{s}' for s in 'abc']
+
+    # ---- pricing ----------------------------------------------------------
+    def hourly_cost(self, resources, region=None, zone=None) -> float:
+        region = region or resources.region
+        assert resources.instance_type is not None, resources
+        return catalog.get_instance_hourly_cost(
+            resources.instance_type, resources.use_spot, region=region,
+            cloud=self.NAME)
+
+    def egress_cost_per_gb(self, dst_cloud: str, dst_region: str,
+                           src_region: Optional[str]) -> float:
+        if src_region is None or dst_cloud != self.NAME:
+            return 0.09  # internet egress (public AWS pricing, first tier)
+        if src_region == dst_region:
+            return 0.0
+        return 0.02  # inter-region within AWS
+
+    # ---- feasibility ------------------------------------------------------
+    def get_feasible_resources(self,
+                               resources) -> cloud_lib.FeasibleResources:
+        if resources.tpu is not None:
+            return cloud_lib.FeasibleResources(
+                [], hint='AWS has no TPU accelerators; use cloud: gcp.')
+        if resources.instance_type is not None:
+            if not catalog.get_vm_regions(resources.instance_type,
+                                          cloud=self.NAME):
+                return cloud_lib.FeasibleResources(
+                    [], hint=(f'{resources.instance_type} is not an EC2 '
+                              'instance type in the catalog.'))
+            return cloud_lib.FeasibleResources(
+                [resources.copy(cloud=self.NAME)])
+        itype = catalog.get_default_instance_type(
+            cpus=resources._cpus, cpus_plus=resources._cpus_plus,  # pylint: disable=protected-access
+            memory=resources._memory, memory_plus=resources._memory_plus,  # pylint: disable=protected-access
+            region=resources.region, cloud=self.NAME)
+        if itype is None:
+            return cloud_lib.FeasibleResources(
+                [], hint=(f'No EC2 instance with cpus={resources.cpus}, '
+                          f'memory={resources.memory}'))
+        return cloud_lib.FeasibleResources(
+            [resources.copy(cloud=self.NAME, instance_type=itype)])
+
+    # ---- deployment -------------------------------------------------------
+    def make_deploy_variables(self, resources, cluster_name_on_cloud: str,
+                              region: str,
+                              zone: Optional[str]) -> Dict[str, Any]:
+        return {
+            'cloud': self.NAME,
+            'mode': 'ec2',
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region,
+            'zone': zone,
+            'use_spot': resources.use_spot,
+            'disk_size_gb': resources.disk_size,
+            'labels': dict(resources.labels or {}),
+            'ports': list(resources.ports or ()),
+            'instance_type': resources.instance_type,
+            'image_id': resources.image_id,
+        }

@@ -22,6 +22,7 @@ from skypilot_tpu import exceptions
 _CLOUD_MODULES = {
     'local': 'skypilot_tpu.provision.local_impl',
     'gcp': 'skypilot_tpu.provision.gcp',
+    'aws': 'skypilot_tpu.provision.aws',
     'kubernetes': 'skypilot_tpu.provision.kubernetes',
 }
 

@@ -1,0 +1,343 @@
+"""AWS provisioner: EC2 VM host groups (controllers, CPU tasks, storage).
+
+Counterpart of reference ``sky/provision/aws/instance.py`` (956 LoC of EC2
+ops) + ``config.py`` (security-group bootstrap). Differences in this
+TPU-native stack: no TPU accelerators on AWS — EC2 covers the multi-cloud
+half of the story (controllers, CPU tasks, egress-optimized placement,
+inter-cloud storage), with the same record/classification/failover shape
+as the GCP provisioner so ``RetryingProvisioner`` drives both identically.
+
+Cluster bookkeeping (region, AZ, name-on-cloud) lives in the client state
+kv, mirroring ``provision/gcp.py``.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import authentication
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import provision as provision_lib
+from skypilot_tpu.provision import aws_api
+from skypilot_tpu.utils import command_runner as runner_lib
+
+_TAG_CLUSTER = 'skytpu-cluster'
+_TAG_RANK = 'skytpu-rank'
+
+_EC2_STATE_MAP = {
+    'pending': 'pending', 'running': 'running', 'stopping': 'stopping',
+    'stopped': 'stopped', 'shutting-down': 'terminating',
+    'terminated': 'terminated',
+}
+
+SSH_USER = 'ubuntu'  # canonical Ubuntu AMI login
+
+
+# ---- cluster record --------------------------------------------------------
+def _record_key(cluster_name: str) -> str:
+    return f'aws_cluster/{cluster_name}'
+
+
+def _save_record(cluster_name: str, record: Dict[str, Any]) -> None:
+    global_user_state.set_kv(_record_key(cluster_name), json.dumps(record))
+
+
+def _load_record(cluster_name: str) -> Optional[Dict[str, Any]]:
+    raw = global_user_state.get_kv(_record_key(cluster_name))
+    return json.loads(raw) if raw else None
+
+
+def _delete_record(cluster_name: str) -> None:
+    global_user_state.set_kv(_record_key(cluster_name), '')
+
+
+def _require_record(cluster_name: str) -> Dict[str, Any]:
+    record = _load_record(cluster_name)
+    if not record:
+        raise exceptions.ClusterError(
+            f'No AWS provisioning record for {cluster_name!r}')
+    return record
+
+
+def _sg_name(name_on_cloud: str) -> str:
+    return f'skytpu-{name_on_cloud}-sg'
+
+
+def _key_name() -> str:
+    return 'skytpu-key'
+
+
+def _live_instances(ec2, name: str,
+                    states: Optional[List[str]] = None
+                    ) -> List[Dict[str, Any]]:
+    filters = [{'Name': f'tag:{_TAG_CLUSTER}', 'Values': [name]}]
+    if states is None:
+        states = ['pending', 'running', 'stopping', 'stopped']
+    filters.append({'Name': 'instance-state-name', 'Values': states})
+    resp = aws_api.call(ec2, 'describe_instances', Filters=filters)
+    return aws_api.instances_from_describe(resp)
+
+
+def _ensure_key_pair(ec2) -> str:
+    """Import the skytpu ed25519 public key as an EC2 key pair
+    (idempotent; reference uses per-cluster keys via cluster YAML)."""
+    name = _key_name()
+    resp = aws_api.call(ec2, 'describe_key_pairs')
+    if any(kp.get('KeyName') == name for kp in resp.get('KeyPairs', [])):
+        return name
+    _, pub_path = authentication.get_or_generate_keys()
+    with open(pub_path) as f:
+        pub = f.read().strip()
+    aws_api.call(ec2, 'import_key_pair', KeyName=name,
+                 PublicKeyMaterial=pub.encode())
+    return name
+
+
+def _ensure_security_group(ec2, name: str) -> str:
+    """Per-cluster SG with SSH open; serve/task ports added by
+    open_ports (reference sky/provision/aws/config.py SG bootstrap)."""
+    sg_name = _sg_name(name)
+    resp = aws_api.call(ec2, 'describe_security_groups', Filters=[
+        {'Name': 'group-name', 'Values': [sg_name]}])
+    groups = resp.get('SecurityGroups', [])
+    if groups:
+        return groups[0]['GroupId']
+    created = aws_api.call(ec2, 'create_security_group',
+                           GroupName=sg_name,
+                           Description=f'skytpu cluster {name}')
+    sg_id = created['GroupId']
+    aws_api.call(ec2, 'authorize_security_group_ingress',
+                 GroupId=sg_id,
+                 IpPermissions=[{'IpProtocol': 'tcp', 'FromPort': 22,
+                                 'ToPort': 22,
+                                 'IpRanges': [{'CidrIp': '0.0.0.0/0'}]}])
+    return sg_id
+
+
+# ---- provision API ---------------------------------------------------------
+def run_instances(cluster_name: str, region: str, zone: Optional[str],
+                  num_hosts: int, deploy_vars: Dict[str, Any]) -> None:
+    name = deploy_vars['cluster_name_on_cloud']
+    record = {'region': region, 'zone': zone, 'name_on_cloud': name,
+              'num_hosts': num_hosts, 'deploy_vars': deploy_vars}
+    # Record BEFORE creating (partial-failure resources must stay
+    # reachable by terminate_instances; same contract as provision/gcp.py).
+    _save_record(cluster_name, record)
+    ec2 = aws_api.get_ec2(region)
+    try:
+        key_name = _ensure_key_pair(ec2)
+        sg_id = _ensure_security_group(ec2, name)
+        existing = {aws_api.tag_value(i, _TAG_RANK): i
+                    for i in _live_instances(ec2, name)}
+        to_start = []
+        missing_ranks = []
+        for rank in range(num_hosts):
+            inst = existing.get(str(rank))
+            if inst is None:
+                missing_ranks.append(rank)
+            elif inst['State']['Name'] == 'stopped':
+                to_start.append(inst['InstanceId'])
+        if to_start:
+            aws_api.call(ec2, 'start_instances', InstanceIds=to_start)
+        for rank in missing_ranks:
+            placement: Dict[str, Any] = {}
+            if zone:
+                placement['AvailabilityZone'] = zone
+            market = ({'MarketType': 'spot', 'SpotOptions': {
+                'InstanceInterruptionBehavior': 'terminate'}}
+                if deploy_vars.get('use_spot') else None)
+            kwargs: Dict[str, Any] = dict(
+                ImageId=deploy_vars.get('image_id') or 'ami-ubuntu-2204',
+                InstanceType=deploy_vars.get('instance_type', 'm6i.large'),
+                MinCount=1, MaxCount=1,
+                KeyName=key_name,
+                SecurityGroupIds=[sg_id],
+                Placement=placement,
+                BlockDeviceMappings=[{
+                    'DeviceName': '/dev/sda1',
+                    'Ebs': {'VolumeSize':
+                            deploy_vars.get('disk_size_gb', 256),
+                            'DeleteOnTermination': True},
+                }],
+                TagSpecifications=[{
+                    'ResourceType': 'instance',
+                    'Tags': [
+                        {'Key': _TAG_CLUSTER, 'Value': name},
+                        {'Key': _TAG_RANK, 'Value': str(rank)},
+                        {'Key': 'Name', 'Value': f'{name}-{rank}'},
+                    ] + [{'Key': k, 'Value': str(v)} for k, v in
+                         (deploy_vars.get('labels') or {}).items()],
+                }],
+            )
+            if market:
+                kwargs['InstanceMarketOptions'] = market
+            aws_api.call(ec2, 'run_instances', **kwargs)
+    except exceptions.InsufficientCapacityError:
+        # Clean up any partial hosts, then drop the record so zone
+        # failover retries don't see a stale pointer.
+        try:
+            _terminate_all(ec2, name)
+        except exceptions.CloudError:
+            pass
+        _delete_record(cluster_name)
+        raise
+
+
+def wait_instances(cluster_name: str, region: str, state: str = 'running',
+                   timeout: float = 1800) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        states = set(query_instances(cluster_name, region).values())
+        if states == {state}:
+            return
+        if not states or 'terminated' in states or 'terminating' in states:
+            # Empty set = every host gone (EC2 spot reclaim deletes, it
+            # doesn't stop) — same capacity classification as a partial
+            # loss so failover fires immediately instead of timing out.
+            raise exceptions.InsufficientCapacityError(
+                f'{cluster_name}: instance(s) terminated while waiting '
+                f'for {state} (spot reclaim?)', reason='capacity')
+        time.sleep(5)
+    raise exceptions.ProvisionError(
+        f'{cluster_name} did not reach {state!r} within {timeout}s')
+
+
+def query_instances(cluster_name: str, region: str) -> Dict[str, str]:
+    """Live host states. A PARTIALLY-dead cluster reports its missing
+    ranks as 'terminated' (managed-job recovery must see the hole —
+    same contract as the GCP multi-slice path); a fully-dead cluster
+    returns {} ("terminated cluster" contract in core.py). Terminated
+    EC2 instances linger in describe_instances for ~an hour, so absence
+    is judged per-rank against the record's num_hosts, not by reading
+    terminated rows (which would outlive relaunches)."""
+    record = _load_record(cluster_name)
+    if not record:
+        return {}
+    ec2 = aws_api.get_ec2(record['region'])
+    out: Dict[str, str] = {}
+    live_ranks = set()
+    for inst in _live_instances(ec2, record['name_on_cloud']):
+        raw = inst['State']['Name']
+        out[inst['InstanceId']] = _EC2_STATE_MAP.get(raw, 'unknown')
+        live_ranks.add(aws_api.tag_value(inst, _TAG_RANK))
+    if not out:
+        return {}
+    for rank in range(int(record.get('num_hosts') or 0)):
+        if str(rank) not in live_ranks:
+            out[f'rank{rank}-missing'] = 'terminated'
+    return out
+
+
+def stop_instances(cluster_name: str, region: str) -> None:
+    record = _require_record(cluster_name)
+    ec2 = aws_api.get_ec2(record['region'])
+    ids = [i['InstanceId'] for i in _live_instances(
+        ec2, record['name_on_cloud'], states=['pending', 'running'])]
+    if ids:
+        aws_api.call(ec2, 'stop_instances', InstanceIds=ids)
+
+
+def _terminate_all(ec2, name: str) -> None:
+    ids = [i['InstanceId'] for i in _live_instances(ec2, name)]
+    if ids:
+        aws_api.call(ec2, 'terminate_instances', InstanceIds=ids)
+
+
+def terminate_instances(cluster_name: str, region: str) -> None:
+    record = _load_record(cluster_name)
+    if not record:
+        return
+    ec2 = aws_api.get_ec2(record['region'])
+    name = record['name_on_cloud']
+    _terminate_all(ec2, name)
+    # Best-effort SG cleanup (fails with DependencyViolation while
+    # instances are shutting down — retried briefly, then left; the SG is
+    # free and reused on relaunch).
+    for _ in range(6):
+        try:
+            resp = aws_api.call(ec2, 'describe_security_groups', Filters=[
+                {'Name': 'group-name', 'Values': [_sg_name(name)]}])
+            groups = resp.get('SecurityGroups', [])
+            if not groups:
+                break
+            aws_api.call(ec2, 'delete_security_group',
+                         GroupId=groups[0]['GroupId'])
+            break
+        except exceptions.CloudError:
+            time.sleep(2)
+    _delete_record(cluster_name)
+
+
+def get_cluster_info(cluster_name: str,
+                     region: str) -> provision_lib.ClusterInfo:
+    record = _require_record(cluster_name)
+    ec2 = aws_api.get_ec2(record['region'])
+    hosts: List[provision_lib.HostInfo] = []
+    insts = _live_instances(ec2, record['name_on_cloud'])
+    insts.sort(key=lambda i: int(aws_api.tag_value(i, _TAG_RANK) or 0))
+    for inst in insts:
+        rank = int(aws_api.tag_value(inst, _TAG_RANK) or 0)
+        hosts.append(provision_lib.HostInfo(
+            host_id=inst['InstanceId'], rank=rank,
+            internal_ip=inst.get('PrivateIpAddress', ''),
+            external_ip=inst.get('PublicIpAddress'),
+            extra={}))
+    return provision_lib.ClusterInfo(
+        cluster_name=cluster_name, cloud='aws', region=record['region'],
+        zone=record.get('zone'), hosts=hosts,
+        deploy_vars=record['deploy_vars'])
+
+
+def open_ports(cluster_name: str, region: str, ports: List[str]) -> None:
+    """Authorize task/serve ports on the cluster's security group
+    (reference sky/provision/aws/instance.py open_ports). Source ranges
+    configurable via ``aws.firewall_source_ranges`` like GCP's."""
+    if not ports:
+        return
+    record = _require_record(cluster_name)
+    ec2 = aws_api.get_ec2(record['region'])
+    name = record['name_on_cloud']
+    resp = aws_api.call(ec2, 'describe_security_groups', Filters=[
+        {'Name': 'group-name', 'Values': [_sg_name(name)]}])
+    groups = resp.get('SecurityGroups', [])
+    if not groups:
+        raise exceptions.ClusterError(
+            f'security group {_sg_name(name)} missing for {cluster_name}')
+    sg = groups[0]
+    have = {(p.get('FromPort'), p.get('ToPort'))
+            for p in sg.get('IpPermissions', [])}
+    from skypilot_tpu import config as config_lib
+    ranges = config_lib.get_nested(('aws', 'firewall_source_ranges'),
+                                   ['0.0.0.0/0'])
+    perms = []
+    for port in ports:
+        # Port specs are ints or 'lo-hi' ranges (resources._parse_ports).
+        if '-' in str(port):
+            lo, hi = (int(p) for p in str(port).split('-', 1))
+        else:
+            lo = hi = int(port)
+        if (lo, hi) in have:
+            continue
+        perms.append({'IpProtocol': 'tcp', 'FromPort': lo,
+                      'ToPort': hi,
+                      'IpRanges': [{'CidrIp': r} for r in ranges]})
+    if perms:
+        aws_api.call(ec2, 'authorize_security_group_ingress',
+                     GroupId=sg['GroupId'], IpPermissions=perms)
+
+
+def get_command_runners(cluster_info: provision_lib.ClusterInfo,
+                        ssh_credentials: Optional[Dict[str, str]] = None
+                        ) -> List[runner_lib.CommandRunner]:
+    creds = ssh_credentials or {}
+    key_path = creds.get('key_path')
+    if key_path is None:
+        key_path, _ = authentication.get_or_generate_keys()
+    user = creds.get('user', SSH_USER)
+    runners: List[runner_lib.CommandRunner] = []
+    for h in cluster_info.hosts:
+        ip = h.external_ip or h.internal_ip
+        runners.append(runner_lib.SSHCommandRunner(ip, user, key_path))
+    return runners

@@ -168,6 +168,14 @@ CONFIG_SCHEMA: Dict[str, Any] = {
             },
             'additionalProperties': True,
         },
+        'aws': {
+            'type': 'object',
+            'properties': {
+                'firewall_source_ranges': {
+                    'type': 'array', 'items': {'type': 'string'}},
+            },
+            'additionalProperties': True,
+        },
         'local': {
             'type': 'object',
             'properties': {

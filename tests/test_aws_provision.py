@@ -1,0 +1,373 @@
+"""AWS provisioner tests against an in-process fake EC2.
+
+The fake implements the boto3 client surface the provisioner calls
+(run_instances / describe_instances / terminate... snake_case), including
+per-AZ capacity errors — so lifecycle, failover, and security-group logic
+run for real with no cloud and no boto3 (reference tests use moto for the
+same seam, SURVEY.md §4).
+"""
+import itertools
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import exceptions
+from skypilot_tpu.backends.slice_backend import RetryingProvisioner
+from skypilot_tpu.provision import aws as aws_provision
+from skypilot_tpu.provision import aws_api
+
+
+class FakeEc2:
+    """In-memory EC2 for one region."""
+
+    def __init__(self, region):
+        self.region = region
+        self.instances = {}       # id -> instance dict
+        self.security_groups = {}  # id -> sg dict
+        self.key_pairs = {}
+        self.fail_zones = set()   # AZs with InsufficientInstanceCapacity
+        self.run_calls = []
+        self._ids = itertools.count(1)
+
+    # -- helpers -------------------------------------------------------------
+    def _match(self, inst, filters):
+        for f in filters or []:
+            name, values = f['Name'], f['Values']
+            if name == 'instance-state-name':
+                if inst['State']['Name'] not in values:
+                    return False
+            elif name.startswith('tag:'):
+                key = name[4:]
+                tags = {t['Key']: t['Value'] for t in inst.get('Tags', [])}
+                if tags.get(key) not in values:
+                    return False
+            else:
+                raise AssertionError(f'fake ec2: unknown filter {name}')
+        return True
+
+    # -- boto3 client surface ------------------------------------------------
+    def run_instances(self, **kw):
+        zone = (kw.get('Placement') or {}).get('AvailabilityZone')
+        self.run_calls.append(zone)
+        if zone in self.fail_zones:
+            raise aws_api.AwsApiError(
+                'InsufficientInstanceCapacity',
+                f'We currently do not have sufficient capacity in {zone}.')
+        iid = f'i-{next(self._ids):08x}'
+        n = len(self.instances)
+        inst = {
+            'InstanceId': iid,
+            'InstanceType': kw['InstanceType'],
+            'State': {'Name': 'running'},
+            'Placement': kw.get('Placement', {}),
+            'PrivateIpAddress': f'10.2.0.{n + 10}',
+            'PublicIpAddress': f'54.0.0.{n + 10}',
+            'Tags': list((kw.get('TagSpecifications') or [{}])[0]
+                         .get('Tags', [])),
+            'SecurityGroups': [{'GroupId': g}
+                               for g in kw.get('SecurityGroupIds', [])],
+        }
+        self.instances[iid] = inst
+        return {'Instances': [inst]}
+
+    def describe_instances(self, Filters=None, **kw):
+        matched = [i for i in self.instances.values()
+                   if self._match(i, Filters)]
+        return {'Reservations': [{'Instances': matched}]}
+
+    def start_instances(self, InstanceIds, **kw):
+        for iid in InstanceIds:
+            self.instances[iid]['State']['Name'] = 'running'
+        return {}
+
+    def stop_instances(self, InstanceIds, **kw):
+        for iid in InstanceIds:
+            self.instances[iid]['State']['Name'] = 'stopped'
+        return {}
+
+    def terminate_instances(self, InstanceIds, **kw):
+        for iid in InstanceIds:
+            self.instances[iid]['State']['Name'] = 'terminated'
+        return {}
+
+    def describe_key_pairs(self, **kw):
+        return {'KeyPairs': [{'KeyName': k} for k in self.key_pairs]}
+
+    def import_key_pair(self, KeyName, PublicKeyMaterial, **kw):
+        self.key_pairs[KeyName] = PublicKeyMaterial
+        return {'KeyName': KeyName}
+
+    def describe_security_groups(self, Filters=None, **kw):
+        names = []
+        for f in Filters or []:
+            if f['Name'] == 'group-name':
+                names = f['Values']
+        groups = [g for g in self.security_groups.values()
+                  if not names or g['GroupName'] in names]
+        return {'SecurityGroups': groups}
+
+    def create_security_group(self, GroupName, Description, **kw):
+        gid = f'sg-{next(self._ids):08x}'
+        self.security_groups[gid] = {
+            'GroupId': gid, 'GroupName': GroupName,
+            'Description': Description, 'IpPermissions': [],
+        }
+        return {'GroupId': gid}
+
+    def authorize_security_group_ingress(self, GroupId, IpPermissions,
+                                         **kw):
+        self.security_groups[GroupId]['IpPermissions'].extend(IpPermissions)
+        return {}
+
+    def delete_security_group(self, GroupId, **kw):
+        attached = any(
+            g.get('GroupId') == GroupId
+            for i in self.instances.values()
+            if i['State']['Name'] not in ('terminated',)
+            for g in i.get('SecurityGroups', []))
+        if attached:
+            raise aws_api.AwsApiError('DependencyViolation',
+                                      'resource sg has a dependent object')
+        self.security_groups.pop(GroupId, None)
+        return {}
+
+
+class FakeEc2Fleet:
+    """Region -> FakeEc2, shared across the provisioner's get_ec2 calls."""
+
+    def __init__(self):
+        self.regions = {}
+
+    def __call__(self, region):
+        if region not in self.regions:
+            self.regions[region] = FakeEc2(region)
+        return self.regions[region]
+
+
+@pytest.fixture
+def fake_aws(monkeypatch, tmp_path):
+    fleet = FakeEc2Fleet()
+    aws_api.set_ec2_factory(fleet)
+    monkeypatch.setenv('SKYTPU_FAKE_AWS_CREDENTIALS', '1')
+    # Key files without invoking ssh-keygen.
+    priv = tmp_path / 'key'
+    pub = tmp_path / 'key.pub'
+    priv.write_text('fake-private')
+    pub.write_text('ssh-ed25519 AAAA test')
+    monkeypatch.setattr('skypilot_tpu.authentication.get_or_generate_keys',
+                        lambda: (str(priv), str(pub)))
+    yield fleet
+    aws_api.set_ec2_factory(None)
+
+
+def _deploy_vars(**over):
+    base = {
+        'cloud': 'aws', 'mode': 'ec2', 'cluster_name_on_cloud': 'c-aws1',
+        'instance_type': 'm6i.large', 'image_id': None,
+        'disk_size_gb': 128, 'use_spot': False, 'labels': {}, 'ports': [],
+    }
+    base.update(over)
+    return base
+
+
+class TestEc2Lifecycle:
+
+    def test_create_query_info_stop_start_terminate(self, fake_aws):
+        dv = _deploy_vars()
+        aws_provision.run_instances('a1', 'us-east-1', 'us-east-1a', 2, dv)
+        aws_provision.wait_instances('a1', 'us-east-1', timeout=5)
+        states = aws_provision.query_instances('a1', 'us-east-1')
+        assert set(states.values()) == {'running'} and len(states) == 2
+
+        info = aws_provision.get_cluster_info('a1', 'us-east-1')
+        assert info.num_hosts == 2
+        assert [h.rank for h in info.hosts] == [0, 1]
+        assert info.head.internal_ip.startswith('10.2.')
+        assert info.head.external_ip.startswith('54.')
+
+        aws_provision.stop_instances('a1', 'us-east-1')
+        assert set(aws_provision.query_instances(
+            'a1', 'us-east-1').values()) == {'stopped'}
+
+        # restart path: run_instances on stopped hosts starts them.
+        aws_provision.run_instances('a1', 'us-east-1', 'us-east-1a', 2, dv)
+        assert set(aws_provision.query_instances(
+            'a1', 'us-east-1').values()) == {'running'}
+
+        aws_provision.terminate_instances('a1', 'us-east-1')
+        assert aws_provision.query_instances('a1', 'us-east-1') == {}
+        # SG cleaned up once instances were gone.
+        assert fake_aws.regions['us-east-1'].security_groups == {}
+
+    def test_ssh_key_imported_once(self, fake_aws):
+        dv = _deploy_vars()
+        aws_provision.run_instances('a2', 'us-east-1', 'us-east-1a', 1, dv)
+        aws_provision.run_instances('a2', 'us-east-1', 'us-east-1a', 1, dv)
+        assert list(fake_aws.regions['us-east-1'].key_pairs) \
+            == ['skytpu-key']
+
+    def test_capacity_error_classified_and_record_dropped(self, fake_aws):
+        fleet = fake_aws
+        fleet('us-east-1').fail_zones.add('us-east-1a')
+        with pytest.raises(exceptions.InsufficientCapacityError):
+            aws_provision.run_instances('a3', 'us-east-1', 'us-east-1a', 2,
+                                        _deploy_vars())
+        # Clean failure leaves no record (failover must not see stale
+        # pointers) and no instances.
+        assert aws_provision.query_instances('a3', 'us-east-1') == {}
+
+    def test_spot_market_options(self, fake_aws):
+        dv = _deploy_vars(use_spot=True)
+        aws_provision.run_instances('a4', 'us-east-1', 'us-east-1a', 1, dv)
+        states = aws_provision.query_instances('a4', 'us-east-1')
+        assert set(states.values()) == {'running'}
+
+
+class TestOpenPorts:
+
+    def test_open_ports_on_security_group(self, fake_aws):
+        aws_provision.run_instances('a1', 'us-east-1', 'us-east-1a', 1,
+                                    _deploy_vars())
+        aws_provision.open_ports('a1', 'us-east-1', ['8080'])
+        aws_provision.open_ports('a1', 'us-east-1', ['8080'])  # idempotent
+        aws_provision.open_ports('a1', 'us-east-1', ['9000'])
+        sg = next(iter(
+            fake_aws.regions['us-east-1'].security_groups.values()))
+        opened = sorted((p['FromPort'], p['ToPort'])
+                        for p in sg['IpPermissions'])
+        assert opened == [(22, 22), (8080, 8080), (9000, 9000)]
+
+
+class TestFailover:
+
+    def _cpu_task(self, region='us-east-1'):
+        task = sky.Task(run='echo x')
+        res = sky.Resources(cloud='aws', instance_type='m6i.large',
+                            region=region)
+        task.set_resources([res])
+        task.best_resources = res
+        task.candidate_resources = [res]
+        return task
+
+    def test_zone_failover_within_region(self, fake_aws):
+        fake_aws('us-east-1').fail_zones.add('us-east-1a')
+        launched, info = RetryingProvisioner().provision(
+            self._cpu_task(), 'aws-fo')
+        assert launched.zone == 'us-east-1b'
+        assert info.num_hosts == 1
+        assert fake_aws.regions['us-east-1'].run_calls[0] == 'us-east-1a'
+
+    def test_cross_region_failover(self, fake_aws):
+        task = sky.Task(run='echo x')
+        r1 = sky.Resources(cloud='aws', instance_type='m6i.large',
+                           region='us-east-1')
+        r2 = sky.Resources(cloud='aws', instance_type='m6i.large',
+                           region='us-west-2')
+        task.set_resources([r1])
+        task.best_resources = r1
+        task.candidate_resources = [r1, r2]
+        for s in 'abc':
+            fake_aws('us-east-1').fail_zones.add(f'us-east-1{s}')
+        launched, info = RetryingProvisioner().provision(task, 'aws-fo2')
+        assert launched.region == 'us-west-2'
+        assert info.num_hosts == 1
+
+    def test_all_exhausted_raises_with_history(self, fake_aws):
+        for s in 'abc':
+            fake_aws('us-east-1').fail_zones.add(f'us-east-1{s}')
+        with pytest.raises(exceptions.ResourcesUnavailableError) as ei:
+            RetryingProvisioner().provision(self._cpu_task(), 'aws-fo3')
+        assert any(isinstance(e, exceptions.InsufficientCapacityError)
+                   for e in ei.value.failover_history)
+
+
+class TestOptimizerCrossCloud:
+
+    def test_cpu_task_picks_cheaper_cloud(self, fake_aws, monkeypatch):
+        """With both clouds enabled, a CPU task lands on AWS: t3.medium
+        ($0.0416/h) undercuts the cheapest catalog GCE type."""
+        from skypilot_tpu import catalog, optimizer
+        monkeypatch.setenv('SKYTPU_FAKE_GCP_CREDENTIALS', '1')
+        t = sky.Task('t', run='x')
+        t.set_resources(sky.Resources(cpus='2+'))
+        optimizer.optimize(t, quiet=True, blocked_resources=[
+            sky.Resources(cloud='local')])  # hermetic $0 cloud aside
+        assert t.best_resources.cloud == 'aws'
+        assert t.estimated_cost_per_hour == pytest.approx(
+            catalog.get_instance_hourly_cost('t3.medium', False,
+                                             cloud='aws'))
+
+    def test_cloud_pin_still_respected(self, fake_aws, monkeypatch):
+        from skypilot_tpu import optimizer
+        monkeypatch.setenv('SKYTPU_FAKE_GCP_CREDENTIALS', '1')
+        t = sky.Task('t', run='x')
+        t.set_resources(sky.Resources(cloud='gcp', cpus='2+'))
+        optimizer.optimize(t, quiet=True)
+        assert t.best_resources.cloud == 'gcp'
+
+
+class TestErrorClassification:
+
+    @pytest.mark.parametrize('code,expected', [
+        ('InsufficientInstanceCapacity', 'capacity'),
+        ('Unsupported', 'capacity'),
+        ('SpotMaxPriceTooLow', 'capacity'),
+        ('VcpuLimitExceeded', 'quota'),
+        ('InvalidParameterValue', None),
+    ])
+    def test_classify(self, code, expected):
+        err = aws_api.classify_error(aws_api.AwsApiError(code, 'boom'))
+        if expected == 'capacity':
+            assert isinstance(err, exceptions.InsufficientCapacityError)
+        elif expected == 'quota':
+            assert err.reason == 'quota'
+            assert not isinstance(err,
+                                  exceptions.InsufficientCapacityError)
+        else:
+            assert err.reason is None
+
+
+class TestSpotReclaim:
+
+    def test_partial_reclaim_reports_terminated(self, fake_aws):
+        """EC2 spot reclaim DELETES instances; the missing rank must read
+        as terminated so managed-job recovery sees the hole."""
+        aws_provision.run_instances('sr1', 'us-east-1', 'us-east-1a', 2,
+                                    _deploy_vars(use_spot=True))
+        ec2 = fake_aws.regions['us-east-1']
+        victim = next(iter(ec2.instances))
+        ec2.instances[victim]['State']['Name'] = 'terminated'
+        states = aws_provision.query_instances('sr1', 'us-east-1')
+        assert sorted(states.values()) == ['running', 'terminated']
+        with pytest.raises(exceptions.InsufficientCapacityError):
+            aws_provision.wait_instances('sr1', 'us-east-1', timeout=3)
+
+    def test_full_reclaim_is_immediate_capacity_error(self, fake_aws):
+        aws_provision.run_instances('sr2', 'us-east-1', 'us-east-1a', 1,
+                                    _deploy_vars(use_spot=True))
+        ec2 = fake_aws.regions['us-east-1']
+        for inst in ec2.instances.values():
+            inst['State']['Name'] = 'terminated'
+        assert aws_provision.query_instances('sr2', 'us-east-1') == {}
+        with pytest.raises(exceptions.InsufficientCapacityError):
+            aws_provision.wait_instances('sr2', 'us-east-1', timeout=30)
+
+
+class TestPortRangesAndZones:
+
+    def test_open_port_range(self, fake_aws):
+        aws_provision.run_instances('pr1', 'us-east-1', 'us-east-1a', 1,
+                                    _deploy_vars())
+        aws_provision.open_ports('pr1', 'us-east-1', ['8000-8010'])
+        sg = next(iter(
+            fake_aws.regions['us-east-1'].security_groups.values()))
+        assert (8000, 8010) in {(p['FromPort'], p['ToPort'])
+                                for p in sg['IpPermissions']}
+
+    def test_pinned_d_zone_accepted(self, fake_aws):
+        from skypilot_tpu import catalog
+        from skypilot_tpu.clouds.aws import AWS
+        catalog.validate_region_zone('us-east-1', 'us-east-1d')
+        res = sky.Resources(cloud='aws', instance_type='m6i.large',
+                            region='us-east-1', zone='us-east-1d')
+        assert AWS().zones_for(res, 'us-east-1') == ['us-east-1d']

@@ -1,8 +1,10 @@
-"""FUSE mount script generation (gcsfuse-first).
+"""FUSE mount script generation (gcsfuse for GCS, rclone for S3).
 
-Counterpart of reference ``sky/data/mounting_utils.py:41-464`` (per-tool
-install + mount command builders wrapped in a guard script). Only the GCS
-path is generated here; the hermetic LocalStore "mounts" via symlink (see
+Counterpart of reference ``sky/data/mounting_utils.py:41-367`` (per-tool
+install + mount command builders wrapped in a guard script; the reference
+mounts S3 via goofys or rclone — rclone here: still maintained, single
+static binary, no per-host config file needed thanks to env-based remote
+config). The hermetic LocalStore "mounts" via symlink (see
 data/storage.py) so tests never need FUSE.
 """
 from __future__ import annotations
@@ -10,14 +12,29 @@ from __future__ import annotations
 import shlex
 
 GCSFUSE_VERSION = '2.4.0'
+RCLONE_VERSION = '1.67.0'
 
-_INSTALL_GCSFUSE = (
-    'command -v gcsfuse >/dev/null || { '
-    'ARCH=$(uname -m | grep -q aarch64 && echo arm64 || echo amd64); '
-    'curl -fsSL -o /tmp/gcsfuse.deb '
+
+def _deb_install(tool: str, url_template: str) -> str:
+    """Idempotent guard that downloads + installs a .deb for the host
+    arch. ``{arch}`` in the template expands to the shell's $ARCH.
+    Grouping matters: `apt-get install -f` only repairs a FAILED dpkg —
+    it must not mask a failed download (a bare `a && b || c` would run c,
+    exit 0, and defer the real error to a confusing 'command not
+    found' at mount time)."""
+    url = url_template.format(arch='$ARCH')
+    return (f'command -v {tool} >/dev/null || {{ '
+            'ARCH=$(uname -m | grep -q aarch64 && echo arm64 '
+            '|| echo amd64); '
+            f'curl -fsSL -o /tmp/{tool}.deb {url} && '
+            f'{{ sudo dpkg -i /tmp/{tool}.deb '
+            '|| sudo apt-get install -f -y; }; }')
+
+
+_INSTALL_GCSFUSE = _deb_install(
+    'gcsfuse',
     'https://github.com/GoogleCloudPlatform/gcsfuse/releases/download/'
-    f'v{GCSFUSE_VERSION}/gcsfuse_{GCSFUSE_VERSION}_$ARCH.deb && '
-    'sudo dpkg -i /tmp/gcsfuse.deb || sudo apt-get install -f -y; }')
+    f'v{GCSFUSE_VERSION}/gcsfuse_{GCSFUSE_VERSION}_{{arch}}.deb')
 
 
 def gcsfuse_mount_command(bucket: str, mount_point: str,
@@ -36,6 +53,42 @@ def gcsfuse_mount_command(bucket: str, mount_point: str,
         f'sudo chown $(id -u):$(id -g) {q(mount_point)} && '
         f'(mountpoint -q {q(mount_point)} || '
         f'gcsfuse --implicit-dirs {only_dir}{q(bucket)} {q(mount_point)})')
+
+
+_INSTALL_RCLONE = _deb_install(
+    'rclone',
+    'https://github.com/rclone/rclone/releases/download/'
+    f'v{RCLONE_VERSION}/rclone-v{RCLONE_VERSION}-linux-{{arch}}.deb')
+
+
+def rclone_s3_mount_command(bucket: str, mount_point: str,
+                            sub_path: str = '',
+                            read_only: bool = True) -> str:
+    """Idempotent install + rclone FUSE mount of an S3 bucket.
+
+    The remote is configured entirely through RCLONE_CONFIG_* env vars
+    (``env_auth`` picks up the instance role / AWS_* credentials) — no
+    config file to ship. Defaults to read-only: the realistic TPU story
+    is S3 as a dataset *source*; ``--vfs-cache-mode writes`` is enabled
+    only for read-write mounts. Reference counterpart:
+    sky/data/mounting_utils.py:41-367 (goofys/rclone S3 branch).
+    """
+    q = shlex.quote
+    src = f'skytpu-s3:{bucket}'
+    if sub_path:
+        src += f'/{sub_path}'
+    ro = '--read-only ' if read_only else '--vfs-cache-mode writes '
+    return (
+        f'{_INSTALL_RCLONE} && '
+        f'sudo mkdir -p {q(mount_point)} && '
+        f'sudo chown $(id -u):$(id -g) {q(mount_point)} && '
+        f'(mountpoint -q {q(mount_point)} || '
+        'RCLONE_CONFIG_SKYTPU_S3_TYPE=s3 '
+        'RCLONE_CONFIG_SKYTPU_S3_PROVIDER=AWS '
+        'RCLONE_CONFIG_SKYTPU_S3_ENV_AUTH=true '
+        f'rclone mount {q(src)} {q(mount_point)} '
+        f'--daemon --allow-non-empty {ro}'
+        '--dir-cache-time 30s --vfs-read-chunk-size 64M)')
 
 
 def unmount_command(mount_point: str) -> str:

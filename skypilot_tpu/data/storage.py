@@ -148,11 +148,10 @@ class S3Store(AbstractStore):
     """Amazon S3 via the aws CLI.
 
     Reference counterpart: sky/data/storage.py S3Store (:118-211 family).
-    The realistic TPU story is S3 as a *source* (datasets produced on AWS)
-    that COPY-materializes onto GCP hosts or transfers to GCS
-    (data/data_transfer.py); FUSE-mounting S3 on TPU-VMs is deliberately
-    unsupported — cross-cloud FUSE latency makes training input pipelines
-    stall, so the framework forces an explicit COPY/transfer decision.
+    The realistic TPU story is S3 as a *source* (datasets produced on
+    AWS): COPY materializes onto hosts, MOUNT is a read-only rclone FUSE
+    mount (cross-cloud FUSE writes are a data-loss trap; for outputs use
+    COPY-back or transfer the bucket to GCS via data/data_transfer.py).
     """
 
     SCHEME = 's3'
@@ -175,10 +174,15 @@ class S3Store(AbstractStore):
         return f'{self._ENSURE_AWS}aws s3 sync {q(src)} {q(self.url)}'
 
     def mount_command(self, mount_point: str) -> str:
-        raise exceptions.StorageError(
-            'MOUNT is not supported for s3:// on TPU hosts; use COPY, or '
-            'transfer the bucket to GCS first '
-            '(skypilot_tpu.data.data_transfer).')
+        """rclone FUSE mount, read-only (reference mounts S3 via
+        goofys/rclone, sky/data/mounting_utils.py:41-367).
+
+        Read-only by design: cross-cloud FUSE writes from TPU hosts are
+        a data-loss trap; for outputs use COPY or transfer the bucket to
+        GCS (data/data_transfer.py)."""
+        from skypilot_tpu.data import mounting_utils
+        return mounting_utils.rclone_s3_mount_command(
+            self.bucket, mount_point, self.sub_path, read_only=True)
 
     def upload_local(self, local_path: str) -> None:
         local_path = os.path.expanduser(local_path)

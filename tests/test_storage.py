@@ -190,8 +190,27 @@ class TestS3Store:
         s = S3Store('b', 'p')
         assert 'aws s3 sync' in s.download_command('/data')
         assert 's3://b/p' in s.upload_command('/src')
-        with pytest.raises(exceptions.StorageError, match='COPY'):
-            s.mount_command('/data')
+
+    def test_mount_command_rclone_read_only(self):
+        from skypilot_tpu.data.storage import S3Store
+        cmd = S3Store('bkt', 'sub/dir').mount_command('/data')
+        assert 'rclone mount' in cmd
+        assert 'skytpu-s3:bkt/sub/dir' in cmd
+        assert '--read-only' in cmd
+        assert 'RCLONE_CONFIG_SKYTPU_S3_ENV_AUTH=true' in cmd
+        # idempotency guard + install guard
+        assert 'mountpoint -q /data ||' in cmd
+        assert 'command -v rclone' in cmd
+        # no write-cache flags on a read-only mount
+        assert '--vfs-cache-mode' not in cmd
+
+    def test_mount_command_no_subpath_and_quoting(self):
+        from skypilot_tpu.data.mounting_utils import (
+            rclone_s3_mount_command)
+        cmd = rclone_s3_mount_command('bkt', '/my data', read_only=False)
+        assert 'rclone mount skytpu-s3:bkt ' in cmd
+        assert "'/my data'" in cmd
+        assert '--vfs-cache-mode writes' in cmd
 
 
 class TestTransfer:

@@ -265,6 +265,7 @@ class SliceBackend(backend_lib.Backend):
                 cluster_name, handle=handle,
                 requested_resources=task.resources, ready=False)
             self._post_provision_setup(handle, info)
+            self._write_ssh_config(handle, info)
             # resources.ports (task YAML `ports:`) open at provision time
             # (reference opens resources ports via provision/instance.py).
             ports = [str(p) for p in (launched.ports or ())]
@@ -610,8 +611,31 @@ class SliceBackend(backend_lib.Backend):
                                               handle.cluster_name,
                                               handle.region)
         self._post_provision_setup(handle, info)
+        self._write_ssh_config(handle, info)
         global_user_state.add_or_update_cluster(
             handle.cluster_name, handle=handle, ready=True)
+
+    @staticmethod
+    def _write_ssh_config(handle: backend_lib.ResourceHandle,
+                          info: provision_lib.ClusterInfo) -> None:
+        """Per-cluster ssh Host blocks so ``ssh <cluster>`` works
+        (reference SSHConfigHelper, sky/utils/cluster_utils.py:38).
+        SSH-reachable clouds only — local runs in-process, k8s execs
+        through kubectl."""
+        if handle.cloud in ('local', 'kubernetes'):
+            return
+        import importlib
+
+        from skypilot_tpu import authentication
+        from skypilot_tpu.utils import cluster_utils
+        # The provisioner owns the login-user knowledge (its runners use
+        # the same default); fall back to the platform-wide user.
+        mod = importlib.import_module(
+            provision_lib._CLOUD_MODULES[handle.cloud])  # pylint: disable=protected-access
+        user = getattr(mod, 'SSH_USER', authentication.SSH_USER)
+        key_path, _ = authentication.get_or_generate_keys()
+        ips = [h.external_ip or h.internal_ip for h in info.hosts]
+        cluster_utils.add_cluster(handle.cluster_name, ips, user, key_path)
 
     def teardown(self, handle: backend_lib.ResourceHandle,
                  terminate: bool = True) -> None:
@@ -629,3 +653,9 @@ class SliceBackend(backend_lib.Backend):
                                          handle.region)
             global_user_state.remove_cluster(handle.cluster_name,
                                              terminate=False)
+        # Only after the cloud op succeeded: a failed teardown leaves a
+        # live, billing cluster — its ssh alias must keep working for
+        # debugging. (Stopped clusters get fresh IPs on restart, so the
+        # config is stale either way; restart() rewrites it.)
+        from skypilot_tpu.utils import cluster_utils
+        cluster_utils.remove_cluster(handle.cluster_name)

@@ -211,6 +211,28 @@ def logs(cluster, job_id, no_follow):
     sys.exit(rc)
 
 
+@cli.command(context_settings=dict(ignore_unknown_options=True))
+@click.argument('cluster')
+@click.argument('command', nargs=-1, type=click.UNPROCESSED)
+def ssh(cluster, command):
+    """SSH into a cluster's head host (``skytpu ssh mycluster [cmd]``).
+
+    Uses the per-cluster Host block written at provision time (reference
+    SSHConfigHelper, sky/utils/cluster_utils.py:38); plain
+    ``ssh <cluster>`` works too once a cluster is UP.
+    """
+    import subprocess
+
+    from skypilot_tpu.utils import cluster_utils
+    argv = cluster_utils.head_ssh_args(cluster)
+    if argv is None:
+        raise click.ClickException(
+            f'No ssh config for cluster {cluster!r} — is it UP on an '
+            'SSH-reachable cloud? (local/kubernetes clusters have no '
+            'direct ssh)')
+    sys.exit(subprocess.call(argv + list(command)))
+
+
 @cli.command()
 @click.argument('cluster')
 @click.argument('job_ids', nargs=-1, type=int)

@@ -1,0 +1,102 @@
+"""Per-cluster SSH config: ``ssh <cluster>`` just works after launch.
+
+Counterpart of reference ``sky/utils/cluster_utils.py:38``
+(``SSHConfigHelper`` writes Host blocks into the user's ssh config on
+provision, removes them on down). Layout here: one file per cluster under
+``<state_dir>/ssh/<cluster>.conf`` plus a single ``Include`` directive
+prepended to the user ssh config (Include must appear before any Host
+block to apply globally). ``$SKYTPU_SSH_CONFIG`` overrides the user
+config path (tests point it into a temp dir).
+
+Host aliases: ``<cluster>`` = head (rank 0), ``<cluster>-<rank>`` for
+every host of a multi-host slice.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from skypilot_tpu import global_user_state
+
+_MARKER = '# Added by skytpu (cluster ssh config)'
+
+
+def _user_config_path() -> str:
+    return os.path.expanduser(
+        os.environ.get('SKYTPU_SSH_CONFIG', '~/.ssh/config'))
+
+
+def _cluster_dir() -> str:
+    return os.path.join(global_user_state.get_state_dir(), 'ssh')
+
+
+def cluster_config_path(cluster_name: str) -> str:
+    return os.path.join(_cluster_dir(), f'{cluster_name}.conf')
+
+
+def _ensure_include() -> None:
+    """Prepend ``Include <state>/ssh/*.conf`` to the user ssh config
+    (idempotent). Prepended, not appended: ssh applies Include inside the
+    scope of a preceding Host block, so it must come first."""
+    path = _user_config_path()
+    include_line = f'Include {_cluster_dir()}/*.conf'
+    content = ''
+    if os.path.exists(path):
+        with open(path) as f:
+            content = f.read()
+    if include_line in content:
+        return
+    os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
+    block = f'{_MARKER}\n{include_line}\n\n'
+    tmp = path + '.tmp'
+    with open(tmp, 'w') as f:
+        f.write(block + content)
+    os.replace(tmp, path)
+    os.chmod(path, 0o600)
+
+
+def add_cluster(cluster_name: str, ips: List[str], user: str,
+                key_path: str, ssh_port: int = 22) -> str:
+    """Write Host blocks for a provisioned cluster; returns the file."""
+    os.makedirs(_cluster_dir(), exist_ok=True)
+    lines = [f'{_MARKER}: {cluster_name}']
+    for rank, ip in enumerate(ips):
+        aliases = f'{cluster_name}-{rank}'
+        if rank == 0:
+            aliases = f'{cluster_name} {aliases}'
+        lines += [
+            f'Host {aliases}',
+            f'  HostName {ip}',
+            f'  User {user}',
+            f'  IdentityFile {key_path}',
+            f'  Port {ssh_port}',
+            '  IdentitiesOnly yes',
+            '  StrictHostKeyChecking no',
+            '  UserKnownHostsFile /dev/null',
+            '  LogLevel ERROR',
+            '',
+        ]
+    path = cluster_config_path(cluster_name)
+    tmp = path + '.tmp'
+    with open(tmp, 'w') as f:
+        f.write('\n'.join(lines))
+    os.replace(tmp, path)
+    os.chmod(path, 0o600)
+    _ensure_include()
+    return path
+
+
+def remove_cluster(cluster_name: str) -> None:
+    try:
+        os.remove(cluster_config_path(cluster_name))
+    except FileNotFoundError:
+        pass
+
+
+def head_ssh_args(cluster_name: str) -> Optional[List[str]]:
+    """argv for ``ssh`` to the cluster head using the written config
+    (None if no config exists — cluster not up or a local cluster)."""
+    path = cluster_config_path(cluster_name)
+    if not os.path.exists(path):
+        return None
+    return ['ssh', '-F', path, cluster_name]

@@ -1,0 +1,108 @@
+"""Per-cluster SSH config helper (reference SSHConfigHelper,
+sky/utils/cluster_utils.py:38): Host blocks written on provision,
+removed on down, Include prepended to the user config exactly once."""
+import os
+
+import pytest
+
+from skypilot_tpu.utils import cluster_utils
+
+
+@pytest.fixture
+def ssh_env(monkeypatch, tmp_path):
+    cfg = tmp_path / 'sshconfig'
+    monkeypatch.setenv('SKYTPU_SSH_CONFIG', str(cfg))
+    return cfg
+
+
+class TestSSHConfigHelper:
+
+    def test_add_writes_host_blocks_and_include(self, ssh_env):
+        path = cluster_utils.add_cluster(
+            'train1', ['35.0.0.1', '10.0.0.2'], 'skytpu', '/keys/id')
+        content = open(path).read()
+        assert 'Host train1 train1-0' in content
+        assert 'Host train1-1' in content
+        assert 'HostName 35.0.0.1' in content
+        assert 'IdentityFile /keys/id' in content
+        user_cfg = open(ssh_env).read()
+        assert user_cfg.startswith('# Added by skytpu')
+        assert 'Include' in user_cfg
+        assert oct(os.stat(path).st_mode & 0o777) == '0o600'
+
+    def test_include_prepended_once_and_preserves_existing(self, ssh_env):
+        ssh_env.write_text('Host myhost\n  HostName 1.2.3.4\n')
+        cluster_utils.add_cluster('c1', ['1.1.1.1'], 'u', '/k')
+        cluster_utils.add_cluster('c2', ['2.2.2.2'], 'u', '/k')
+        content = open(ssh_env).read()
+        assert content.count('Include') == 1
+        # Include comes BEFORE any Host block (ssh scoping rule).
+        assert content.index('Include') < content.index('Host myhost')
+
+    def test_remove_deletes_only_that_cluster(self, ssh_env):
+        cluster_utils.add_cluster('c1', ['1.1.1.1'], 'u', '/k')
+        cluster_utils.add_cluster('c2', ['2.2.2.2'], 'u', '/k')
+        cluster_utils.remove_cluster('c1')
+        assert not os.path.exists(cluster_utils.cluster_config_path('c1'))
+        assert os.path.exists(cluster_utils.cluster_config_path('c2'))
+        cluster_utils.remove_cluster('c1')  # idempotent
+
+    def test_head_ssh_args(self, ssh_env):
+        assert cluster_utils.head_ssh_args('nope') is None
+        cluster_utils.add_cluster('c1', ['1.1.1.1'], 'u', '/k')
+        argv = cluster_utils.head_ssh_args('c1')
+        assert argv[0] == 'ssh' and argv[-1] == 'c1'
+        assert '-F' in argv
+
+
+class TestProvisionIntegration:
+    """Fake-GCP provision writes the config; teardown removes it."""
+
+    def test_gce_provision_writes_and_down_removes(self, monkeypatch,
+                                                   tmp_path, ssh_env):
+        import re
+        from urllib.parse import urlparse
+
+        import skypilot_tpu as sky
+        from skypilot_tpu import core
+        from skypilot_tpu.provision import gcp_api
+        from tests.test_gcp_provision import FakeGcpCloud
+
+        fake = FakeGcpCloud()
+        gcp_api.set_transport(fake)
+        monkeypatch.setenv('SKYTPU_FAKE_GCP_CREDENTIALS', '1')
+        monkeypatch.setattr(
+            'skypilot_tpu.authentication.gcp_ssh_keys_metadata',
+            lambda: 'skytpu:ssh-ed25519 AAAA test')
+        key = tmp_path / 'id'
+        key.write_text('x')
+        (tmp_path / 'id.pub').write_text('ssh-ed25519 AAAA test')
+        monkeypatch.setattr(
+            'skypilot_tpu.authentication.get_or_generate_keys',
+            lambda: (str(key), str(key) + '.pub'))
+        from skypilot_tpu.clouds import gcp as gcp_cloud
+        monkeypatch.setattr(gcp_cloud.GCP, 'get_project_id',
+                            classmethod(lambda cls: 'test-proj'))
+        # Stop before runtime bring-up (fake hosts aren't SSH-able).
+        from skypilot_tpu.backends import slice_backend
+        monkeypatch.setattr(slice_backend.SliceBackend,
+                            '_post_provision_setup',
+                            lambda self, handle, info: None)
+
+        task = sky.Task(run='true')
+        task.set_resources(sky.Resources(cloud='gcp',
+                                         instance_type='n2-standard-2',
+                                         region='us-central1'))
+        try:
+            from skypilot_tpu import optimizer
+            optimizer.optimize(task, quiet=True)
+            slice_backend.SliceBackend().provision(task, 'sshc')
+            path = cluster_utils.cluster_config_path('sshc')
+            assert os.path.exists(path)
+            content = open(path).read()
+            assert 'Host sshc sshc-0' in content
+            assert 'HostName 35.' in content  # external ip preferred
+            core.down('sshc')
+            assert not os.path.exists(path)
+        finally:
+            gcp_api.set_transport(None)

@@ -264,8 +264,10 @@ class SliceBackend(backend_lib.Backend):
             global_user_state.add_or_update_cluster(
                 cluster_name, handle=handle,
                 requested_resources=task.resources, ready=False)
-            self._post_provision_setup(handle, info)
+            # ssh alias BEFORE runtime bring-up: if bring-up fails the
+            # cluster is alive and billing, and debugging it needs ssh.
             self._write_ssh_config(handle, info)
+            self._post_provision_setup(handle, info)
             # resources.ports (task YAML `ports:`) open at provision time
             # (reference opens resources ports via provision/instance.py).
             ports = [str(p) for p in (launched.ports or ())]
@@ -610,8 +612,8 @@ class SliceBackend(backend_lib.Backend):
         info = provision_lib.get_cluster_info(handle.cloud,
                                               handle.cluster_name,
                                               handle.region)
-        self._post_provision_setup(handle, info)
         self._write_ssh_config(handle, info)
+        self._post_provision_setup(handle, info)
         global_user_state.add_or_update_cluster(
             handle.cluster_name, handle=handle, ready=True)
 

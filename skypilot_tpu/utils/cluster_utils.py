@@ -39,7 +39,8 @@ def _ensure_include() -> None:
     (idempotent). Prepended, not appended: ssh applies Include inside the
     scope of a preceding Host block, so it must come first."""
     path = _user_config_path()
-    include_line = f'Include {_cluster_dir()}/*.conf'
+    # Quoted: an unquoted path with spaces parses as two include patterns.
+    include_line = f'Include "{_cluster_dir()}/*.conf"'
     content = ''
     if os.path.exists(path):
         with open(path) as f:
@@ -68,7 +69,7 @@ def add_cluster(cluster_name: str, ips: List[str], user: str,
             f'Host {aliases}',
             f'  HostName {ip}',
             f'  User {user}',
-            f'  IdentityFile {key_path}',
+            f'  IdentityFile "{key_path}"',
             f'  Port {ssh_port}',
             '  IdentitiesOnly yes',
             '  StrictHostKeyChecking no',

@@ -17,6 +17,11 @@ flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in flags:
     os.environ['XLA_FLAGS'] = (
         flags + ' --xla_force_host_platform_device_count=8').strip()
+# Blank (not unset): SUBPROCESSES spawned by tests — launched jax jobs on
+# the local cloud, serve replicas — must not grab the real tunneled TPU
+# either; a blank value stops the axon sitecustomize from registering the
+# backend while the runtime's stash/restore logic treats it as absent.
+os.environ['PALLAS_AXON_POOL_IPS'] = ''
 
 import pytest  # noqa: E402
 

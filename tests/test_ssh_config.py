@@ -24,7 +24,7 @@ class TestSSHConfigHelper:
         assert 'Host train1 train1-0' in content
         assert 'Host train1-1' in content
         assert 'HostName 35.0.0.1' in content
-        assert 'IdentityFile /keys/id' in content
+        assert 'IdentityFile "/keys/id"' in content
         user_cfg = open(ssh_env).read()
         assert user_cfg.startswith('# Added by skytpu')
         assert 'Include' in user_cfg

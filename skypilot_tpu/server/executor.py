@@ -21,6 +21,80 @@ from typing import Any, Callable, Dict, Optional
 
 from skypilot_tpu.server import requests_store as store
 
+
+class _ThreadAwareStdout:
+    """Per-thread stdout redirection for inline SHORT requests.
+
+    ``contextlib.redirect_stdout`` swaps the PROCESS-global sys.stdout —
+    with 8 concurrent SHORT dispatcher threads, one request's prints land
+    in another's log, and any other thread in an in-process server (tests,
+    embedding apps) can write into a since-closed request log. This proxy
+    is installed once; each dispatcher thread pushes/pops its own target
+    while every other thread keeps the real stdout.
+    """
+
+    def __init__(self, base):
+        self.base = base
+        self._local = threading.local()
+
+    def push(self, target) -> None:
+        self._local.target = target
+
+    def pop(self) -> None:
+        self._local.target = None
+
+    def _cur(self):
+        return getattr(self._local, 'target', None) or self.base
+
+    def write(self, s):
+        try:
+            return self._cur().write(s)
+        except ValueError:  # target/base closed (teardown, test capture)
+            fallback = sys.__stdout__
+            return fallback.write(s) if fallback is not None else 0
+
+    def flush(self):
+        try:
+            return self._cur().flush()
+        except ValueError:  # target closed mid-teardown
+            pass
+
+    def fileno(self):
+        return self.base.fileno()
+
+    def isatty(self):
+        # Redirected request threads are never a tty; everyone else keeps
+        # the real answer (spinners/ANSI in embedding processes).
+        if getattr(self._local, 'target', None) is not None:
+            return False
+        base_isatty = getattr(self.base, 'isatty', None)
+        return bool(base_isatty()) if base_isatty is not None else False
+
+    @property
+    def encoding(self):
+        return getattr(self.base, 'encoding', 'utf-8')
+
+
+_stdout_proxy: Optional[_ThreadAwareStdout] = None
+_stdout_lock = threading.Lock()
+
+
+def _thread_stdout() -> _ThreadAwareStdout:
+    """The ONE process-wide proxy. If external code swapped sys.stdout
+    (test capture, CLI piping), rebind the proxy's base to the new stdout
+    and reinstall — never create a second proxy, or threads mid-request
+    would lose their pushed targets."""
+    global _stdout_proxy
+    with _stdout_lock:
+        if _stdout_proxy is None:
+            _stdout_proxy = _ThreadAwareStdout(sys.stdout)
+            sys.stdout = _stdout_proxy
+        elif sys.stdout is not _stdout_proxy:
+            _stdout_proxy.base = sys.stdout
+            sys.stdout = _stdout_proxy
+    return _stdout_proxy
+
+
 # ---- entrypoints -----------------------------------------------------------
 
 
@@ -274,12 +348,15 @@ class Executor:
 
     @staticmethod
     def _run_inline(request_id: str, row: Dict[str, Any]) -> None:
-        import contextlib
         store.set_running(request_id, os.getpid())
         try:
-            with open(store.log_path(request_id), 'a', buffering=1) as log, \
-                    contextlib.redirect_stdout(log):
-                result = ENTRYPOINTS[row['name']](row['payload'] or {})
+            with open(store.log_path(request_id), 'a', buffering=1) as log:
+                proxy = _thread_stdout()
+                proxy.push(log)
+                try:
+                    result = ENTRYPOINTS[row['name']](row['payload'] or {})
+                finally:
+                    proxy.pop()
             store.finish(request_id, result=result)
         except Exception as e:  # noqa: BLE001
             store.finish(request_id, error=f'{type(e).__name__}: {e}')

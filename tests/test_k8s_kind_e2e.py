@@ -1,0 +1,90 @@
+"""Gated kind-backed e2e for the Kubernetes provider (reference
+tests/kubernetes/README.md:22-28 — `sky local up` smoke on kind).
+
+Runs ONLY when `kind` + `kubectl` are installed and a cluster can be
+created; skips cleanly otherwise (CI boxes without Docker). Unlike
+test_k8s_provision.py (in-process fake REST), this drives the REAL
+apiserver through the real kubectl transport, catching REST-shape drift
+the fake can't.
+"""
+import shutil
+import subprocess
+import time
+import uuid
+
+import pytest
+
+KIND_CLUSTER = 'skytpu-e2e'
+
+
+def _have_kind() -> bool:
+    return (shutil.which('kind') is not None
+            and shutil.which('kubectl') is not None)
+
+
+pytestmark = pytest.mark.skipif(
+    not _have_kind(), reason='kind/kubectl not installed')
+
+
+@pytest.fixture(scope='module')
+def kind_cluster(tmp_path_factory):
+    kubeconfig = str(tmp_path_factory.mktemp('kind') / 'kubeconfig')
+    create = subprocess.run(
+        ['kind', 'create', 'cluster', '--name', KIND_CLUSTER,
+         '--kubeconfig', kubeconfig, '--wait', '120s'],
+        capture_output=True, text=True, timeout=600)
+    if create.returncode != 0:
+        pytest.skip(f'kind cluster creation failed: '
+                    f'{create.stderr[-300:]}')
+    yield kubeconfig
+    subprocess.run(['kind', 'delete', 'cluster', '--name', KIND_CLUSTER],
+                   capture_output=True, timeout=300)
+
+
+@pytest.mark.slow
+class TestKindE2E:
+
+    def test_pod_launch_exec_down(self, kind_cluster, monkeypatch, capfd):
+        """launch -> job runs in a real pod -> logs -> down, through the
+        real kubectl runner (no fakes)."""
+        monkeypatch.setenv('KUBECONFIG', kind_cluster)
+
+        import skypilot_tpu as sky
+        from skypilot_tpu import core, execution
+        from skypilot_tpu.clouds.kubernetes import Kubernetes
+        from skypilot_tpu.runtime import job_lib
+
+        ok, reason = Kubernetes.check_credentials()
+        assert ok, f'kind cluster up but credentials check failed: {reason}'
+
+        name = f'kind-{uuid.uuid4().hex[:6]}'
+        task = sky.Task(run='echo kind-says-$((40 + 2))')
+        task.set_resources([sky.Resources(cloud='kubernetes', cpus='1+')])
+        job_id, handle = execution.launch(task, cluster_name=name,
+                                         detach_run=True,
+                                         stream_logs=False)
+        try:
+            assert handle.cloud == 'kubernetes'
+            deadline = time.time() + 300
+            status = None
+            while time.time() < deadline:
+                status = core.job_status(name, job_id)
+                if status and job_lib.JobStatus(status).is_terminal():
+                    break
+                time.sleep(2)
+            assert status == 'SUCCEEDED', status
+            # Logs flow back through the kubectl-exec runner.
+            core.tail_logs(name, job_id, follow=False)
+            assert 'kind-says-42' in capfd.readouterr().out
+        finally:
+            core.down(name)
+
+    def test_query_states_match_real_pods(self, kind_cluster, monkeypatch):
+        monkeypatch.setenv('KUBECONFIG', kind_cluster)
+        from skypilot_tpu.provision import k8s_api
+        pods = k8s_api.PodClient().list_pods(
+            label_selector='skytpu-cluster')
+        # After the previous test's down, no skytpu pods remain.
+        assert pods == [] or all(
+            p.get('status', {}).get('phase') in ('Succeeded', 'Failed')
+            for p in pods)

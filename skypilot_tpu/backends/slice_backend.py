@@ -298,6 +298,12 @@ class SliceBackend(backend_lib.Backend):
         if handle.cloud != 'local':
             self._sync_runtime_code(runners)
 
+        from skypilot_tpu.provision import docker_utils
+        image_id = getattr(handle.launched_resources, 'image_id', None)
+        docker_boot = (docker_utils.bootstrap_command(image_id)
+                       if docker_utils.is_docker_image(image_id)
+                       and handle.cloud != 'kubernetes' else None)
+
         def bring_up(rank: int, runner) -> None:
             cmds = [
                 f'mkdir -p {rtdir} {rt_constants.WORKDIR}',
@@ -309,6 +315,14 @@ class SliceBackend(backend_lib.Backend):
                 raise exceptions.ProvisionError(
                     f'runtime dir setup failed on rank {rank}: '
                     f'{res.stderr or res.stdout}')
+            if docker_boot is not None:
+                # image_id: docker:<img> — install docker + pre-pull the
+                # image so the first job doesn't pay for it.
+                res = runner.run(docker_boot, timeout=900)
+                if res.returncode != 0:
+                    raise exceptions.ProvisionError(
+                        f'docker bootstrap failed on rank {rank}: '
+                        f'{(res.stderr or res.stdout)[-500:]}')
             if rank == 0:
                 tick = (rt_constants.AGENT_TICK_LOCAL
                         if handle.cloud == 'local'
@@ -516,6 +530,14 @@ class SliceBackend(backend_lib.Backend):
             # (runtime/job_lib.next_pending_job scheduling rules).
             'exclusive': handle.launched_resources.tpu is not None,
         }
+        from skypilot_tpu.provision import docker_utils
+        image_id = handle.launched_resources.image_id
+        if docker_utils.is_docker_image(image_id) \
+                and handle.cloud != 'kubernetes':
+            # Ranks run inside containers (image pre-pulled at
+            # provision). Not on k8s: there the pod IS the container
+            # (clouds/kubernetes maps the image onto the pod spec).
+            spec['docker_image'] = image_id
         name = task.name or handle.cluster_name
         args = (f'add --name {shlex.quote(name)} '
                 f'--username {shlex.quote(common_utils.get_user_name())} '

@@ -122,6 +122,10 @@ class AWS(cloud_lib.Cloud):
     def make_deploy_variables(self, resources, cluster_name_on_cloud: str,
                               region: str,
                               zone: Optional[str]) -> Dict[str, Any]:
+        from skypilot_tpu.provision import docker_utils
+        image_id = resources.image_id
+        if docker_utils.is_docker_image(image_id):
+            image_id = None  # stock AMI; ranks run in the container
         return {
             'cloud': self.NAME,
             'mode': 'ec2',
@@ -133,5 +137,5 @@ class AWS(cloud_lib.Cloud):
             'labels': dict(resources.labels or {}),
             'ports': list(resources.ports or ()),
             'instance_type': resources.instance_type,
-            'image_id': resources.image_id,
+            'image_id': image_id,
         }

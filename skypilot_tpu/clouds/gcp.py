@@ -202,9 +202,15 @@ class GCP(cloud_lib.Cloud):
                 'reserved': resources.reserved,
             })
         else:
+            from skypilot_tpu.provision import docker_utils
+            image_id = resources.image_id
+            if docker_utils.is_docker_image(image_id):
+                # Container tasks boot a stock host image; the backend
+                # bootstraps docker + runs ranks in the container.
+                image_id = None
             base.update({
                 'mode': 'gce',
                 'instance_type': resources.instance_type,
-                'image_family': resources.image_id or 'ubuntu-2204-lts',
+                'image_family': image_id or 'ubuntu-2204-lts',
             })
         return base

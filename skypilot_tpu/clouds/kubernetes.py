@@ -25,6 +25,7 @@ class Kubernetes(cloud_lib.Cloud):
         cloud_lib.CloudFeature.OPEN_PORTS,
         cloud_lib.CloudFeature.AUTOSTOP,   # autostop hook tears pods down
         cloud_lib.CloudFeature.STORAGE_MOUNTS,
+        cloud_lib.CloudFeature.CUSTOM_IMAGES,  # pod image (docker: too)
         # no STOP (pods), no SPOT (preemption comes from the node pool)
     })
 
@@ -69,12 +70,19 @@ class Kubernetes(cloud_lib.Cloud):
     def make_deploy_variables(self, resources, cluster_name_on_cloud: str,
                               region: str,
                               zone: Optional[str]) -> Dict[str, Any]:
+        # `image_id: docker:<img>` maps straight onto the pod image —
+        # pods ARE containers, no docker-in-docker (VM clouds handle the
+        # prefix via provision/docker_utils instead).
+        from skypilot_tpu.provision import docker_utils
+        image = resources.image_id
+        if docker_utils.is_docker_image(image):
+            image = docker_utils.image_name(image)
         out: Dict[str, Any] = {
             'cloud': self.NAME,
             'cluster_name_on_cloud': cluster_name_on_cloud,
             'namespace': config_lib.get_nested(
                 ('kubernetes', 'namespace'), 'default'),
-            'image': (resources.image_id or config_lib.get_nested(
+            'image': (image or config_lib.get_nested(
                 ('kubernetes', 'image'), None)),
             'num_hosts': resources.num_hosts,
         }

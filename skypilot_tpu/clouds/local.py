@@ -36,6 +36,8 @@ class Local(cloud_lib.Cloud):
         # SPOT accepted so spot-serving paths run hermetically; actual
         # preemption is still injected by tests (nothing preempts here).
         cloud_lib.CloudFeature.SPOT,
+        # docker: image tasks run hermetically with a stub docker binary.
+        cloud_lib.CloudFeature.CUSTOM_IMAGES,
     })
 
     @classmethod

@@ -1,0 +1,79 @@
+"""Docker image runtime for VM hosts: ``image_id: docker:<image>`` tasks.
+
+Counterpart of reference ``sky/provision/docker_utils.py:1-447``
+(DockerInitializer: install docker, login, pull, run). Architectural
+difference: the reference keeps ONE long-lived container per host and
+``docker exec``s every command into it; here the host runs the runtime
+(agent/job queue) and each JOB RANK runs as its own ``docker run``
+container. That keeps the existing process-group lifecycle intact —
+``docker run`` stays attached with --sig-proxy, so the agent's
+setsid/kill -TERM cancellation and exit-code propagation work unchanged,
+and a finished job leaves no container behind (--rm).
+
+The home directory mounts into the container at the same path, so the
+shipped workdir, runtime dir (logs, compile cache), and checkpoints are
+shared between host and container.
+"""
+from __future__ import annotations
+
+import shlex
+from typing import Dict
+
+DOCKER_PREFIX = 'docker:'
+
+
+def is_docker_image(image_id) -> bool:
+    return bool(image_id) and str(image_id).startswith(DOCKER_PREFIX)
+
+
+def image_name(image_id: str) -> str:
+    assert is_docker_image(image_id), image_id
+    return image_id[len(DOCKER_PREFIX):]
+
+
+def bootstrap_command(image_id: str) -> str:
+    """Idempotent per-host bring-up: install docker (Ubuntu), enable the
+    daemon, grant the login user access, pre-pull the image so the first
+    job doesn't pay the pull (reference DockerInitializer.initialize)."""
+    img = shlex.quote(image_name(image_id))
+    # $SUDO resolves empty when running as root / sudo-less images.
+    return (
+        'SUDO=$(command -v sudo || true); '
+        'command -v docker >/dev/null || { '
+        '$SUDO apt-get update -qq && '
+        '$SUDO apt-get install -y -qq docker.io && '
+        '$SUDO systemctl enable --now docker; }; '
+        '$SUDO usermod -aG docker "$(id -un)" 2>/dev/null || true; '
+        f'$SUDO docker pull -q {img}')
+
+
+def run_in_container_command(image_id: str, container_name: str,
+                             script: str, env: Dict[str, str],
+                             workdir: str) -> str:
+    """One rank's job as an attached ``docker run``.
+
+    - ``--network host``: the SKYTPU_* rank contract (coordinator ports,
+      MEGASCALE) must resolve exactly as on the host.
+    - ``$HOME`` bind-mount at the same path + ``-w`` into the shipped
+      workdir: container sees the same filesystem contract as a host job.
+    - attached + default sig-proxy: the agent's kill -TERM on the process
+      group reaches the container's PID 1; --rm reaps it.
+    - TPU-VM hosts pass the accelerator through with --privileged (the
+      reference's docker runs do the same for GPUs via nvidia runtime).
+    """
+    img = shlex.quote(image_name(image_id))
+    env_flags = ' '.join(
+        f'-e {shlex.quote(f"{k}={v}")}' for k, v in env.items())
+    # Plain `docker` (not sudo): bootstrap added the login user to the
+    # docker group, and each runner command is a fresh shell session.
+    # --user: container writes into the bind-mounted $HOME as the login
+    # user, not root — root-owned droppings would break the next
+    # launch's rsync --delete workdir sync.
+    return (
+        f'docker rm -f {shlex.quote(container_name)} '
+        '>/dev/null 2>&1 || true; '
+        f'exec docker run --rm --name {shlex.quote(container_name)} '
+        '--network host --privileged --user "$(id -u):$(id -g)" '
+        '-v "$HOME:$HOME" -e HOME="$HOME" '
+        f'-w "$HOME/{workdir}" {env_flags} {img} '
+        f'bash -c {shlex.quote(script)}')

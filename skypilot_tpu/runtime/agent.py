@@ -54,12 +54,24 @@ def dump_cluster_info(info: provision_lib.ClusterInfo) -> str:
     }, indent=2)
 
 
+def container_name(pid_file: str) -> str:
+    """Stable container name for a docker-image job rank, derived from
+    its pidfile (.skytpu_job_<id>_rank<r>.pid -> skytpu_job_<id>_rank<r>)
+    so the run and kill paths always agree."""
+    name = pid_file.lstrip('.')
+    return name[:-4] if name.endswith('.pid') else name
+
+
 def make_job_command(spec: Dict[str, Any], rank: int, env: Dict[str, str],
                      pid_file: str) -> str:
-    """Build the per-host shell command for one rank of a job."""
+    """Build the per-host shell command for one rank of a job.
+
+    ``spec['docker_image']`` (task ``image_id: docker:<img>``) runs the
+    rank inside an attached container instead (provision/docker_utils):
+    same pidfile/setsid lifecycle — docker run proxies SIGTERM to the
+    container — so cancellation and exit codes are identical.
+    """
     workdir = spec.get('workdir') or constants.WORKDIR
-    exports = ' '.join(f'export {k}={shlex.quote(v)};'
-                       for k, v in env.items())
     script = spec['run_script']
     # Persistent XLA compilation cache, host-local ($PWD here is the
     # runner's start dir: the host home). Warm relaunches then skip
@@ -68,13 +80,29 @@ def make_job_command(spec: Dict[str, Any], rank: int, env: Dict[str, str],
     # override the path (exports run after and win).
     cache = ('export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE'
              f'_DIR:-$PWD/{constants.RUNTIME_DIR}/jax_cache}}"; ')
-    # setsid: new process group whose pgid == the leader pid written to the
-    # pidfile, so cancellation can kill the whole tree without touching the
-    # agent's own group (local runners share the agent's session).
-    inner = (f'echo $$ > {shlex.quote(pid_file)}; {cache}{exports} '
-             'mkdir -p "$JAX_COMPILATION_CACHE_DIR"; '
-             f'cd {shlex.quote(workdir)} 2>/dev/null || cd ~; '
-             + script)
+    docker_image = spec.get('docker_image')
+    if docker_image:
+        from skypilot_tpu.provision import docker_utils
+        # Cache anchored at $HOME (bind-mounted, survives relaunches):
+        # docker -w already moved $PWD into the rsync --delete'd workdir,
+        # which would wipe the cache on every relaunch.
+        docker_cache = cache.replace('$PWD/', '$HOME/')
+        body = (f'{docker_cache}mkdir -p "$JAX_COMPILATION_CACHE_DIR"; '
+                + script)
+        run = docker_utils.run_in_container_command(
+            docker_image, container_name(pid_file), body, env, workdir)
+        inner = f'echo $$ > {shlex.quote(pid_file)}; {run}'
+    else:
+        exports = ' '.join(f'export {k}={shlex.quote(v)};'
+                           for k, v in env.items())
+        # setsid: new process group whose pgid == the leader pid written
+        # to the pidfile, so cancellation can kill the whole tree without
+        # touching the agent's own group (local runners share the agent's
+        # session).
+        inner = (f'echo $$ > {shlex.quote(pid_file)}; {cache}{exports} '
+                 'mkdir -p "$JAX_COMPILATION_CACHE_DIR"; '
+                 f'cd {shlex.quote(workdir)} 2>/dev/null || cd ~; '
+                 + script)
     return f'mkdir -p {shlex.quote(workdir)}; setsid bash -c {shlex.quote(inner)}'
 
 
@@ -165,14 +193,21 @@ class JobDriver(threading.Thread):
                 f.write(f'per-rank return codes: {results}\n')
 
     def _kill_all(self, runners) -> None:
+        docker = bool(self.job['spec'].get('docker_image'))
         for rank, runner in enumerate(runners):
             pid_file = self._pid_file(rank)
+            # SIGKILL on the group kills only the attached docker CLIENT
+            # (KILL cannot be sig-proxied) — the container must be
+            # removed by name or it would keep running (and holding the
+            # chips) under dockerd.
+            rmc = (f'docker rm -f {container_name(pid_file)} '
+                   '>/dev/null 2>&1; ' if docker else '')
             try:
                 runner.run(
                     f'test -f {pid_file} && kill -TERM -- -$(cat {pid_file}) '
                     f'2>/dev/null; sleep 1; '
                     f'test -f {pid_file} && kill -KILL -- -$(cat {pid_file}) '
-                    f'2>/dev/null; rm -f {pid_file}; true',
+                    f'2>/dev/null; {rmc}rm -f {pid_file}; true',
                     timeout=30)
             except Exception:
                 pass

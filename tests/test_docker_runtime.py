@@ -1,0 +1,218 @@
+"""Docker image runtime (``image_id: docker:<img>``), reference
+sky/provision/docker_utils.py:1-447.
+
+E2E on the local cloud with a stub ``docker`` binary on PATH: the stub
+records its argv (bootstrap pull + per-rank ``docker run``) and executes
+the containerized command locally — so the full command path (bootstrap
+-> env flags -> workdir -> script-in-container -> exit code) runs for
+real without a docker daemon.
+"""
+import os
+import stat
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu.provision import docker_utils
+from skypilot_tpu.runtime import agent as agent_lib
+
+_FAKE_DOCKER = r'''#!/usr/bin/env bash
+echo "docker $*" >> "$FAKE_DOCKER_LOG"
+cmd="$1"; shift
+case "$cmd" in
+  pull|rm) exit 0 ;;
+  run)
+    envs=(); wd=""
+    while [[ $# -gt 0 ]]; do
+      case "$1" in
+        --rm|--privileged) shift ;;
+        --network|--name|-v|--user) shift 2 ;;
+        -w) wd="$2"; shift 2 ;;
+        -e) envs+=("$2"); shift 2 ;;
+        *) break ;;
+      esac
+    done
+    shift  # image
+    mkdir -p "$wd" 2>/dev/null && cd "$wd"
+    exec env "${envs[@]}" "$@"
+    ;;
+  *) echo "fake docker: unknown $cmd" >&2; exit 64 ;;
+esac
+'''
+
+
+@pytest.fixture
+def fake_docker(monkeypatch, tmp_path):
+    bin_dir = tmp_path / 'bin'
+    bin_dir.mkdir()
+    stub = bin_dir / 'docker'
+    stub.write_text(_FAKE_DOCKER)
+    stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+    log = tmp_path / 'docker_calls.log'
+    log.write_text('')
+    monkeypatch.setenv('PATH', f'{bin_dir}:{os.environ["PATH"]}')
+    monkeypatch.setenv('FAKE_DOCKER_LOG', str(log))
+    return log
+
+
+class TestCommandGeneration:
+
+    def test_is_docker_image(self):
+        assert docker_utils.is_docker_image('docker:python:3.11')
+        assert not docker_utils.is_docker_image('ubuntu-2204-lts')
+        assert not docker_utils.is_docker_image(None)
+        assert docker_utils.image_name('docker:a/b:v1') == 'a/b:v1'
+
+    def test_bootstrap_pulls_image(self):
+        cmd = docker_utils.bootstrap_command('docker:python:3.11')
+        assert 'docker pull -q python:3.11' in cmd
+        assert 'apt-get install -y -qq docker.io' in cmd
+
+    def test_job_command_wraps_in_docker_run(self):
+        spec = {'run_script': 'echo hi', 'docker_image': 'docker:img:v1',
+                'workdir': 'wd'}
+        cmd = agent_lib.make_job_command(
+            spec, rank=0, env={'K': 'v space'},
+            pid_file='.skytpu_job_7_rank0.pid')
+        assert 'docker run --rm --name skytpu_job_7_rank0' in cmd
+        assert '--network host' in cmd
+        assert 'K=v space' in cmd  # env flag survives nested quoting
+        assert 'img:v1' in cmd
+        # Host-side pidfile + setsid lifecycle preserved.
+        assert 'setsid bash -c' in cmd
+        assert '.skytpu_job_7_rank0.pid' in cmd
+
+    def test_plain_job_command_unchanged(self):
+        spec = {'run_script': 'echo hi', 'workdir': 'wd'}
+        cmd = agent_lib.make_job_command(spec, 0, {'K': 'v'}, '.p.pid')
+        assert 'docker' not in cmd
+
+    def test_cloud_deploy_vars_strip_docker_image(self):
+        from skypilot_tpu.clouds.aws import AWS
+        from skypilot_tpu.clouds.gcp import GCP
+        res = sky.Resources(cloud='aws', instance_type='m6i.large',
+                            image_id='docker:python:3.11')
+        dv = AWS().make_deploy_variables(res, 'c-1', 'us-east-1',
+                                         'us-east-1a')
+        assert dv['image_id'] is None  # stock AMI boots the host
+        res = sky.Resources(cloud='gcp', instance_type='n2-standard-2',
+                            image_id='docker:python:3.11')
+        import unittest.mock as mock
+        with mock.patch.object(GCP, 'get_project_id',
+                               classmethod(lambda cls: 'p')):
+            dv = GCP().make_deploy_variables(res, 'c-1', 'us-central1',
+                                             'us-central1-a')
+        assert dv['image_family'] == 'ubuntu-2204-lts'
+
+
+class TestDockerE2E:
+
+    def test_launch_runs_inside_container_path(self, fake_docker):
+        """launch -> bootstrap pull recorded -> rank executes through
+        `docker run` (stub) -> logs + exit code flow back -> down."""
+        from skypilot_tpu import core, execution
+        from skypilot_tpu.runtime import job_lib
+
+        task = sky.Task(run='echo from-container-$MARKER; pwd',
+                        envs={'MARKER': 'xyz'})
+        task.set_resources([sky.Resources(cloud='local',
+                                          image_id='docker:busybox:1.36')])
+        job_id, handle = execution.launch(task, cluster_name='dock1',
+                                          detach_run=True,
+                                          stream_logs=False)
+        try:
+            deadline = time.time() + 120
+            status = None
+            while time.time() < deadline:
+                status = core.job_status('dock1', job_id)
+                if status and job_lib.JobStatus(status).is_terminal():
+                    break
+                time.sleep(0.3)
+            assert status == 'SUCCEEDED', status
+
+            calls = fake_docker.read_text()
+            assert 'docker pull -q busybox:1.36' in calls  # bootstrap
+            assert 'docker run --rm --name skytpu_job_1_rank0' in calls
+            assert '--network host' in calls
+
+            import io
+            from skypilot_tpu.provision import local_impl
+            from skypilot_tpu.runtime import log_lib
+            info = local_impl.get_cluster_info('dock1', 'local')
+            rtdir = os.path.join(info.hosts[0].extra['host_dir'],
+                                 '.skytpu-runtime')
+            buf = io.StringIO()
+            log_lib.tail_logs(rtdir, job_id, follow=False, out=buf)
+            assert 'from-container-xyz' in buf.getvalue()
+        finally:
+            core.down('dock1')
+
+    def test_failing_container_job_reports_failure(self, fake_docker):
+        from skypilot_tpu import core, execution
+        from skypilot_tpu.runtime import job_lib
+
+        task = sky.Task(run='exit 3')
+        task.set_resources([sky.Resources(cloud='local',
+                                          image_id='docker:busybox:1.36')])
+        job_id, _ = execution.launch(task, cluster_name='dock2',
+                                     detach_run=True, stream_logs=False)
+        try:
+            deadline = time.time() + 120
+            status = None
+            while time.time() < deadline:
+                status = core.job_status('dock2', job_id)
+                if status and job_lib.JobStatus(status).is_terminal():
+                    break
+                time.sleep(0.3)
+            assert status == 'FAILED', status
+        finally:
+            core.down('dock2')
+
+
+class TestCancelAndK8s:
+
+    def test_cancel_removes_container_by_name(self, fake_docker):
+        """Cancellation must docker rm -f the container: SIGKILL on the
+        process group only reaches the attached client."""
+        from skypilot_tpu import core, execution
+        from skypilot_tpu.runtime import job_lib
+
+        task = sky.Task(run='sleep 300')
+        task.set_resources([sky.Resources(cloud='local',
+                                          image_id='docker:busybox:1.36')])
+        job_id, _ = execution.launch(task, cluster_name='dock3',
+                                     detach_run=True, stream_logs=False)
+        try:
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                if core.job_status('dock3', job_id) == 'RUNNING':
+                    break
+                time.sleep(0.3)
+            core.cancel('dock3', [job_id])
+            deadline = time.time() + 60
+            status = None
+            while time.time() < deadline:
+                status = core.job_status('dock3', job_id)
+                if status and job_lib.JobStatus(status).is_terminal():
+                    break
+                time.sleep(0.3)
+            assert status == 'CANCELLED', status
+            assert 'docker rm -f skytpu_job_1_rank0' \
+                in fake_docker.read_text()
+        finally:
+            core.down('dock3')
+
+    def test_k8s_maps_docker_image_onto_pod(self, monkeypatch):
+        """No docker-in-docker on k8s: the pod image IS the image."""
+        from skypilot_tpu.clouds.kubernetes import Kubernetes
+        res = sky.Resources(cloud='kubernetes',
+                            image_id='docker:myrepo/img:v2', cpus='1+')
+        dv = Kubernetes().make_deploy_variables(res, 'c-1', 'in-cluster',
+                                                None)
+        assert dv['image'] == 'myrepo/img:v2'
+
+    def test_docker_run_sets_user(self):
+        cmd = docker_utils.run_in_container_command(
+            'docker:img', 'cnt', 'true', {}, 'wd')
+        assert '--user "$(id -u):$(id -g)"' in cmd

@@ -71,11 +71,17 @@ def _workload():
     on_tpu = backend in ('tpu', 'axon')
     if on_tpu:
         # Largest preset whose ~10N-byte train state + activations fit one
-        # chip's HBM (v5e: 16GB). 'names' remat (selective: keep attention
-        # context + SwiGLU product) + Pallas flash fwd/bwd; measured best
-        # of {dots, names} x {batch 1, 2} at seq 8192 on v5e.
+        # chip's HBM (v5e: 16GB). 'names_qkv' remat (selective: keep
+        # attention context + SwiGLU product + post-rotary Q/K/V) +
+        # Pallas flash fwd/bwd; measured best of {dots, names, names_qkv,
+        # names_offload} x {batch 1, 2} at seq 8192 on v5e (names_qkv is
+        # +3.2% over names in interleaved A/B; offload loses 33%; the
+        # flash kernels run at 41% fwd / 65% bwd of bf16 peak, so the
+        # 6N-only MFU gap to the with-attention figure is accounting,
+        # not kernel inefficiency).
         preset, batch, seq, steps = 'llama-1b', 1, 8192, 8
-        config = dataclasses.replace(PRESETS[preset], remat_policy='names')
+        config = dataclasses.replace(PRESETS[preset],
+                                     remat_policy='names_qkv')
     else:  # CPU fallback so the bench always emits a record
         preset, batch, seq, steps = 'test-tiny', 4, 256, 4
         config = PRESETS[preset]

@@ -43,7 +43,8 @@ def parse_args(argv=None):
                    help="cross-slice data-parallel degree; 'auto' = the "
                         'number of ganged slices (SKYTPU_NUM_SLICES)')
     p.add_argument('--remat', default=None,
-                   help="remat policy override ('none'/'dots'/'full')")
+                   help="remat policy override ('none'/'full'/'dots'/"
+                        "'names'/'names_qkv'/'names_offload')")
     p.add_argument('--ckpt-dir', default=None)
     p.add_argument('--save-every', type=int, default=50)
     p.add_argument('--log-every', type=int, default=10)

@@ -158,3 +158,34 @@ class TestLlama:
         np.testing.assert_allclose(np.asarray(dec_logits),
                                    np.asarray(logits[:, -1]), atol=2e-4)
         assert int(cache['length']) == 8
+
+
+class TestRematPolicies:
+    """Every device-memory remat policy compiles and produces the same
+    loss (remat trades memory for recompute; the math must be identical).
+    'names_offload' is excluded: it needs a pinned_host memory space,
+    which the CPU test backend does not model."""
+
+    def test_policies_agree(self):
+        import dataclasses
+        import jax
+        import jax.numpy as jnp
+
+        from skypilot_tpu.models.llama import PRESETS, LlamaModel
+        from skypilot_tpu.train import Trainer
+
+        losses = {}
+        for policy in ('full', 'dots', 'names', 'names_qkv'):
+            cfg = dataclasses.replace(PRESETS['test-tiny'], remat=True,
+                                      remat_policy=policy)
+            tr = Trainer(LlamaModel(cfg))
+            state = tr.init_fn()(jax.random.key(0))
+            tok = jax.random.randint(jax.random.key(1), (2, 64), 0,
+                                     cfg.vocab_size)
+            batch = tr.shard_batch({'tokens': tok,
+                                    'targets': jnp.roll(tok, -1, 1)})
+            _, metrics = tr.step_fn()(state, batch)
+            losses[policy] = float(metrics['loss'])
+        base = losses['full']
+        for policy, loss in losses.items():
+            assert abs(loss - base) < 1e-4, losses

@@ -10,13 +10,22 @@ import skypilot_tpu as sky
 EXAMPLES_DIR = os.path.join(os.path.dirname(__file__), '..', 'examples')
 
 
+# Examples that deliberately target CPU instances (no accelerators).
+_CPU_EXAMPLES = {'aws_cpu_task.yaml', 'docker_task.yaml'}
+
+
 @pytest.mark.parametrize('path', sorted(
     glob.glob(os.path.join(EXAMPLES_DIR, '*.yaml'))))
 def test_example_yaml_parses(path):
     task = sky.Task.from_yaml(path)
     assert task.run, f'{path} has no run section'
-    for res in task.resources:
-        assert res.accelerators is not None
+    if os.path.basename(path) in _CPU_EXAMPLES:
+        # Keep the exemption honest: these must actually be CPU-only.
+        for res in task.resources:
+            assert res.accelerators is None
+    else:
+        for res in task.resources:
+            assert res.accelerators is not None
     if 'serve' in os.path.basename(path):
         assert task.service is not None
         assert task.service.replica_policy.min_replicas >= 1

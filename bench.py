@@ -153,7 +153,10 @@ def run_launched(preset: str, batch: int, seq: int, steps: int,
         job_id, _ = execution.launch(task, cluster_name='bench-launched',
                                      detach_run=True, stream_logs=False,
                                      fast=fast)
-        deadline = time_lib.time() + 3600
+        # Worst healthy case is ~2 min of compile + seconds of steps; a
+        # 15-min ceiling keeps a wedged chip/tunnel from eating the whole
+        # bench window (the record then carries the non-terminal status).
+        deadline = time_lib.time() + 900
         status = None
         while time_lib.time() < deadline:
             try:

@@ -233,6 +233,26 @@ def ssh(cluster, command):
     sys.exit(subprocess.call(argv + list(command)))
 
 
+@cli.command(context_settings=dict(ignore_unknown_options=True))
+@click.argument('cluster')
+@click.argument('command', nargs=-1, required=True,
+                type=click.UNPROCESSED)
+def shell(cluster, command):
+    """Run a command on a cluster head THROUGH the API server.
+
+    The exec path for clusters you can't ssh to directly — Kubernetes
+    pods, or any cluster managed by a shared remote API server
+    (reference websocket ssh proxy, sky/server/server.py:1016). For VM
+    clouds with direct reachability, `skytpu ssh` is interactive.
+    """
+    from skypilot_tpu import exceptions
+    from skypilot_tpu.client import sdk
+    try:
+        sys.exit(sdk.shell(cluster, ' '.join(command)))
+    except exceptions.ApiServerConnectionError as e:
+        raise click.ClickException(str(e))
+
+
 @cli.command()
 @click.argument('cluster')
 @click.argument('job_ids', nargs=-1, type=int)

@@ -245,6 +245,54 @@ def serve_update(task, service_name: str) -> str:
                                    'service_name': service_name})
 
 
+def shell(cluster_name: str, command: str, out=None,
+          timeout_s: float = 3600) -> int:
+    """Stream a command on a cluster's head host through the API server
+    (the exec path for k8s pods / remote servers; reference websocket
+    ssh proxy, sky/server/server.py:1016). Returns the exit code.
+
+    ``timeout_s`` is enforced server-side; the client socket caps out at
+    ~3700s regardless (the _conn timeout), so longer-running commands
+    should go through the job queue (`exec`) instead."""
+    import re
+    out = out or sys.stdout
+    conn = _conn()
+    try:
+        payload = json.dumps({'cluster_name': cluster_name,
+                              'command': command,
+                              'timeout_s': timeout_s}).encode()
+        headers = dict(_headers())
+        headers['Content-Type'] = 'application/json'
+        conn.request('POST', '/api/v1/shell', body=payload,
+                     headers=headers)
+        resp = conn.getresponse()
+        if resp.status >= 400:
+            data = resp.read().decode(errors='replace')
+            raise exceptions.ApiServerConnectionError(
+                f'shell {cluster_name}: {resp.status} {data[:300]}')
+        tail = ''
+        read1 = getattr(resp, 'read1', None)
+        while True:
+            chunk = read1(16384) if read1 is not None else resp.read(16384)
+            if not chunk:
+                break
+            text = chunk.decode(errors='replace')
+            tail = (tail + text)[-64:]
+            out.write(text)
+            out.flush()
+        # LAST marker wins: command output could itself end with a
+        # marker-shaped string (e.g. catting a captured shell log).
+        marks = re.findall(r'\[skytpu exit (\d+)\]', tail)
+        return int(marks[-1]) if marks else 255
+    except (ConnectionRefusedError, OSError) as e:
+        raise exceptions.ApiServerConnectionError(
+            f'Cannot reach API server at {server_url()}: {e}. '
+            'Run `skytpu api start` (or set SKYTPU_API_SERVER_URL).') \
+            from e
+    finally:
+        conn.close()
+
+
 def check() -> str:
     return submit('check', {})
 

@@ -123,6 +123,9 @@ class _Handler(BaseHTTPRequestHandler):
         if path == f'{API_PREFIX}/upload':
             self._upload(q)
             return
+        if path == f'{API_PREFIX}/shell':
+            self._shell(self._read_body())
+            return
         if not path.startswith(API_PREFIX + '/'):
             self._json(404, {'error': f'unknown path {path}'})
             return
@@ -204,6 +207,73 @@ class _Handler(BaseHTTPRequestHandler):
             except OSError:
                 pass
         self._json(200, {'workdir': dest})
+
+    def _shell(self, body: Dict[str, Any]) -> None:
+        """Streaming remote exec on a cluster's head host THROUGH the API
+        server (reference sky/server/server.py:1016 websocket ssh proxy).
+        This is the interactive-exec path for clusters a client can't ssh
+        to directly — Kubernetes pods (kubectl-exec runner) and any
+        cluster behind a shared remote server. One-shot command exec with
+        chunked output + a trailing exit marker; true interactive ssh for
+        VM clouds goes through `skytpu ssh` / the written ssh config."""
+        cluster = body.get('cluster_name') or ''
+        command = body.get('command') or ''
+        if not cluster or not command:
+            self._json(400, {'error': 'cluster_name and command required'})
+            return
+        from skypilot_tpu import core
+        from skypilot_tpu import exceptions as exc
+        from skypilot_tpu import provision as provision_lib
+        try:
+            handle = core._get_handle(cluster, need_up=True)  # pylint: disable=protected-access
+            info = provision_lib.get_cluster_info(
+                handle.cloud, handle.cluster_name, handle.region)
+            runner = provision_lib.get_command_runners(handle.cloud,
+                                                       info)[0]
+        except exc.SkyTpuError as e:
+            self._json(404, {'error': f'{type(e).__name__}: {e}'})
+            return
+        except Exception as e:  # noqa: BLE001 — a per-cluster resolution
+            # failure must answer 500, not drop the connection (the
+            # client would misread that as "server down").
+            self._json(500, {'error': f'{type(e).__name__}: {e}'})
+            return
+        self.send_response(200)
+        self.send_header('Content-Type', 'text/plain; charset=utf-8')
+        self.send_header('Transfer-Encoding', 'chunked')
+        self.end_headers()
+        outer = self
+
+        class _ChunkWriter:
+
+            def write(self, data):
+                if isinstance(data, str):
+                    data = data.encode()
+                if not data:
+                    return 0
+                outer.wfile.write(f'{len(data):x}\r\n'.encode()
+                                  + data + b'\r\n')
+                return len(data)
+
+            def flush(self):
+                outer.wfile.flush()
+
+        w = _ChunkWriter()
+        try:
+            res = runner.run(command, stream_to=w,
+                             timeout=float(body.get('timeout_s', 3600)))
+            code = res.returncode
+        except Exception as e:  # noqa: BLE001 — report into the stream
+            code = 255
+            try:
+                w.write(f'\n[skytpu] shell transport error: {e!r}\n')
+            except OSError:
+                pass  # client already gone; nothing to report to
+        try:
+            w.write(f'\n[skytpu exit {code}]\n')
+            self.wfile.write(b'0\r\n\r\n')
+        except OSError:
+            pass  # client went away mid-stream
 
     # -- get/stream ----------------------------------------------------------
     def _get_request(self, q: Dict[str, str]) -> None:

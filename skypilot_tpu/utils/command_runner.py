@@ -96,9 +96,29 @@ class CommandRunner:
                                 stderr=subprocess.STDOUT, text=True,
                                 errors='replace')
         assert proc.stdout is not None
-        for line in proc.stdout:
-            stream_to.write(line)
-            stream_to.flush()
+        timer = None
+        if timeout:
+            # The line-pump below has no natural timeout hook; a timer
+            # kill bounds it (otherwise `timeout` is silently ignored on
+            # the streaming path and a hung remote command pins the
+            # caller's thread forever).
+            import threading as _threading
+            timer = _threading.Timer(timeout, proc.kill)
+            timer.start()
+        try:
+            for line in proc.stdout:
+                stream_to.write(line)
+                stream_to.flush()
+        except BaseException:
+            # Consumer went away (e.g. HTTP client disconnect): the child
+            # must not be orphaned mid-run — it would block forever once
+            # its 64KB pipe buffer fills.
+            proc.kill()
+            proc.wait()
+            raise
+        finally:
+            if timer is not None:
+                timer.cancel()
         return CommandResult(proc.wait(), '', '')
 
     def rsync(self, source: str, target: str, up: bool = True) -> None:

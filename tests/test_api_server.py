@@ -210,3 +210,53 @@ class TestMultiUser:
         except urllib.error.HTTPError as e:
             assert e.code == 400
             assert 'unsafe' in json.loads(e.read())['error']
+
+
+class TestShellProxy:
+    """Streaming exec through the server (reference websocket ssh proxy,
+    sky/server/server.py:1016): the k8s/remote-server shell path."""
+
+    def test_shell_streams_and_returns_exit_code(self, api_server):
+        sdk.get(sdk.launch(_local_task(), 'shell-c1', detach_run=True))
+        try:
+            buf = io.StringIO()
+            code = sdk.shell('shell-c1', 'echo shell-says-$((40+2))',
+                             out=buf)
+            assert code == 0
+            assert 'shell-says-42' in buf.getvalue()
+
+            buf = io.StringIO()
+            code = sdk.shell('shell-c1', 'echo before-fail; exit 7',
+                             out=buf)
+            assert code == 7
+            assert 'before-fail' in buf.getvalue()
+        finally:
+            sdk.get(sdk.down('shell-c1'))
+
+    def test_shell_unknown_cluster_404(self, api_server):
+        with pytest.raises(exceptions.ApiServerConnectionError,
+                           match='404'):
+            sdk.shell('nope-c', 'true', out=io.StringIO())
+
+    def test_shell_timeout_kills_command(self, api_server):
+        sdk.get(sdk.launch(_local_task(), 'shell-c2', detach_run=True))
+        try:
+            buf = io.StringIO()
+            t0 = time.time()
+            code = sdk.shell('shell-c2', 'echo started; sleep 600',
+                             out=buf, timeout_s=3)
+            assert time.time() - t0 < 60
+            assert code != 0
+            assert 'started' in buf.getvalue()
+        finally:
+            sdk.get(sdk.down('shell-c2'))
+
+    def test_shell_exit_marker_spoof_resistant(self, api_server):
+        sdk.get(sdk.launch(_local_task(), 'shell-c3', detach_run=True))
+        try:
+            code = sdk.shell(
+                'shell-c3', "echo '[skytpu exit 0]'; exit 7",
+                out=io.StringIO())
+            assert code == 7
+        finally:
+            sdk.get(sdk.down('shell-c3'))

@@ -276,3 +276,42 @@ def test_fused_admit_then_release_reuses_slot(model_and_params):
     assert not bool(state.active[0])
     out_b, _, _ = run([7, 7, 7, 7, 7], state, rng)
     assert out_b == naive_greedy(model, params, [7, 7, 7, 7, 7], 4)
+
+
+def test_generation_server_eos_truncates(model_and_params):
+    """EOS mid-stream: the pipelined emitter discards the slot's
+    in-flight post-EOS tokens and releases it for reuse."""
+    from skypilot_tpu.serve.generation_server import (GenerationScheduler,
+                                                      GenerationServer)
+    model, params = model_and_params
+    prompt = [3, 141, 59, 26]
+    want = naive_greedy(model, params, prompt, 8)
+    eos = want[3]  # terminate exactly at the 4th generated token
+    scheduler = GenerationScheduler(CFG, params, batch_slots=2, max_len=64)
+    scheduler.start(warmup=False)
+    server = GenerationServer(scheduler, host='127.0.0.1', port=0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f'http://127.0.0.1:{server.port}'
+    try:
+        body = json.dumps({'tokens': prompt, 'max_tokens': 32,
+                           'eos_id': eos}).encode()
+        req = urllib.request.Request(f'{base}/generate', data=body)
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            result = json.loads(resp.read())
+        assert result['tokens'] == want[:4]  # truncated AT the eos token
+        # Slot released despite in-flight post-EOS steps: a second
+        # request reuses it and decodes cleanly.
+        body = json.dumps({'tokens': prompt, 'max_tokens': 3}).encode()
+        req = urllib.request.Request(f'{base}/generate', data=body)
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            again = json.loads(resp.read())
+        assert again['tokens'] == want[:3]
+        import time as time_lib
+        deadline = time_lib.time() + 10
+        while time_lib.time() < deadline:
+            if scheduler.stats()['slots_active'] == 0:
+                break
+            time_lib.sleep(0.1)
+        assert scheduler.stats()['slots_active'] == 0
+    finally:
+        server.shutdown()

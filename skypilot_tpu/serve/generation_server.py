@@ -101,12 +101,15 @@ class GenerationScheduler:
     MAX_BACKLOG = 32
 
     def __init__(self, config: LlamaConfig, params: Any,
-                 batch_slots: int = 8, max_len: Optional[int] = None):
+                 batch_slots: int = 8, max_len: Optional[int] = None,
+                 model: Any = None):
+        """``model`` serves a non-Llama family through the same engine
+        (e.g. a MixtralModel for MoE decode via its _mlp_delta)."""
         import jax
         self.config = config
         self.params = params
         self.engine = DecodeEngine(config, batch_slots=batch_slots,
-                                   max_len=max_len)
+                                   max_len=max_len, model=model)
         self.state = self.engine.init_state()
         self._rng = jax.random.key(0)
         self._pending: 'queue.Queue[_Request]' = queue.Queue()
@@ -567,18 +570,54 @@ def main() -> None:
     import jax
 
     parser = argparse.ArgumentParser()
+    parser.add_argument('--model', default='llama',
+                        choices=['llama', 'mixtral'])
     parser.add_argument('--preset', default='llama-1b',
-                        choices=sorted(PRESETS))
+                        help='PRESETS key of the chosen --model family')
     parser.add_argument(
         '--port', type=int,
         default=int(os.environ.get('SKYTPU_SERVE_REPLICA_PORT', '8001')))
     parser.add_argument('--batch-slots', type=int, default=8)
     parser.add_argument('--max-len', type=int, default=None)
+    parser.add_argument('--ckpt-dir', default=None,
+                        help='orbax checkpoint dir (train/checkpoint '
+                             'layout) to serve trained weights from; '
+                             'omitted = randomly initialized weights')
     args = parser.parse_args()
 
-    config = PRESETS[args.preset]
-    model = LlamaModel(config)
-    params = jax.jit(model.init)(jax.random.key(0))
+    if args.model == 'mixtral':
+        from skypilot_tpu.models.mixtral import (PRESETS as MOE_PRESETS,
+                                                 MixtralModel)
+        presets, model_cls = MOE_PRESETS, MixtralModel
+    else:
+        presets, model_cls = PRESETS, LlamaModel
+    if args.preset not in presets:
+        raise SystemExit(
+            f'unknown --preset {args.preset!r} for --model {args.model}; '
+            f'valid: {sorted(presets)}')
+    config = presets[args.preset]
+    model = model_cls(config)
+    if args.ckpt_dir:
+        # Checkpoints store the full TrainState (train/checkpoint.py);
+        # restore into its structure and keep only the params.
+        from skypilot_tpu.train import Trainer
+        from skypilot_tpu.train.checkpoint import CheckpointManager
+        mgr = CheckpointManager(args.ckpt_dir)
+        step = mgr.latest_step()
+        if step is None:
+            raise SystemExit(f'no checkpoint found in {args.ckpt_dir}')
+        # Abstract restore target: a real init would allocate ~3x param
+        # size (f32 params + both AdamW moments) on a replica that only
+        # keeps the params.
+        abstract = jax.eval_shape(Trainer(model).init_fn(),
+                                  jax.random.key(0))
+        state = mgr.restore(abstract)
+        params = state.params
+        del state
+        print(f'serving weights from step {step} of {args.ckpt_dir}',
+              flush=True)
+    else:
+        params = jax.jit(model.init)(jax.random.key(0))
     # Serve in the model's compute dtype: f32 master weights double the
     # HBM footprint for no serving benefit (the forward casts to
     # config.dtype anyway).
@@ -588,7 +627,8 @@ def main() -> None:
         params)
     scheduler = GenerationScheduler(config, params,
                                     batch_slots=args.batch_slots,
-                                    max_len=args.max_len)
+                                    max_len=args.max_len,
+                                    model=model)
     scheduler.start()
     server = GenerationServer(scheduler, port=args.port)
     print(f'generation server on :{server.port} '

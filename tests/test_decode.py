@@ -315,3 +315,54 @@ def test_generation_server_eos_truncates(model_and_params):
         assert scheduler.stats()['slots_active'] == 0
     finally:
         server.shutdown()
+
+
+def test_generation_server_main_mixtral_and_ckpt(tmp_path, monkeypatch):
+    """CLI entry serves MoE presets and trained checkpoints: train 2
+    steps of tiny mixtral, checkpoint, serve from it, generate."""
+    import socket
+    import subprocess
+    import sys
+    import time as time_lib
+
+    from skypilot_tpu.train import run as train_run
+    ckpt = str(tmp_path / 'ck')
+    train_run.main(['--model', 'mixtral', '--preset', 'test-tiny-moe',
+                    '--batch', '8', '--seq', '32', '--steps', '2',
+                    '--ckpt-dir', ckpt, '--save-every', '1',
+                    '--log-every', '2'])
+
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        port = s.getsockname()[1]
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.serve.generation_server',
+         '--model', 'mixtral', '--preset', 'test-tiny-moe',
+         '--port', str(port), '--batch-slots', '2', '--max-len', '64',
+         '--ckpt-dir', ckpt],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    base = f'http://127.0.0.1:{port}'
+    try:
+        deadline = time_lib.time() + 180
+        while time_lib.time() < deadline:
+            if proc.poll() is not None:  # crashed at startup: fail fast
+                raise AssertionError(
+                    f'server exited {proc.returncode}; output: '
+                    f'{proc.stdout.read()[-2000:]}')
+            try:
+                with urllib.request.urlopen(f'{base}/health',
+                                            timeout=5) as resp:
+                    if resp.status == 200:
+                        break
+            except OSError:
+                time_lib.sleep(1.0)
+        else:
+            raise AssertionError('server never became healthy')
+        body = json.dumps({'tokens': [1, 9, 77], 'max_tokens': 4}).encode()
+        req = urllib.request.Request(f'{base}/generate', data=body)
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            result = json.loads(resp.read())
+        assert result['num_tokens'] == 4
+    finally:
+        proc.terminate()
+        proc.wait(timeout=30)

@@ -63,12 +63,15 @@ _INSTALL_RCLONE = _deb_install(
 
 def rclone_s3_mount_command(bucket: str, mount_point: str,
                             sub_path: str = '',
-                            read_only: bool = True) -> str:
-    """Idempotent install + rclone FUSE mount of an S3 bucket.
+                            read_only: bool = True,
+                            endpoint: str = '') -> str:
+    """Idempotent install + rclone FUSE mount of an S3(-compatible)
+    bucket.
 
     The remote is configured entirely through RCLONE_CONFIG_* env vars
     (``env_auth`` picks up the instance role / AWS_* credentials) — no
-    config file to ship. Defaults to read-only: the realistic TPU story
+    config file to ship. ``endpoint`` targets S3-compatible providers
+    (Cloudflare R2 etc.). Defaults to read-only: the realistic TPU story
     is S3 as a dataset *source*; ``--vfs-cache-mode writes`` is enabled
     only for read-write mounts. Reference counterpart:
     sky/data/mounting_utils.py:41-367 (goofys/rclone S3 branch).
@@ -78,13 +81,16 @@ def rclone_s3_mount_command(bucket: str, mount_point: str,
     if sub_path:
         src += f'/{sub_path}'
     ro = '--read-only ' if read_only else '--vfs-cache-mode writes '
+    provider = ('RCLONE_CONFIG_SKYTPU_S3_PROVIDER=Other '
+                f'RCLONE_CONFIG_SKYTPU_S3_ENDPOINT={q(endpoint)} '
+                if endpoint else 'RCLONE_CONFIG_SKYTPU_S3_PROVIDER=AWS ')
     return (
         f'{_INSTALL_RCLONE} && '
         f'sudo mkdir -p {q(mount_point)} && '
         f'sudo chown $(id -u):$(id -g) {q(mount_point)} && '
         f'(mountpoint -q {q(mount_point)} || '
         'RCLONE_CONFIG_SKYTPU_S3_TYPE=s3 '
-        'RCLONE_CONFIG_SKYTPU_S3_PROVIDER=AWS '
+        f'{provider}'
         'RCLONE_CONFIG_SKYTPU_S3_ENV_AUTH=true '
         f'rclone mount {q(src)} {q(mount_point)} '
         f'--daemon --allow-non-empty {ro}'

@@ -71,7 +71,7 @@ _STORAGE_SCHEMA: Dict[str, Any] = {
         'source': {'anyOf': [{'type': 'string'},
                              {'type': 'array', 'items': {'type': 'string'}},
                              {'type': 'null'}]},
-        'store': {'enum': ['gcs', 's3', None]},
+        'store': {'enum': ['gcs', 's3', 'r2', None]},
         'mode': {'enum': ['MOUNT', 'COPY', 'mount', 'copy', None]},
         'persistent': {'type': 'boolean'},
     },

@@ -280,3 +280,43 @@ class TestValidation:
             time.sleep(0.2)
         assert s == 'SUCCEEDED'
         core.down('t-okbkt')
+
+
+class TestR2Store:
+
+    def test_parse_and_urls(self, monkeypatch):
+        monkeypatch.setenv('R2_ACCOUNT_ID', 'acct123')
+        from skypilot_tpu.data.storage import R2Store, parse_store_url
+        s = parse_store_url('r2://bkt/sub')
+        assert isinstance(s, R2Store)
+        assert s.url == 'r2://bkt/sub'
+
+    def test_commands_use_endpoint(self, monkeypatch):
+        monkeypatch.setenv('R2_ACCOUNT_ID', 'acct123')
+        from skypilot_tpu.data.storage import R2Store
+        s = R2Store('bkt', 'p')
+        ep = 'https://acct123.r2.cloudflarestorage.com'
+        assert f'--endpoint-url {ep}' in s.download_command('/data')
+        assert f'--endpoint-url {ep}' in s.upload_command('/src')
+        assert 's3://bkt/p' in s.download_command('/data')
+        cmd = s.mount_command('/data')
+        assert f'RCLONE_CONFIG_SKYTPU_S3_ENDPOINT={ep}' in cmd
+        assert 'RCLONE_CONFIG_SKYTPU_S3_PROVIDER=Other' in cmd
+        assert '--read-only' in cmd
+
+    def test_missing_account_raises(self, monkeypatch):
+        monkeypatch.delenv('R2_ACCOUNT_ID', raising=False)
+        from skypilot_tpu.data.storage import R2Store
+        with pytest.raises(exceptions.StorageError, match='account id'):
+            R2Store('bkt').download_command('/data')
+
+    def test_named_store_and_yaml_round_trip(self, monkeypatch):
+        monkeypatch.setenv('R2_ACCOUNT_ID', 'acct123')
+        from skypilot_tpu.data.storage import R2Store, Storage
+        st = Storage(name='bkt', store='r2')
+        assert isinstance(st.store, R2Store)
+        task = sky.Task(run='true', file_mounts={
+            '/d': {'name': 'bkt', 'store': 'r2', 'mode': 'MOUNT'}})
+        cfg = task.to_yaml_config()
+        again = sky.Task.from_yaml_config(cfg)
+        assert isinstance(again.storage_mounts['/d'].store, R2Store)

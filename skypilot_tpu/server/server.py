@@ -352,7 +352,14 @@ def serve(host: str = '127.0.0.1', port: int = DEFAULT_PORT,
     _Handler.executor = executor_lib.Executor()
     _Handler.auth_token = (auth_token
                            or os.environ.get('SKYTPU_API_TOKEN') or None)
-    httpd = ThreadingHTTPServer((host, port), _Handler)
+    class _Server(ThreadingHTTPServer):
+        # Default listen backlog is 5: a burst of concurrent clients
+        # (team API server, the load test) overflows it and gets
+        # connection resets instead of queueing.
+        request_queue_size = 128
+        daemon_threads = True
+
+    httpd = _Server((host, port), _Handler)
     if background:
         t = threading.Thread(target=httpd.serve_forever, daemon=True)
         t.start()

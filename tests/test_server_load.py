@@ -29,6 +29,7 @@ import urllib.request
 import pytest
 
 import skypilot_tpu as sky
+from skypilot_tpu import exceptions
 from skypilot_tpu.client import sdk
 from skypilot_tpu.server import server as server_lib
 
@@ -65,10 +66,22 @@ class TestServerLoad:
         stop_at = time.time() + 10.0
 
         def client():
+            transient = 0
             while time.time() < stop_at:
                 t0 = time.perf_counter()
                 try:
                     sdk.get(sdk.status(refresh=False), timeout_s=60)
+                except exceptions.ApiServerConnectionError as e:
+                    # A reset under extreme burst is connection-level
+                    # backpressure, not a server failure: retry a few
+                    # times before declaring an error.
+                    transient += 1
+                    if transient > 3:
+                        with lock:
+                            errors.append(repr(e))
+                        return
+                    time.sleep(0.2)
+                    continue
                 except Exception as e:  # noqa: BLE001 — recorded
                     with lock:
                         errors.append(repr(e))

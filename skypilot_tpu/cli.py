@@ -15,24 +15,44 @@ import click
 from skypilot_tpu.utils import common_utils
 
 
+def _parse_env_overrides(env) -> 'dict | None':
+    """--env KEY=VALUE tuples -> dict (None when empty), with a usage
+    error (not a ValueError traceback) on malformed entries."""
+    if not env:
+        return None
+    out = {}
+    for kv in env:
+        key, sep, value = kv.partition('=')
+        if not sep or not key:
+            raise click.BadParameter(
+                f'--env expects KEY=VALUE, got {kv!r}')
+        out[key] = value
+    return out
+
+
 def _task_from_args(entrypoint, name, workdir, cloud, accelerators,
                     num_nodes, env, cmd):
     from skypilot_tpu import resources as resources_lib
     from skypilot_tpu import task as task_lib
 
+    env_overrides = _parse_env_overrides(env)
     if entrypoint and entrypoint.endswith(('.yaml', '.yml')):
-        task = task_lib.Task.from_yaml(entrypoint)
+        # --env must apply at PARSE time: $VAR substitution into run:/
+        # file_mounts happens on load, so a post-hoc update_envs would
+        # leave the rendered command on the YAML defaults.
+        task = task_lib.Task.from_yaml(entrypoint,
+                                       env_overrides=env_overrides)
     else:
         run_cmd = cmd or entrypoint
         task = task_lib.Task(run=run_cmd)
+        if env_overrides:
+            task.update_envs(env_overrides)
     if name:
         task.name = name
     if workdir:
         task.workdir = workdir
     if num_nodes:
         task.num_nodes = num_nodes
-    if env:
-        task.update_envs(dict(kv.split('=', 1) for kv in env))
     overrides = {}
     if cloud:
         overrides['cloud'] = cloud
@@ -463,11 +483,13 @@ def serve():
 @serve.command('up')
 @click.argument('entrypoint')
 @click.option('--service-name', '-n', required=True)
-def serve_up(entrypoint, service_name):
+@click.option('--env', multiple=True, help='KEY=VALUE (repeatable).')
+def serve_up(entrypoint, service_name, env):
     """Start a service from a task YAML with a `service:` section."""
     from skypilot_tpu import task as task_lib
     from skypilot_tpu.serve import core as serve_core
-    task = task_lib.Task.from_yaml(entrypoint)
+    task = task_lib.Task.from_yaml(
+        entrypoint, env_overrides=_parse_env_overrides(env))
     result = serve_core.up(task, service_name)
     click.echo(f'Service {result["name"]!r} starting. '
                f'Endpoint: {result["endpoint"]}')
@@ -477,12 +499,14 @@ def serve_up(entrypoint, service_name):
 @serve.command('update')
 @click.argument('service_name')
 @click.argument('entrypoint')
-def serve_update(service_name, entrypoint):
+@click.option('--env', multiple=True, help='KEY=VALUE (repeatable).')
+def serve_update(service_name, entrypoint, env):
     """Rolling-update a running service to a new task YAML (zero
     downtime: old replicas drain only as new ones turn READY)."""
     from skypilot_tpu import task as task_lib
     from skypilot_tpu.serve import core as serve_core
-    task = task_lib.Task.from_yaml(entrypoint)
+    task = task_lib.Task.from_yaml(
+        entrypoint, env_overrides=_parse_env_overrides(env))
     result = serve_core.update(task, service_name)
     click.echo(f'Service {result["name"]!r} rolling to '
                f'version {result["version"]}.')

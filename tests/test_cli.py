@@ -69,3 +69,17 @@ class TestCli:
         res = _invoke('status')
         assert '30m' in res.output
         _invoke('down', 'cli-auto', '--yes')
+
+
+def test_launch_env_overrides_substitute_into_run(tmp_path, monkeypatch):
+    """--env must win over YAML `envs:` defaults inside the rendered run
+    command ($VAR substitution happens at parse time)."""
+    yaml_path = tmp_path / 't.yaml'
+    yaml_path.write_text(
+        'envs:\n  MODE: "default"\nrun: echo mode=$MODE\n'
+        'resources:\n  cloud: local\n')
+    from skypilot_tpu.cli import _task_from_args
+    task = _task_from_args(str(yaml_path), None, None, None, None, None,
+                           ('MODE=overridden',), None)
+    assert 'mode=overridden' in task.run
+    assert task.envs['MODE'] == 'overridden'

@@ -168,15 +168,22 @@ def run(*, preset: str = 'llama-1b', batch_slots: int = 16,
 
         # Warmup THROUGH the LB: the first full-length request compiles the
         # big prefill bucket + insert; repeats hit the LB sync + caches.
+        # Per-attempt timeout + overall deadline: a READY-but-wedged chip
+        # (degraded tunnel) must fail the phase in minutes, not hang the
+        # whole bench on 30 x 15-minute request timeouts.
         rnd = random.Random(7)
+        warm_deadline = time.time() + max(300.0, ready_timeout_s / 2)
         for i in range(max(1, warmup_requests)):
             tokens = [rnd.randrange(config.vocab_size)
                       for _ in range(prompt_len)]
             for attempt in range(30):
+                if time.time() > warm_deadline:
+                    raise TimeoutError('serve warmup never completed '
+                                       '(chip wedged or replica hung)')
                 try:
                     with _post_generate(endpoint, tokens,
-                                        min(output_len, 16),
-                                        stream=False) as resp:
+                                        min(output_len, 16), stream=False,
+                                        timeout=180) as resp:
                         resp.read()
                     break
                 except (urllib.error.URLError, OSError):

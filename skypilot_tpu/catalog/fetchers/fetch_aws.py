@@ -1,0 +1,160 @@
+"""Generate the AWS EC2 catalog CSV (aws_vms.csv).
+
+Counterpart of the reference's AWS data fetcher
+(sky/clouds/service_catalog/data_fetchers/fetch_aws.py — boto3 walks the
+EC2 + Pricing APIs per region). Two sources, merged:
+
+1. **AWS Pricing API** (``pricing:GetProducts``, via boto3 when
+   installed): ``refresh(online=True)`` queries on-demand Linux
+   shared-tenancy prices per instance type/region and overrides the
+   static table wherever a live price was found. A ``pricing_client``
+   seam lets tests fake the API without boto3.
+2. **Static table** below (public on-demand pricing; spot at the typical
+   ~60% discount): the offline fallback — this build environment has
+   zero egress.
+
+Run:  python -m skypilot_tpu.catalog.fetchers.fetch_aws [--online]
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+DATA_DIR = os.path.join(_HERE, '..', 'data')
+
+# (vcpus, memory_gb, on-demand $/h in us-east-1). Spot = 0.4x on-demand
+# (the long-run average discount AWS publishes); other-region multipliers
+# below match public price sheets.
+_INSTANCE_TYPES: Dict[str, Tuple[int, float, float]] = {
+    't3.medium': (2, 4, 0.0416),
+    'm6i.large': (2, 8, 0.096),
+    'm6i.xlarge': (4, 16, 0.192),
+    'm6i.2xlarge': (8, 32, 0.384),
+    'm6i.4xlarge': (16, 64, 0.768),
+    'c6i.xlarge': (4, 8, 0.17),
+    'c6i.4xlarge': (16, 32, 0.68),
+    'r6i.xlarge': (4, 32, 0.252),
+    'r6i.4xlarge': (16, 128, 1.008),
+}
+
+_REGION_MULTIPLIER: Dict[str, float] = {
+    'us-east-1': 1.0,
+    'us-west-2': 1.0,
+    'eu-west-1': 1.1126,  # m6i sheet ratio, close enough fleet-wide
+}
+
+_SPOT_DISCOUNT = 0.4
+
+# Pricing API location names (the API keys products by human-readable
+# location, not region code).
+_REGION_LOCATION = {
+    'us-east-1': 'US East (N. Virginia)',
+    'us-west-2': 'US West (Oregon)',
+    'eu-west-1': 'EU (Ireland)',
+}
+
+
+def fetch_ec2_prices(pricing_client: Optional[Any] = None
+                     ) -> Dict[Tuple[str, str], float]:
+    """(instance_type, region) -> live on-demand $/h via the Pricing API.
+
+    ``pricing_client`` is the test seam (an object with
+    ``get_products(**kwargs) -> {'PriceList': [json_str, ...]}``);
+    defaults to a real boto3 pricing client (us-east-1 hosts the API).
+    """
+    if pricing_client is None:
+        import boto3  # type: ignore  # gated: not in this image
+        pricing_client = boto3.client('pricing', region_name='us-east-1')
+    out: Dict[Tuple[str, str], float] = {}
+    for region, location in _REGION_LOCATION.items():
+        # One filtered query per tracked instance type: the unfiltered
+        # product list for a region is thousands of SKUs across many
+        # pages, and a first-page-only read would silently keep stale
+        # static prices for whatever didn't fit the page.
+        for itype in _INSTANCE_TYPES:
+            resp = pricing_client.get_products(
+                ServiceCode='AmazonEC2',
+                Filters=[
+                    {'Type': 'TERM_MATCH', 'Field': 'instanceType',
+                     'Value': itype},
+                    {'Type': 'TERM_MATCH', 'Field': 'location',
+                     'Value': location},
+                    {'Type': 'TERM_MATCH', 'Field': 'operatingSystem',
+                     'Value': 'Linux'},
+                    {'Type': 'TERM_MATCH', 'Field': 'tenancy',
+                     'Value': 'Shared'},
+                    {'Type': 'TERM_MATCH', 'Field': 'preInstalledSw',
+                     'Value': 'NA'},
+                    {'Type': 'TERM_MATCH', 'Field': 'capacitystatus',
+                     'Value': 'Used'},
+                ])
+            for raw in resp.get('PriceList', []):
+                product = json.loads(raw) if isinstance(raw, str) else raw
+                attrs = product.get('product', {}).get('attributes', {})
+                if attrs.get('instanceType') != itype:
+                    continue
+                on_demand = product.get('terms', {}).get('OnDemand', {})
+                for term in on_demand.values():
+                    for dim in term.get('priceDimensions', {}).values():
+                        usd = dim.get('pricePerUnit', {}).get('USD')
+                        if usd and float(usd) > 0:
+                            out[(itype, region)] = float(usd)
+    return out
+
+
+def generate_vm_rows(live: Optional[Dict[Tuple[str, str], float]] = None
+                     ) -> List[Dict[str, object]]:
+    live = live or {}
+    rows: List[Dict[str, object]] = []
+    for itype, (vcpus, mem, base) in _INSTANCE_TYPES.items():
+        for region, mult in _REGION_MULTIPLIER.items():
+            price = live.get((itype, region), round(base * mult, 4))
+            rows.append({
+                'instance_type': itype,
+                'vcpus': vcpus,
+                'memory_gb': mem,
+                'region': region,
+                'price': price,
+                'spot_price': round(price * _SPOT_DISCOUNT, 4),
+            })
+    return rows
+
+
+def refresh(online: bool = False,
+            pricing_client: Optional[Any] = None) -> str:
+    """Regenerate aws_vms.csv; returns 'online'/'offline'/'stale'."""
+    live: Dict[Tuple[str, str], float] = {}
+    source = 'offline'
+    if online:
+        try:
+            live = fetch_ec2_prices(pricing_client)
+            if live:
+                source = 'online'
+        except Exception as e:  # noqa: BLE001 — any failure = fallback
+            print(f'pricing API unavailable ({type(e).__name__}: {e}); '
+                  'using static price table')
+    from skypilot_tpu.catalog.fetchers.fetch_gcp import write_csv
+    rows = generate_vm_rows(live)
+    try:
+        write_csv(os.path.join(DATA_DIR, 'aws_vms.csv'), rows)
+    except OSError as e:
+        print(f'catalog dir not writable ({e}); keeping existing CSV')
+        return 'stale'
+    print(f'Wrote {len(rows)} EC2 rows to {os.path.normpath(DATA_DIR)} '
+          f'({source}; {len(live)} live price points)')
+    return source
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    import argparse
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--online', action='store_true',
+                        help='fetch live prices from the AWS Pricing API')
+    args = parser.parse_args(argv)
+    refresh(online=args.online)
+
+
+if __name__ == '__main__':
+    main()

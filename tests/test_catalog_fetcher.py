@@ -128,3 +128,67 @@ def test_committed_catalog_matches_regeneration(tmp_path, monkeypatch):
         assert committed == regenerated, (
             f'{fname} drifted from the fetcher: run '
             'python -m skypilot_tpu.catalog.fetchers.fetch_gcp and commit')
+
+
+class TestAwsFetcher:
+
+    def test_committed_aws_catalog_matches_regeneration(self, tmp_path,
+                                                        monkeypatch):
+        """Same drift guard as GCP: aws_vms.csv must equal the offline
+        regeneration."""
+        import skypilot_tpu.catalog as catalog_pkg
+        from skypilot_tpu.catalog.fetchers import fetch_aws
+        committed_dir = os.path.join(
+            os.path.dirname(os.path.abspath(catalog_pkg.__file__)), 'data')
+        monkeypatch.setattr(fetch_aws, 'DATA_DIR', str(tmp_path))
+        assert fetch_aws.refresh(online=False) == 'offline'
+        committed = open(os.path.join(committed_dir,
+                                      'aws_vms.csv')).read()
+        assert committed == (tmp_path / 'aws_vms.csv').read_text(), (
+            'aws_vms.csv drifted from the fetcher: run '
+            'python -m skypilot_tpu.catalog.fetchers.fetch_aws and commit')
+
+    def test_live_price_overrides_static(self, tmp_path, monkeypatch):
+        import csv as csv_lib
+        import json as json_lib
+
+        from skypilot_tpu.catalog.fetchers import fetch_aws
+
+        class FakePricing:
+            def get_products(self, **kwargs):
+                loc = [f['Value'] for f in kwargs['Filters']
+                       if f['Field'] == 'location'][0]
+                if loc != 'US East (N. Virginia)':
+                    return {'PriceList': []}
+                product = {
+                    'product': {'attributes':
+                                {'instanceType': 'm6i.large'}},
+                    'terms': {'OnDemand': {'x': {'priceDimensions': {
+                        'y': {'pricePerUnit': {'USD': '0.123'}}}}}},
+                }
+                return {'PriceList': [json_lib.dumps(product)]}
+
+        monkeypatch.setattr(fetch_aws, 'DATA_DIR', str(tmp_path))
+        assert fetch_aws.refresh(online=True,
+                                 pricing_client=FakePricing()) == 'online'
+        rows = list(csv_lib.DictReader(open(tmp_path / 'aws_vms.csv')))
+        live = [r for r in rows if r['instance_type'] == 'm6i.large'
+                and r['region'] == 'us-east-1'][0]
+        assert float(live['price']) == 0.123
+        assert float(live['spot_price']) == pytest.approx(0.123 * 0.4)
+        # Other regions keep the static table.
+        other = [r for r in rows if r['instance_type'] == 'm6i.large'
+                 and r['region'] == 'us-west-2'][0]
+        assert float(other['price']) == 0.096
+
+    def test_online_failure_falls_back(self, tmp_path, monkeypatch):
+        from skypilot_tpu.catalog.fetchers import fetch_aws
+
+        class Exploding:
+            def get_products(self, **kwargs):
+                raise RuntimeError('no egress')
+
+        monkeypatch.setattr(fetch_aws, 'DATA_DIR', str(tmp_path))
+        assert fetch_aws.refresh(online=True,
+                                 pricing_client=Exploding()) == 'offline'
+        assert (tmp_path / 'aws_vms.csv').exists()

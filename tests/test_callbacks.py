@@ -1,0 +1,54 @@
+"""Benchmark callback library: arming, step timing, phase marks, and the
+launch-overhead decomposition bench.py derives from them."""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+import bench  # noqa: E402  (repo-root module)
+from skypilot_tpu import callbacks  # noqa: E402
+
+
+class TestCallbacks:
+
+    def test_noop_without_env(self, monkeypatch):
+        monkeypatch.delenv('SKYTPU_BENCHMARK_LOG_DIR', raising=False)
+        assert callbacks.init() is False
+        callbacks.mark('proc_start')  # must not raise unarmed
+        callbacks.step_end()
+
+    def test_summary_with_marks_and_rate(self, tmp_path, monkeypatch):
+        monkeypatch.setenv('SKYTPU_BENCHMARK_LOG_DIR', str(tmp_path))
+        assert callbacks.init(total_steps=4) is True
+        callbacks.mark('proc_start')
+        callbacks.mark('jax_ready')
+        for _ in range(4):
+            callbacks.step_begin()
+            callbacks.step_end()
+        summary = json.load(open(tmp_path / callbacks.SUMMARY_FILE))
+        assert summary['num_steps'] == 4
+        assert summary['total_steps'] == 4
+        assert set(summary['marks']) == {'proc_start', 'jax_ready'}
+        assert summary['seconds_per_step'] >= 0
+        assert summary['first_step_end_ts'] <= summary['last_step_ts']
+
+
+class TestOverheadBreakdown:
+
+    def test_phases_from_marks(self):
+        summary = {
+            'marks': {'proc_start': 110.0, 'jax_ready': 125.0,
+                      'init_done': 150.0},
+            'first_step_end_ts': 180.0,
+        }
+        out = bench._overhead_breakdown(summary, t_submit=100.0)
+        assert out == {'control_plane_s': 10.0, 'runtime_startup_s': 15.0,
+                       'param_init_s': 25.0, 'first_step_s': 30.0}
+
+    def test_partial_marks_and_prefix(self):
+        out = bench._overhead_breakdown(
+            {'marks': {'proc_start': 5.0}, 'first_step_end_ts': 9.0},
+            t_submit=1.0, prefix='warm_')
+        assert out == {'warm_control_plane_s': 4.0}
+        assert bench._overhead_breakdown({}, 0.0) == {}

@@ -173,6 +173,7 @@ def run(*, preset: str = 'llama-1b', batch_slots: int = 16,
         # whole bench on 30 x 15-minute request timeouts.
         rnd = random.Random(7)
         warm_deadline = time.time() + max(300.0, ready_timeout_s / 2)
+        warmed = False
         for i in range(max(1, warmup_requests)):
             tokens = [rnd.randrange(config.vocab_size)
                       for _ in range(prompt_len)]
@@ -185,9 +186,18 @@ def run(*, preset: str = 'llama-1b', batch_slots: int = 16,
                                         min(output_len, 16), stream=False,
                                         timeout=180) as resp:
                         resp.read()
+                    warmed = True
                     break
                 except (urllib.error.URLError, OSError):
                     time.sleep(2.0)  # LB may not have synced the replica
+        if not warmed:
+            # Every attempt failed but the deadline never fired (e.g. fast
+            # connection-refused loops): the sweep below would fold compile
+            # time into TTFT/TPOT. Record it so the numbers are legible.
+            out['serve_warmup_failed'] = True
+            print('serve bench WARNING: warmup exhausted all attempts '
+                  'without a successful request; sweep numbers include '
+                  'compile time', file=sys.stderr)
 
         sweep = []
         for conc in concurrencies:

@@ -12,7 +12,6 @@ pseudo-instance handling, gcp_catalog.py:232-254). TPU-native changes:
 """
 from __future__ import annotations
 
-import functools
 import os
 from typing import Dict, List, Optional, Tuple
 
@@ -24,8 +23,16 @@ from skypilot_tpu import exceptions
 _DATA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), 'data')
 
 
-@functools.lru_cache(maxsize=None)
+_read_cache: Dict[str, pd.DataFrame] = {}
+
+
 def _read(name: str) -> pd.DataFrame:
+    """Load a catalog CSV, caching only successful reads: the empty
+    fallback for a missing file is NOT cached, so a catalog regenerated
+    later in the same process (e.g. via a fetcher) is picked up."""
+    cached = _read_cache.get(name)
+    if cached is not None:
+        return cached
     path = os.path.join(_DATA_DIR, name)
     if not os.path.exists(path) and name.startswith('gcp_'):
         # Regenerate on first use (e.g. fresh checkout). Only the GCP
@@ -36,7 +43,12 @@ def _read(name: str) -> pd.DataFrame:
         return pd.DataFrame(columns=[
             'instance_type', 'vcpus', 'memory_gb', 'region', 'price',
             'spot_price'])
-    return pd.read_csv(path)
+    df = pd.read_csv(path)
+    _read_cache[name] = df
+    return df
+
+
+_read.cache_clear = _read_cache.clear  # type: ignore[attr-defined]
 
 
 def refresh(online: bool = True) -> str:

@@ -74,8 +74,11 @@ class AWS(cloud_lib.Cloud):
             # valid pin away).
             return ([resources.zone]
                     if resources.zone.startswith(region) else [])
-        # Default probe order; failover walks them.
-        return [f'{region}{s}' for s in 'abc']
+        # Default probe order; failover walks every AZ the region really
+        # has ('Unsupported'/capacity in a-c must not skip d-f, and 3-AZ
+        # regions must not be probed with a nonexistent '<region>d').
+        from skypilot_tpu.provision import aws_api
+        return list(aws_api.available_zones(region))
 
     # ---- pricing ----------------------------------------------------------
     def hourly_cost(self, resources, region=None, zone=None) -> float:

@@ -141,6 +141,9 @@ def run_instances(cluster_name: str, region: str, zone: Optional[str],
                 to_start.append(inst['InstanceId'])
         if to_start:
             aws_api.call(ec2, 'start_instances', InstanceIds=to_start)
+        image_id = deploy_vars.get('image_id')
+        if image_id is None and missing_ranks:
+            image_id = aws_api.resolve_default_ami(region)
         for rank in missing_ranks:
             placement: Dict[str, Any] = {}
             if zone:
@@ -149,7 +152,7 @@ def run_instances(cluster_name: str, region: str, zone: Optional[str],
                 'InstanceInterruptionBehavior': 'terminate'}}
                 if deploy_vars.get('use_spot') else None)
             kwargs: Dict[str, Any] = dict(
-                ImageId=deploy_vars.get('image_id') or 'ami-ubuntu-2204',
+                ImageId=image_id,
                 InstanceType=deploy_vars.get('instance_type', 'm6i.large'),
                 MinCount=1, MaxCount=1,
                 KeyName=key_name,
@@ -306,26 +309,47 @@ def open_ports(cluster_name: str, region: str, ports: List[str]) -> None:
         raise exceptions.ClusterError(
             f'security group {_sg_name(name)} missing for {cluster_name}')
     sg = groups[0]
-    have = {(p.get('FromPort'), p.get('ToPort'))
-            for p in sg.get('IpPermissions', [])}
+    # Keyed by (lo, hi) → currently-authorized IPv4 source ranges (tcp
+    # only; IPv6/udp rules added out of band are left untouched), so a
+    # tightened aws.firewall_source_ranges re-applies to already-open
+    # ports, matching gcp.open_ports' patch behavior. New CIDRs are
+    # authorized BEFORE stale ones are revoked: a failure mid-way must
+    # never leave a previously-open serving port fully closed.
+    have: Dict[Any, set] = {}
+    for p in sg.get('IpPermissions', []):
+        if p.get('IpProtocol') != 'tcp':
+            continue
+        key = (p.get('FromPort'), p.get('ToPort'))
+        have.setdefault(key, set()).update(
+            r.get('CidrIp') for r in p.get('IpRanges', []))
     from skypilot_tpu import config as config_lib
     ranges = config_lib.get_nested(('aws', 'firewall_source_ranges'),
                                    ['0.0.0.0/0'])
     perms = []
+    revoke = []
     for port in ports:
         # Port specs are ints or 'lo-hi' ranges (resources._parse_ports).
         if '-' in str(port):
             lo, hi = (int(p) for p in str(port).split('-', 1))
         else:
             lo = hi = int(port)
-        if (lo, hi) in have:
-            continue
-        perms.append({'IpProtocol': 'tcp', 'FromPort': lo,
-                      'ToPort': hi,
-                      'IpRanges': [{'CidrIp': r} for r in ranges]})
+        existing = have.get((lo, hi), set())
+        to_add = [r for r in ranges if r not in existing]
+        to_remove = sorted(existing - set(ranges) - {None})
+        if to_add:
+            perms.append({'IpProtocol': 'tcp', 'FromPort': lo,
+                          'ToPort': hi,
+                          'IpRanges': [{'CidrIp': r} for r in to_add]})
+        if to_remove:
+            revoke.append({'IpProtocol': 'tcp', 'FromPort': lo,
+                           'ToPort': hi,
+                           'IpRanges': [{'CidrIp': r} for r in to_remove]})
     if perms:
         aws_api.call(ec2, 'authorize_security_group_ingress',
                      GroupId=sg['GroupId'], IpPermissions=perms)
+    if revoke:
+        aws_api.call(ec2, 'revoke_security_group_ingress',
+                     GroupId=sg['GroupId'], IpPermissions=revoke)
 
 
 def get_command_runners(cluster_info: provision_lib.ClusterInfo,

@@ -80,6 +80,74 @@ def get_ec2(region: str) -> Any:
     return boto3.client('ec2', region_name=region)
 
 
+# Canonical's public SSM parameter for the latest Ubuntu 22.04 LTS AMI —
+# per-region, maintained by Canonical (the reference resolves AMIs via its
+# catalog's per-region image column, fetched the same way).
+_UBUNTU_SSM_PARAM = ('/aws/service/canonical/ubuntu/server/22.04/stable/'
+                     'current/amd64/hvm/ebs-gp3/ami-id')
+_FAKE_AMI = 'ami-ubuntu-2204'  # accepted by the in-process fake EC2 only
+_ami_cache: Dict[str, str] = {}  # region -> AMI id (real mode only)
+
+
+def resolve_default_ami(region: str) -> str:
+    """Default Ubuntu 22.04 AMI for ``region`` when no image_id is given.
+
+    AMI IDs are per-region, so there is no single valid default literal.
+    In fake mode (test seam installed) the placeholder is fine; against
+    real EC2 we resolve Canonical's SSM public parameter, and fail fast
+    with an actionable error rather than letting run_instances die with
+    InvalidAMIID.Malformed (which would mis-classify as a generic cloud
+    error and burn failover retries)."""
+    if _ec2_factory is not None:
+        return _FAKE_AMI
+    cached = _ami_cache.get(region)
+    if cached is not None:
+        return cached
+    try:
+        import boto3  # type: ignore
+        ssm = boto3.client('ssm', region_name=region)
+        ami = ssm.get_parameter(Name=_UBUNTU_SSM_PARAM)['Parameter']['Value']
+    except Exception as e:  # noqa: BLE001 — any failure → actionable error
+        raise exceptions.CloudError(
+            f'Could not resolve a default Ubuntu AMI for region {region} '
+            f'via SSM ({e!r}). Set an explicit image_id in the task '
+            'resources (e.g. image_id: ami-0123456789abcdef0).') from e
+    _ami_cache[region] = ami
+    return ami
+
+
+_zones_cache: Dict[str, tuple] = {}  # region -> AZ names (real mode only)
+
+
+def available_zones(region: str) -> List[str]:
+    """Availability-zone names for ``region``, best-effort.
+
+    Real mode asks EC2 (describe_availability_zones, cached per region) so
+    3-AZ regions never get probed with a nonexistent '<region>d' — that
+    fails with InvalidParameterValue, which is NOT a capacity error and
+    would abort the whole region mid-failover. Fake clients that don't
+    implement the op (and real-mode API failures) fall back to a-f: the
+    fake raises per-AZ capacity errors, so extra suffixes only extend the
+    walk."""
+    if _ec2_factory is None and region in _zones_cache:
+        return list(_zones_cache[region])
+    fallback = [f'{region}{s}' for s in 'abcdef']
+    try:
+        ec2 = get_ec2(region)
+        resp = ec2.describe_availability_zones(
+            Filters=[{'Name': 'state', 'Values': ['available']}])
+    except Exception:  # noqa: BLE001 — fall back to suffix probing
+        return fallback
+    zones = sorted(
+        z['ZoneName'] for z in resp.get('AvailabilityZones', [])
+        if z.get('ZoneType', 'availability-zone') == 'availability-zone')
+    if not zones:
+        return fallback
+    if _ec2_factory is None:
+        _zones_cache[region] = tuple(zones)
+    return zones
+
+
 def call(ec2: Any, op: str, **kwargs) -> Dict[str, Any]:
     """Invoke a client op, normalizing errors to CloudError subclasses."""
     try:

@@ -119,6 +119,21 @@ class FakeEc2:
         self.security_groups[GroupId]['IpPermissions'].extend(IpPermissions)
         return {}
 
+    def revoke_security_group_ingress(self, GroupId, IpPermissions, **kw):
+        # Real EC2 revokes per-CIDR within a (proto, lo, hi) rule and
+        # drops the rule once its last source range is gone.
+        perms = self.security_groups[GroupId]['IpPermissions']
+        for rm in IpPermissions:
+            cidrs = {r['CidrIp'] for r in rm.get('IpRanges', [])}
+            for p in perms:
+                if (p.get('FromPort') == rm.get('FromPort')
+                        and p.get('ToPort') == rm.get('ToPort')
+                        and p.get('IpProtocol') == rm.get('IpProtocol')):
+                    p['IpRanges'] = [r for r in p.get('IpRanges', [])
+                                     if r.get('CidrIp') not in cidrs]
+            perms[:] = [p for p in perms if p.get('IpRanges')]
+        return {}
+
     def delete_security_group(self, GroupId, **kw):
         attached = any(
             g.get('GroupId') == GroupId
@@ -237,6 +252,45 @@ class TestOpenPorts:
                         for p in sg['IpPermissions'])
         assert opened == [(22, 22), (8080, 8080), (9000, 9000)]
 
+    def test_tightened_source_ranges_reapply(self, fake_aws):
+        """Changing aws.firewall_source_ranges revokes + re-authorizes an
+        already-open port (parity with gcp.open_ports patch behavior)."""
+        from skypilot_tpu import config as config_lib
+        aws_provision.run_instances('a2', 'us-east-1', 'us-east-1a', 1,
+                                    _deploy_vars())
+        aws_provision.open_ports('a2', 'us-east-1', ['8080'])
+        with config_lib.override(
+                {'aws': {'firewall_source_ranges': ['10.0.0.0/8']}}):
+            aws_provision.open_ports('a2', 'us-east-1', ['8080'])
+        sg = next(iter(
+            fake_aws.regions['us-east-1'].security_groups.values()))
+        rules = [p for p in sg['IpPermissions']
+                 if p.get('FromPort') == 8080]
+        assert len(rules) == 1
+        assert [r['CidrIp'] for r in rules[0]['IpRanges']] == ['10.0.0.0/8']
+
+    def test_default_ami_fails_fast_without_fake(self, monkeypatch):
+        """No image_id + no fake seam must raise an actionable CloudError,
+        not pass a placeholder AMI to EC2."""
+        import sys
+        from skypilot_tpu import exceptions
+        from skypilot_tpu.provision import aws_api as api
+        # `sys.modules[name] = None` makes `import boto3` raise
+        # ImportError even if boto3 is installed — keeps the test offline
+        # and deterministic everywhere.
+        monkeypatch.setitem(sys.modules, 'boto3', None)
+        monkeypatch.setattr(api, '_ami_cache', {})
+        old = api._ec2_factory
+        api.set_ec2_factory(None)
+        try:
+            with pytest.raises(exceptions.CloudError, match='image_id'):
+                api.resolve_default_ami('us-east-1')
+        finally:
+            api.set_ec2_factory(old)
+
+    def test_default_ami_in_fake_mode(self, fake_aws):
+        assert aws_api.resolve_default_ami('us-east-1') == 'ami-ubuntu-2204'
+
 
 class TestFailover:
 
@@ -266,14 +320,14 @@ class TestFailover:
         task.set_resources([r1])
         task.best_resources = r1
         task.candidate_resources = [r1, r2]
-        for s in 'abc':
+        for s in 'abcdef':
             fake_aws('us-east-1').fail_zones.add(f'us-east-1{s}')
         launched, info = RetryingProvisioner().provision(task, 'aws-fo2')
         assert launched.region == 'us-west-2'
         assert info.num_hosts == 1
 
     def test_all_exhausted_raises_with_history(self, fake_aws):
-        for s in 'abc':
+        for s in 'abcdef':
             fake_aws('us-east-1').fail_zones.add(f'us-east-1{s}')
         with pytest.raises(exceptions.ResourcesUnavailableError) as ei:
             RetryingProvisioner().provision(self._cpu_task(), 'aws-fo3')

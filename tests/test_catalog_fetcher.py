@@ -192,3 +192,25 @@ class TestAwsFetcher:
         assert fetch_aws.refresh(online=True,
                                  pricing_client=Exploding()) == 'offline'
         assert (tmp_path / 'aws_vms.csv').exists()
+
+
+def test_missing_csv_fallback_not_cached(tmp_path, monkeypatch):
+    """A catalog CSV that is absent at first query must be re-read once it
+    appears (e.g. regenerated by a fetcher in the same process) — the
+    empty-DataFrame fallback may not be cached permanently."""
+    import skypilot_tpu.catalog as catalog
+
+    monkeypatch.setattr(catalog, '_DATA_DIR', str(tmp_path))
+    catalog._read.cache_clear()
+    try:
+        assert catalog._read('xcloud_vms.csv').empty
+        (tmp_path / 'xcloud_vms.csv').write_text(
+            'instance_type,vcpus,memory_gb,region,price,spot_price\n'
+            'x1.large,4,16,xr-1,0.1,0.04\n')
+        df = catalog._read('xcloud_vms.csv')
+        assert list(df['instance_type']) == ['x1.large']
+        # And successful reads ARE cached (file delete is not noticed).
+        (tmp_path / 'xcloud_vms.csv').unlink()
+        assert not catalog._read('xcloud_vms.csv').empty
+    finally:
+        catalog._read.cache_clear()

@@ -118,7 +118,9 @@ def run(*, preset: str = 'llama-1b', batch_slots: int = 16,
         max_len: int = 4096, prompt_len: int = 2500, output_len: int = 150,
         concurrencies: Sequence[int] = (8, 24), window_s: float = 75.0,
         warmup_requests: int = 2, ready_timeout_s: float = 900.0,
-        service_name: str = 'bench-serve') -> Dict[str, Any]:
+        warmup_deadline_s: Optional[float] = None,
+        service_name: str = 'bench-serve',
+        progress=None) -> Dict[str, Any]:
     """Stand up the full serve stack on the local cloud, warm the replica
     (big prefill bucket + steady step compile), sweep concurrency, tear
     down. Returns the sweep plus the best-throughput point flattened into
@@ -171,8 +173,12 @@ def run(*, preset: str = 'llama-1b', batch_slots: int = 16,
         # Per-attempt timeout + overall deadline: a READY-but-wedged chip
         # (degraded tunnel) must fail the phase in minutes, not hang the
         # whole bench on 30 x 15-minute request timeouts.
+        if progress is not None:
+            progress(dict(out))  # replica READY: persist the config fields
         rnd = random.Random(7)
-        warm_deadline = time.time() + max(300.0, ready_timeout_s / 2)
+        if warmup_deadline_s is None:
+            warmup_deadline_s = max(300.0, ready_timeout_s / 2)
+        warm_deadline = time.time() + warmup_deadline_s
         warmed = False
         for i in range(max(1, warmup_requests)):
             tokens = [rnd.randrange(config.vocab_size)
@@ -208,6 +214,8 @@ def run(*, preset: str = 'llama-1b', batch_slots: int = 16,
             print(f'serve bench @ concurrency {conc}: {stats}',
                   file=sys.stderr)
             sweep.append(stats)
+            if progress is not None:
+                progress({**out, 'serve_sweep': sweep})
         out['serve_sweep'] = sweep
         best = max(sweep, key=lambda s: s.get('req_per_s', 0.0))
         if best.get('completed'):

@@ -142,14 +142,40 @@ def _kill_host_processes(cluster_name: str) -> None:
     except FileNotFoundError:
         pass
     # Job leaders run setsid'd (their pgid == the pid in the file).
+    # SIGTERM first for a clean exit, but follow up with SIGKILL: python
+    # only delivers signals between bytecodes, so a job wedged inside a
+    # blocking C call (e.g. a hung device-tunnel RPC) would otherwise
+    # survive teardown and keep holding the chip.
+    job_pgids = []
     for job_pid_file in glob_lib.glob(
             os.path.join(root, 'host*', '.skytpu_job_*.pid')):
         try:
             with open(job_pid_file) as f:
-                os.killpg(int(f.read().strip()), signal.SIGTERM)
+                pgid = int(f.read().strip())
+            os.killpg(pgid, signal.SIGTERM)
+            job_pgids.append(pgid)
         except (FileNotFoundError, ValueError, ProcessLookupError,
                 PermissionError):
             pass
+    if job_pgids:
+        deadline = time.time() + 3
+        while time.time() < deadline:
+            live = []
+            for pgid in job_pgids:
+                try:
+                    os.killpg(pgid, 0)
+                    live.append(pgid)
+                except (ProcessLookupError, PermissionError):
+                    pass
+            job_pgids = live
+            if not job_pgids:
+                break
+            time.sleep(0.05)
+        for pgid in job_pgids:
+            try:
+                os.killpg(pgid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
 
 
 def stop_instances(cluster_name: str, region: str) -> None:

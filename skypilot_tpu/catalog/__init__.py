@@ -193,10 +193,14 @@ def validate_region_zone(
     regions = set(tpus['region']).union(vms['region'])
     aws_regions = set(_vms('aws')['region'].unique())
     regions.update(aws_regions)
+    azure_regions = set(_vms('azure')['region'].unique())
+    regions.update(azure_regions)
     zones = set(tpus['zone'])
     # AWS AZs: region + single-letter suffix; regions carry up to six
     # (us-east-1a..f), so accept any letter on a known region.
     zones.update(f'{r}{s}' for r in aws_regions for s in 'abcdef')
+    # Azure AZs are bare digits within a region ('1'/'2'/'3').
+    zones.update('123')
     if zone is not None and zone not in zones:
         # GCE zones are region+suffix; accept unknown-but-wellformed.
         if zone.rsplit('-', 1)[0] not in regions:
@@ -206,7 +210,12 @@ def validate_region_zone(
         if region not in regions:
             raise exceptions.InvalidResourcesError(
                 f'Unknown region {region!r} (known: {sorted(regions)})')
-        if zone is not None and zone.rsplit('-', 1)[0] != region \
+        if zone is not None and zone in ('1', '2', '3'):
+            if region not in azure_regions:
+                raise exceptions.InvalidResourcesError(
+                    f'Zone {zone!r} is an Azure AZ digit but {region!r} '
+                    'is not an Azure region')
+        elif zone is not None and zone.rsplit('-', 1)[0] != region \
                 and not (zone.startswith(region)
                          and len(zone) == len(region) + 1):
             # GCP: region-suffix (us-central1-a); AWS: region+letter

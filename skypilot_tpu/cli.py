@@ -421,8 +421,31 @@ def jobs():
 @click.option('--detach-run', '-d', is_flag=True)
 def jobs_launch(entrypoint, name, cloud, accelerators, cmd, env,
                 detach_run):
-    """Submit a managed job (controller recovers it on preemption)."""
+    """Submit a managed job (controller recovers it on preemption).
+
+    A multi-document YAML entrypoint is a PIPELINE: its tasks run
+    sequentially on their own clusters, each with preemption recovery.
+    """
     from skypilot_tpu import jobs as jobs_lib
+    if entrypoint and entrypoint.endswith(('.yaml', '.yml')):
+        from skypilot_tpu.utils import common_utils as cu
+        from skypilot_tpu.utils import dag_utils
+        if len([c for c in cu.read_yaml_all(entrypoint) if c]) > 1:
+            if cloud or accelerators or cmd:
+                # Per-task resource flags are ambiguous across a
+                # pipeline's tasks; set them in each YAML document.
+                raise click.UsageError(
+                    '--cloud/--tpus/--cmd do not apply to multi-document '
+                    'pipeline YAMLs; set resources per task in the YAML.')
+            dag = dag_utils.load_chain_dag_from_yaml(
+                entrypoint, env_overrides=_parse_env_overrides(env))
+            job_id = jobs_lib.launch(dag, name=name)
+            click.echo(f'Managed pipeline job {job_id} submitted '
+                       f'({len(dag.tasks)} tasks).'
+                       f' Logs: skytpu jobs logs {job_id}')
+            if not detach_run:
+                sys.exit(jobs_lib.tail_logs(job_id, follow=True))
+            return
     task = _task_from_args(entrypoint, name, None, cloud, accelerators,
                            None, env, cmd)
     job_id = jobs_lib.launch(task, name=name)
@@ -440,12 +463,16 @@ def jobs_queue():
     if not rows:
         click.echo('No managed jobs.')
         return
-    fmt = '{:<5} {:<16} {:<18} {:<10} {:<20}'
-    click.echo(fmt.format('ID', 'NAME', 'STATUS', 'RECOVERIES',
+    fmt = '{:<5} {:<16} {:<18} {:<6} {:<10} {:<20}'
+    click.echo(fmt.format('ID', 'NAME', 'STATUS', 'TASK', 'RECOVERIES',
                           'CLUSTER'))
     for r in rows:
+        n_tasks = r.get('num_tasks', 1) or 1
+        task_col = (f"{(r.get('current_task_id') or 0) + 1}/{n_tasks}"
+                    if n_tasks > 1 else '-')
         click.echo(fmt.format(r['job_id'], (r['name'] or '-')[:16],
-                              r['status'].value, r['recovery_count'],
+                              r['status'].value, task_col,
+                              r['recovery_count'],
                               (r['cluster_name'] or '-')[:20]))
 
 

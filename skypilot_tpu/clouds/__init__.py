@@ -8,6 +8,7 @@ more providers can be added.
 from skypilot_tpu.clouds.cloud import (Cloud, CloudFeature, CLOUD_REGISTRY,
                                        FeasibleResources)
 from skypilot_tpu.clouds import aws as _aws  # registers
+from skypilot_tpu.clouds import azure as _azure  # registers
 from skypilot_tpu.clouds import gcp as _gcp  # registers
 from skypilot_tpu.clouds import kubernetes as _kubernetes  # registers
 from skypilot_tpu.clouds import local as _local  # registers

@@ -1,0 +1,141 @@
+"""Azure: VMs (controllers, CPU tasks, blob storage egress).
+
+Counterpart of reference ``sky/clouds/azure.py`` (feasibility, pricing,
+deploy vars, credential checks). This TPU-native stack has no Azure
+accelerators — Azure is the third VM cloud: it hardens the multi-cloud
+abstraction (optimizer cross-cloud choice, GCP<->AWS<->Azure failover)
+and adds blob-side storage placement.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu.clouds import cloud as cloud_lib
+
+
+@cloud_lib.CLOUD_REGISTRY.register(name='azure')
+class Azure(cloud_lib.Cloud):
+    NAME = 'azure'
+    _FEATURES = frozenset({
+        cloud_lib.CloudFeature.STOP,
+        cloud_lib.CloudFeature.AUTOSTOP,
+        cloud_lib.CloudFeature.SPOT,
+        cloud_lib.CloudFeature.MULTI_HOST,
+        cloud_lib.CloudFeature.STORAGE_MOUNTS,
+        cloud_lib.CloudFeature.OPEN_PORTS,
+        cloud_lib.CloudFeature.CUSTOM_IMAGES,
+    })
+
+    # ---- credentials ------------------------------------------------------
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        if os.environ.get('SKYTPU_FAKE_AZURE_CREDENTIALS'):
+            return True, None
+        # Gate on the SDK too: provisioning needs azure-mgmt-compute, so
+        # reporting Azure usable without it would let the optimizer place
+        # clusters that every provision call then fails (the AWS AMI
+        # lesson: check-time honesty beats launch-time surprises).
+        try:
+            import azure.mgmt.compute  # type: ignore # noqa: F401
+        except ImportError:
+            return False, ('azure-mgmt-compute SDK not installed '
+                           '(pip install azure-mgmt-compute '
+                           'azure-mgmt-network azure-identity).')
+        if os.environ.get('AZURE_SUBSCRIPTION_ID'):
+            return True, None
+        if os.path.exists(os.path.expanduser('~/.azure/azureProfile.json')):
+            return True, None
+        return False, ('Azure credentials not found. Run `az login` or '
+                       'set AZURE_SUBSCRIPTION_ID (+ service principal '
+                       'env).')
+
+    @classmethod
+    def get_active_user_identity(cls) -> Optional[List[str]]:
+        if os.environ.get('SKYTPU_FAKE_AZURE_CREDENTIALS'):
+            return ['fake-identity@azure.test']
+        sub = os.environ.get('AZURE_SUBSCRIPTION_ID')
+        return [sub] if sub else None
+
+    # ---- topology ---------------------------------------------------------
+    def regions_for(self, resources) -> List[str]:
+        if resources.tpu is not None:
+            return []  # no TPUs on Azure
+        itype = resources.instance_type or 'Standard_D2s_v5'
+        regions = catalog.get_vm_regions(itype, cloud=self.NAME)
+        if resources.region is not None:
+            regions = [r for r in regions if r == resources.region]
+        return regions
+
+    def zones_for(self, resources, region: str) -> List[Optional[str]]:
+        # Azure availability zones are optional placement ('1'/'2'/'3');
+        # the default deployment is regional (zone=None) and a zonal
+        # allocation failure fails over to explicit zones, mirroring the
+        # reference's regional-first Azure behavior.
+        if resources.zone is not None:
+            return [resources.zone]
+        return [None, '1', '2', '3']
+
+    # ---- pricing ----------------------------------------------------------
+    def hourly_cost(self, resources, region=None, zone=None) -> float:
+        region = region or resources.region
+        assert resources.instance_type is not None, resources
+        return catalog.get_instance_hourly_cost(
+            resources.instance_type, resources.use_spot, region=region,
+            cloud=self.NAME)
+
+    def egress_cost_per_gb(self, dst_cloud: str, dst_region: str,
+                           src_region: Optional[str]) -> float:
+        if src_region is None or dst_cloud != self.NAME:
+            return 0.087  # internet egress (public Azure pricing, first tier)
+        if src_region == dst_region:
+            return 0.0
+        return 0.02  # inter-region within Azure
+
+    # ---- feasibility ------------------------------------------------------
+    def get_feasible_resources(self,
+                               resources) -> cloud_lib.FeasibleResources:
+        if resources.tpu is not None:
+            return cloud_lib.FeasibleResources(
+                [], hint='Azure has no TPU accelerators; use cloud: gcp.')
+        if resources.instance_type is not None:
+            if not catalog.get_vm_regions(resources.instance_type,
+                                          cloud=self.NAME):
+                return cloud_lib.FeasibleResources(
+                    [], hint=(f'{resources.instance_type} is not an Azure '
+                              'VM size in the catalog.'))
+            return cloud_lib.FeasibleResources(
+                [resources.copy(cloud=self.NAME)])
+        itype = catalog.get_default_instance_type(
+            cpus=resources._cpus, cpus_plus=resources._cpus_plus,  # pylint: disable=protected-access
+            memory=resources._memory, memory_plus=resources._memory_plus,  # pylint: disable=protected-access
+            region=resources.region, cloud=self.NAME)
+        if itype is None:
+            return cloud_lib.FeasibleResources(
+                [], hint=(f'No Azure VM size with cpus={resources.cpus}, '
+                          f'memory={resources.memory}'))
+        return cloud_lib.FeasibleResources(
+            [resources.copy(cloud=self.NAME, instance_type=itype)])
+
+    # ---- deployment -------------------------------------------------------
+    def make_deploy_variables(self, resources, cluster_name_on_cloud: str,
+                              region: str,
+                              zone: Optional[str]) -> Dict[str, Any]:
+        from skypilot_tpu.provision import docker_utils
+        image_id = resources.image_id
+        if docker_utils.is_docker_image(image_id):
+            image_id = None  # stock image; ranks run in the container
+        return {
+            'cloud': self.NAME,
+            'mode': 'azure_vm',
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region,
+            'zone': zone,
+            'use_spot': resources.use_spot,
+            'disk_size_gb': resources.disk_size,
+            'labels': dict(resources.labels or {}),
+            'ports': list(resources.ports or ()),
+            'instance_type': resources.instance_type,
+            'image_id': image_id,
+        }

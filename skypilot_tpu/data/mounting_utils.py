@@ -102,3 +102,47 @@ def unmount_command(mount_point: str) -> str:
     return (f'mountpoint -q {q(mount_point)} && '
             f'(fusermount -u {q(mount_point)} || '
             f'sudo umount {q(mount_point)}) || true')
+
+
+def azureblob_rclone_env(account: str) -> 'dict[str, str]':
+    """The one definition of the rclone azureblob remote: account from
+    config, key/SAS from the standard AZURE_STORAGE_KEY /
+    AZURE_STORAGE_SAS_TOKEN env (or MSI on Azure VMs via env_auth).
+    Shared by blob-store sync commands and the FUSE mount."""
+    return {
+        'RCLONE_CONFIG_SKYTPU_AZ_TYPE': 'azureblob',
+        'RCLONE_CONFIG_SKYTPU_AZ_ACCOUNT': account,
+        'RCLONE_CONFIG_SKYTPU_AZ_ENV_AUTH': 'true',
+    }
+
+
+def azureblob_rclone_env_prefix(account: str) -> str:
+    """azureblob_rclone_env as a shell `K=V K=V ` command prefix."""
+    return ' '.join(f'{k}={shlex.quote(v)}' for k, v in
+                    azureblob_rclone_env(account).items()) + ' '
+
+
+def rclone_azureblob_mount_command(container: str, mount_point: str,
+                                   sub_path: str = '',
+                                   account: str = '',
+                                   read_only: bool = True) -> str:
+    """Idempotent install + rclone FUSE mount of an Azure blob container.
+
+    Same rclone machinery as the S3 mount, with the ``azureblob`` remote
+    type. Reference counterpart: the blobfuse2 branch of
+    sky/data/mounting_utils.py.
+    """
+    q = shlex.quote
+    src = f'skytpu-az:{container}'
+    if sub_path:
+        src += f'/{sub_path}'
+    ro = '--read-only ' if read_only else '--vfs-cache-mode writes '
+    return (
+        f'{_INSTALL_RCLONE} && '
+        f'sudo mkdir -p {q(mount_point)} && '
+        f'sudo chown $(id -u):$(id -g) {q(mount_point)} && '
+        f'(mountpoint -q {q(mount_point)} || '
+        f'{azureblob_rclone_env_prefix(account)}'
+        f'rclone mount {q(src)} {q(mount_point)} '
+        f'--daemon --allow-non-empty {ro}'
+        '--dir-cache-time 30s --vfs-read-chunk-size 64M)')

@@ -306,6 +306,88 @@ class R2Store(S3Store):
         return f'https://{account}.r2.cloudflarestorage.com'
 
 
+class AzureBlobStore(AbstractStore):
+    """Azure Blob Storage via rclone (sync + FUSE mount).
+
+    Reference counterpart: sky/data/storage.py AzureBlobStore (:2413
+    family — there the azure SDK builds container clients; here the
+    rclone machinery used for S3/R2 runs with the ``azureblob`` remote
+    type, so remote hosts need no azure SDK). The bucket is the
+    CONTAINER; the storage account comes from ``$AZURE_STORAGE_ACCOUNT``
+    or ``azure.storage_account`` in ~/.skytpu/config.yaml (same pattern
+    as R2's account id), credentials from the standard
+    AZURE_STORAGE_KEY / AZURE_STORAGE_SAS_TOKEN env.
+    """
+
+    SCHEME = 'az'
+
+    def _account(self) -> str:
+        from skypilot_tpu import config as config_lib
+        account = (os.environ.get('AZURE_STORAGE_ACCOUNT')
+                   or config_lib.get_nested(('azure', 'storage_account'),
+                                            None))
+        if not account:
+            raise exceptions.StorageError(
+                'Azure blob stores need a storage account: set '
+                '$AZURE_STORAGE_ACCOUNT or azure.storage_account in '
+                '~/.skytpu/config.yaml.')
+        return account
+
+    def _env_prefix(self) -> str:
+        from skypilot_tpu.data import mounting_utils
+        return mounting_utils.azureblob_rclone_env_prefix(self._account())
+
+    @property
+    def _remote_path(self) -> str:
+        path = f'skytpu-az:{self.bucket}'
+        return f'{path}/{self.sub_path}' if self.sub_path else path
+
+    def download_command(self, dst: str) -> str:
+        from skypilot_tpu.data import mounting_utils
+        q = shlex.quote
+        return (f'mkdir -p {q(dst)} && '
+                f'{mounting_utils._INSTALL_RCLONE} && '  # pylint: disable=protected-access
+                f'{self._env_prefix()}'
+                f'rclone sync {q(self._remote_path)} {q(dst)}')
+
+    def upload_command(self, src: str) -> str:
+        from skypilot_tpu.data import mounting_utils
+        q = shlex.quote
+        return (f'{mounting_utils._INSTALL_RCLONE} && '  # pylint: disable=protected-access
+                f'{self._env_prefix()}'
+                f'rclone sync {q(src)} {q(self._remote_path)}')
+
+    def mount_command(self, mount_point: str) -> str:
+        from skypilot_tpu.data import mounting_utils
+        return mounting_utils.rclone_azureblob_mount_command(
+            self.bucket, mount_point, self.sub_path,
+            account=self._account(), read_only=True)
+
+    def _rclone(self, *args: str):
+        from skypilot_tpu.data import mounting_utils
+        env = dict(os.environ,
+                   **mounting_utils.azureblob_rclone_env(self._account()))
+        return subprocess.run(['rclone', *args], capture_output=True,
+                              text=True, env=env)
+
+    def upload_local(self, local_path: str) -> None:
+        proc = self._rclone('sync', os.path.expanduser(local_path),
+                            self._remote_path)
+        if proc.returncode != 0:
+            raise exceptions.StorageError(
+                f'upload to {self.url} failed: {proc.stderr[-500:]}')
+
+    def download_local(self, local_path: str) -> None:
+        os.makedirs(local_path, exist_ok=True)
+        proc = self._rclone('sync', self._remote_path, local_path)
+        if proc.returncode != 0:
+            raise exceptions.StorageError(
+                f'download from {self.url} failed: {proc.stderr[-500:]}')
+
+    def exists(self) -> bool:
+        return self._rclone('lsd', self._remote_path).returncode == 0
+
+
 _STORES: Dict[str, Type[AbstractStore]] = {}
 
 
@@ -317,6 +399,7 @@ def register_store(cls: Type[AbstractStore]) -> Type[AbstractStore]:
 register_store(GcsStore)
 register_store(S3Store)
 register_store(R2Store)
+register_store(AzureBlobStore)
 register_store(LocalStore)
 
 

@@ -1,14 +1,21 @@
 """Per-job controller process: launch, watch, recover, clean up.
 
 Counterpart of reference ``sky/jobs/controller.py`` (_run_one_task :119,
-main loop :403, cleanup :508) + the preemption-vs-failure discrimination
-the reference does across jobs/controller.py:119-403:
+main loop :403 — chain-DAG pipelines run tasks sequentially with per-task
+recovery, cleanup :508) + the preemption-vs-failure discrimination the
+reference does across jobs/controller.py:119-403:
 
 - cluster gone / not UP / job record missing  -> PREEMPTION -> recover()
 - job FAILED with cluster healthy             -> user failure ->
   restart up to max_restarts_on_errors, else terminal FAILED
 - job FAILED_SETUP                            -> terminal (setup bugs
   don't heal by relaunching)
+
+Pipelines (multi-task chain DAGs): tasks run sequentially, each on its
+own ephemeral cluster; a preemption mid-task recovers THAT task only —
+earlier tasks' outputs (in mounted storage) are never recomputed. Task
+rows in ``managed_job_tasks`` track per-task lifecycle; the job row's
+status mirrors the current task and ``current_task_id`` points at it.
 
 Entry: ``python -m skypilot_tpu.jobs.controller --job-id N`` (spawned
 detached by jobs.core.launch).
@@ -42,15 +49,25 @@ class JobsController:
         self.job_id = job_id
         row = state.get(job_id)
         assert row is not None, f'managed job {job_id} missing'
-        self.task = task_lib.Task.from_yaml_config(row['task_yaml'])
-        self.cluster_name = (row['cluster_name']
-                             or f'skytpu-jobs-{job_id}')
-        state.update(job_id, cluster_name=self.cluster_name,
-                     controller_pid=os.getpid())
-        self.strategy = recovery_strategy.StrategyExecutor.make(
-            self.task, self.cluster_name)
+        self.tasks = [task_lib.Task.from_yaml_config(cfg)
+                      for cfg in state.tasks_of(row)]
+        self._base_cluster = (row['cluster_name']
+                              or f'skytpu-jobs-{job_id}')
+        state.update(job_id, controller_pid=os.getpid())
+        # Per-task current state (set by _run_one_task):
+        self.task_id = 0
+        self.cluster_name = self._base_cluster
+        self.strategy: Optional[
+            recovery_strategy.StrategyExecutor] = None
 
     # -- helpers -------------------------------------------------------------
+    def _task_cluster(self, task_id: int) -> str:
+        """Single-task jobs keep the legacy name (round<=4 rows resume
+        under it); pipeline tasks each get their own cluster."""
+        if len(self.tasks) == 1:
+            return self._base_cluster
+        return f'{self._base_cluster}-t{task_id}'
+
     def _cluster_job_status(self, cluster_job_id: int
                             ) -> Optional[cluster_job_lib.JobStatus]:
         """None => the cluster (or its job record) is gone: preemption."""
@@ -71,6 +88,15 @@ class JobsController:
         except exceptions.SkyTpuError:
             pass
 
+    def _set_task_and_job_status(self, status: ManagedJobStatus,
+                                 failure_reason: Optional[str] = None,
+                                 respect_cancelling: bool = True) -> None:
+        state.set_task_status(self.job_id, self.task_id, status,
+                              failure_reason=failure_reason)
+        state.set_status(self.job_id, status,
+                         failure_reason=failure_reason,
+                         respect_cancelling=respect_cancelling)
+
     def _finish(self, status: ManagedJobStatus,
                 failure_reason: Optional[str] = None) -> None:
         """Terminalize: teardown -> release schedule slot -> publish status.
@@ -84,6 +110,15 @@ class JobsController:
         """
         self._down_cluster()
         scheduler.job_done(self.job_id)
+        state.set_task_status(self.job_id, self.task_id, status,
+                              failure_reason=failure_reason)
+        if status == ManagedJobStatus.CANCELLED:
+            # Tasks the pipeline never reached are CANCELLED too, so the
+            # queue never shows PENDING rows of a finished job.
+            for trow in state.list_task_rows(self.job_id):
+                if not trow['status'].is_terminal():
+                    state.set_task_status(self.job_id, trow['task_id'],
+                                          ManagedJobStatus.CANCELLED)
         state.set_status(self.job_id, status, failure_reason=failure_reason)
 
     def _fail_no_resource(self, reason: str) -> None:
@@ -103,72 +138,107 @@ class JobsController:
                 pass
         self._finish(ManagedJobStatus.CANCELLED)
 
-    # -- main ----------------------------------------------------------------
-    def run(self) -> None:
+    # -- per-task loop -------------------------------------------------------
+    def _run_one_task(self, task_id: int, task: task_lib.Task) -> bool:
+        """Run one pipeline task to SUCCEEDED; returns False when the job
+        was terminalized (failure/cancel) so the pipeline stops.
+
+        Mirrors reference _run_one_task (sky/jobs/controller.py:119):
+        launch -> poll -> {succeeded | preempted -> recover | failed ->
+        maybe restart} with all state transitions per task."""
         job_id = self.job_id
-        state.set_status(job_id, ManagedJobStatus.STARTING,
-                         respect_cancelling=True)
+        self.task_id = task_id
+        self.cluster_name = self._task_cluster(task_id)
+        state.update(job_id, current_task_id=task_id,
+                     cluster_name=self.cluster_name, cluster_job_id=None)
+        self.strategy = recovery_strategy.StrategyExecutor.make(
+            task, self.cluster_name)
+
+        self._set_task_and_job_status(ManagedJobStatus.STARTING)
         try:
             with scheduler.launch_slot(job_id):
                 cluster_job_id = self.strategy.launch(retry_until_up=False)
         except exceptions.ResourcesUnavailableError as e:
             self._fail_no_resource(str(e))
-            return
+            return False
         state.update(job_id, cluster_job_id=cluster_job_id)
+        state.set_task_status(job_id, task_id, ManagedJobStatus.RUNNING,
+                              cluster_job_id=cluster_job_id)
         state.set_status(job_id, ManagedJobStatus.RUNNING,
                          respect_cancelling=True)
 
         while True:
             if state.cancel_requested(job_id):
                 self._handle_cancel(cluster_job_id)
-                return
+                return False
             status = self._cluster_job_status(cluster_job_id)
             if status is None:
-                # Preemption (slice terminated / cluster unreachable).
-                state.set_status(job_id, ManagedJobStatus.RECOVERING,
-                                 respect_cancelling=True)
+                # Preemption (slice terminated / cluster unreachable):
+                # recover THIS task; earlier tasks' outputs stand.
+                self._set_task_and_job_status(ManagedJobStatus.RECOVERING)
                 state.bump_recovery(job_id)
+                state.bump_task_recovery(job_id, task_id)
                 self._down_cluster()
                 try:
-                    with scheduler.launch_slot(self.job_id):
+                    with scheduler.launch_slot(job_id):
                         cluster_job_id = self.strategy.recover()
                 except exceptions.ResourcesUnavailableError as e:
                     self._fail_no_resource(str(e))
-                    return
+                    return False
                 state.update(job_id, cluster_job_id=cluster_job_id)
+                state.set_task_status(job_id, task_id,
+                                      ManagedJobStatus.RUNNING,
+                                      cluster_job_id=cluster_job_id)
                 state.set_status(job_id, ManagedJobStatus.RUNNING,
                                  respect_cancelling=True)
             elif status == cluster_job_lib.JobStatus.SUCCEEDED:
-                self._finish(ManagedJobStatus.SUCCEEDED)
-                return
+                if task_id == len(self.tasks) - 1:
+                    self._finish(ManagedJobStatus.SUCCEEDED)
+                else:
+                    # Mid-pipeline: retire this task's cluster and hand
+                    # the (still-held) schedule slot to the next task.
+                    state.set_task_status(job_id, task_id,
+                                          ManagedJobStatus.SUCCEEDED)
+                    self._down_cluster()
+                return True
             elif status == cluster_job_lib.JobStatus.FAILED_SETUP:
                 self._finish(ManagedJobStatus.FAILED_SETUP,
                              failure_reason='task setup failed')
-                return
+                return False
             elif status == cluster_job_lib.JobStatus.FAILED:
                 # User-code failure on a healthy cluster.
                 if self.strategy.should_restart_on_failure():
-                    state.set_status(job_id, ManagedJobStatus.RECOVERING,
-                                     respect_cancelling=True)
+                    self._set_task_and_job_status(
+                        ManagedJobStatus.RECOVERING)
                     state.bump_recovery(job_id)
+                    state.bump_task_recovery(job_id, task_id)
                     try:
-                        with scheduler.launch_slot(self.job_id):
+                        with scheduler.launch_slot(job_id):
                             cluster_job_id = self.strategy.launch(
                                 retry_until_up=False)
                     except exceptions.ResourcesUnavailableError as e:
                         self._fail_no_resource(str(e))
-                        return
+                        return False
                     state.update(job_id, cluster_job_id=cluster_job_id)
+                    state.set_task_status(job_id, task_id,
+                                          ManagedJobStatus.RUNNING,
+                                          cluster_job_id=cluster_job_id)
                     state.set_status(job_id, ManagedJobStatus.RUNNING,
                                      respect_cancelling=True)
                 else:
                     self._finish(ManagedJobStatus.FAILED,
                                  failure_reason='task run: non-zero exit')
-                    return
+                    return False
             elif status == cluster_job_lib.JobStatus.CANCELLED:
                 self._finish(ManagedJobStatus.CANCELLED)
-                return
+                return False
             time.sleep(_poll_interval())
+
+    # -- main ----------------------------------------------------------------
+    def run(self) -> None:
+        for task_id, task in enumerate(self.tasks):
+            if not self._run_one_task(task_id, task):
+                return
 
 
 def main() -> None:

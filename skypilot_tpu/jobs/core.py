@@ -42,17 +42,36 @@ def _parse_json_line(res, op: str) -> Dict[str, Any]:
     return controller_utils.parse_rpc_json(res, f'jobs {op}')
 
 
-def launch(task: task_lib.Task, name: Optional[str] = None) -> int:
-    """Submit a managed job to the controller cluster; returns job id.
+def launch(task, name: Optional[str] = None) -> int:
+    """Submit a managed job (a Task, or a chain Dag pipeline whose tasks
+    run sequentially with per-task recovery) to the controller cluster;
+    returns job id.
 
     The admin policy runs HERE, client-side, before the task is shipped:
     a remote controller cluster does not carry the client's config, so
     enforcement on the controller would be silently absent.
     """
     from skypilot_tpu import admin_policy
-    task = admin_policy.apply(task, operation='jobs_launch')
-    job_name = name or task.name or 'managed-job'
-    task_json = json.dumps(task.to_yaml_config())
+    from skypilot_tpu import dag as dag_lib
+    if isinstance(task, dag_lib.Dag):
+        dag = task
+        if not dag.is_chain():
+            raise exceptions.InvalidTaskError(
+                'managed-job pipelines support chain DAGs only '
+                '(sequential tasks); general DAGs run via sky.launch')
+        tasks = [admin_policy.apply(t, operation='jobs_launch')
+                 for t in dag.topological_order()]
+        if len(tasks) == 1:
+            payload = tasks[0].to_yaml_config()
+        else:
+            payload = {'name': dag.name,
+                       'tasks': [t.to_yaml_config() for t in tasks]}
+        job_name = name or dag.name or tasks[0].name or 'managed-job'
+    else:
+        task = admin_policy.apply(task, operation='jobs_launch')
+        payload = task.to_yaml_config()
+        job_name = name or task.name or 'managed-job'
+    task_json = json.dumps(payload)
     res = _run_jobcli(f'submit --name {shlex.quote(job_name)} '
                       f'--task-json {shlex.quote(task_json)}')
     return int(_parse_json_line(res, 'submit')['job_id'])
@@ -68,6 +87,8 @@ def queue(refresh_controller: bool = True) -> List[Dict[str, Any]]:
     for row in rows:
         row['status'] = ManagedJobStatus(row['status'])
         row['schedule_state'] = state.ScheduleState(row['schedule_state'])
+        for trow in row.get('tasks', []):
+            trow['status'] = ManagedJobStatus(trow['status'])
     return rows
 
 
@@ -177,6 +198,9 @@ def queue_on_controller(reconcile: bool = True) -> List[Dict[str, Any]]:
                 reconciled = True
     if reconciled:
         scheduler.maybe_schedule_next_jobs()  # freed slots
+    for row in rows:
+        if row.get('num_tasks', 1) > 1:  # pipeline: attach per-task rows
+            row['tasks'] = state.list_task_rows(row['job_id'])
     return rows
 
 

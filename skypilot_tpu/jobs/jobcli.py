@@ -32,6 +32,8 @@ def _cmd_queue(args) -> int:
     for row in rows:
         row['status'] = row['status'].value
         row['schedule_state'] = row['schedule_state'].value
+        for trow in row.get('tasks', []):
+            trow['status'] = trow['status'].value
     print(json.dumps({'jobs': rows}))
     return 0
 

@@ -97,20 +97,118 @@ def _db() -> sqlite3.Connection:
                          "schedule_state TEXT NOT NULL DEFAULT 'INACTIVE'")
         except sqlite3.OperationalError:
             pass
+        for ddl in (  # migrate pre-pipeline (round<=4) DBs
+                'ALTER TABLE managed_jobs ADD COLUMN '
+                'current_task_id INTEGER NOT NULL DEFAULT 0',
+                'ALTER TABLE managed_jobs ADD COLUMN '
+                'num_tasks INTEGER NOT NULL DEFAULT 1'):
+            try:
+                conn.execute(ddl)
+            except sqlite3.OperationalError:
+                pass
+        # One row per (job, task): pipelines (multi-task chain DAGs) track
+        # per-task lifecycle here; the managed_jobs row carries the overall
+        # job status + a current_task_id pointer (reference
+        # sky/jobs/state.py spot table keyed by job_id+task_id).
+        conn.execute("""
+            CREATE TABLE IF NOT EXISTS managed_job_tasks (
+                job_id INTEGER NOT NULL,
+                task_id INTEGER NOT NULL,
+                name TEXT,
+                status TEXT NOT NULL,
+                cluster_job_id INTEGER,
+                recovery_count INTEGER DEFAULT 0,
+                failure_reason TEXT,
+                started_at REAL,
+                ended_at REAL,
+                PRIMARY KEY (job_id, task_id)
+            )""")
         conn.commit()
         conns[path] = conn
     return conn
 
 
+def tasks_of(row: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Task configs of a managed job: a pipeline stores
+    ``{'tasks': [cfg, ...]}``, a single-task job (incl. every pre-round-5
+    row) stores the bare task config."""
+    yaml_cfg = row['task_yaml']
+    if isinstance(yaml_cfg, dict) and isinstance(yaml_cfg.get('tasks'),
+                                                 list):
+        return yaml_cfg['tasks']
+    return [yaml_cfg]
+
+
 def create(name: str, task_yaml: Dict[str, Any]) -> int:
+    """Insert a managed job. ``task_yaml`` is a single task config or a
+    pipeline ``{'tasks': [cfg, ...]}``; per-task rows are created
+    alongside so queue/status can show pipeline progress from t=0."""
     conn = _db()
+    task_cfgs = (task_yaml['tasks']
+                 if isinstance(task_yaml.get('tasks'), list)
+                 else [task_yaml])
     cur = conn.execute(
-        'INSERT INTO managed_jobs (name, task_yaml, status, submitted_at) '
-        'VALUES (?,?,?,?)',
+        'INSERT INTO managed_jobs (name, task_yaml, status, submitted_at, '
+        'num_tasks) VALUES (?,?,?,?,?)',
         (name, json.dumps(task_yaml), ManagedJobStatus.PENDING.value,
-         time.time()))
+         time.time(), len(task_cfgs)))
+    job_id = int(cur.lastrowid)
+    for task_id, cfg in enumerate(task_cfgs):
+        conn.execute(
+            'INSERT INTO managed_job_tasks (job_id, task_id, name, status) '
+            'VALUES (?,?,?,?)',
+            (job_id, task_id, cfg.get('name'),
+             ManagedJobStatus.PENDING.value))
     conn.commit()
-    return int(cur.lastrowid)
+    return job_id
+
+
+def set_task_status(job_id: int, task_id: int, status: ManagedJobStatus,
+                    failure_reason: Optional[str] = None,
+                    cluster_job_id: Optional[int] = None) -> None:
+    conn = _db()
+    now = time.time()
+    sets = ['status=?']
+    args: List[Any] = [status.value]
+    if status == ManagedJobStatus.RUNNING:
+        sets.append('started_at=COALESCE(started_at, ?)')
+        args.append(now)
+    if status.is_terminal():
+        sets.append('ended_at=?')
+        args.append(now)
+    if failure_reason is not None:
+        sets.append('failure_reason=?')
+        args.append(failure_reason)
+    if cluster_job_id is not None:
+        sets.append('cluster_job_id=?')
+        args.append(cluster_job_id)
+    conn.execute(f'UPDATE managed_job_tasks SET {", ".join(sets)} '
+                 'WHERE job_id=? AND task_id=?', (*args, job_id, task_id))
+    conn.commit()
+
+
+def bump_task_recovery(job_id: int, task_id: int) -> None:
+    conn = _db()
+    conn.execute('UPDATE managed_job_tasks SET '
+                 'recovery_count=recovery_count+1 '
+                 'WHERE job_id=? AND task_id=?', (job_id, task_id))
+    conn.commit()
+
+
+def list_task_rows(job_id: int) -> List[Dict[str, Any]]:
+    out = []
+    for row in _db().execute(
+            'SELECT task_id, name, status, cluster_job_id, recovery_count, '
+            'failure_reason, started_at, ended_at FROM managed_job_tasks '
+            'WHERE job_id=? ORDER BY task_id ASC', (job_id,)):
+        out.append({
+            'task_id': row[0], 'name': row[1],
+            'status': ManagedJobStatus(row[2]),
+            'cluster_job_id': row[3], 'recovery_count': row[4],
+            'failure_reason': row[5], 'started_at': row[6],
+            'ended_at': row[7],
+        })
+    return out
 
 
 def set_status(job_id: int, status: ManagedJobStatus,
@@ -196,8 +294,8 @@ def list_jobs(job_ids: Optional[List[int]] = None
               ) -> List[Dict[str, Any]]:
     q = ('SELECT job_id, name, task_yaml, status, cluster_name, '
          'cluster_job_id, recovery_count, failure_reason, controller_pid, '
-         'submitted_at, started_at, ended_at, schedule_state '
-         'FROM managed_jobs')
+         'submitted_at, started_at, ended_at, schedule_state, '
+         'current_task_id, num_tasks FROM managed_jobs')
     args: List[Any] = []
     if job_ids:
         q += f' WHERE job_id IN ({",".join("?" * len(job_ids))})'
@@ -214,6 +312,7 @@ def list_jobs(job_ids: Optional[List[int]] = None
             'controller_pid': row[8], 'submitted_at': row[9],
             'started_at': row[10], 'ended_at': row[11],
             'schedule_state': ScheduleState(row[12]),
+            'current_task_id': row[13], 'num_tasks': row[14],
         })
     return out
 

@@ -23,6 +23,7 @@ _CLOUD_MODULES = {
     'local': 'skypilot_tpu.provision.local_impl',
     'gcp': 'skypilot_tpu.provision.gcp',
     'aws': 'skypilot_tpu.provision.aws',
+    'azure': 'skypilot_tpu.provision.azure',
     'kubernetes': 'skypilot_tpu.provision.kubernetes',
 }
 

@@ -1,0 +1,329 @@
+"""Azure provisioner: VM host groups (controllers, CPU tasks, storage).
+
+Counterpart of reference ``sky/provision/azure/instance.py`` (VM ops,
+NSG bootstrap in config.py) — the third VM cloud proving the functional
+provision API generalizes. Same record/classification/failover shape as
+the GCP/AWS provisioners so ``RetryingProvisioner`` drives all three
+identically: tag-based rank discovery, capacity-vs-quota error
+classification, partial-failure teardown.
+
+Azure-isms vs EC2 (mirrored from the reference's handling):
+- stop is ``deallocate`` (billing stops; 'stopped' alone still bills);
+- spot is ``priority='Spot'`` + an eviction policy, and reclaim
+  DEALLOCATES the VM rather than deleting it — a spot VM found
+  deallocated that we did not stop counts as preempted;
+- ports are NSG rules with priorities, not SG permissions.
+
+Cluster bookkeeping (region, zone, name-on-cloud) lives in the client
+state kv, mirroring ``provision/gcp.py``.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import authentication
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import provision as provision_lib
+from skypilot_tpu.provision import azure_api
+from skypilot_tpu.utils import command_runner as runner_lib
+
+_TAG_CLUSTER = 'skytpu-cluster'
+_TAG_RANK = 'skytpu-rank'
+
+# Azure power/provisioning states -> the provision API's state words.
+_STATE_MAP = {
+    'creating': 'pending', 'starting': 'pending', 'running': 'running',
+    'stopping': 'stopping', 'stopped': 'stopping',  # stopped still bills
+    'deallocating': 'stopping', 'deallocated': 'stopped',
+    'deleting': 'terminating',
+}
+
+SSH_USER = 'azureuser'  # canonical Azure Linux login
+
+# NSG rule priorities: 100-4096, lower wins; SSH at 1000, task ports from
+# 2000 upward (one rule per port spec, priority derived from the port so
+# re-opening is idempotent).
+_SSH_PRIORITY = 1000
+_PORT_PRIORITY_BASE = 2000
+
+
+# ---- cluster record --------------------------------------------------------
+def _record_key(cluster_name: str) -> str:
+    return f'azure_cluster/{cluster_name}'
+
+
+def _save_record(cluster_name: str, record: Dict[str, Any]) -> None:
+    global_user_state.set_kv(_record_key(cluster_name), json.dumps(record))
+
+
+def _load_record(cluster_name: str) -> Optional[Dict[str, Any]]:
+    raw = global_user_state.get_kv(_record_key(cluster_name))
+    return json.loads(raw) if raw else None
+
+
+def _delete_record(cluster_name: str) -> None:
+    global_user_state.set_kv(_record_key(cluster_name), '')
+
+
+def _require_record(cluster_name: str) -> Dict[str, Any]:
+    record = _load_record(cluster_name)
+    if not record:
+        raise exceptions.ClusterError(
+            f'No Azure provisioning record for {cluster_name!r}')
+    return record
+
+
+def _nsg_name(name_on_cloud: str) -> str:
+    return f'skytpu-{name_on_cloud}-nsg'
+
+
+def _live_vms(client, name: str,
+              include_deleting: bool = False) -> List[Dict[str, Any]]:
+    vms = azure_api.call(client, 'list_vms').get('vms', [])
+    out = []
+    for vm in vms:
+        if azure_api.tag_value(vm, _TAG_CLUSTER) != name:
+            continue
+        if not include_deleting and vm.get('state') == 'deleting':
+            continue
+        if vm.get('state') == 'deleted':
+            continue
+        out.append(vm)
+    return out
+
+
+def _ensure_nsg(client, name: str) -> str:
+    """Per-cluster network security group with SSH open; task/serve ports
+    added by open_ports (reference sky/provision/azure/config.py)."""
+    nsg = _nsg_name(name)
+    existing = azure_api.call(client, 'list_nsgs').get('nsgs', [])
+    if nsg not in existing:
+        azure_api.call(client, 'create_nsg', name=nsg)
+        azure_api.call(client, 'upsert_nsg_rule', nsg=nsg,
+                       rule_name='skytpu-ssh', priority=_SSH_PRIORITY,
+                       port_range='22', source_ranges=['0.0.0.0/0'])
+    return nsg
+
+
+# ---- provision API ---------------------------------------------------------
+def run_instances(cluster_name: str, region: str, zone: Optional[str],
+                  num_hosts: int, deploy_vars: Dict[str, Any]) -> None:
+    name = deploy_vars['cluster_name_on_cloud']
+    record = {'region': region, 'zone': zone, 'name_on_cloud': name,
+              'num_hosts': num_hosts, 'deploy_vars': deploy_vars}
+    # Record BEFORE creating (partial-failure resources must stay
+    # reachable by terminate_instances; same contract as provision/gcp.py).
+    _save_record(cluster_name, record)
+    client = azure_api.get_client(region)
+    try:
+        nsg = _ensure_nsg(client, name)
+        _, pub_path = authentication.get_or_generate_keys()
+        with open(pub_path) as f:
+            ssh_pub = f.read().strip()
+        existing = {azure_api.tag_value(vm, _TAG_RANK): vm
+                    for vm in _live_vms(client, name)}
+        to_start = []
+        missing_ranks = []
+        for rank in range(num_hosts):
+            vm = existing.get(str(rank))
+            if vm is None:
+                missing_ranks.append(rank)
+            elif vm['state'] == 'deallocated':
+                to_start.append(vm['name'])
+        if to_start:
+            azure_api.call(client, 'start_vms', names=to_start)
+        for rank in missing_ranks:
+            azure_api.call(
+                client, 'create_vm',
+                name=f'{name}-{rank}',
+                vm_size=deploy_vars.get('instance_type',
+                                        'Standard_D2s_v5'),
+                image=(deploy_vars.get('image_id')
+                       or 'Canonical:ubuntu-24_04-lts:server:latest'),
+                zone=zone,
+                nsg=nsg,
+                os_disk_gb=deploy_vars.get('disk_size_gb', 256),
+                ssh_user=SSH_USER,
+                ssh_public_key=ssh_pub,
+                priority=('Spot' if deploy_vars.get('use_spot')
+                          else 'Regular'),
+                eviction_policy=('Deallocate'
+                                 if deploy_vars.get('use_spot') else None),
+                tags={
+                    _TAG_CLUSTER: name,
+                    _TAG_RANK: str(rank),
+                    **{k: str(v) for k, v in
+                       (deploy_vars.get('labels') or {}).items()},
+                })
+    except exceptions.InsufficientCapacityError:
+        # Clean up any partial hosts, then drop the record so zone
+        # failover retries don't see a stale pointer.
+        try:
+            _terminate_all(client, name)
+        except exceptions.CloudError:
+            pass
+        _delete_record(cluster_name)
+        raise
+
+
+def wait_instances(cluster_name: str, region: str, state: str = 'running',
+                   timeout: float = 1800) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        states = set(query_instances(cluster_name, region).values())
+        if states == {state}:
+            return
+        if (not states or 'terminating' in states
+                or 'terminated' in states):
+            # 'terminated' appears as a rank{N}-missing hole from
+            # query_instances: a partially-dead cluster must fail over,
+            # not wait out the timeout (parity with aws.py/gcp.py).
+            raise exceptions.InsufficientCapacityError(
+                f'{cluster_name}: VM(s) disappeared while waiting for '
+                f'{state}', reason='capacity')
+        if state == 'running' and 'stopped' in states:
+            # Azure spot reclaim DEALLOCATES rather than deletes: a VM
+            # that went to 'stopped' while we were waiting for running
+            # was evicted — classify as capacity so failover fires.
+            raise exceptions.InsufficientCapacityError(
+                f'{cluster_name}: VM deallocated while waiting for '
+                'running (spot eviction?)', reason='capacity')
+        time.sleep(5)
+    raise exceptions.ProvisionError(
+        f'{cluster_name} did not reach {state!r} within {timeout}s')
+
+
+def query_instances(cluster_name: str, region: str) -> Dict[str, str]:
+    """Live host states. A PARTIALLY-dead cluster reports its missing
+    ranks as 'terminated' (managed-job recovery must see the hole); a
+    fully-dead cluster returns {} ("terminated cluster" contract in
+    core.py)."""
+    record = _load_record(cluster_name)
+    if not record:
+        return {}
+    client = azure_api.get_client(record['region'])
+    out: Dict[str, str] = {}
+    live_ranks = set()
+    for vm in _live_vms(client, record['name_on_cloud']):
+        out[vm['name']] = _STATE_MAP.get(vm['state'], 'unknown')
+        live_ranks.add(azure_api.tag_value(vm, _TAG_RANK))
+    if not out:
+        return {}
+    for rank in range(int(record.get('num_hosts') or 0)):
+        if str(rank) not in live_ranks:
+            out[f'rank{rank}-missing'] = 'terminated'
+    return out
+
+
+def stop_instances(cluster_name: str, region: str) -> None:
+    """Deallocate (NOT power-off: a merely 'stopped' Azure VM still
+    bills compute; only 'deallocated' releases it)."""
+    record = _require_record(cluster_name)
+    client = azure_api.get_client(record['region'])
+    # 'stopped' (OS powered off) still bills compute — deallocate it too;
+    # only 'deallocated'/'deallocating' are already done.
+    names = [vm['name'] for vm in _live_vms(client, record['name_on_cloud'])
+             if vm['state'] in ('creating', 'starting', 'running',
+                                'stopping', 'stopped')]
+    if names:
+        azure_api.call(client, 'deallocate_vms', names=names)
+
+
+def _terminate_all(client, name: str) -> None:
+    names = [vm['name'] for vm in _live_vms(client, name)]
+    if names:
+        azure_api.call(client, 'delete_vms', names=names)
+
+
+def terminate_instances(cluster_name: str, region: str) -> None:
+    record = _load_record(cluster_name)
+    if not record:
+        return
+    client = azure_api.get_client(record['region'])
+    name = record['name_on_cloud']
+    _terminate_all(client, name)
+    try:
+        azure_api.call(client, 'delete_nsg', name=_nsg_name(name))
+    except exceptions.CloudError:
+        pass  # best-effort; reused on relaunch otherwise
+    _delete_record(cluster_name)
+
+
+def get_cluster_info(cluster_name: str,
+                     region: str) -> provision_lib.ClusterInfo:
+    record = _require_record(cluster_name)
+    client = azure_api.get_client(record['region'])
+    hosts: List[provision_lib.HostInfo] = []
+    vms = _live_vms(client, record['name_on_cloud'])
+    vms.sort(key=lambda vm: int(azure_api.tag_value(vm, _TAG_RANK) or 0))
+    for vm in vms:
+        rank = int(azure_api.tag_value(vm, _TAG_RANK) or 0)
+        hosts.append(provision_lib.HostInfo(
+            host_id=vm['name'], rank=rank,
+            internal_ip=vm.get('private_ip', ''),
+            external_ip=vm.get('public_ip'),
+            extra={}))
+    return provision_lib.ClusterInfo(
+        cluster_name=cluster_name, cloud='azure', region=record['region'],
+        zone=record.get('zone'), hosts=hosts,
+        deploy_vars=record['deploy_vars'])
+
+
+def open_ports(cluster_name: str, region: str, ports: List[str]) -> None:
+    """Upsert one NSG rule per port spec (reference
+    sky/provision/azure open_ports). A rule keeps its name across calls,
+    so re-opening is idempotent and a tightened
+    ``azure.firewall_source_ranges`` re-applies on the next call.
+    Priorities must be UNIQUE per NSG direction on real Azure: an
+    existing rule reuses its priority, a new rule takes the lowest free
+    slot at/above the task-port base."""
+    if not ports:
+        return
+    record = _require_record(cluster_name)
+    client = azure_api.get_client(record['region'])
+    nsg = _nsg_name(record['name_on_cloud'])
+    from skypilot_tpu import config as config_lib
+    ranges = config_lib.get_nested(('azure', 'firewall_source_ranges'),
+                                   ['0.0.0.0/0'])
+    existing = azure_api.call(client, 'list_nsg_rules',
+                              nsg=nsg).get('rules', {})
+    used = {r['priority'] for r in existing.values()}
+
+    def next_free_priority() -> int:
+        p = _PORT_PRIORITY_BASE
+        while p in used:
+            p += 1
+        used.add(p)
+        return p
+
+    for port in sorted(ports, key=str):
+        if '-' in str(port):
+            lo, hi = (int(p) for p in str(port).split('-', 1))
+        else:
+            lo = hi = int(port)
+        rule_name = f'skytpu-port-{lo}-{hi}'
+        priority = (existing[rule_name]['priority']
+                    if rule_name in existing else next_free_priority())
+        azure_api.call(
+            client, 'upsert_nsg_rule', nsg=nsg,
+            rule_name=rule_name, priority=priority,
+            port_range=(f'{lo}' if lo == hi else f'{lo}-{hi}'),
+            source_ranges=list(ranges))
+
+
+def get_command_runners(cluster_info: provision_lib.ClusterInfo,
+                        ssh_credentials: Optional[Dict[str, str]] = None
+                        ) -> List[runner_lib.CommandRunner]:
+    creds = ssh_credentials or {}
+    key_path = creds.get('key_path')
+    if key_path is None:
+        key_path, _ = authentication.get_or_generate_keys()
+    user = creds.get('user', SSH_USER)
+    runners: List[runner_lib.CommandRunner] = []
+    for h in cluster_info.hosts:
+        ip = h.external_ip or h.internal_ip
+        runners.append(runner_lib.SSHCommandRunner(ip, user, key_path))
+    return runners

@@ -1,0 +1,97 @@
+"""Thin Azure compute client with a test seam.
+
+Counterpart of the reference's azure-mgmt usage in
+``sky/provision/azure/instance.py`` (VM CRUD, NSG bootstrap,
+per-error-code failover classification in azure.py). The real transport
+is the azure SDK (gated: this build may not ship it); tests install an
+in-process fake via ``set_azure_factory`` that implements the same flat
+client surface (``create_vm``, ``list_vms``, ...), so lifecycle +
+failover logic runs for real with no cloud and no SDK.
+
+The client surface is deliberately FLAT (one method per operation, dict
+payloads) rather than the SDK's poller/model-class shape: the
+provisioner's logic — tag-based rank discovery, capacity classification,
+partial-failure teardown — is what we test; SDK plumbing belongs in the
+one real adapter.
+
+Error classification mirrors the reference Azure handler
+(sky/clouds/azure.py stockout handling): allocation/SKU-capacity errors
+-> zone/region failover; quota errors -> region/cloud blocklist.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from skypilot_tpu import exceptions
+
+# Azure error codes -> failover classification.
+_CAPACITY_CODES = {
+    'AllocationFailed',
+    'ZonalAllocationFailed',
+    'OverconstrainedAllocationRequest',
+    'OverconstrainedZonalAllocationRequest',
+    'SkuNotAvailable',
+    'NotAvailableForSubscription',
+    'SpotKeepDeallocated',  # spot capacity reclaimed
+}
+_QUOTA_CODES = {
+    'QuotaExceeded',
+    'OperationNotAllowed',  # the SDK's quota-exceeded umbrella
+}
+
+
+class AzureApiError(Exception):
+    """Fake/real client error carrying an Azure error code."""
+
+    def __init__(self, code: str, message: str = ''):
+        super().__init__(message or code)
+        self.code = code
+        self.message = message or code
+
+
+def classify_error(exc: Exception) -> exceptions.CloudError:
+    code = getattr(exc, 'code', None)
+    if code is None:  # azure.core HttpResponseError shape
+        err = getattr(exc, 'error', None)
+        code = getattr(err, 'code', '') if err is not None else ''
+    msg = str(exc)
+    if code in _CAPACITY_CODES:
+        return exceptions.InsufficientCapacityError(msg, reason='capacity')
+    if code in _QUOTA_CODES:
+        return exceptions.CloudError(msg, reason='quota')
+    return exceptions.CloudError(msg)
+
+
+_azure_factory: Optional[Callable[[str], Any]] = None
+
+
+def set_azure_factory(factory: Optional[Callable[[str], Any]]) -> None:
+    """Test seam: ``factory(region) -> fake Azure client``."""
+    global _azure_factory
+    _azure_factory = factory
+
+
+def get_client(region: str) -> Any:
+    if _azure_factory is not None:
+        return _azure_factory(region)
+    raise exceptions.CloudError(
+        'Real Azure provisioning needs the azure-mgmt-compute SDK, which '
+        'is not installed (pip install azure-mgmt-compute '
+        'azure-mgmt-network azure-identity).')
+
+
+def call(client: Any, op: str, **kwargs) -> Dict[str, Any]:
+    """Invoke a client op, normalizing errors to CloudError subclasses."""
+    try:
+        return getattr(client, op)(**kwargs)
+    except AzureApiError as e:
+        raise classify_error(e) from e
+    except Exception as e:  # azure.core.exceptions.HttpResponseError
+        # (duck-typed: the SDK may be absent, so the except can't name it)
+        if getattr(e, 'error', None) is not None or hasattr(e, 'code'):
+            raise classify_error(e) from e
+        raise
+
+
+def tag_value(vm: Dict[str, Any], key: str) -> Optional[str]:
+    return (vm.get('tags') or {}).get(key)

@@ -176,6 +176,15 @@ CONFIG_SCHEMA: Dict[str, Any] = {
             },
             'additionalProperties': True,
         },
+        'azure': {
+            'type': 'object',
+            'properties': {
+                'storage_account': {'type': ['string', 'null']},
+                'firewall_source_ranges': {
+                    'type': 'array', 'items': {'type': 'string'}},
+            },
+            'additionalProperties': True,
+        },
         'local': {
             'type': 'object',
             'properties': {

@@ -331,6 +331,22 @@ class GenerationScheduler:
             if r is not None:
                 self._dispatched[s] += 1
         self._queue_emission(('step', sampled, list(self._slots)))
+        # Eager slot turnover: once a request's FINAL token has been
+        # dispatched (prefill token + max_tokens-1 steps), its KV is dead
+        # weight — release the slot NOW so the next _admit reuses it,
+        # instead of waiting for the emitter to fetch the whole in-flight
+        # window (up to MAX_BACKLOG steps of lag, ~1s on a high-latency
+        # link) and discover completion host-side. At concurrency above
+        # the slot count, TTFT is exactly this slot-turnover wait.
+        # EOS-truncated requests still release via the emitter, whose
+        # queued release is ignored by _apply_releases' identity check
+        # once the slot has been reassigned; the emitter keeps emitting
+        # this request's remaining in-flight tokens from its snapshots.
+        for s, r in enumerate(self._slots):
+            if (r is not None and not r.done
+                    and 1 + self._dispatched[s] >= r.max_tokens):
+                self.state = self.engine.release(self.state, s)
+                self._slots[s] = None
 
     # -- emitter ------------------------------------------------------------
     def _emit_loop(self) -> None:

@@ -1,0 +1,53 @@
+"""Chain-DAG <-> YAML helpers for managed-job pipelines.
+
+Counterpart of reference ``sky/utils/dag_utils.py``
+(load_chain_dag_from_yaml :59, dump_chain_dag_to_yaml :119). A pipeline
+YAML is a multi-document file: an optional first doc containing only
+``name:`` titles the pipeline; each following doc is a task config, run
+sequentially by the jobs controller.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.utils import common_utils
+
+
+def load_chain_dag_from_yaml(
+        path: str,
+        env_overrides: Optional[Dict[str, str]] = None) -> dag_lib.Dag:
+    configs = [c for c in common_utils.read_yaml_all(path) if c]
+    return load_chain_dag_from_yaml_configs(configs, env_overrides,
+                                            source=path)
+
+
+def load_chain_dag_from_yaml_configs(
+        configs: List[Dict[str, Any]],
+        env_overrides: Optional[Dict[str, str]] = None,
+        source: str = '<configs>') -> dag_lib.Dag:
+    dag_name = None
+    if configs and set(configs[0].keys()) == {'name'}:
+        # Header doc: names the pipeline, defines no task.
+        dag_name = configs[0]['name']
+        configs = configs[1:]
+    if not configs:
+        raise exceptions.InvalidTaskError(
+            f'{source}: no task documents found')
+    dag = dag_lib.Dag(name=dag_name)
+    prev = None
+    for cfg in configs:
+        task = task_lib.Task.from_yaml_config(cfg, env_overrides,
+                                              source=source)
+        dag.add(task)
+        if prev is not None:
+            dag.add_edge(prev, task)
+        prev = task
+    return dag
+
+
+def dag_to_yaml_configs(dag: dag_lib.Dag) -> List[Dict[str, Any]]:
+    """Task configs in chain order (topological)."""
+    return [t.to_yaml_config() for t in dag.topological_order()]

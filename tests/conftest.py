@@ -38,6 +38,17 @@ except Exception:
     pass
 
 
+def pytest_collection_modifyitems(config, items):
+    """Tier markers (VERDICT r4 #9): tests not explicitly marked
+    e2e/compute/slow are 'fast' — ``pytest -m fast`` is the sub-minute
+    tier to run on every change; the full suite stays the merge gate."""
+    slow_markers = {'e2e', 'compute', 'slow'}
+    for item in items:
+        if not slow_markers.intersection(m.name for m in
+                                         item.iter_markers()):
+            item.add_marker(pytest.mark.fast)
+
+
 @pytest.fixture(autouse=True)
 def _isolated_state(tmp_path, monkeypatch):
     """Point all persistent state at a per-test temp dir."""

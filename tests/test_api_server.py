@@ -13,6 +13,8 @@ from skypilot_tpu import exceptions
 from skypilot_tpu.client import sdk
 from skypilot_tpu.server import server as server_lib
 
+pytestmark = pytest.mark.e2e
+
 
 def _free_port() -> int:
     with socket.socket() as s:

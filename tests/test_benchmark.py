@@ -2,6 +2,7 @@
 summary collection, $/step report."""
 import json
 import os
+import pytest
 import sys
 import time
 
@@ -31,6 +32,7 @@ class TestCallback:
         assert data['seconds_per_step'] >= 0.005
 
 
+@pytest.mark.e2e
 class TestBenchE2E:
 
     def test_bench_two_local_candidates(self):

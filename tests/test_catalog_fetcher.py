@@ -214,3 +214,60 @@ def test_missing_csv_fallback_not_cached(tmp_path, monkeypatch):
         assert not catalog._read('xcloud_vms.csv').empty
     finally:
         catalog._read.cache_clear()
+
+
+def test_committed_azure_catalog_matches_regeneration(tmp_path,
+                                                      monkeypatch):
+    """Same drift guard as GCP/AWS: azure_vms.csv must equal the offline
+    fetcher output."""
+    import csv as csv_lib
+    import os
+    from skypilot_tpu.catalog.fetchers import fetch_azure
+
+    monkeypatch.setattr(fetch_azure, 'DATA_DIR', str(tmp_path))
+    assert fetch_azure.refresh(online=False) == 'offline'
+    committed_path = os.path.join(
+        os.path.dirname(os.path.abspath(fetch_azure.__file__)), '..',
+        'data', 'azure_vms.csv')
+    committed = open(committed_path).read()
+    assert committed == (tmp_path / 'azure_vms.csv').read_text(), (
+        'azure_vms.csv drifted from the fetcher: run '
+        'python -m skypilot_tpu.catalog.fetchers.fetch_azure')
+    rows = list(csv_lib.DictReader(open(tmp_path / 'azure_vms.csv')))
+    d2s = [r for r in rows if r['instance_type'] == 'Standard_D2s_v5'
+           and r['region'] == 'eastus'][0]
+    assert float(d2s['price']) == 0.096
+
+
+def test_azure_online_override(tmp_path, monkeypatch):
+    import csv as csv_lib
+    from skypilot_tpu.catalog.fetchers import fetch_azure
+
+    def fake_fetcher(url):
+        assert 'eastus' in url or 'westus2' in url or 'westeurope' in url
+        if 'eastus' not in url:
+            return {'Items': []}
+        return {'Items': [{
+            'armSkuName': 'Standard_D2s_v5',
+            'armRegionName': 'eastus',
+            'meterName': 'D2s v5',
+            'productName': 'Virtual Machines Dsv5 Series',
+            'retailPrice': 0.111,
+        }, {
+            'armSkuName': 'Standard_D2s_v5',
+            'armRegionName': 'eastus',
+            'meterName': 'D2s v5 Spot',
+            'productName': 'Virtual Machines Dsv5 Series',
+            'retailPrice': 0.03,   # spot meter: must be skipped
+        }]}
+
+    monkeypatch.setattr(fetch_azure, 'DATA_DIR', str(tmp_path))
+    assert fetch_azure.refresh(online=True,
+                               price_fetcher=fake_fetcher) == 'online'
+    rows = list(csv_lib.DictReader(open(tmp_path / 'azure_vms.csv')))
+    live = [r for r in rows if r['instance_type'] == 'Standard_D2s_v5'
+            and r['region'] == 'eastus'][0]
+    assert float(live['price']) == 0.111
+    other = [r for r in rows if r['instance_type'] == 'Standard_D2s_v5'
+             and r['region'] == 'westus2'][0]
+    assert float(other['price']) == 0.096

@@ -15,6 +15,8 @@ import skypilot_tpu.ops.attention as attn
 from skypilot_tpu.parallel import MeshSpec, make_mesh, ring_attention
 from skypilot_tpu.train import Trainer
 
+pytestmark = pytest.mark.compute
+
 
 def _qkv(key, b=2, s=64, h=4, hkv=None, d=16):
     kq, kk, kv = jax.random.split(key, 3)

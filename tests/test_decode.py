@@ -16,6 +16,8 @@ import pytest
 from skypilot_tpu.models.decode import DecodeEngine, prefill_bucket
 from skypilot_tpu.models.llama import PRESETS, LlamaModel
 
+pytestmark = pytest.mark.compute
+
 CFG = PRESETS['test-tiny']
 
 
@@ -366,3 +368,108 @@ def test_generation_server_main_mixtral_and_ckpt(tmp_path, monkeypatch):
     finally:
         proc.terminate()
         proc.wait(timeout=30)
+
+
+# ---- round-5 perf regression pins (VERDICT r4 #2) --------------------------
+# The r4 standalone decode bench regressed ~4.5x because step() rebuilt its
+# scalar sampling arrays with eager ops on every call — extra device
+# dispatches per decoded token on a high-latency link. These tests pin the
+# structural properties that keep a decode step at exactly one dispatch.
+
+def test_step_scalar_sampling_arrays_are_cached(model_and_params):
+    """Scalar temperature/top_k must map to the SAME device arrays on
+    every step() call (no per-step eager asarray/broadcast dispatches)."""
+    engine = DecodeEngine(CFG, batch_slots=4, max_len=64)
+    t1 = engine._scalar_sampling(0.0, jnp.float32)
+    t2 = engine._scalar_sampling(0.0, jnp.float32)
+    assert t1 is t2
+    k1 = engine._scalar_sampling(0, jnp.int32)
+    assert k1 is engine._scalar_sampling(0, jnp.int32)
+    # Distinct settings get distinct (still cached) arrays.
+    assert engine._scalar_sampling(0.7, jnp.float32) is not t1
+    assert engine._scalar_sampling(0.7, jnp.float32) is engine.\
+        _scalar_sampling(0.7, jnp.float32)
+
+
+def test_step_compiles_once_across_steps_and_settings(model_and_params):
+    """N steps with varying rng, scalar defaults, and per-slot sampling
+    arrays must reuse ONE compiled step (recompilation per step/setting
+    would be a silent throughput cliff)."""
+    model, params = model_and_params
+    engine = DecodeEngine(CFG, batch_slots=4, max_len=64)
+    out, state = engine_greedy(engine, params, [5, 17, 200], 4)
+    rng = jax.random.key(1)
+    for i in range(8):
+        state, _, rng = engine.step(params, state, rng)
+    state, _, rng = engine.step(params, state, rng, temperature=0.5,
+                                top_k=8)
+    state, _, rng = engine.step(
+        params, state, rng,
+        temperature=jnp.full((4,), 0.9, jnp.float32),
+        top_k=jnp.full((4,), 3, jnp.int32))
+    assert engine._step._cache_size() == 1
+
+
+def test_step_advances_every_active_slot_exactly_once(model_and_params):
+    """slots x steps invariant: n steps advance each ACTIVE slot's length
+    by exactly n and leave inactive slots untouched (no wasted or skipped
+    per-slot work)."""
+    model, params = model_and_params
+    engine = DecodeEngine(CFG, batch_slots=4, max_len=64)
+    state = engine.init_state()
+    for slot, prompt in ((0, [5, 17, 200]), (2, [9, 1])):
+        bucket = prefill_bucket(len(prompt), engine.max_len)
+        padded = jnp.asarray(prompt + [0] * (bucket - len(prompt)),
+                             jnp.int32)
+        k, v, logits = engine.prefill(params, padded, len(prompt))
+        state = engine.insert(state, k, v, len(prompt),
+                              int(jnp.argmax(logits)), slot)
+    lengths_before = np.asarray(state.lengths)
+    n = 6
+    rng = jax.random.key(3)
+    for _ in range(n):
+        state, sampled, rng = engine.step(params, state, rng)
+    lengths_after = np.asarray(state.lengths)
+    assert list(lengths_after - lengths_before) == [n, 0, n, 0]
+
+
+def test_eager_slot_release_turns_over_without_emitter(model_and_params):
+    """A slot whose final token has been DISPATCHED is reusable
+    immediately — the next request admits without waiting for the
+    emitter to fetch the in-flight window (at concurrency > slots, TTFT
+    is exactly this turnover wait). Driven tick-by-tick with NO emitter
+    thread running; the emitter then drains afterwards and every token
+    must still match the naive-greedy oracle."""
+    from skypilot_tpu.serve.generation_server import (GenerationScheduler,
+                                                      _Request)
+    model, params = model_and_params
+    sched = GenerationScheduler(CFG, params, batch_slots=1, max_len=32)
+    p1, p2 = [5, 17, 200], [9, 1]
+    r1 = _Request(p1, max_tokens=3, temperature=0.0, top_k=0, eos_id=None)
+    r2 = _Request(p2, max_tokens=2, temperature=0.0, top_k=0, eos_id=None)
+    sched.submit(r1)
+    sched.submit(r2)
+    for _ in range(12):  # scheduler ticks only; emitter never runs
+        sched._tick()
+        if sched._pending.empty() and sched._slots[0] is None:
+            break
+    # Both requests fully dispatched and both slots released, with zero
+    # device->host fetches so far.
+    assert sched._pending.empty()
+    assert sched._slots[0] is None
+    with sched._emit_lock:
+        batch, sched._emit_q = sched._emit_q, []
+    assert any(item[0] == 'first' and item[2] is r2 for item in batch), \
+        'second request was never admitted without the emitter'
+    sched._emit_batch(batch)
+
+    def drain(req):
+        toks = []
+        while True:
+            t = req.out_queue.get(timeout=5)
+            if t is None:
+                return toks
+            toks.append(t)
+
+    assert drain(r1) == naive_greedy(model, params, p1, 3)
+    assert drain(r2) == naive_greedy(model, params, p2, 2)

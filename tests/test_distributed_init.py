@@ -14,6 +14,8 @@ import pytest
 
 from skypilot_tpu.runtime import constants
 
+pytestmark = pytest.mark.compute
+
 _WORKER = r'''
 import os
 os.environ['JAX_PLATFORMS'] = 'cpu'
@@ -38,6 +40,7 @@ sharding = NamedSharding(mesh, P('dp'))
 # Each device contributes (device_id + 1); the global sum proves all four
 # devices across both processes participate in one program.
 import numpy as np
+
 dbs = [jax.device_put(np.array([d.id + 1.0]), d) for d in jax.local_devices()]
 arr = jax.make_array_from_single_device_arrays((4,), sharding, dbs)
 total = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(arr)

@@ -17,6 +17,8 @@ import skypilot_tpu as sky
 from skypilot_tpu.provision import docker_utils
 from skypilot_tpu.runtime import agent as agent_lib
 
+pytestmark = pytest.mark.e2e
+
 _FAKE_DOCKER = r'''#!/usr/bin/env bash
 echo "docker $*" >> "$FAKE_DOCKER_LOG"
 cmd="$1"; shift

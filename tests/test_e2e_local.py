@@ -19,6 +19,8 @@ from skypilot_tpu import execution
 from skypilot_tpu import global_user_state
 from skypilot_tpu.runtime import job_lib
 
+pytestmark = pytest.mark.e2e
+
 
 def _local_task(run='echo hello-skytpu', num_nodes=1, **task_kwargs):
     task = sky.Task(run=run, num_nodes=num_nodes, **task_kwargs)

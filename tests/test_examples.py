@@ -7,6 +7,8 @@ import pytest
 
 import skypilot_tpu as sky
 
+pytestmark = pytest.mark.e2e
+
 EXAMPLES_DIR = os.path.join(os.path.dirname(__file__), '..', 'examples')
 
 

@@ -131,6 +131,7 @@ class TestStateGuards:
             jobs_core.cancel_on_controller(job_ids=[])
 
 
+@pytest.mark.e2e
 class TestControllerCluster:
     """Client ops route through the controller cluster (local cloud)."""
 

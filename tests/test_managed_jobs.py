@@ -12,6 +12,8 @@ from skypilot_tpu import global_user_state
 from skypilot_tpu.jobs import core as jobs_core
 from skypilot_tpu.jobs import state as jobs_state
 
+pytestmark = pytest.mark.e2e
+
 ManagedJobStatus = jobs_state.ManagedJobStatus
 
 
@@ -163,3 +165,123 @@ class TestManagedJobs:
         assert after > steps_before, resumed_logs
         jobs_core.cancel([job_id])
         _wait_status(job_id, {ManagedJobStatus.CANCELLED}, timeout=60)
+
+
+class TestPipelines:
+    """Multi-task chain-DAG managed jobs (reference
+    sky/jobs/controller.py:409-469: sequential tasks, per-task recovery,
+    earlier outputs preserved)."""
+
+    def _pipeline(self, tmp_path, sleep_in_eval=0.0):
+        """train -> eval passing output through a MOUNT-backed bucket."""
+        from skypilot_tpu import dag as dag_lib
+        bucket = tmp_path / 'artifacts'
+        bucket.mkdir(exist_ok=True)
+        train = sky.Task(name='train',
+                         run='echo model-v1 > ../out/model.txt',
+                         file_mounts={'./out': {
+                             'source': f'file://{bucket}',
+                             'mode': 'MOUNT'}})
+        train.set_resources([sky.Resources(cloud='local')])
+        eval_cmd = ('test -f ../out/model.txt && '
+                    'cp ../out/model.txt ../out/eval-saw.txt')
+        if sleep_in_eval:
+            eval_cmd = f'sleep {sleep_in_eval}; {eval_cmd}'
+        ev = sky.Task(name='eval', run=eval_cmd,
+                      file_mounts={'./out': {
+                          'source': f'file://{bucket}',
+                          'mode': 'MOUNT'}})
+        ev.set_resources([sky.Resources(cloud='local')])
+        dag = dag_lib.Dag(name='train-eval')
+        dag.add_edge(train, ev)
+        return dag, bucket
+
+    def test_pipeline_runs_tasks_sequentially(self, tmp_path):
+        dag, bucket = self._pipeline(tmp_path)
+        job_id = jobs_core.launch(dag)
+        row = _wait_status(job_id, {ManagedJobStatus.SUCCEEDED,
+                                    ManagedJobStatus.FAILED,
+                                    ManagedJobStatus.FAILED_CONTROLLER},
+                           timeout=120)
+        assert row['status'] == ManagedJobStatus.SUCCEEDED, \
+            jobs_core.controller_logs(job_id)
+        # Task 2 really saw task 1's output.
+        assert (bucket / 'eval-saw.txt').read_text().strip() == 'model-v1'
+        assert row['num_tasks'] == 2 and row['current_task_id'] == 1
+        tasks = jobs_state.list_task_rows(job_id)
+        assert [t['status'] for t in tasks] == [
+            ManagedJobStatus.SUCCEEDED, ManagedJobStatus.SUCCEEDED]
+        assert [t['name'] for t in tasks] == ['train', 'eval']
+        # Both per-task clusters torn down.
+        for t in (0, 1):
+            assert global_user_state.get_cluster_from_name(
+                f'skytpu-jobs-{job_id}-t{t}') is None
+
+    def test_pipeline_preemption_mid_task2_recovers_task2_only(
+            self, tmp_path):
+        """Preempting the cluster while task 2 runs must recover task 2
+        on a fresh cluster WITHOUT re-running task 1 (its artifact is
+        not recomputed)."""
+        dag, bucket = self._pipeline(tmp_path, sleep_in_eval=30)
+        job_id = jobs_core.launch(dag)
+        # Wait for task 2 (eval) to be the current RUNNING task.
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            row = jobs_state.get(job_id)
+            if (row['current_task_id'] == 1
+                    and row['status'] == ManagedJobStatus.RUNNING):
+                break
+            assert not row['status'].is_terminal(), \
+                jobs_core.controller_logs(job_id)
+            time.sleep(0.2)
+        else:
+            raise TimeoutError('task 2 never started: '
+                               + jobs_core.controller_logs(job_id))
+        # Tamper the artifact marker to prove task 1 is not re-run.
+        (bucket / 'model.txt').write_text('model-v1\n')
+        time.sleep(1.0)
+        from skypilot_tpu.provision import local_impl
+        local_impl.terminate_instances(f'skytpu-jobs-{job_id}-t1', 'local')
+        _wait_status(job_id, {ManagedJobStatus.RECOVERING}, timeout=30)
+        row = _wait_status(job_id, {ManagedJobStatus.RUNNING}, timeout=60)
+        assert row['current_task_id'] == 1  # still on task 2
+        tasks = jobs_state.list_task_rows(job_id)
+        assert tasks[0]['status'] == ManagedJobStatus.SUCCEEDED
+        assert tasks[0]['recovery_count'] == 0   # task 1 untouched
+        assert tasks[1]['recovery_count'] >= 1   # task 2 recovered
+        # Cancel the remainder; every task row reaches a terminal state.
+        jobs_core.cancel([job_id])
+        _wait_status(job_id, {ManagedJobStatus.CANCELLED}, timeout=60)
+        tasks = jobs_state.list_task_rows(job_id)
+        assert all(t['status'].is_terminal() for t in tasks)
+
+    def test_pipeline_task_failure_stops_pipeline(self, tmp_path):
+        from skypilot_tpu import dag as dag_lib
+        t1 = sky.Task(name='boom', run='exit 7')
+        t1.set_resources([sky.Resources(cloud='local')])
+        t2 = sky.Task(name='never', run='echo never')
+        t2.set_resources([sky.Resources(cloud='local')])
+        dag = dag_lib.Dag(name='fail-fast')
+        dag.add_edge(t1, t2)
+        job_id = jobs_core.launch(dag)
+        row = _wait_status(job_id, {ManagedJobStatus.FAILED}, timeout=90)
+        assert row['current_task_id'] == 0
+        tasks = jobs_state.list_task_rows(job_id)
+        assert tasks[0]['status'] == ManagedJobStatus.FAILED
+        assert tasks[1]['status'] == ManagedJobStatus.PENDING  # never ran
+
+    def test_pipeline_yaml_roundtrip(self, tmp_path):
+        from skypilot_tpu.utils import dag_utils
+        yaml_path = tmp_path / 'pipe.yaml'
+        yaml_path.write_text(
+            'name: my-pipeline\n'
+            '---\n'
+            'name: a\n'
+            'run: echo a\n'
+            '---\n'
+            'name: b\n'
+            'run: echo b\n')
+        dag = dag_utils.load_chain_dag_from_yaml(str(yaml_path))
+        assert dag.name == 'my-pipeline'
+        assert [t.name for t in dag.topological_order()] == ['a', 'b']
+        assert dag.is_chain()

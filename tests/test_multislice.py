@@ -18,6 +18,8 @@ from skypilot_tpu.parallel import (MeshSpec, make_mesh, multislice_rules)
 from skypilot_tpu.parallel.sharding import DEFAULT_RULES
 from skypilot_tpu.runtime import constants as rt_constants
 
+pytestmark = pytest.mark.compute
+
 
 # ---- mesh -------------------------------------------------------------------
 class TestDcnMesh:

@@ -16,6 +16,8 @@ from skypilot_tpu.models.mixtral import MixtralConfig, MixtralModel, PRESETS
 from skypilot_tpu.ops import moe as moe_ops
 from skypilot_tpu.parallel import MeshSpec, make_mesh, pipeline, split_stages
 
+pytestmark = pytest.mark.compute
+
 
 class TestPipelinePrimitive:
 

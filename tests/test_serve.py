@@ -20,6 +20,8 @@ from skypilot_tpu.serve import load_balancing_policies as lb_policies
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve import service_spec as spec_lib
 
+pytestmark = pytest.mark.e2e
+
 ReplicaStatus = serve_state.ReplicaStatus
 ServiceStatus = serve_state.ServiceStatus
 

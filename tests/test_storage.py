@@ -131,6 +131,7 @@ class TestStorageE2E:
         core.down('t-storage-host')
 
 
+@pytest.mark.compute
 class TestCheckpointResume:
 
     def test_trainer_restore_or_init_resumes(self, tmp_path):

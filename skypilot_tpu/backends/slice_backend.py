@@ -478,6 +478,8 @@ class SliceBackend(backend_lib.Backend):
                 assert isinstance(storage, storage_lib.Storage), storage
                 if storage.mode is storage_lib.StorageMode.MOUNT:
                     cmd = storage.store.mount_command(dst)
+                elif storage.mode is storage_lib.StorageMode.MOUNT_CACHED:
+                    cmd = storage.store.mount_cached_command(dst)
                 else:
                     cmd = storage.store.download_command(dst)
                 result = runner.run(cmd, timeout=600)

@@ -1,0 +1,14 @@
+"""Framework adapters for skytpu_callback step timing.
+
+Counterpart of reference ``sky/callbacks/sky_callback/integrations/``
+(keras.py, transformers.py, pytorch_lightning.py): drop-in callbacks so
+``skytpu bench`` can time ARBITRARY user training code — a HF Trainer or
+a Keras fit loop — not just the in-tree trainer. Imports are lazy: each
+adapter only needs its framework at construction time, so this package
+imports cleanly everywhere.
+"""
+from skypilot_tpu.callbacks.integrations.keras import SkyTpuKerasCallback
+from skypilot_tpu.callbacks.integrations.transformers import (
+    SkyTpuTransformersCallback)
+
+__all__ = ['SkyTpuKerasCallback', 'SkyTpuTransformersCallback']

@@ -1,0 +1,74 @@
+"""skytpu_callback adapter for Keras.
+
+Counterpart of reference
+``sky/callbacks/sky_callback/integrations/keras.py``: a Keras callback
+that arms the benchmark summary on train begin and times train batches,
+so ``skytpu bench`` can time a ``model.fit`` loop.
+
+    from skypilot_tpu.callbacks.integrations import SkyTpuKerasCallback
+    model.fit(..., callbacks=[SkyTpuKerasCallback()])
+
+Duck-typed against the ``keras.callbacks.Callback`` protocol
+(on_train_begin / on_train_batch_begin / on_train_batch_end + set_params
+/ set_model): Keras drives any object with these methods, so unit tests
+need no TensorFlow.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from skypilot_tpu import callbacks as skytpu_callback
+
+
+class SkyTpuKerasCallback:
+    """Keras callback armed by $SKYTPU_BENCHMARK_LOG_DIR."""
+
+    def __init__(self, log_dir: Optional[str] = None,
+                 total_steps: Optional[int] = None) -> None:
+        self._log_dir = log_dir
+        self._total_steps = total_steps
+        self._armed = False
+        self.params: Optional[dict] = None
+        self.model = None
+
+    # Keras wires these on every callback it drives.
+    def set_params(self, params) -> None:
+        self.params = params
+
+    def set_model(self, model) -> None:
+        self.model = model
+
+    def _infer_total_steps(self) -> Optional[int]:
+        if self._total_steps is not None:
+            return self._total_steps
+        if self.params:
+            epochs = self.params.get('epochs')
+            steps = self.params.get('steps')
+            if epochs and steps:
+                return int(epochs) * int(steps)
+        return None
+
+    # -- Callback protocol ---------------------------------------------------
+    def on_train_begin(self, logs=None) -> None:
+        self._armed = skytpu_callback.init(
+            total_steps=self._infer_total_steps(),
+            log_dir=self._log_dir)
+        if self._armed:
+            skytpu_callback.mark('init_done')
+
+    def on_train_batch_begin(self, batch, logs=None) -> None:
+        if self._armed:
+            skytpu_callback.step_begin()
+
+    def on_train_batch_end(self, batch, logs=None) -> None:
+        if self._armed:
+            skytpu_callback.step_end()
+
+    def on_epoch_begin(self, epoch, logs=None) -> None:
+        pass
+
+    def on_epoch_end(self, epoch, logs=None) -> None:
+        pass
+
+    def on_train_end(self, logs=None) -> None:
+        pass

@@ -1,0 +1,83 @@
+"""skytpu_callback adapter for HuggingFace Transformers.
+
+Counterpart of reference
+``sky/callbacks/sky_callback/integrations/transformers.py``: a
+``TrainerCallback`` that arms the benchmark summary on train begin and
+marks step ends, so ``skytpu bench`` decomposes launch overhead and
+$/step for any HF ``Trainer`` run.
+
+    from skypilot_tpu.callbacks.integrations import (
+        SkyTpuTransformersCallback)
+    trainer = transformers.Trainer(
+        ..., callbacks=[SkyTpuTransformersCallback()])
+
+Duck-typed against the TrainerCallback protocol (on_train_begin /
+on_step_end receiving args/state/control): transformers is only needed
+by the Trainer itself, so unit tests can drive this with a fake loop.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from skypilot_tpu import callbacks as skytpu_callback
+
+
+class SkyTpuTransformersCallback:
+    """HF TrainerCallback armed by $SKYTPU_BENCHMARK_LOG_DIR.
+
+    Not subclassing ``transformers.TrainerCallback`` keeps the import
+    lazy (the Trainer accepts any object with the callback methods);
+    pass an instance via ``callbacks=[...]``.
+    """
+
+    def __init__(self, log_dir: Optional[str] = None,
+                 total_steps: Optional[int] = None) -> None:
+        self._log_dir = log_dir
+        self._total_steps = total_steps
+        self._armed = False
+
+    def _infer_total_steps(self, args, state) -> Optional[int]:
+        if self._total_steps is not None:
+            return self._total_steps
+        max_steps = getattr(state, 'max_steps', None) or getattr(
+            args, 'max_steps', None)
+        if max_steps and max_steps > 0:
+            return int(max_steps)
+        return None
+
+    # -- TrainerCallback protocol -------------------------------------------
+    def on_train_begin(self, args=None, state=None, control=None,
+                       **kwargs) -> None:
+        # Only the world-zero process writes the summary (HF runs the
+        # callback on every process; state.is_world_process_zero is True
+        # in single-process runs and on rank 0).
+        if state is not None and not getattr(state,
+                                             'is_world_process_zero', True):
+            return
+        self._armed = skytpu_callback.init(
+            total_steps=self._infer_total_steps(args, state),
+            log_dir=self._log_dir)
+        if self._armed:
+            skytpu_callback.mark('init_done')
+
+    def on_step_begin(self, args=None, state=None, control=None,
+                      **kwargs) -> None:
+        if self._armed:
+            skytpu_callback.step_begin()
+
+    def on_step_end(self, args=None, state=None, control=None,
+                    **kwargs) -> None:
+        if self._armed:
+            skytpu_callback.step_end()
+
+    def on_train_end(self, args=None, state=None, control=None,
+                     **kwargs) -> None:
+        pass  # summaries flush on step_end; nothing to close
+
+    def __getattr__(self, name: str):
+        # The HF callback handler invokes the FULL TrainerCallback event
+        # surface (on_init_end, on_save, on_log, ...); every event this
+        # adapter doesn't time is a no-op.
+        if name.startswith('on_'):
+            return lambda *args, **kwargs: None
+        raise AttributeError(name)

@@ -430,15 +430,17 @@ def jobs_launch(entrypoint, name, cloud, accelerators, cmd, env,
     if entrypoint and entrypoint.endswith(('.yaml', '.yml')):
         from skypilot_tpu.utils import common_utils as cu
         from skypilot_tpu.utils import dag_utils
-        if len([c for c in cu.read_yaml_all(entrypoint) if c]) > 1:
+        configs = [c for c in cu.read_yaml_all(entrypoint) if c]
+        if len(configs) > 1:
             if cloud or accelerators or cmd:
                 # Per-task resource flags are ambiguous across a
                 # pipeline's tasks; set them in each YAML document.
                 raise click.UsageError(
                     '--cloud/--tpus/--cmd do not apply to multi-document '
                     'pipeline YAMLs; set resources per task in the YAML.')
-            dag = dag_utils.load_chain_dag_from_yaml(
-                entrypoint, env_overrides=_parse_env_overrides(env))
+            dag = dag_utils.load_chain_dag_from_yaml_configs(
+                configs, env_overrides=_parse_env_overrides(env),
+                source=entrypoint)
             job_id = jobs_lib.launch(dag, name=name)
             click.echo(f'Managed pipeline job {job_id} submitted '
                        f'({len(dag.tasks)} tasks).'

@@ -61,40 +61,85 @@ _INSTALL_RCLONE = _deb_install(
     f'v{RCLONE_VERSION}/rclone-v{RCLONE_VERSION}-linux-{{arch}}.deb')
 
 
-def rclone_s3_mount_command(bucket: str, mount_point: str,
-                            sub_path: str = '',
-                            read_only: bool = True,
-                            endpoint: str = '') -> str:
-    """Idempotent install + rclone FUSE mount of an S3(-compatible)
-    bucket.
+def _rclone_mount(src: str, mount_point: str, env_prefix: str,
+                  cached: bool, read_only: bool) -> str:
+    """The one rclone mount shape, shared by every remote type.
 
-    The remote is configured entirely through RCLONE_CONFIG_* env vars
-    (``env_auth`` picks up the instance role / AWS_* credentials) — no
-    config file to ship. ``endpoint`` targets S3-compatible providers
-    (Cloudflare R2 etc.). Defaults to read-only: the realistic TPU story
-    is S3 as a dataset *source*; ``--vfs-cache-mode writes`` is enabled
-    only for read-write mounts. Reference counterpart:
-    sky/data/mounting_utils.py:41-367 (goofys/rclone S3 branch).
+    Flavors (reference sky/data/mounting_utils.py:302-314, the rclone
+    vfs-cache writeback branch):
+    - read_only:  ``--read-only`` dataset-source mount;
+    - writable:   ``--vfs-cache-mode writes`` — writes buffer locally
+      and upload on close (checkpoint-to-bucket works; partial-write
+      visibility is at file granularity, like the reference's mounts);
+    - cached (MOUNT_CACHED): ``--vfs-cache-mode full`` with async
+      write-back — reads cache locally too and writes flush in the
+      background, decoupling training-step latency from object-store
+      latency.
     """
     q = shlex.quote
-    src = f'skytpu-s3:{bucket}'
-    if sub_path:
-        src += f'/{sub_path}'
-    ro = '--read-only ' if read_only else '--vfs-cache-mode writes '
-    provider = ('RCLONE_CONFIG_SKYTPU_S3_PROVIDER=Other '
-                f'RCLONE_CONFIG_SKYTPU_S3_ENDPOINT={q(endpoint)} '
-                if endpoint else 'RCLONE_CONFIG_SKYTPU_S3_PROVIDER=AWS ')
+    if cached:
+        flavor = ('--vfs-cache-mode full --vfs-write-back 1s '
+                  '--vfs-cache-max-size 10G --dir-cache-time 5s ')
+    elif read_only:
+        flavor = '--read-only --dir-cache-time 30s '
+    else:
+        flavor = '--vfs-cache-mode writes --dir-cache-time 30s '
     return (
         f'{_INSTALL_RCLONE} && '
         f'sudo mkdir -p {q(mount_point)} && '
         f'sudo chown $(id -u):$(id -g) {q(mount_point)} && '
         f'(mountpoint -q {q(mount_point)} || '
-        'RCLONE_CONFIG_SKYTPU_S3_TYPE=s3 '
-        f'{provider}'
-        'RCLONE_CONFIG_SKYTPU_S3_ENV_AUTH=true '
+        f'{env_prefix}'
         f'rclone mount {q(src)} {q(mount_point)} '
-        f'--daemon --allow-non-empty {ro}'
-        '--dir-cache-time 30s --vfs-read-chunk-size 64M)')
+        f'--daemon --allow-non-empty {flavor}'
+        '--vfs-read-chunk-size 64M)')
+
+
+def s3_rclone_env_prefix(endpoint: str = '') -> str:
+    """The one definition of the rclone S3 remote, as a shell env
+    prefix: ``env_auth`` picks up the instance role / AWS_* credentials
+    — no config file to ship. ``endpoint`` targets S3-compatible
+    providers (Cloudflare R2 etc.)."""
+    q = shlex.quote
+    provider = ('RCLONE_CONFIG_SKYTPU_S3_PROVIDER=Other '
+                f'RCLONE_CONFIG_SKYTPU_S3_ENDPOINT={q(endpoint)} '
+                if endpoint else 'RCLONE_CONFIG_SKYTPU_S3_PROVIDER=AWS ')
+    return ('RCLONE_CONFIG_SKYTPU_S3_TYPE=s3 '
+            f'{provider}'
+            'RCLONE_CONFIG_SKYTPU_S3_ENV_AUTH=true ')
+
+
+def rclone_s3_mount_command(bucket: str, mount_point: str,
+                            sub_path: str = '',
+                            read_only: bool = False,
+                            endpoint: str = '',
+                            cached: bool = False) -> str:
+    """Idempotent install + rclone FUSE mount of an S3(-compatible)
+    bucket. Writable by default (checkpoint-to-bucket on AWS clusters
+    needs a mount path); ``cached`` selects the MOUNT_CACHED write-back
+    flavor. Reference counterpart: sky/data/mounting_utils.py:41-367."""
+    src = f'skytpu-s3:{bucket}'
+    if sub_path:
+        src += f'/{sub_path}'
+    return _rclone_mount(src, mount_point,
+                         s3_rclone_env_prefix(endpoint),
+                         cached=cached, read_only=read_only)
+
+
+def rclone_gcs_mount_command(bucket: str, mount_point: str,
+                             sub_path: str = '',
+                             cached: bool = True) -> str:
+    """rclone mount of a GCS bucket — used for MOUNT_CACHED (plain MOUNT
+    uses gcsfuse, which has no write-back cache mode). ``env_auth``
+    rides the VM/TPU-VM service account."""
+    src = f'skytpu-gcs:{bucket}'
+    if sub_path:
+        src += f'/{sub_path}'
+    env = ("RCLONE_CONFIG_SKYTPU_GCS_TYPE='google cloud storage' "
+           'RCLONE_CONFIG_SKYTPU_GCS_ENV_AUTH=true '
+           'RCLONE_CONFIG_SKYTPU_GCS_BUCKET_POLICY_ONLY=true ')
+    return _rclone_mount(src, mount_point, env, cached=cached,
+                         read_only=False)
 
 
 def unmount_command(mount_point: str) -> str:
@@ -125,24 +170,17 @@ def azureblob_rclone_env_prefix(account: str) -> str:
 def rclone_azureblob_mount_command(container: str, mount_point: str,
                                    sub_path: str = '',
                                    account: str = '',
-                                   read_only: bool = True) -> str:
+                                   read_only: bool = False,
+                                   cached: bool = False) -> str:
     """Idempotent install + rclone FUSE mount of an Azure blob container.
 
     Same rclone machinery as the S3 mount, with the ``azureblob`` remote
     type. Reference counterpart: the blobfuse2 branch of
     sky/data/mounting_utils.py.
     """
-    q = shlex.quote
     src = f'skytpu-az:{container}'
     if sub_path:
         src += f'/{sub_path}'
-    ro = '--read-only ' if read_only else '--vfs-cache-mode writes '
-    return (
-        f'{_INSTALL_RCLONE} && '
-        f'sudo mkdir -p {q(mount_point)} && '
-        f'sudo chown $(id -u):$(id -g) {q(mount_point)} && '
-        f'(mountpoint -q {q(mount_point)} || '
-        f'{azureblob_rclone_env_prefix(account)}'
-        f'rclone mount {q(src)} {q(mount_point)} '
-        f'--daemon --allow-non-empty {ro}'
-        '--dir-cache-time 30s --vfs-read-chunk-size 64M)')
+    return _rclone_mount(src, mount_point,
+                         azureblob_rclone_env_prefix(account),
+                         cached=cached, read_only=read_only)

@@ -28,6 +28,11 @@ from skypilot_tpu import exceptions
 class StorageMode(enum.Enum):
     COPY = 'COPY'    # materialize bucket contents onto host disk
     MOUNT = 'MOUNT'  # FUSE-mount the bucket at the mount point
+    # FUSE-mount with a local read cache + async write-back (reference
+    # sky/data/storage.py:265-273): writes land locally and flush to the
+    # bucket in the background, so training-step latency is decoupled
+    # from object-store latency. Best for checkpoint/output dirs.
+    MOUNT_CACHED = 'MOUNT_CACHED'
 
 
 class AbstractStore:
@@ -61,7 +66,13 @@ class AbstractStore:
         raise NotImplementedError
 
     def mount_command(self, mount_point: str) -> str:
-        """Shell that FUSE-mounts the bucket at ``mount_point`` (MOUNT)."""
+        """Shell that FUSE-mounts the bucket at ``mount_point`` (MOUNT,
+        read-write)."""
+        raise NotImplementedError
+
+    def mount_cached_command(self, mount_point: str) -> str:
+        """Shell for the MOUNT_CACHED flavor: local read cache + async
+        write-back (rclone vfs-cache full)."""
         raise NotImplementedError
 
     # -- client-side ops ----------------------------------------------------
@@ -121,6 +132,13 @@ class GcsStore(AbstractStore):
         return mounting_utils.gcsfuse_mount_command(
             self.bucket, mount_point, sub_path=self.sub_path)
 
+    def mount_cached_command(self, mount_point: str) -> str:
+        # gcsfuse has no write-back cache mode; MOUNT_CACHED rides the
+        # same rclone vfs machinery as the other object stores.
+        from skypilot_tpu.data import mounting_utils
+        return mounting_utils.rclone_gcs_mount_command(
+            self.bucket, mount_point, self.sub_path, cached=True)
+
     def upload_local(self, local_path: str) -> None:
         local_path = os.path.expanduser(local_path)
         cmd = gcs_cli(['rsync', '-r', local_path, self.url],
@@ -148,10 +166,9 @@ class S3Store(AbstractStore):
     """Amazon S3 via the aws CLI.
 
     Reference counterpart: sky/data/storage.py S3Store (:118-211 family).
-    The realistic TPU story is S3 as a *source* (datasets produced on
-    AWS): COPY materializes onto hosts, MOUNT is a read-only rclone FUSE
-    mount (cross-cloud FUSE writes are a data-loss trap; for outputs use
-    COPY-back or transfer the bucket to GCS via data/data_transfer.py).
+    COPY materializes onto hosts; MOUNT is a writable rclone FUSE mount
+    (write-on-close buffering); MOUNT_CACHED adds a local read cache +
+    async write-back for checkpoint/output dirs.
     """
 
     SCHEME = 's3'
@@ -192,16 +209,20 @@ class S3Store(AbstractStore):
                 f'{q(src)} {q(self._s3_url)}')
 
     def mount_command(self, mount_point: str) -> str:
-        """rclone FUSE mount, read-only (reference mounts S3 via
-        goofys/rclone, sky/data/mounting_utils.py:41-367).
-
-        Read-only by design: cross-cloud FUSE writes from TPU hosts are
-        a data-loss trap; for outputs use COPY or transfer the bucket to
-        GCS (data/data_transfer.py)."""
+        """rclone FUSE mount, read-write (reference mounts S3 via
+        goofys/rclone, sky/data/mounting_utils.py:41-367): writes buffer
+        locally and upload on close, so checkpoint-to-bucket works on
+        AWS clusters."""
         from skypilot_tpu.data import mounting_utils
         return mounting_utils.rclone_s3_mount_command(
-            self.bucket, mount_point, self.sub_path, read_only=True,
+            self.bucket, mount_point, self.sub_path, read_only=False,
             endpoint=self._endpoint())
+
+    def mount_cached_command(self, mount_point: str) -> str:
+        from skypilot_tpu.data import mounting_utils
+        return mounting_utils.rclone_s3_mount_command(
+            self.bucket, mount_point, self.sub_path,
+            endpoint=self._endpoint(), cached=True)
 
     def _aws(self, *args: str):
         ep = self._endpoint()
@@ -263,6 +284,10 @@ class LocalStore(AbstractStore):
                 f'mkdir -p $(dirname {q(mount_point)}) && '
                 f'rm -rf {q(mount_point)} && '
                 f'ln -sfn {q(self.root)} {q(mount_point)}')
+
+    def mount_cached_command(self, mount_point: str) -> str:
+        # Local disk IS the cache; the symlink mount is already both.
+        return self.mount_command(mount_point)
 
     def upload_local(self, local_path: str) -> None:
         local_path = os.path.expanduser(local_path)
@@ -361,7 +386,13 @@ class AzureBlobStore(AbstractStore):
         from skypilot_tpu.data import mounting_utils
         return mounting_utils.rclone_azureblob_mount_command(
             self.bucket, mount_point, self.sub_path,
-            account=self._account(), read_only=True)
+            account=self._account(), read_only=False)
+
+    def mount_cached_command(self, mount_point: str) -> str:
+        from skypilot_tpu.data import mounting_utils
+        return mounting_utils.rclone_azureblob_mount_command(
+            self.bucket, mount_point, self.sub_path,
+            account=self._account(), cached=True)
 
     def _rclone(self, *args: str):
         from skypilot_tpu.data import mounting_utils
@@ -533,7 +564,8 @@ class Storage:
 
 def _normalize_scheme(store: str) -> str:
     aliases = {'gcs': 'gs', 'gs': 'gs', 's3': 's3', 'aws': 's3',
-               'r2': 'r2', 'file': 'file', 'local': 'file'}
+               'r2': 'r2', 'az': 'az', 'azure': 'az',
+               'file': 'file', 'local': 'file'}
     try:
         return aliases[store.lower()]
     except KeyError:

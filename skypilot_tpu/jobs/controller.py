@@ -112,13 +112,13 @@ class JobsController:
         scheduler.job_done(self.job_id)
         state.set_task_status(self.job_id, self.task_id, status,
                               failure_reason=failure_reason)
-        if status == ManagedJobStatus.CANCELLED:
-            # Tasks the pipeline never reached are CANCELLED too, so the
-            # queue never shows PENDING rows of a finished job.
-            for trow in state.list_task_rows(self.job_id):
-                if not trow['status'].is_terminal():
-                    state.set_task_status(self.job_id, trow['task_id'],
-                                          ManagedJobStatus.CANCELLED)
+        # Tasks the pipeline never reached are CANCELLED — whatever ended
+        # the job (cancel OR a mid-pipeline failure) — so the queue never
+        # shows live-looking PENDING rows under a terminal job.
+        for trow in state.list_task_rows(self.job_id):
+            if not trow['status'].is_terminal():
+                state.set_task_status(self.job_id, trow['task_id'],
+                                      ManagedJobStatus.CANCELLED)
         state.set_status(self.job_id, status, failure_reason=failure_reason)
 
     def _fail_no_resource(self, reason: str) -> None:

@@ -171,11 +171,15 @@ def run_instances(cluster_name: str, region: str, zone: Optional[str],
 
 def wait_instances(cluster_name: str, region: str, state: str = 'running',
                    timeout: float = 1800) -> None:
+    record = _load_record(cluster_name) or {}
+    use_spot = bool((record.get('deploy_vars') or {}).get('use_spot'))
     deadline = time.time() + timeout
+    saw_running = False
     while time.time() < deadline:
         states = set(query_instances(cluster_name, region).values())
         if states == {state}:
             return
+        saw_running = saw_running or 'running' in states
         if (not states or 'terminating' in states
                 or 'terminated' in states):
             # 'terminated' appears as a rank{N}-missing hole from
@@ -184,10 +188,13 @@ def wait_instances(cluster_name: str, region: str, state: str = 'running',
             raise exceptions.InsufficientCapacityError(
                 f'{cluster_name}: VM(s) disappeared while waiting for '
                 f'{state}', reason='capacity')
-        if state == 'running' and 'stopped' in states:
+        if (state == 'running' and 'stopped' in states
+                and (use_spot or saw_running)):
             # Azure spot reclaim DEALLOCATES rather than deletes: a VM
-            # that went to 'stopped' while we were waiting for running
-            # was evicted — classify as capacity so failover fires.
+            # going (back) to deallocated mid-wait was evicted — capacity,
+            # so failover fires. Gated on spot / a previously-seen running
+            # state: a non-spot restart of a deallocated cluster passes
+            # through 'stopped' legitimately while its async start lands.
             raise exceptions.InsufficientCapacityError(
                 f'{cluster_name}: VM deallocated while waiting for '
                 'running (spot eviction?)', reason='capacity')

@@ -144,8 +144,20 @@ class Resources:
     candidates.
     """
 
-    # Pickled into cluster records; bump on incompatible field changes.
+    # Pickled into cluster records; bump on incompatible field changes
+    # and add a per-version upgrade in __setstate__ (reference discipline:
+    # sky/resources.py:50 is at _VERSION = 22 with a migration ladder).
     _VERSION = 1
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        """Upgrade old pickled Resources: any field a newer version added
+        defaults to its fresh-request value, so round-N state dirs load
+        under round-N+1 code (tests/fixtures/state_r3 pins this)."""
+        state.setdefault('_version', 0)
+        defaults = Resources().__dict__
+        for key, value in defaults.items():
+            state.setdefault(key, value)
+        self.__dict__.update(state)
 
     def __init__(
         self,
@@ -182,6 +194,7 @@ class Resources:
         self._tpu: Optional[accel_lib.TpuSlice] = None
         self._set_accelerators(accelerators)
 
+        self._version = self._VERSION
         self._instance_type = instance_type
         try:
             self._cpus, self._cpus_plus = common_utils.parse_plus_number(

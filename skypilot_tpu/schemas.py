@@ -71,8 +71,9 @@ _STORAGE_SCHEMA: Dict[str, Any] = {
         'source': {'anyOf': [{'type': 'string'},
                              {'type': 'array', 'items': {'type': 'string'}},
                              {'type': 'null'}]},
-        'store': {'enum': ['gcs', 's3', 'r2', None]},
-        'mode': {'enum': ['MOUNT', 'COPY', 'mount', 'copy', None]},
+        'store': {'enum': ['gcs', 's3', 'r2', 'az', 'azure', None]},
+        'mode': {'enum': ['MOUNT', 'COPY', 'MOUNT_CACHED',
+                          'mount', 'copy', 'mount_cached', None]},
         'persistent': {'type': 'boolean'},
     },
     'additionalProperties': False,

@@ -17,6 +17,7 @@ mesh, lax.scan layers, Pallas flash attention).
 from __future__ import annotations
 
 import argparse
+import os
 import time
 
 
@@ -140,7 +141,15 @@ def main(argv=None) -> None:
             if is_main and start_step:
                 print(f'[train] resumed from step {start_step}', flush=True)
         else:
-            state = trainer.init_fn()(rng)
+            warm_cache = os.environ.get('SKYTPU_WARM_INIT_CACHE')
+            if warm_cache and jax.device_count() == 1:
+                state, source = trainer.init_with_warm_cache(warm_cache,
+                                                             rng)
+                if is_main and source == 'restored':
+                    print('[train] warm-init snapshot restored '
+                          f'(key {trainer.warm_cache_key()})', flush=True)
+            else:
+                state = trainer.init_fn()(rng)
             start_step = 0
         if cb_armed:
             # Scalar fetch: force param-init compile+run to finish so the

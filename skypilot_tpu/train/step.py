@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -135,6 +136,55 @@ class Trainer:
                               opt_state=opt_state), metrics
 
         return jax.jit(step, donate_argnums=0)
+
+    def warm_cache_key(self) -> str:
+        """Cache key for the warm-init snapshot: everything that changes
+        the init result or its shapes (model config, optimizer hypers,
+        backend, device count, jax version)."""
+        import dataclasses
+        import hashlib
+        import json
+        payload = json.dumps({
+            'config': {k: str(v) for k, v in
+                       dataclasses.asdict(self.model.config).items()},
+            'model': type(self.model).__name__,
+            'accum_steps': self.accum_steps,
+            'backend': jax.default_backend(),
+            'n_devices': jax.device_count(),
+            'jax': jax.__version__,
+        }, sort_keys=True)
+        return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+    def init_with_warm_cache(self, cache_dir: str,
+                             rng: jax.Array) -> 'tuple[TrainState, str]':
+        """Init via a persisted snapshot when one matches (VERDICT r4 #7:
+        a warm ``--fast`` relaunch re-ran 13.5s of param init for a
+        0.89B model): restore skips the init computation AND its compile.
+        On miss, init normally and persist the snapshot for the next
+        launch. Returns (state, 'restored'|'initialized').
+
+        Single-device only: a sharded multi-chip restore target needs
+        the live shardings, which only the init computation produces —
+        and real multi-chip jobs resume through CheckpointManager
+        anyway. Whether restore beats re-init depends on host->device
+        bandwidth (a tunneled dev chip may lose); callers gate on
+        $SKYTPU_WARM_INIT_CACHE so the bench can A/B it.
+        """
+        import orbax.checkpoint as ocp
+        path = os.path.join(os.path.expanduser(cache_dir),
+                            self.warm_cache_key())
+        ckptr = ocp.StandardCheckpointer()
+        if os.path.isdir(path):
+            abstract = jax.eval_shape(self.init_fn(), rng)
+            state = ckptr.restore(path, abstract)
+            return state, 'restored'
+        state = self.init_fn()(rng)
+        try:
+            ckptr.save(path, state)
+            ckptr.wait_until_finished()
+        except Exception as e:  # noqa: BLE001 — cache write is best-effort
+            print(f'[train] warm-init cache save failed: {e}', flush=True)
+        return state, 'initialized'
 
     def restore_or_init(self, ckpt_mgr, rng: jax.Array) -> TrainState:
         """Resume from the latest checkpoint if one exists, else fresh init.

@@ -341,3 +341,13 @@ class TestBlobStore:
         with pytest.raises(exceptions.StorageError,
                            match='AZURE_STORAGE_ACCOUNT'):
             store.download_command('/tmp/x')
+
+    def test_named_store_key_selects_azure(self, monkeypatch):
+        """The `store: az` config form (named bucket, no URL) reaches
+        AzureBlobStore — the alias/schema path, not just az:// URLs."""
+        from skypilot_tpu.data import storage as storage_lib
+        monkeypatch.setenv('AZURE_STORAGE_ACCOUNT', 'myacct')
+        st = storage_lib.Storage(name='cont1', store='az')
+        assert isinstance(st.store, storage_lib.AzureBlobStore)
+        st2 = storage_lib.Storage(name='cont1', store='azure')
+        assert isinstance(st2.store, storage_lib.AzureBlobStore)

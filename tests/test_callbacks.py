@@ -52,3 +52,121 @@ class TestOverheadBreakdown:
             t_submit=1.0, prefix='warm_')
         assert out == {'warm_control_plane_s': 4.0}
         assert bench._overhead_breakdown({}, 0.0) == {}
+
+
+class TestFrameworkIntegrations:
+    """Adapters so `skytpu bench` times arbitrary user training code
+    (VERDICT r4 #8; reference sky/callbacks/sky_callback/integrations/)."""
+
+    def _summary(self, log_dir):
+        import json
+        import os
+        from skypilot_tpu.callbacks import SUMMARY_FILE
+        with open(os.path.join(log_dir, SUMMARY_FILE)) as f:
+            return json.load(f)
+
+    def test_transformers_callback_fake_trainer_loop(self, tmp_path,
+                                                     monkeypatch):
+        from skypilot_tpu.callbacks.integrations import (
+            SkyTpuTransformersCallback)
+        monkeypatch.setenv('SKYTPU_BENCHMARK_LOG_DIR', str(tmp_path))
+
+        class FakeState:
+            max_steps = 7
+            is_world_process_zero = True
+
+        cb = SkyTpuTransformersCallback()
+        cb.on_train_begin(args=None, state=FakeState(), control=None)
+        for _ in range(7):
+            cb.on_step_begin()
+            cb.on_step_end()
+        cb.on_train_end()
+        summary = self._summary(tmp_path)
+        assert summary['num_steps'] == 7
+        assert summary['total_steps'] == 7
+        assert 'init_done' in summary['marks']
+        assert summary['seconds_per_step'] >= 0
+
+    def test_transformers_callback_non_main_process_is_silent(
+            self, tmp_path, monkeypatch):
+        from skypilot_tpu.callbacks.integrations import (
+            SkyTpuTransformersCallback)
+        import os
+        monkeypatch.setenv('SKYTPU_BENCHMARK_LOG_DIR', str(tmp_path))
+
+        class Rank1State:
+            is_world_process_zero = False
+
+        cb = SkyTpuTransformersCallback()
+        cb.on_train_begin(args=None, state=Rank1State(), control=None)
+        cb.on_step_end()
+        from skypilot_tpu.callbacks import SUMMARY_FILE
+        assert not os.path.exists(os.path.join(str(tmp_path),
+                                               SUMMARY_FILE))
+
+    def test_keras_callback_fake_fit_loop(self, tmp_path, monkeypatch):
+        from skypilot_tpu.callbacks.integrations import SkyTpuKerasCallback
+        monkeypatch.setenv('SKYTPU_BENCHMARK_LOG_DIR', str(tmp_path))
+        cb = SkyTpuKerasCallback()
+        cb.set_params({'epochs': 2, 'steps': 3})
+        cb.set_model(object())
+        cb.on_train_begin()
+        for epoch in range(2):
+            cb.on_epoch_begin(epoch)
+            for b in range(3):
+                cb.on_train_batch_begin(b)
+                cb.on_train_batch_end(b)
+            cb.on_epoch_end(epoch)
+        cb.on_train_end()
+        summary = self._summary(tmp_path)
+        assert summary['num_steps'] == 6
+        assert summary['total_steps'] == 6
+
+    def test_noop_without_benchmark_env(self, tmp_path, monkeypatch):
+        from skypilot_tpu.callbacks.integrations import SkyTpuKerasCallback
+        monkeypatch.delenv('SKYTPU_BENCHMARK_LOG_DIR', raising=False)
+        cb = SkyTpuKerasCallback()
+        cb.on_train_begin()
+        cb.on_train_batch_begin(0)
+        cb.on_train_batch_end(0)  # must not raise or write anywhere
+        assert not cb._armed
+
+    def test_real_hf_trainer_accepts_callback(self, tmp_path, monkeypatch):
+        """The duck-typed adapter rides a REAL transformers Trainer: a
+        2-step tiny-model run produces the benchmark summary."""
+        import pytest as _pytest
+        transformers = _pytest.importorskip('transformers')
+        torch = _pytest.importorskip('torch')
+        from skypilot_tpu.callbacks.integrations import (
+            SkyTpuTransformersCallback)
+        monkeypatch.setenv('SKYTPU_BENCHMARK_LOG_DIR', str(tmp_path))
+
+        class TinyModel(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.lin = torch.nn.Linear(4, 2)
+
+            def forward(self, x=None, labels=None):
+                logits = self.lin(x)
+                loss = torch.nn.functional.cross_entropy(logits, labels)
+                return {'loss': loss, 'logits': logits}
+
+        class DS(torch.utils.data.Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                return {'x': torch.randn(4),
+                        'labels': torch.tensor(i % 2)}
+
+        args = transformers.TrainingArguments(
+            output_dir=str(tmp_path / 'out'), max_steps=2,
+            per_device_train_batch_size=4, report_to=[],
+            disable_tqdm=True, use_cpu=True)
+        trainer = transformers.Trainer(
+            model=TinyModel(), args=args, train_dataset=DS(),
+            callbacks=[SkyTpuTransformersCallback()])
+        trainer.train()
+        summary = self._summary(tmp_path)
+        assert summary['num_steps'] == 2
+        assert summary['total_steps'] == 2

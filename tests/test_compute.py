@@ -191,3 +191,32 @@ class TestRematPolicies:
         base = losses['full']
         for policy, loss in losses.items():
             assert abs(loss - base) < 1e-4, losses
+
+
+class TestWarmInitCache:
+
+    def test_snapshot_roundtrip_and_key_sensitivity(self, tmp_path):
+        """Warm-init snapshot (VERDICT r4 #7): first call initializes +
+        persists, second call restores byte-identical state without
+        re-running init; a different config misses the cache."""
+        import dataclasses
+        import jax
+        import numpy as np
+        from skypilot_tpu.models.llama import PRESETS, LlamaModel
+        from skypilot_tpu.train import Trainer
+
+        cfg = PRESETS['test-tiny']
+        trainer = Trainer(LlamaModel(cfg))
+        rng = jax.random.key(0)
+        state1, source1 = trainer.init_with_warm_cache(str(tmp_path), rng)
+        assert source1 == 'initialized'
+        state2, source2 = trainer.init_with_warm_cache(str(tmp_path), rng)
+        assert source2 == 'restored'
+        for a, b in zip(jax.tree.leaves(state1), jax.tree.leaves(state2)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # A different model config keys a different snapshot.
+        cfg2 = dataclasses.replace(cfg, num_layers=cfg.num_layers + 1)
+        trainer2 = Trainer(LlamaModel(cfg2))
+        assert trainer2.warm_cache_key() != trainer.warm_cache_key()
+        _, source3 = trainer2.init_with_warm_cache(str(tmp_path), rng)
+        assert source3 == 'initialized'

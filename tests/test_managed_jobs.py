@@ -268,7 +268,9 @@ class TestPipelines:
         assert row['current_task_id'] == 0
         tasks = jobs_state.list_task_rows(job_id)
         assert tasks[0]['status'] == ManagedJobStatus.FAILED
-        assert tasks[1]['status'] == ManagedJobStatus.PENDING  # never ran
+        # Unreached tasks terminalize as CANCELLED: the queue must never
+        # show live-looking PENDING rows under a terminal job.
+        assert tasks[1]['status'] == ManagedJobStatus.CANCELLED
 
     def test_pipeline_yaml_roundtrip(self, tmp_path):
         from skypilot_tpu.utils import dag_utils

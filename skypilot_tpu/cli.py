@@ -91,9 +91,13 @@ def cli():
 @click.option('--fast', is_flag=True,
               help='Skip file mounts + setup when the cluster is UP and '
                    'the setup config is unchanged.')
+@click.option('--clone-disk-from', default=None,
+              help='Image a STOPPED cluster\'s disk and start the new '
+                   'cluster from it.')
 def launch(entrypoint, cluster, name, workdir, cloud, accelerators,
            num_nodes, env, cmd, detach_run, retry_until_up,
-           idle_minutes_to_autostop, down, dryrun, fast):
+           idle_minutes_to_autostop, down, dryrun, fast,
+           clone_disk_from):
     """Launch a task (YAML file or inline command) on a new/existing
     cluster."""
     from skypilot_tpu import execution
@@ -103,7 +107,8 @@ def launch(entrypoint, cluster, name, workdir, cloud, accelerators,
     job_id, _ = execution.launch(
         task, cluster_name=cluster, retry_until_up=retry_until_up,
         idle_minutes_to_autostop=idle_minutes_to_autostop, down=down,
-        detach_run=detach_run, dryrun=dryrun, fast=fast)
+        detach_run=detach_run, dryrun=dryrun, fast=fast,
+        clone_disk_from=clone_disk_from)
     if dryrun:
         click.echo('Dry run complete (optimizer table above).')
     elif job_id is not None and detach_run:
@@ -712,6 +717,40 @@ def api_status():
 def api_logs(request_id):
     from skypilot_tpu.client import sdk
     sdk.stream(request_id)
+
+
+@cli.group()
+def local():
+    """Manage a local Kubernetes cloud (kind)."""
+
+
+@local.command('up')
+@click.option('--name', default=None,
+              help='kind cluster name (default: skytpu-local).')
+def local_up(name):
+    """Bootstrap a kind cluster as a local Kubernetes cloud
+    (reference `sky local up`)."""
+    from skypilot_tpu.utils import kind_utils
+    kwargs = {'name': name} if name else {}
+    path, created = kind_utils.local_up(**kwargs)
+    verb = 'created' if created else 'already running; kubeconfig refreshed'
+    click.echo(f'Local Kubernetes cluster {verb}.\n'
+               f'  kubeconfig: {path}\n'
+               f'Use it with:\n'
+               f'  export KUBECONFIG={path}\n'
+               f'  skytpu launch --cloud kubernetes -- echo hi')
+
+
+@local.command('down')
+@click.option('--name', default=None)
+def local_down(name):
+    """Tear down the kind-backed local Kubernetes cloud."""
+    from skypilot_tpu.utils import kind_utils
+    kwargs = {'name': name} if name else {}
+    if kind_utils.local_down(**kwargs):
+        click.echo('Local Kubernetes cluster deleted.')
+    else:
+        click.echo('No local Kubernetes cluster found.')
 
 
 def main():

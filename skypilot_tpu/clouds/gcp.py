@@ -208,9 +208,14 @@ class GCP(cloud_lib.Cloud):
                 # Container tasks boot a stock host image; the backend
                 # bootstraps docker + runs ranks in the container.
                 image_id = None
-            base.update({
-                'mode': 'gce',
-                'instance_type': resources.instance_type,
-                'image_family': image_id or 'ubuntu-2204-lts',
-            })
+            if image_id and '/' in str(image_id):
+                # Full image path (e.g. a clone-disk image:
+                # projects/<p>/global/images/<name>) — NOT a family.
+                base.update({'mode': 'gce',
+                             'instance_type': resources.instance_type,
+                             'image_id': image_id})
+            else:
+                base.update({'mode': 'gce',
+                             'instance_type': resources.instance_type,
+                             'image_family': image_id or 'ubuntu-2204-lts'})
         return base

@@ -78,4 +78,7 @@ class Local(cloud_lib.Cloud):
             'zone': zone,
             'num_hosts': resources.num_hosts,
             'tpu_slice': resources.tpu.name if resources.tpu else None,
+            # clone-disk images (local-image://...) materialize into the
+            # emulated host dirs on first provision.
+            'image_id': resources.image_id,
         }

@@ -151,6 +151,43 @@ def _execute(task: task_lib.Task,
     return job_id, handle
 
 
+def _apply_clone_disk(task: task_lib.Task, source_cluster: str,
+                      dryrun: bool = False) -> task_lib.Task:
+    """Image the STOPPED source cluster's head boot disk and pin every
+    task candidate to (source cloud, produced image) — reference
+    ``--clone-disk-from`` (sky/execution.py:38-55: the new cluster starts
+    from the old one's disk content)."""
+    import time as time_lib
+
+    from skypilot_tpu import global_user_state
+    from skypilot_tpu import provision as provision_lib
+    record = global_user_state.get_cluster_from_name(source_cluster)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f"clone-disk-from: cluster {source_cluster!r} does not exist")
+    status = global_user_state.ClusterStatus(record['status'])
+    if status is not global_user_state.ClusterStatus.STOPPED:
+        raise exceptions.NotSupportedError(
+            f'clone-disk-from needs {source_cluster!r} STOPPED for a '
+            f'consistent disk image (is {status.value}); run '
+            f'`skytpu stop {source_cluster}` first.')
+    handle = record['handle']
+    if dryrun:
+        # A dry run must have zero cloud side effects: validate + pin the
+        # cloud, but do NOT create the (billable) image.
+        task.set_resources([r.copy(cloud=handle.cloud)
+                            for r in task.resources])
+        return task
+    image_name = (f'skytpu-clone-{source_cluster}-'
+                  f'{int(time_lib.time())}'.lower().replace('_', '-'))
+    image_id = provision_lib.create_image_from_cluster(
+        handle.cloud, source_cluster, handle.region, image_name)
+    new_resources = [r.copy(cloud=handle.cloud, image_id=image_id)
+                     for r in task.resources]
+    task.set_resources(new_resources)
+    return task
+
+
 def launch(task, cluster_name: str,
            retry_until_up: bool = False,
            idle_minutes_to_autostop: Optional[int] = None,
@@ -162,7 +199,9 @@ def launch(task, cluster_name: str,
            stream_logs: bool = True,
            policy_operation: str = 'launch',
            fast: bool = False,
-           blocked_resources=None) -> Tuple[Optional[int], Optional[Any]]:
+           blocked_resources=None,
+           clone_disk_from: Optional[str] = None
+           ) -> Tuple[Optional[int], Optional[Any]]:
     """Provision (or reuse) a cluster and run the task on it.
 
     ``policy_operation`` names this request to the admin policy
@@ -183,6 +222,9 @@ def launch(task, cluster_name: str,
     task = admin_policy.apply(task, cluster_name=cluster_name,
                               operation=policy_operation, dryrun=dryrun)
     common_utils.check_cluster_name_is_valid(cluster_name)
+
+    if clone_disk_from:
+        task = _apply_clone_disk(task, clone_disk_from, dryrun=dryrun)
 
     if idle_minutes_to_autostop is not None \
             and idle_minutes_to_autostop >= 0 and not down:

@@ -121,3 +121,11 @@ def get_command_runners(cluster_info: 'ClusterInfo',
                         ssh_credentials: Optional[Dict[str, str]] = None
                         ) -> List[Any]:
     """One CommandRunner per host, rank order (head first)."""
+
+
+@_cloud_api
+def create_image_from_cluster(cluster_name: str, region: str,
+                              image_name: str) -> str:
+    """Snapshot the (stopped) cluster's head boot disk into a reusable
+    image; returns the image id a new launch can pass as ``image_id``
+    (reference ``--clone-disk-from``, sky/execution.py:38-55)."""

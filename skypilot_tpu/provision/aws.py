@@ -365,3 +365,33 @@ def get_command_runners(cluster_info: provision_lib.ClusterInfo,
         ip = h.external_ip or h.internal_ip
         runners.append(runner_lib.SSHCommandRunner(ip, user, key_path))
     return runners
+
+
+def create_image_from_cluster(cluster_name: str, region: str,
+                              image_name: str) -> str:
+    """AMI from the stopped cluster's head instance (reference
+    ``--clone-disk-from``; EC2 CreateImage on a stopped instance is a
+    consistent snapshot)."""
+    record = _require_record(cluster_name)
+    ec2 = aws_api.get_ec2(record['region'])
+    insts = _live_instances(ec2, record['name_on_cloud'])
+    head = next((i for i in insts
+                 if aws_api.tag_value(i, _TAG_RANK) == '0'), None)
+    if head is None:
+        raise exceptions.ClusterError(
+            f'{cluster_name}: no rank-0 instance to image')
+    resp = aws_api.call(ec2, 'create_image',
+                        InstanceId=head['InstanceId'], Name=image_name)
+    image_id = resp['ImageId']
+    deadline = time.time() + 900
+    while time.time() < deadline:
+        desc = aws_api.call(ec2, 'describe_images', ImageIds=[image_id])
+        images = desc.get('Images', [])
+        state = images[0].get('State') if images else None
+        if state == 'available':
+            return image_id
+        if state in ('failed', 'error'):
+            raise exceptions.CloudError(
+                f'AMI {image_id} creation failed')
+        time.sleep(5)
+    raise exceptions.ProvisionError(f'AMI {image_id} not available in time')

@@ -236,6 +236,10 @@ def _run_gce_instances(project: str, zone: str, name: str, num_hosts: int,
         zone, label_filter=f'labels.{_LABEL}={name}')}
     machine = deploy_vars.get('instance_type', 'n2-standard-8')
     image = deploy_vars.get('image_family', 'ubuntu-2204-lts')
+    # An explicit image_id (e.g. a clone-disk image URL) wins over the
+    # public family default.
+    source_image = (deploy_vars.get('image_id')
+                    or f'projects/ubuntu-os-cloud/global/images/family/{image}')
     pending_ops = []
     for rank in range(num_hosts):
         iname = f'{name}-{rank}'
@@ -251,8 +255,7 @@ def _run_gce_instances(project: str, zone: str, name: str, num_hosts: int,
             'disks': [{
                 'boot': True,
                 'initializeParams': {
-                    'sourceImage':
-                        f'projects/ubuntu-os-cloud/global/images/family/{image}',
+                    'sourceImage': source_image,
                     'diskSizeGb': deploy_vars.get('disk_size_gb', 256),
                 },
                 'autoDelete': True,
@@ -487,3 +490,22 @@ def get_command_runners(cluster_info: provision_lib.ClusterInfo,
         ip = h.external_ip or h.internal_ip
         runners.append(runner_lib.SSHCommandRunner(ip, user, key_path))
     return runners
+
+
+def create_image_from_cluster(cluster_name: str, region: str,
+                              image_name: str) -> str:
+    """Image the stopped cluster's head boot disk (reference
+    ``--clone-disk-from``). GCE clusters only: TPU-VM boot disks are not
+    imageable through the images API."""
+    record = _require_record(cluster_name)
+    if record.get('mode') != 'gce':
+        raise exceptions.NotSupportedError(
+            'clone-disk-from needs a GCE (CPU VM) cluster; TPU-VM boot '
+            'disks cannot be imaged')
+    project = record['project']
+    zone = record['zone']
+    head = f"{record['name_on_cloud']}-0"
+    gce = gcp_api.GceClient(project)
+    op = gce.create_image(image_name, zone, head)
+    gce.wait_global_operation(op)
+    return f'projects/{project}/global/images/{image_name}'

@@ -299,6 +299,17 @@ class GceClient:
             time.sleep(2)
         raise exceptions.ProvisionError('GCE operation timed out')
 
+    # -- images (clone-disk support) -----------------------------------------
+    def create_image(self, image_name: str, zone: str,
+                     source_disk: str) -> Dict[str, Any]:
+        """Create a global image from a zonal disk (the boot disk of an
+        auto-created instance shares the instance's name)."""
+        body = {'name': image_name,
+                'sourceDisk': (f'projects/{self.project}/zones/{zone}/'
+                               f'disks/{source_disk}')}
+        return get_transport().request(
+            'POST', f'{self._global_url()}/images', json_body=body)
+
     # -- firewalls (global resources; serving-port exposure) -----------------
     def _global_url(self) -> str:
         return f'{GCE_BASE}/projects/{self.project}/global'

@@ -70,9 +70,20 @@ def run_instances(cluster_name: str, region: str, zone: Optional[str],
     if zone in fail_zones:
         raise exceptions.InsufficientCapacityError(
             f'local: no more capacity in zone {zone!r}')
+    image_id = (deploy_vars or {}).get('image_id')
     for rank in range(num_hosts):
-        os.makedirs(os.path.join(_cluster_dir(cluster_name), f'host{rank}'),
-                    exist_ok=True)
+        host_dir = os.path.join(_cluster_dir(cluster_name), f'host{rank}')
+        fresh = not os.path.isdir(host_dir)
+        os.makedirs(host_dir, exist_ok=True)
+        if fresh and image_id and str(image_id).startswith('local-image://'):
+            # Cloned-disk launch: the emulated host 'disk' is its dir —
+            # materialize the image contents into it (clone-disk parity
+            # for the hermetic cloud; see create_image_from_cluster).
+            src = _image_dir(image_id[len('local-image://'):])
+            if not os.path.isdir(src):
+                raise exceptions.ClusterError(
+                    f'local image {image_id!r} does not exist')
+            shutil.copytree(src, host_dir, dirs_exist_ok=True)
     _write_metadata(cluster_name, {
         'status': 'running',
         'num_hosts': num_hosts,
@@ -230,3 +241,31 @@ def get_command_runners(cluster_info: provision_lib.ClusterInfo,
         runner_lib.LocalProcessRunner(h.extra['host_dir'])
         for h in cluster_info.hosts
     ]
+
+
+def _image_dir(image_name: str) -> str:
+    from skypilot_tpu import global_user_state
+    return os.path.join(global_user_state.get_state_dir(), 'local_images',
+                        image_name)
+
+
+def create_image_from_cluster(cluster_name: str, region: str,
+                              image_name: str) -> str:
+    """Snapshot the head host dir (the emulated boot disk) into a
+    reusable local image; new clusters launched with the returned
+    ``local-image://`` id start from a copy of its contents."""
+    head = os.path.join(_cluster_dir(cluster_name), 'host0')
+    if not os.path.isdir(head):
+        raise exceptions.ClusterError(
+            f'local cluster {cluster_name!r} has no host dir to image')
+    dst = _image_dir(image_name)
+    shutil.rmtree(dst, ignore_errors=True)
+    # The runtime dir (agent pidfiles, job queue) is the "OS" half of the
+    # emulated disk — a clone must not import the source's live job
+    # state, exactly like a real boot-disk image excludes instance
+    # identity.
+    from skypilot_tpu.runtime import constants as rt_constants
+    shutil.copytree(head, dst, dirs_exist_ok=True,
+                    ignore=shutil.ignore_patterns(
+                        rt_constants.RUNTIME_DIR, '.skytpu_job_*'))
+    return f'local-image://{image_name}'

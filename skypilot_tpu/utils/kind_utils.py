@@ -1,0 +1,94 @@
+"""`skytpu local up/down`: a local Kubernetes cloud via kind.
+
+Counterpart of reference ``sky/cli.py:5548-5644`` (`sky local up`
+bootstraps a kind cluster so the Kubernetes code path runs on a laptop).
+The created cluster's kubeconfig lands in the skytpu state dir and
+becomes the default the k8s transport reads (merged into $KUBECONFIG for
+the current invocation; the CLI prints the export line for shells).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+
+CLUSTER_NAME = 'skytpu-local'
+
+
+def kubeconfig_path(name: str = CLUSTER_NAME) -> str:
+    suffix = '' if name == CLUSTER_NAME else f'-{name}'
+    return os.path.join(global_user_state.get_state_dir(),
+                        f'kind-kubeconfig{suffix}')
+
+
+def _check_tools() -> Optional[str]:
+    missing = [t for t in ('kind', 'kubectl', 'docker')
+               if shutil.which(t) is None]
+    if missing:
+        return ('local up needs ' + ', '.join(missing) + ' installed. '
+                'Install kind: https://kind.sigs.k8s.io/docs/user/'
+                'quick-start/#installation')
+    return None
+
+
+def local_up(name: str = CLUSTER_NAME,
+             wait: str = '120s') -> Tuple[str, bool]:
+    """Create (or reuse) the kind cluster; returns (kubeconfig_path,
+    created). Raises CloudError with an actionable message on failure."""
+    hint = _check_tools()
+    if hint:
+        raise exceptions.CloudError(hint)
+    path = kubeconfig_path(name)
+    existing = subprocess.run(['kind', 'get', 'clusters'],
+                              capture_output=True, text=True, timeout=60)
+    if name in (existing.stdout or '').split():
+        # Reuse: refresh the kubeconfig (it may have rotated certs).
+        export = subprocess.run(
+            ['kind', 'export', 'kubeconfig', '--name', name,
+             '--kubeconfig', path],
+            capture_output=True, text=True, timeout=60)
+        if export.returncode != 0:
+            raise exceptions.CloudError(
+                f'kind cluster {name!r} exists but exporting its '
+                f'kubeconfig failed: {export.stderr[-300:]}')
+        return path, False
+    create = subprocess.run(
+        ['kind', 'create', 'cluster', '--name', name,
+         '--kubeconfig', path, '--wait', wait],
+        capture_output=True, text=True, timeout=600)
+    if create.returncode != 0:
+        raise exceptions.CloudError(
+            f'kind cluster creation failed: {create.stderr[-500:]}')
+    nodes = subprocess.run(
+        ['kubectl', '--kubeconfig', path, 'get', 'nodes', '-o', 'name'],
+        capture_output=True, text=True, timeout=60)
+    if nodes.returncode != 0 or not nodes.stdout.strip():
+        raise exceptions.CloudError(
+            f'kind cluster came up but kubectl cannot see nodes: '
+            f'{nodes.stderr[-300:]}')
+    return path, True
+
+
+def local_down(name: str = CLUSTER_NAME) -> bool:
+    """Delete the kind cluster; returns True if one was deleted."""
+    hint = _check_tools()
+    if hint:
+        raise exceptions.CloudError(hint)
+    existing = subprocess.run(['kind', 'get', 'clusters'],
+                              capture_output=True, text=True, timeout=60)
+    if name not in (existing.stdout or '').split():
+        return False
+    delete = subprocess.run(['kind', 'delete', 'cluster', '--name', name],
+                            capture_output=True, text=True, timeout=300)
+    if delete.returncode != 0:
+        raise exceptions.CloudError(
+            f'kind cluster deletion failed: {delete.stderr[-500:]}')
+    try:
+        os.remove(kubeconfig_path(name))
+    except FileNotFoundError:
+        pass
+    return True

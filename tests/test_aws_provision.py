@@ -90,6 +90,19 @@ class FakeEc2:
             self.instances[iid]['State']['Name'] = 'terminated'
         return {}
 
+    def create_image(self, InstanceId, Name, **kw):
+        assert InstanceId in self.instances
+        img_id = f'ami-{next(self._ids):08x}'
+        self.images = getattr(self, 'images', {})
+        self.images[img_id] = {'ImageId': img_id, 'Name': Name,
+                               'State': 'available'}
+        return {'ImageId': img_id}
+
+    def describe_images(self, ImageIds, **kw):
+        self.images = getattr(self, 'images', {})
+        return {'Images': [self.images[i] for i in ImageIds
+                           if i in self.images]}
+
     def describe_key_pairs(self, **kw):
         return {'KeyPairs': [{'KeyName': k} for k in self.key_pairs]}
 
@@ -425,3 +438,23 @@ class TestPortRangesAndZones:
         res = sky.Resources(cloud='aws', instance_type='m6i.large',
                             region='us-east-1', zone='us-east-1d')
         assert AWS().zones_for(res, 'us-east-1') == ['us-east-1d']
+
+
+class TestCloneDiskImage:
+
+    def test_create_image_from_cluster(self, fake_aws):
+        aws_provision.run_instances('img1', 'us-east-1', 'us-east-1a', 2,
+                                    _deploy_vars())
+        aws_provision.stop_instances('img1', 'us-east-1')
+        image_id = aws_provision.create_image_from_cluster(
+            'img1', 'us-east-1', 'skytpu-clone-img1')
+        assert image_id.startswith('ami-')
+        region = fake_aws.regions['us-east-1']
+        assert region.images[image_id]['Name'] == 'skytpu-clone-img1'
+        # Launching with the produced AMI pins it on the new instances.
+        aws_provision.run_instances('img2', 'us-east-1', 'us-east-1a', 1,
+                                    _deploy_vars(
+                                        cluster_name_on_cloud='c-aws2',
+                                        image_id=image_id))
+        assert set(aws_provision.query_instances(
+            'img2', 'us-east-1').values()) == {'running'}

@@ -1,5 +1,6 @@
 """CLI surface coverage beyond the core launch cycle: show-tpus,
 cost-report, optimize, bench group, jobs guards, api group parses."""
+import pytest
 from click.testing import CliRunner
 
 from skypilot_tpu import cli
@@ -96,3 +97,55 @@ class TestHelpSurface:
         res = _invoke('launch', '--help')
         assert '--fast' in res.output
         assert '--retry-until-up' in res.output
+
+
+class TestLocalUpDown:
+    """`skytpu local up/down` (reference sky/cli.py:5548: kind bootstrap).
+    kind isn't installed in CI, so the tool gate + the happy path are
+    driven with monkeypatched subprocess/shutil."""
+
+    def test_missing_tools_is_actionable(self, monkeypatch):
+        from skypilot_tpu import exceptions
+        from skypilot_tpu.utils import kind_utils
+        monkeypatch.setattr('shutil.which', lambda t: None)
+        with pytest.raises(exceptions.CloudError, match='kind'):
+            kind_utils.local_up()
+        with pytest.raises(exceptions.CloudError, match='kind'):
+            kind_utils.local_down()
+
+    def test_up_creates_then_reuses(self, monkeypatch):
+        from skypilot_tpu.utils import kind_utils
+        monkeypatch.setattr('shutil.which', lambda t: f'/usr/bin/{t}')
+        clusters = []
+        calls = []
+
+        class R:
+            def __init__(self, stdout='', rc=0):
+                self.stdout = stdout
+                self.stderr = ''
+                self.returncode = rc
+
+        def fake_run(argv, **kw):
+            calls.append(argv)
+            if argv[:3] == ['kind', 'get', 'clusters']:
+                return R('\n'.join(clusters))
+            if argv[:3] == ['kind', 'create', 'cluster']:
+                clusters.append(argv[argv.index('--name') + 1])
+                return R()
+            if argv[:3] == ['kind', 'export', 'kubeconfig']:
+                return R()
+            if argv[0] == 'kubectl':
+                return R('node/kind-control-plane')
+            if argv[:3] == ['kind', 'delete', 'cluster']:
+                clusters.remove(argv[argv.index('--name') + 1])
+                return R()
+            raise AssertionError(f'unexpected: {argv}')
+
+        monkeypatch.setattr('subprocess.run', fake_run)
+        path, created = kind_utils.local_up()
+        assert created and clusters == ['skytpu-local']
+        path2, created2 = kind_utils.local_up()
+        assert not created2 and path2 == path  # reuse, no second create
+        assert kind_utils.local_down() is True
+        assert clusters == []
+        assert kind_utils.local_down() is False  # idempotent

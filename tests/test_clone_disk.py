@@ -1,0 +1,58 @@
+"""--clone-disk-from (reference sky/execution.py:38-55): image a STOPPED
+cluster's disk, start a new cluster from it."""
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import core, exceptions, execution
+
+pytestmark = pytest.mark.e2e
+
+
+def _local_task(run):
+    task = sky.Task(run=run)
+    task.set_resources([sky.Resources(cloud='local')])
+    return task
+
+
+def _wait(cluster, job_id):
+    from tests.test_e2e_local import _wait_job
+    return _wait_job(cluster, job_id)
+
+
+class TestCloneDiskLocal:
+
+    def test_clone_carries_disk_content(self):
+        # c1 writes a marker OUTSIDE the workdir (the host "disk" root).
+        job_id, _ = execution.launch(
+            _local_task('echo from-c1 > ../marker.txt'),
+            cluster_name='clone-src', detach_run=True)
+        assert _wait('clone-src', job_id) == 'SUCCEEDED'
+        core.stop('clone-src')
+
+        job_id2, _ = execution.launch(
+            _local_task('cat ../marker.txt'),
+            cluster_name='clone-dst', detach_run=True,
+            clone_disk_from='clone-src')
+        assert _wait('clone-dst', job_id2) == 'SUCCEEDED'
+        from tests.test_e2e_local import _logs_text
+        assert 'from-c1' in _logs_text('clone-dst', job_id2)
+        # Source untouched; both tear down cleanly.
+        core.down('clone-dst')
+        core.down('clone-src')
+
+    def test_running_source_is_refused(self):
+        job_id, _ = execution.launch(_local_task('sleep 60'),
+                                     cluster_name='clone-live',
+                                     detach_run=True)
+        with pytest.raises(exceptions.NotSupportedError, match='STOPPED'):
+            execution.launch(_local_task('true'),
+                             cluster_name='clone-live-dst',
+                             detach_run=True,
+                             clone_disk_from='clone-live')
+        core.down('clone-live')
+
+    def test_missing_source_is_refused(self):
+        with pytest.raises(exceptions.ClusterDoesNotExist):
+            execution.launch(_local_task('true'), cluster_name='x',
+                             detach_run=True,
+                             clone_disk_from='never-existed')

@@ -92,6 +92,13 @@ def probe_chip(timeout: float) -> dict | None:
     """Run a tiny jit in a throwaway subprocess (the wedged tunnel HANGS
     rather than erroring, so this must be killable; and the orchestrator
     must never hold the chip itself)."""
+    forced = os.environ.get('SKYTPU_BENCH_FORCE_PROBE')
+    if forced:  # test seam: 'backend,count,device kind' or 'none'
+        if forced == 'none':
+            return None
+        backend, count, kind = forced.split(',', 2)
+        return {'backend': backend, 'n_devices': int(count),
+                'device_kind': kind}
     try:
         proc = subprocess.Popen(
             [sys.executable, '-c', _PROBE_SRC],
@@ -602,7 +609,7 @@ def main() -> None:
         record['train_tpu_failure'] = train
         on_tpu = False
         _cleanup_orphans()
-        train = run_phase('train', _phase_budget('train', 300),
+        train = run_phase('train', _phase_budget('train_retry', 300),
                           force_cpu=True)
     record.update(train)
     if 'value' not in record:  # CPU fallback also failed: emit SOMETHING

@@ -85,3 +85,45 @@ def test_all_phases_wedged_record_still_parses(tmp_path):
     assert final['serve_timeout'] is True
     assert final['decode_timeout'] is True
     assert 'bench_elapsed_s' in final
+
+
+def test_tpu_train_wedge_falls_back_to_cpu_and_flags(tmp_path):
+    """The critical recovery path: probe says TPU, the train phase wedges
+    (simulated), the orchestrator flags chip_wedged, retries train on
+    CPU, and skips remaining phases to CPU — record complete."""
+    marker = tmp_path / 'wedged-once'
+    env = dict(os.environ)
+    env.update({
+        'SKYTPU_STATE_DIR': str(tmp_path / 'state'),
+        # Probe reports a (fake) TPU; phases are forced-CPU only after
+        # the wedge, so the first train attempt runs in "TPU mode".
+        'SKYTPU_BENCH_FORCE_PROBE': 'axon,1,TPU v5 lite',
+        'SKYTPU_BENCH_WEDGE_PHASE': 'train',
+        'SKYTPU_BENCH_WEDGE_ONCE': str(marker),
+        'SKYTPU_BENCH_BUDGET_TRAIN': '8',
+        'SKYTPU_BENCH_BUDGET_TRAIN_RETRY': '240',  # CPU retry needs time
+        'SKYTPU_BENCH_BUDGET_LAUNCHED': '5',
+        'SKYTPU_BENCH_BUDGET_SERVE': '5',
+        'SKYTPU_BENCH_BUDGET_DECODE': '5',
+    })
+    # Wedge-once means the retry proceeds; but the retry still runs the
+    # TPU workload preset if jax reports axon... it cannot here (CPU
+    # jax), so _workload(on_tpu=False) picks test-tiny. The later phases
+    # have 5s budgets: if healthy they'd need more — but this test only
+    # asserts the train record + flags survive, so let them time out.
+    out = subprocess.run([sys.executable, BENCH], capture_output=True,
+                         text=True, timeout=420, env=env)
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    assert lines, f'no record; stderr: {out.stderr[-1500:]}'
+    first = json.loads(lines[0])
+    # First emitted record: train succeeded on the CPU retry, with the
+    # wedge flagged and the TPU failure preserved for diagnosis.
+    assert first['chip_wedged'] is True
+    assert first['chip_wedged_at'] == 'train'
+    assert first['value'] > 0
+    assert first['train_tpu_failure']['train_timeout'] is True
+    assert marker.exists()
+    final = json.loads(lines[-1])
+    assert final['chip_wedged'] is True
+    for key in ('metric', 'value', 'unit', 'vs_baseline'):
+        assert key in final

@@ -96,9 +96,17 @@ def probe_chip(timeout: float) -> dict | None:
     if forced:  # test seam: 'backend,count,device kind' or 'none'
         if forced == 'none':
             return None
-        backend, count, kind = forced.split(',', 2)
-        return {'backend': backend, 'n_devices': int(count),
-                'device_kind': kind}
+        try:
+            backend, count, kind = forced.split(',', 2)
+            return {'backend': backend, 'n_devices': int(count),
+                    'device_kind': kind}
+        except ValueError:
+            # A malformed seam value must not break the every-run-emits-
+            # a-record contract; treat it as a failed probe.
+            print(f'bench: bad SKYTPU_BENCH_FORCE_PROBE {forced!r} '
+                  '(want backend,count,kind) -> treating as wedged',
+                  file=sys.stderr)
+            return None
     try:
         proc = subprocess.Popen(
             [sys.executable, '-c', _PROBE_SRC],

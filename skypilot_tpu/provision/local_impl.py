@@ -193,12 +193,23 @@ def stop_instances(cluster_name: str, region: str) -> None:
     meta = _read_metadata(cluster_name)
     if meta is None:
         return
+    # Publish the state transition BEFORE killing: real clouds report
+    # 'stopping'/'shutting-down' the moment the API call lands, and
+    # observers (the serve replica prober's preemption discriminator)
+    # depend on cloud-truth changing before the host processes finish
+    # dying — the kill waits below can take seconds.
+    meta['status'] = 'stopping'
+    _write_metadata(cluster_name, meta)
     _kill_host_processes(cluster_name)
     meta['status'] = 'stopped'
     _write_metadata(cluster_name, meta)
 
 
 def terminate_instances(cluster_name: str, region: str) -> None:
+    meta = _read_metadata(cluster_name)
+    if meta is not None:
+        meta['status'] = 'terminating'  # visible before the kill waits
+        _write_metadata(cluster_name, meta)
     _kill_host_processes(cluster_name)
     shutil.rmtree(_cluster_dir(cluster_name), ignore_errors=True)
 

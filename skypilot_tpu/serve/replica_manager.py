@@ -56,6 +56,7 @@ class ReplicaManager:
         self.placer = spot_placer_lib.make(spec.replica_policy.spot_placer)
         self._inflight: Dict[int, threading.Thread] = {}
         self._lock = threading.Lock()
+        self._debug = bool(os.environ.get('SKYTPU_SERVE_DEBUG'))
         self._probe_pool = ThreadPoolExecutor(
             max_workers=_PROBE_POOL, thread_name_prefix='probe')
 
@@ -333,17 +334,28 @@ class ReplicaManager:
         list(self._probe_pool.map(self._probe_one, to_probe))
 
     def _cluster_alive(self, cluster: str) -> bool:
+        """Cloud-truth liveness for the preemption discriminator.
+        $SKYTPU_SERVE_DEBUG logs each verdict — preemption-vs-probing
+        misclassification is timing-dependent and unreproducible without
+        this trace."""
         from skypilot_tpu import global_user_state
         from skypilot_tpu import provision as provision_lib
+        dbg = self._debug
         record = global_user_state.get_cluster_from_name(cluster)
         if record is None or record['handle'] is None:
+            if dbg:
+                self.log(f'alive({cluster}): no record/handle -> False')
             return False
         handle = record['handle']
         try:
             states = provision_lib.query_instances(handle.cloud, cluster,
                                                    handle.region)
-        except exceptions.SkyTpuError:
+        except exceptions.SkyTpuError as e:
+            if dbg:
+                self.log(f'alive({cluster}): query raised {e!r} -> True')
             return True  # cloud unreachable: do not false-positive preemption
+        if dbg:
+            self.log(f'alive({cluster}): states={states}')
         return bool(states) and set(states.values()) == {'running'}
 
     def _probe_one(self, replica: Dict) -> None:

@@ -457,10 +457,17 @@ class TestServeE2E:
                         ready[0]['replica_id'] != first['replica_id'])
 
             _wait(recovered, 120, 'replacement replica READY')
-            rows = serve_state.list_replicas('svc-preempt')
-            preempted = [r for r in rows
-                         if r['status'] == ReplicaStatus.PREEMPTED]
-            assert preempted, [r['status'] for r in rows]
+
+            # The preempted replica's cleanup runs in a background
+            # thread (SHUTTING_DOWN -> PREEMPTED): wait for the terminal
+            # status instead of asserting at a racy instant.
+            def preempted_terminal():
+                rows = serve_state.list_replicas('svc-preempt')
+                return [r for r in rows
+                        if r['status'] == ReplicaStatus.PREEMPTED] or None
+
+            preempted = _wait(preempted_terminal, 60,
+                              'preempted replica terminalized')
         finally:
             serve_core.down('svc-preempt')
 

@@ -80,9 +80,13 @@ def render() -> str:
     try:
         from skypilot_tpu.jobs import state as jobs_state
         for j in jobs_state.list_jobs():
+            n_tasks = j.get('num_tasks') or 1
+            task_col = (f"{(j.get('current_task_id') or 0) + 1}/{n_tasks}"
+                        if n_tasks > 1 else '-')
             job_rows.append([
                 _esc(j['job_id']), _esc(j['name']),
                 _status_cell(j['status'].value),
+                _esc(task_col),
                 _esc(j['schedule_state'].value),
                 _esc(j['recovery_count']), _esc(j['cluster_name']),
             ])
@@ -126,7 +130,8 @@ def render() -> str:
             ['name', 'status', 'resources', 'hosts', 'autostop'],
             cluster_rows),
         jobs=_table(
-            ['id', 'name', 'status', 'schedule', 'recoveries', 'cluster'],
+            ['id', 'name', 'status', 'task', 'schedule', 'recoveries',
+             'cluster'],
             job_rows),
         services=_table(['name', 'status', 'ready', 'lb port'],
                         service_rows),

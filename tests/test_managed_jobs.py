@@ -287,3 +287,35 @@ class TestPipelines:
         assert dag.name == 'my-pipeline'
         assert [t.name for t in dag.topological_order()] == ['a', 'b']
         assert dag.is_chain()
+
+
+def test_pipeline_tail_logs_follows_across_tasks(tmp_path):
+    """`skytpu jobs logs` on a pipeline follows the CURRENT task's
+    cluster: output from both tasks lands in one follow stream."""
+    import io
+    import threading
+
+    from skypilot_tpu import dag as dag_lib
+    t1 = sky.Task(name='one', run='echo from-task-one')
+    t1.set_resources([sky.Resources(cloud='local')])
+    t2 = sky.Task(name='two', run='echo from-task-two')
+    t2.set_resources([sky.Resources(cloud='local')])
+    dag = dag_lib.Dag(name='logs-pipe')
+    dag.add_edge(t1, t2)
+    job_id = jobs_core.launch(dag)
+    buf = io.StringIO()
+    rc_holder = {}
+
+    def tail():
+        rc_holder['rc'] = jobs_core.tail_logs(job_id, follow=True, out=buf)
+
+    th = threading.Thread(target=tail, daemon=True)
+    th.start()
+    _wait_status(job_id, {ManagedJobStatus.SUCCEEDED}, timeout=120)
+    th.join(timeout=60)
+    assert not th.is_alive(), 'follow never returned after terminal'
+    text = buf.getvalue()
+    assert 'from-task-one' in text, text[-2000:]
+    assert 'from-task-two' in text, text[-2000:]
+    assert 'SUCCEEDED' in text
+    assert rc_holder['rc'] == 0

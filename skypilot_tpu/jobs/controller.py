@@ -57,6 +57,7 @@ class JobsController:
         # Per-task current state (set by _run_one_task):
         self.task_id = 0
         self.cluster_name = self._base_cluster
+        self._current_cluster_job_id: Optional[int] = None
         self.strategy: Optional[
             recovery_strategy.StrategyExecutor] = None
 
@@ -88,6 +89,28 @@ class JobsController:
         except exceptions.SkyTpuError:
             pass
 
+    def _archive_task_log(self, cluster_job_id: Optional[int]) -> None:
+        """Persist the current task's job log controller-side BEFORE its
+        cluster is torn down, so `jobs logs` can replay finished pipeline
+        tasks (their clusters no longer exist to tail). Best-effort: a
+        preempted cluster has nothing left to read."""
+        if cluster_job_id is None:
+            return
+        try:
+            from skypilot_tpu import backends, global_user_state
+            record = global_user_state.get_cluster_from_name(
+                self.cluster_name)
+            if record is None or record['handle'] is None:
+                return
+            path = scheduler.task_log_path(self.job_id, self.task_id)
+            with open(path + '.tmp', 'w') as f:
+                backends.SliceBackend().tail_logs(
+                    record['handle'], cluster_job_id, follow=False,
+                    stream_to=f)
+            os.replace(path + '.tmp', path)
+        except Exception:  # noqa: BLE001 — archival must never stop a job
+            pass
+
     def _set_task_and_job_status(self, status: ManagedJobStatus,
                                  failure_reason: Optional[str] = None,
                                  respect_cancelling: bool = True) -> None:
@@ -108,6 +131,7 @@ class JobsController:
         If this process dies mid-sequence the row is still non-terminal
         with a dead pid, so the reconciler retires it and frees the slot.
         """
+        self._archive_task_log(self._current_cluster_job_id)
         self._down_cluster()
         scheduler.job_done(self.job_id)
         state.set_task_status(self.job_id, self.task_id, status,
@@ -161,6 +185,7 @@ class JobsController:
         except exceptions.ResourcesUnavailableError as e:
             self._fail_no_resource(str(e))
             return False
+        self._current_cluster_job_id = cluster_job_id
         state.update(job_id, cluster_job_id=cluster_job_id)
         state.set_task_status(job_id, task_id, ManagedJobStatus.RUNNING,
                               cluster_job_id=cluster_job_id)
@@ -185,6 +210,7 @@ class JobsController:
                 except exceptions.ResourcesUnavailableError as e:
                     self._fail_no_resource(str(e))
                     return False
+                self._current_cluster_job_id = cluster_job_id
                 state.update(job_id, cluster_job_id=cluster_job_id)
                 state.set_task_status(job_id, task_id,
                                       ManagedJobStatus.RUNNING,
@@ -195,10 +221,12 @@ class JobsController:
                 if task_id == len(self.tasks) - 1:
                     self._finish(ManagedJobStatus.SUCCEEDED)
                 else:
-                    # Mid-pipeline: retire this task's cluster and hand
-                    # the (still-held) schedule slot to the next task.
+                    # Mid-pipeline: archive the task's log, retire its
+                    # cluster, and hand the (still-held) schedule slot
+                    # to the next task.
                     state.set_task_status(job_id, task_id,
                                           ManagedJobStatus.SUCCEEDED)
+                    self._archive_task_log(cluster_job_id)
                     self._down_cluster()
                 return True
             elif status == cluster_job_lib.JobStatus.FAILED_SETUP:
@@ -219,6 +247,7 @@ class JobsController:
                     except exceptions.ResourcesUnavailableError as e:
                         self._fail_no_resource(str(e))
                         return False
+                    self._current_cluster_job_id = cluster_job_id
                     state.update(job_id, cluster_job_id=cluster_job_id)
                     state.set_task_status(job_id, task_id,
                                           ManagedJobStatus.RUNNING,

@@ -224,17 +224,46 @@ def cancel_on_controller(job_ids: Optional[List[int]] = None,
 
 def tail_logs_on_controller(job_id: int, follow: bool = True,
                             out=None) -> int:
-    """Stream the managed job's task logs (through its current cluster)."""
+    """Stream the managed job's task logs.
+
+    Pipelines: finished tasks' clusters are gone, but the controller
+    archived their logs (scheduler.task_log_path) — replay those in task
+    order, then live-tail the CURRENT task's cluster. A task is emitted
+    exactly once (live-tailing a task to completion supersedes its
+    archive)."""
+    from skypilot_tpu.jobs import scheduler
     out = out or sys.stdout
     row = state.get(job_id)
     if row is None:
         raise exceptions.JobNotFoundError(f'No managed job {job_id}')
+    emitted: set = set()          # task_ids whose ARCHIVE is superseded
+    followed: dict = {}           # task_id -> cluster_job_id last tailed
+
+    def replay_archived(up_to: int) -> None:
+        import shutil
+        for task_id in range(up_to):
+            if task_id in emitted:
+                continue
+            emitted.add(task_id)
+            try:
+                with open(scheduler.task_log_path(job_id, task_id)) as f:
+                    shutil.copyfileobj(f, out)
+                out.flush()
+            except OSError:
+                pass  # never archived (e.g. preempted mid-write)
+
     while True:
         row = state.get(job_id)
         assert row is not None
+        current = row.get('current_task_id') or 0
+        replay_archived(current)
         cluster = row['cluster_name']
         cluster_job_id = row['cluster_job_id']
-        if cluster and cluster_job_id:
+        # Tail whenever this task has a cluster job we haven't followed
+        # yet — a RESTARTED task gets a NEW cluster_job_id, so its retry
+        # attempt streams too (parity with the pre-pipeline loop).
+        if cluster and cluster_job_id \
+                and followed.get(current) != cluster_job_id:
             try:
                 from skypilot_tpu import backends
                 handle_record = \
@@ -243,11 +272,17 @@ def tail_logs_on_controller(job_id: int, follow: bool = True,
                     backends.SliceBackend().tail_logs(
                         handle_record['handle'], cluster_job_id,
                         follow=follow, stream_to=out)
+                    if follow:
+                        # Followed to that job's terminal state: the
+                        # archive would only duplicate what streamed.
+                        followed[current] = cluster_job_id
+                        emitted.add(current)
             except exceptions.SkyTpuError:
                 pass
         row = state.get(job_id)
         assert row is not None
         if row['status'].is_terminal():
+            replay_archived(row.get('num_tasks') or 1)
             out.write(f'\n[managed job {job_id}] {row["status"].value}'
                       + (f': {row["failure_reason"]}'
                          if row['failure_reason'] else '') + '\n')

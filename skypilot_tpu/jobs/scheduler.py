@@ -73,6 +73,14 @@ def controller_log_path(job_id: int) -> str:
     return os.path.join(_controller_log_dir(), f'{job_id}.log')
 
 
+def task_log_path(job_id: int, task_id: int) -> str:
+    """Archived task output for pipeline jobs: each task's cluster is
+    torn down when the task finishes, so the controller persists its job
+    log here first — `jobs logs` can then replay completed tasks."""
+    return os.path.join(_controller_log_dir(),
+                        f'{job_id}_task{task_id}.log')
+
+
 def _scheduler_lock(blocking: bool) -> filelock.FileLock:
     path = os.path.join(_controller_log_dir(), 'scheduler.lock')
     return filelock.FileLock(path, timeout=-1 if blocking else 0)

@@ -49,6 +49,38 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.fast)
 
 
+def _kill_processes_under(root: str) -> int:
+    """SIGKILL any skytpu runtime/serve process whose cwd lies under
+    ``root``. e2e tests that fail (or deliberately skip teardown) orphan
+    agents/controllers/replicas; their per-test state dir disappears but
+    the processes would tick forever, accumulating load across a long
+    suite run."""
+    import signal
+
+    killed = 0
+    if not os.path.isdir('/proc'):
+        return 0  # no procfs (macOS): skip, tests there leak at most a few
+    for pid_str in os.listdir('/proc'):
+        if not pid_str.isdigit():
+            continue
+        pid = int(pid_str)
+        try:
+            with open(f'/proc/{pid}/cmdline', 'rb') as f:
+                cmd = f.read().decode(errors='replace')
+            if 'skypilot_tpu' not in cmd:
+                continue
+            cwd = os.readlink(f'/proc/{pid}/cwd')
+        except OSError:
+            continue
+        if cwd.startswith(root + os.sep) or cwd == root:
+            try:
+                os.kill(pid, signal.SIGKILL)
+                killed += 1
+            except OSError:
+                pass
+    return killed
+
+
 @pytest.fixture(autouse=True)
 def _isolated_state(tmp_path, monkeypatch):
     """Point all persistent state at a per-test temp dir."""
@@ -62,3 +94,4 @@ def _isolated_state(tmp_path, monkeypatch):
     config_lib.reload()
     yield
     config_lib.reload()
+    _kill_processes_under(str(tmp_path))

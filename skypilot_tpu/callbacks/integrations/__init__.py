@@ -8,7 +8,10 @@ adapter only needs its framework at construction time, so this package
 imports cleanly everywhere.
 """
 from skypilot_tpu.callbacks.integrations.keras import SkyTpuKerasCallback
+from skypilot_tpu.callbacks.integrations.pytorch_lightning import (
+    SkyTpuLightningCallback)
 from skypilot_tpu.callbacks.integrations.transformers import (
     SkyTpuTransformersCallback)
 
-__all__ = ['SkyTpuKerasCallback', 'SkyTpuTransformersCallback']
+__all__ = ['SkyTpuKerasCallback', 'SkyTpuLightningCallback',
+           'SkyTpuTransformersCallback']

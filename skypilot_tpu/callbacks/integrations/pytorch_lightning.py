@@ -1,0 +1,72 @@
+"""skytpu_callback adapter for PyTorch Lightning.
+
+Counterpart of reference
+``sky/callbacks/sky_callback/integrations/pytorch_lightning.py``: a
+Lightning ``Callback`` that arms the benchmark summary on fit start and
+times train batches, so ``skytpu bench`` can time a ``Trainer.fit``.
+
+    from skypilot_tpu.callbacks.integrations import (
+        SkyTpuLightningCallback)
+    trainer = pl.Trainer(..., callbacks=[SkyTpuLightningCallback()])
+
+Duck-typed against the ``lightning.Callback`` protocol
+(on_fit_start / on_train_batch_start / on_train_batch_end receiving
+trainer/module args): Lightning drives any object exposing its hook
+names, so this imports without the lightning package and unit tests use
+a fake fit loop. Unknown hooks no-op via __getattr__ (Lightning invokes
+its full event surface).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from skypilot_tpu import callbacks as skytpu_callback
+
+
+class SkyTpuLightningCallback:
+    """Lightning callback armed by $SKYTPU_BENCHMARK_LOG_DIR."""
+
+    def __init__(self, log_dir: Optional[str] = None,
+                 total_steps: Optional[int] = None) -> None:
+        self._log_dir = log_dir
+        self._total_steps = total_steps
+        self._armed = False
+
+    def _infer_total_steps(self, trainer) -> Optional[int]:
+        if self._total_steps is not None:
+            return self._total_steps
+        max_steps = getattr(trainer, 'max_steps', None)
+        if max_steps and max_steps > 0:
+            return int(max_steps)
+        return None
+
+    # -- Callback protocol ---------------------------------------------------
+    def on_fit_start(self, trainer=None, pl_module=None) -> None:
+        # Only rank zero writes the summary (Lightning runs callbacks on
+        # every process; is_global_zero is True in single-process runs).
+        if trainer is not None and not getattr(trainer, 'is_global_zero',
+                                               True):
+            return
+        self._armed = skytpu_callback.init(
+            total_steps=self._infer_total_steps(trainer),
+            log_dir=self._log_dir)
+        if self._armed:
+            skytpu_callback.mark('init_done')
+
+    def on_train_batch_start(self, trainer=None, pl_module=None,
+                             batch=None, batch_idx=None) -> None:
+        if self._armed:
+            skytpu_callback.step_begin()
+
+    def on_train_batch_end(self, trainer=None, pl_module=None,
+                           outputs=None, batch=None,
+                           batch_idx=None) -> None:
+        if self._armed:
+            skytpu_callback.step_end()
+
+    def __getattr__(self, name: str):
+        # Lightning invokes its full Callback event surface (on_*,
+        # setup/teardown, state_dict, ...); everything untimed no-ops.
+        if name.startswith('on_') or name in ('setup', 'teardown'):
+            return lambda *args, **kwargs: None
+        raise AttributeError(name)

@@ -71,7 +71,7 @@ _STORAGE_SCHEMA: Dict[str, Any] = {
         'source': {'anyOf': [{'type': 'string'},
                              {'type': 'array', 'items': {'type': 'string'}},
                              {'type': 'null'}]},
-        'store': {'enum': ['gcs', 's3', 'r2', 'az', 'azure', None]},
+        'store': {'enum': ['gcs', 's3', 'r2', 'az', 'azure', 'cos', 'ibm', 'oci', None]},
         'mode': {'enum': ['MOUNT', 'COPY', 'MOUNT_CACHED',
                           'mount', 'copy', 'mount_cached', None]},
         'persistent': {'type': 'boolean'},

@@ -170,3 +170,46 @@ class TestFrameworkIntegrations:
         summary = self._summary(tmp_path)
         assert summary['num_steps'] == 2
         assert summary['total_steps'] == 2
+
+
+class TestLightningIntegration:
+
+    def test_lightning_callback_fake_fit_loop(self, tmp_path, monkeypatch):
+        import json
+        import os
+        from skypilot_tpu.callbacks import SUMMARY_FILE
+        from skypilot_tpu.callbacks.integrations import (
+            SkyTpuLightningCallback)
+        monkeypatch.setenv('SKYTPU_BENCHMARK_LOG_DIR', str(tmp_path))
+
+        class FakeTrainer:
+            max_steps = 4
+            is_global_zero = True
+
+        cb = SkyTpuLightningCallback()
+        cb.setup(FakeTrainer(), None, stage='fit')  # unknown hook no-ops
+        cb.on_fit_start(trainer=FakeTrainer())
+        for i in range(4):
+            cb.on_train_batch_start(batch_idx=i)
+            cb.on_train_batch_end(batch_idx=i)
+        cb.on_fit_end()  # another no-op event
+        with open(os.path.join(str(tmp_path), SUMMARY_FILE)) as f:
+            summary = json.load(f)
+        assert summary['num_steps'] == 4
+        assert summary['total_steps'] == 4
+
+    def test_non_global_zero_is_silent(self, tmp_path, monkeypatch):
+        import os
+        from skypilot_tpu.callbacks import SUMMARY_FILE
+        from skypilot_tpu.callbacks.integrations import (
+            SkyTpuLightningCallback)
+        monkeypatch.setenv('SKYTPU_BENCHMARK_LOG_DIR', str(tmp_path))
+
+        class Rank1Trainer:
+            is_global_zero = False
+
+        cb = SkyTpuLightningCallback()
+        cb.on_fit_start(trainer=Rank1Trainer())
+        cb.on_train_batch_end()
+        assert not os.path.exists(os.path.join(str(tmp_path),
+                                               SUMMARY_FILE))

@@ -376,3 +376,50 @@ class TestMountCachedE2E:
         assert storage.mode is storage_lib.StorageMode.MOUNT_CACHED
         out = task.to_yaml_config()
         assert (out['storage_mounts']['/out']['mode'] == 'MOUNT_CACHED')
+
+
+class TestIbmOciStores:
+    """S3-compatible endpoint stores (reference storage.py IBMCosStore
+    :3752, OciStore :4216)."""
+
+    def test_ibm_cos_endpoint(self, monkeypatch):
+        from skypilot_tpu.data import storage as storage_lib
+        monkeypatch.setenv('IBM_COS_REGION', 'eu-de')
+        store = storage_lib.parse_store_url('cos://bkt/sub')
+        assert isinstance(store, storage_lib.IbmCosStore)
+        cmd = store.mount_command('/data')
+        assert ('https://s3.eu-de.cloud-object-storage.appdomain.cloud'
+                in cmd)
+        assert 's3://bkt/sub' in store.download_command('/d')
+
+    def test_oci_endpoint(self, monkeypatch):
+        from skypilot_tpu.data import storage as storage_lib
+        monkeypatch.setenv('OCI_NAMESPACE', 'mytenancy')
+        monkeypatch.setenv('OCI_REGION', 'eu-frankfurt-1')
+        store = storage_lib.parse_store_url('oci://bkt')
+        assert isinstance(store, storage_lib.OciStore)
+        cmd = store.download_command('/d')
+        assert ('https://mytenancy.compat.objectstorage.eu-frankfurt-1'
+                '.oraclecloud.com' in cmd)
+
+    def test_missing_config_is_actionable(self, monkeypatch):
+        import pytest as _pytest
+        from skypilot_tpu import exceptions
+        from skypilot_tpu.data import storage as storage_lib
+        monkeypatch.delenv('IBM_COS_REGION', raising=False)
+        monkeypatch.delenv('OCI_NAMESPACE', raising=False)
+        monkeypatch.delenv('OCI_REGION', raising=False)
+        with _pytest.raises(exceptions.StorageError, match='IBM_COS'):
+            storage_lib.parse_store_url('cos://b').download_command('/d')
+        with _pytest.raises(exceptions.StorageError, match='OCI_'):
+            storage_lib.parse_store_url('oci://b').download_command('/d')
+
+    def test_named_store_aliases(self, monkeypatch):
+        from skypilot_tpu.data import storage as storage_lib
+        monkeypatch.setenv('IBM_COS_REGION', 'us-south')
+        monkeypatch.setenv('OCI_NAMESPACE', 'ns')
+        monkeypatch.setenv('OCI_REGION', 'r1')
+        assert isinstance(storage_lib.Storage(name='c', store='ibm').store,
+                          storage_lib.IbmCosStore)
+        assert isinstance(storage_lib.Storage(name='c', store='oci').store,
+                          storage_lib.OciStore)

@@ -1,0 +1,46 @@
+#!/bin/bash
+# Chip-gated round-5 measurements (VERDICT r4 #2/#3/#7), runnable the
+# moment a TPU is reachable. The dev tunnel was down for the entire
+# round-5 session, so these numbers could not be refreshed; the CPU-side
+# fixes they validate are in-tree and unit-pinned:
+#   #2 decode: scalar-sampling cache (models/decode.py) — expect the
+#      standalone fresh-process decode back at >= 2300 tok/s/chip
+#      @ 16 slots (r3 level) vs r4's 523.
+#   #7 warm init: A/B restore-vs-reinit; enable $SKYTPU_WARM_INIT_CACHE
+#      for launched jobs if restore wins on this link.
+#   #3 serve: full bench serve phase — TTFT p50 target < 3 s at c24,
+#      0 errors, equivalence estimate in the record.
+set -x
+cd "$(dirname "$0")/.."
+
+# 1. Chip probe (a wedged tunnel HANGS; keep it killable).
+timeout 120 python -c "
+import jax, jax.numpy as jnp
+x = jax.jit(lambda a: a + 1)(jnp.ones((4,)))
+print('PROBE OK', jax.default_backend(), float(x.sum()))" \
+    || { echo 'PROBE FAILED — chip unreachable'; exit 1; }
+
+# 2. Standalone decode, fresh process.
+O=$(mktemp)
+timeout 600 python bench.py --phase decode --out "$O" && cat "$O" && echo
+
+# 3. Warm-init A/B (run twice: first saves, second restores).
+AB=$(mktemp -d)
+for attempt in save restore; do
+  timeout 900 python - "$AB" << 'PYEOF'
+import dataclasses, sys, time
+import jax
+from skypilot_tpu.models.llama import PRESETS, LlamaModel
+from skypilot_tpu.train import Trainer
+config = dataclasses.replace(PRESETS['llama-1b'],
+                             remat_policy='names_qkv')
+trainer = Trainer(LlamaModel(config))
+t0 = time.time()
+state, src = trainer.init_with_warm_cache(sys.argv[1], jax.random.key(0))
+int(jax.device_get(state.step))
+print(f'init_with_warm_cache: {src} in {time.time() - t0:.1f}s')
+PYEOF
+done
+
+# 4. Full bench (train first, per-phase budgets, wedge-proof).
+timeout 2400 python bench.py 2>/tmp/tpu_bench.err | tail -1

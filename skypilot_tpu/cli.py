@@ -500,13 +500,17 @@ def jobs_cancel(job_ids, all_jobs):
 @click.option('--no-follow', is_flag=True)
 @click.option('--controller', is_flag=True,
               help='Show the controller process log instead.')
-def jobs_logs(job_id, no_follow, controller):
+@click.option('--task', 'task_id', type=int, default=None,
+              help='Replay one pipeline task\'s log (archived after the '
+                   'task finishes).')
+def jobs_logs(job_id, no_follow, controller, task_id):
     """Stream a managed job's logs."""
     from skypilot_tpu.jobs import core as jobs_core
     if controller:
         click.echo(jobs_core.controller_logs(job_id))
         return
-    sys.exit(jobs_core.tail_logs(job_id, follow=not no_follow))
+    sys.exit(jobs_core.tail_logs(job_id, follow=not no_follow,
+                                 task_id=task_id))
 
 
 @cli.group()

@@ -105,9 +105,12 @@ def cancel(job_ids: Optional[List[int]] = None,
     return _parse_json_line(res, 'cancel')['cancelled']
 
 
-def tail_logs(job_id: int, follow: bool = True, out=None) -> int:
+def tail_logs(job_id: int, follow: bool = True, out=None,
+              task_id: Optional[int] = None) -> int:
     out = out or sys.stdout
     args = f'tail --job-id {job_id}' + (' --follow' if follow else '')
+    if task_id is not None:
+        args += f' --task-id {task_id}'
     res = _run_jobcli(args, stream_to=out, launch_if_missing=False)
     if res is None:
         raise exceptions.JobNotFoundError(
@@ -223,7 +226,8 @@ def cancel_on_controller(job_ids: Optional[List[int]] = None,
 
 
 def tail_logs_on_controller(job_id: int, follow: bool = True,
-                            out=None) -> int:
+                            out=None,
+                            task_id: Optional[int] = None) -> int:
     """Stream the managed job's task logs.
 
     Pipelines: finished tasks' clusters are gone, but the controller
@@ -236,6 +240,31 @@ def tail_logs_on_controller(job_id: int, follow: bool = True,
     row = state.get(job_id)
     if row is None:
         raise exceptions.JobNotFoundError(f'No managed job {job_id}')
+    if task_id is not None:
+        # One specific pipeline task: replay its archive (finished
+        # tasks' clusters are gone), or live-tail it if it IS the
+        # current task and not yet archived.
+        try:
+            with open(scheduler.task_log_path(job_id, task_id)) as f:
+                import shutil
+                shutil.copyfileobj(f, out)
+            out.flush()
+            return 0
+        except OSError:
+            pass
+        if (row.get('current_task_id') or 0) == task_id \
+                and row['cluster_name'] and row['cluster_job_id']:
+            from skypilot_tpu import backends
+            handle_record = global_user_state.get_cluster_from_name(
+                row['cluster_name'])
+            if handle_record and handle_record['handle']:
+                backends.SliceBackend().tail_logs(
+                    handle_record['handle'], row['cluster_job_id'],
+                    follow=follow, stream_to=out)
+                return 0
+        out.write(f'[managed job {job_id}] no log for task {task_id} '
+                  '(not started, or lost to preemption)\n')
+        return 1
     emitted: set = set()          # task_ids whose ARCHIVE is superseded
     followed: dict = {}           # task_id -> cluster_job_id last tailed
 

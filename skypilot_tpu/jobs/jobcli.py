@@ -50,7 +50,8 @@ def _cmd_tail(args) -> int:
     from skypilot_tpu.jobs import core
     return core.tail_logs_on_controller(args.job_id,
                                         follow=args.follow,
-                                        out=sys.stdout)
+                                        out=sys.stdout,
+                                        task_id=args.task_id)
 
 
 def _cmd_controller_log(args) -> int:
@@ -84,6 +85,7 @@ def main() -> None:
     p = sub.add_parser('tail')
     p.add_argument('--job-id', type=int, required=True)
     p.add_argument('--follow', action='store_true')
+    p.add_argument('--task-id', type=int, default=None)
     p.set_defaults(fn=_cmd_tail)
 
     p = sub.add_parser('controller-log')

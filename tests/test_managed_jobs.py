@@ -319,3 +319,33 @@ def test_pipeline_tail_logs_follows_across_tasks(tmp_path):
     assert 'from-task-two' in text, text[-2000:]
     assert 'SUCCEEDED' in text
     assert rc_holder['rc'] == 0
+
+
+def test_pipeline_logs_single_task_replay(tmp_path):
+    """`jobs logs --task N` replays one finished task's archived log."""
+    import io
+
+    from skypilot_tpu import dag as dag_lib
+    t1 = sky.Task(name='alpha', run='echo alpha-output')
+    t1.set_resources([sky.Resources(cloud='local')])
+    t2 = sky.Task(name='beta', run='echo beta-output')
+    t2.set_resources([sky.Resources(cloud='local')])
+    dag = dag_lib.Dag(name='replay-pipe')
+    dag.add_edge(t1, t2)
+    job_id = jobs_core.launch(dag)
+    _wait_status(job_id, {ManagedJobStatus.SUCCEEDED}, timeout=120)
+
+    buf = io.StringIO()
+    assert jobs_core.tail_logs(job_id, follow=False, out=buf,
+                               task_id=0) == 0
+    assert 'alpha-output' in buf.getvalue()
+    assert 'beta-output' not in buf.getvalue()
+    buf = io.StringIO()
+    assert jobs_core.tail_logs(job_id, follow=False, out=buf,
+                               task_id=1) == 0
+    assert 'beta-output' in buf.getvalue()
+    # Out-of-range task: explicit message, nonzero rc.
+    buf = io.StringIO()
+    assert jobs_core.tail_logs(job_id, follow=False, out=buf,
+                               task_id=7) == 1
+    assert 'no log for task 7' in buf.getvalue()

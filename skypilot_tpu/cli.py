@@ -463,7 +463,9 @@ def jobs_launch(entrypoint, name, cloud, accelerators, cmd, env,
 
 
 @jobs.command('queue')
-def jobs_queue():
+@click.option('--verbose', '-v', is_flag=True,
+              help='Show per-task rows for pipelines.')
+def jobs_queue(verbose):
     """List managed jobs."""
     from skypilot_tpu import jobs as jobs_lib
     rows = jobs_lib.queue()
@@ -481,6 +483,12 @@ def jobs_queue():
                               r['status'].value, task_col,
                               r['recovery_count'],
                               (r['cluster_name'] or '-')[:20]))
+        if verbose:
+            for t in r.get('tasks', []):
+                click.echo(fmt.format(
+                    f"  {r['job_id']}.{t['task_id']}",
+                    ('  ' + (t['name'] or '-'))[:16],
+                    t['status'].value, '-', t['recovery_count'], '-'))
 
 
 @jobs.command('cancel')

@@ -349,3 +349,24 @@ def test_pipeline_logs_single_task_replay(tmp_path):
     assert jobs_core.tail_logs(job_id, follow=False, out=buf,
                                task_id=7) == 1
     assert 'no log for task 7' in buf.getvalue()
+
+
+def test_jobs_queue_verbose_shows_task_rows(tmp_path):
+    from click.testing import CliRunner
+
+    from skypilot_tpu import cli as cli_mod
+    from skypilot_tpu import dag as dag_lib
+    t1 = sky.Task(name='qa', run='echo a')
+    t1.set_resources([sky.Resources(cloud='local')])
+    t2 = sky.Task(name='qb', run='echo b')
+    t2.set_resources([sky.Resources(cloud='local')])
+    dag = dag_lib.Dag(name='queue-pipe')
+    dag.add_edge(t1, t2)
+    job_id = jobs_core.launch(dag)
+    _wait_status(job_id, {ManagedJobStatus.SUCCEEDED}, timeout=120)
+    result = CliRunner().invoke(cli_mod.cli, ['jobs', 'queue', '-v'])
+    assert result.exit_code == 0, result.output
+    assert '2/2' in result.output          # pipeline progress column
+    assert f'{job_id}.0' in result.output  # per-task rows
+    assert f'{job_id}.1' in result.output
+    assert 'qa' in result.output and 'qb' in result.output

@@ -195,6 +195,8 @@ def validate_region_zone(
     regions.update(aws_regions)
     azure_regions = set(_vms('azure')['region'].unique())
     regions.update(azure_regions)
+    lambda_regions = set(_vms('lambda')['region'].unique())
+    regions.update(lambda_regions)
     zones = set(tpus['zone'])
     # AWS AZs: region + single-letter suffix; regions carry up to six
     # (us-east-1a..f), so accept any letter on a known region.

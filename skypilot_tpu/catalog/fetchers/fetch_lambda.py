@@ -1,0 +1,129 @@
+"""Generate the Lambda Cloud catalog CSV (lambda_vms.csv).
+
+Counterpart of the reference's Lambda data fetcher
+(sky/clouds/service_catalog/data_fetchers/fetch_lambda_cloud.py — walks
+the authenticated ``/instance-types`` endpoint). Two sources, merged:
+
+1. **Lambda instance-types API** (``GET /api/v1/instance-types`` —
+   needs an API key): ``refresh(online=True)`` pulls live
+   ``price_cents_per_hour`` + specs + per-type region availability and
+   overrides the static table. A ``types_fetcher`` seam lets tests fake
+   the API without network.
+2. **Static table** below (public on-demand pricing; Lambda has NO spot
+   market, so ``spot_price`` mirrors ``price`` and the cloud class
+   rejects ``use_spot`` before the column is ever read): the offline
+   fallback — this build environment has zero egress.
+
+Run:  python -m skypilot_tpu.catalog.fetchers.fetch_lambda [--online]
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+DATA_DIR = os.path.join(_HERE, '..', 'data')
+
+# (vcpus, memory_gb, on-demand $/h, regions). Public Lambda pricing;
+# regions are the typical availability set per type.
+_US = ('us-east-1', 'us-west-1', 'us-midwest-1')
+_GLOBAL = _US + ('europe-central-1', 'asia-northeast-1')
+_INSTANCE_TYPES: Dict[str, Tuple[int, float, float, Tuple[str, ...]]] = {
+    'gpu_1x_a10': (30, 200, 0.75, _GLOBAL),
+    'gpu_1x_a100_sxm4': (30, 200, 1.29, _GLOBAL),
+    'gpu_8x_a100_80gb_sxm4': (240, 1800, 14.32, _US),
+    'gpu_1x_h100_pcie': (26, 200, 2.49, _GLOBAL),
+    'gpu_8x_h100_sxm5': (208, 1800, 23.92, _US),
+    'gpu_1x_gh200': (64, 432, 1.49, ('us-east-1', 'us-west-1')),
+}
+
+
+def fetch_instance_types(
+        types_fetcher: Optional[Callable[[], Dict[str, Any]]] = None
+) -> Dict[str, Any]:
+    """Live /instance-types payload: name -> {instance_type:
+    {price_cents_per_hour, specs{vcpus, memory_gib}},
+    regions_with_capacity_available: [{name}]}. ``types_fetcher`` is the
+    test seam; the default uses the authenticated REST client."""
+    if types_fetcher is not None:
+        return types_fetcher()
+    from skypilot_tpu.provision import lambda_api
+    return lambda_api.get_client().instance_types()
+
+
+def generate_vm_rows(live: Optional[Dict[str, Any]] = None
+                     ) -> List[Dict[str, object]]:
+    rows: List[Dict[str, object]] = []
+    if live:
+        for name, entry in sorted(live.items()):
+            spec = (entry.get('instance_type') or {})
+            specs = spec.get('specs') or {}
+            price = float(spec.get('price_cents_per_hour') or 0) / 100.0
+            regions = [r.get('name') for r in
+                       entry.get('regions_with_capacity_available') or []]
+            # A type with no capacity anywhere still gets its static
+            # regions: the catalog answers "where does Lambda OFFER
+            # this", capacity errors are the provisioner's failover job.
+            if not regions and name in _INSTANCE_TYPES:
+                regions = list(_INSTANCE_TYPES[name][3])
+            for region in regions:
+                rows.append({
+                    'instance_type': name,
+                    'vcpus': int(specs.get('vcpus') or 0),
+                    'memory_gb': float(specs.get('memory_gib') or 0),
+                    'region': region,
+                    'price': round(price, 4),
+                    'spot_price': round(price, 4),
+                })
+        if rows:
+            return rows
+    for name, (vcpus, mem, price, regions) in _INSTANCE_TYPES.items():
+        for region in regions:
+            rows.append({
+                'instance_type': name,
+                'vcpus': vcpus,
+                'memory_gb': mem,
+                'region': region,
+                'price': price,
+                'spot_price': price,
+            })
+    return rows
+
+
+def refresh(online: bool = False,
+            types_fetcher: Optional[Callable[[], Dict[str, Any]]] = None
+            ) -> str:
+    """Regenerate lambda_vms.csv; returns 'online'/'offline'/'stale'."""
+    live: Dict[str, Any] = {}
+    source = 'offline'
+    if online:
+        try:
+            live = fetch_instance_types(types_fetcher)
+            if live:
+                source = 'online'
+        except Exception as e:  # noqa: BLE001 — any failure = fallback
+            print(f'instance-types API unavailable ({type(e).__name__}: '
+                  f'{e}); using static price table')
+    from skypilot_tpu.catalog.fetchers.fetch_gcp import write_csv
+    rows = generate_vm_rows(live)
+    try:
+        write_csv(os.path.join(DATA_DIR, 'lambda_vms.csv'), rows)
+    except OSError as e:
+        print(f'catalog dir not writable ({e}); keeping existing CSV')
+        return 'stale'
+    print(f'Wrote {len(rows)} Lambda VM rows to '
+          f'{os.path.normpath(DATA_DIR)} ({source})')
+    return source
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    import argparse
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--online', action='store_true',
+                        help='fetch live prices from /instance-types')
+    args = parser.parse_args(argv)
+    refresh(online=args.online)
+
+
+if __name__ == '__main__':
+    main()

@@ -11,6 +11,7 @@ from skypilot_tpu.clouds import aws as _aws  # registers
 from skypilot_tpu.clouds import azure as _azure  # registers
 from skypilot_tpu.clouds import gcp as _gcp  # registers
 from skypilot_tpu.clouds import kubernetes as _kubernetes  # registers
+from skypilot_tpu.clouds import lambda_cloud as _lambda  # registers
 from skypilot_tpu.clouds import local as _local  # registers
 
 __all__ = ['Cloud', 'CloudFeature', 'CLOUD_REGISTRY', 'FeasibleResources',

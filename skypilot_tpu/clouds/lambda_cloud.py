@@ -1,0 +1,132 @@
+"""Lambda Cloud: GPU VMs (terminate-only lifecycle, no zones, no spot).
+
+Counterpart of reference ``sky/clouds/lambda_cloud.py`` (feasibility,
+pricing, deploy vars, credential checks; unsupported-feature table at
+:39-47). In this TPU-native stack Lambda is the fourth VM cloud and the
+first with a REDUCED capability surface — no STOP/AUTOSTOP-to-stop, no
+SPOT, no custom images — which exercises the feature-gating path
+(``check_features_are_supported``) that the full-featured clouds never
+hit.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu.clouds import cloud as cloud_lib
+
+
+@cloud_lib.CLOUD_REGISTRY.register(name='lambda')
+class Lambda(cloud_lib.Cloud):
+    NAME = 'lambda'
+    # Terminate-only: autostop is supported as autodown (the agent's
+    # autostop hook always terminates where STOP is absent).
+    _FEATURES = frozenset({
+        cloud_lib.CloudFeature.AUTOSTOP,
+        cloud_lib.CloudFeature.MULTI_HOST,
+        cloud_lib.CloudFeature.STORAGE_MOUNTS,
+        cloud_lib.CloudFeature.OPEN_PORTS,
+    })
+
+    # Lambda caps instance names at 64 chars; '-r{rank}' needs headroom
+    # (reference _MAX_CLUSTER_NAME_LEN_LIMIT = 57).
+    MAX_CLUSTER_NAME_LENGTH = 57
+
+    # ---- credentials ------------------------------------------------------
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        if os.environ.get('SKYTPU_FAKE_LAMBDA_CREDENTIALS'):
+            return True, None
+        from skypilot_tpu.provision import lambda_api
+        if lambda_api.read_api_key() is not None:
+            return True, None
+        return False, ('Lambda Cloud credentials not found. Set '
+                       '$LAMBDA_API_KEY or write `api_key = <key>` to '
+                       '~/.lambda_cloud/lambda_keys.')
+
+    @classmethod
+    def get_active_user_identity(cls) -> Optional[List[str]]:
+        if os.environ.get('SKYTPU_FAKE_LAMBDA_CREDENTIALS'):
+            return ['fake-identity@lambda.test']
+        from skypilot_tpu.provision import lambda_api
+        key = lambda_api.read_api_key()
+        # The API has no whoami endpoint; the key prefix is the stable
+        # per-account identity component.
+        return [f'lambda-key-{key[:8]}'] if key else None
+
+    # ---- topology ---------------------------------------------------------
+    def regions_for(self, resources) -> List[str]:
+        if resources.tpu is not None:
+            return []  # no TPUs on Lambda
+        if resources.use_spot:
+            return []  # no spot market
+        itype = resources.instance_type or 'gpu_1x_a10'
+        regions = catalog.get_vm_regions(itype, cloud=self.NAME)
+        if resources.region is not None:
+            regions = [r for r in regions if r == resources.region]
+        return regions
+
+    def zones_for(self, resources, region: str) -> List[Optional[str]]:
+        if resources.zone is not None:
+            return []  # Lambda has no zones; a pinned zone can't match
+        return [None]
+
+    # ---- pricing ----------------------------------------------------------
+    def hourly_cost(self, resources, region=None, zone=None) -> float:
+        region = region or resources.region
+        assert resources.instance_type is not None, resources
+        return catalog.get_instance_hourly_cost(
+            resources.instance_type, resources.use_spot, region=region,
+            cloud=self.NAME)
+
+    def egress_cost_per_gb(self, dst_cloud: str, dst_region: str,
+                           src_region: Optional[str]) -> float:
+        return 0.0  # Lambda does not bill egress
+
+    # ---- feasibility ------------------------------------------------------
+    def get_feasible_resources(self,
+                               resources) -> cloud_lib.FeasibleResources:
+        if resources.tpu is not None:
+            return cloud_lib.FeasibleResources(
+                [], hint='Lambda Cloud has no TPU accelerators; use '
+                         'cloud: gcp.')
+        if resources.use_spot:
+            return cloud_lib.FeasibleResources(
+                [], hint='Lambda Cloud has no spot market.')
+        if resources.instance_type is not None:
+            if not catalog.get_vm_regions(resources.instance_type,
+                                          cloud=self.NAME):
+                return cloud_lib.FeasibleResources(
+                    [], hint=(f'{resources.instance_type} is not a Lambda '
+                              'instance type in the catalog.'))
+            return cloud_lib.FeasibleResources(
+                [resources.copy(cloud=self.NAME)])
+        itype = catalog.get_default_instance_type(
+            cpus=resources._cpus, cpus_plus=resources._cpus_plus,  # pylint: disable=protected-access
+            memory=resources._memory, memory_plus=resources._memory_plus,  # pylint: disable=protected-access
+            region=resources.region, cloud=self.NAME)
+        if itype is None:
+            return cloud_lib.FeasibleResources(
+                [], hint=(f'No Lambda instance with cpus={resources.cpus}, '
+                          f'memory={resources.memory}'))
+        return cloud_lib.FeasibleResources(
+            [resources.copy(cloud=self.NAME, instance_type=itype)])
+
+    # ---- deployment -------------------------------------------------------
+    def make_deploy_variables(self, resources, cluster_name_on_cloud: str,
+                              region: str,
+                              zone: Optional[str]) -> Dict[str, Any]:
+        return {
+            'cloud': self.NAME,
+            'mode': 'lambda_vm',
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region,
+            'zone': None,
+            'use_spot': False,
+            'disk_size_gb': resources.disk_size,
+            'labels': dict(resources.labels or {}),
+            'ports': list(resources.ports or ()),
+            'instance_type': resources.instance_type,
+            'image_id': None,  # Lambda launches its stock Ubuntu image
+        }

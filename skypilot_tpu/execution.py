@@ -98,9 +98,11 @@ def _execute(task: task_lib.Task,
 
         if handle is None:
             if Stage.OPTIMIZE in stages:
+                # A dryrun exists to SHOW the placement plan: never
+                # silence the candidate table here.
                 optimizer_lib.optimize(task, minimize=optimize_target,
                                        blocked_resources=blocked_resources,
-                                       quiet=dryrun)
+                                       quiet=False)
             if dryrun:
                 return None, None
             if Stage.PROVISION in stages:

@@ -25,6 +25,7 @@ _CLOUD_MODULES = {
     'aws': 'skypilot_tpu.provision.aws',
     'azure': 'skypilot_tpu.provision.azure',
     'kubernetes': 'skypilot_tpu.provision.kubernetes',
+    'lambda': 'skypilot_tpu.provision.lambda_impl',
 }
 
 

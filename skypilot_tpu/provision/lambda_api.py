@@ -1,0 +1,181 @@
+"""Thin Lambda Cloud REST client with a test seam.
+
+Counterpart of the reference's ``sky/provision/lambda_cloud/lambda_utils.py``
+(LambdaCloudClient: launch/terminate/list over
+``https://cloud.lambdalabs.com/api/v1``, bearer-token auth from
+``~/.lambda_cloud/lambda_keys``). The real transport is a tiny
+urllib-based client (Lambda's API is plain JSON REST — no SDK needed);
+tests install an in-process fake via ``set_lambda_factory`` implementing
+the same flat surface (``launch``, ``list_instances``, ``terminate``,
+``list_ssh_keys``, ``register_ssh_key``, ``list_firewall_rules``,
+``put_firewall_rules``), so lifecycle + failover logic runs for real
+with no cloud.
+
+Error classification mirrors the reference's error-code strings
+(lambda_utils.py raise_lambda_error): the API returns
+``error.code`` values like ``instance-operations/launch/
+insufficient-capacity`` -> capacity failover;
+``global/quota-exceeded`` -> quota; 429 rate-limit -> retried by the
+transport, surfaced as a plain CloudError if persistent.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Callable, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+
+API_ENDPOINT = 'https://cloud.lambdalabs.com/api/v1'
+CREDENTIALS_PATH = '~/.lambda_cloud/lambda_keys'
+
+_CAPACITY_MARKERS = (
+    'insufficient-capacity',
+    'not-enough-capacity',
+)
+_QUOTA_MARKERS = (
+    'quota-exceeded',
+    'instance-quota',
+)
+
+
+class LambdaApiError(Exception):
+    """Fake/real client error carrying a Lambda API error code string."""
+
+    def __init__(self, code: str, message: str = ''):
+        super().__init__(message or code)
+        self.code = code
+        self.message = message or code
+
+
+def classify_error(exc: Exception) -> exceptions.CloudError:
+    code = str(getattr(exc, 'code', '') or '')
+    msg = str(exc)
+    blob = f'{code} {msg}'.lower()
+    if any(m in blob for m in _CAPACITY_MARKERS):
+        return exceptions.InsufficientCapacityError(msg, reason='capacity')
+    if any(m in blob for m in _QUOTA_MARKERS):
+        return exceptions.CloudError(msg, reason='quota')
+    return exceptions.CloudError(msg)
+
+
+# ---- real transport --------------------------------------------------------
+def read_api_key() -> Optional[str]:
+    """API key from $LAMBDA_API_KEY or ~/.lambda_cloud/lambda_keys
+    (``api_key = <key>`` lines, the reference's credential format)."""
+    env = os.environ.get('LAMBDA_API_KEY')
+    if env:
+        return env
+    path = os.path.expanduser(CREDENTIALS_PATH)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding='utf-8') as f:
+        for line in f:
+            if ' = ' in line:
+                key, _, value = line.strip().partition(' = ')
+                if key == 'api_key':
+                    return value
+    return None
+
+
+class _RestClient:
+    """Minimal urllib client implementing the flat op surface."""
+
+    _MAX_ATTEMPTS = 6
+
+    def __init__(self):
+        api_key = read_api_key()
+        if api_key is None:
+            raise exceptions.CloudError(
+                'Lambda Cloud credentials not found: set $LAMBDA_API_KEY or '
+                f'write api_key to {CREDENTIALS_PATH}.')
+        self._headers = {'Authorization': f'Bearer {api_key}',
+                         'Content-Type': 'application/json'}
+
+    def _request(self, method: str, path: str,
+                 payload: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        url = f'{API_ENDPOINT}{path}'
+        data = json.dumps(payload).encode() if payload is not None else None
+        backoff = 5.0
+        for attempt in range(self._MAX_ATTEMPTS):
+            req = urllib.request.Request(url, data=data, method=method,
+                                         headers=self._headers)
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    return json.loads(resp.read().decode() or '{}')
+            except urllib.error.HTTPError as e:
+                if e.code == 429 and attempt < self._MAX_ATTEMPTS - 1:
+                    time.sleep(backoff)  # rate limited: retry with backoff
+                    backoff = min(backoff * 2, 60)
+                    continue
+                try:
+                    body = json.loads(e.read().decode())
+                    err = body.get('error', {})
+                    raise LambdaApiError(err.get('code', str(e.code)),
+                                         err.get('message', str(e)))
+                except (ValueError, AttributeError):
+                    raise LambdaApiError(str(e.code), str(e)) from e
+        raise LambdaApiError('429', 'rate limited after retries')
+
+    # -- flat op surface (mirrored by test fakes) ---------------------------
+    def launch(self, region: str, instance_type: str, name: str,
+               ssh_key_names: List[str], quantity: int = 1) -> List[str]:
+        body = self._request('POST', '/instance-operations/launch', {
+            'region_name': region,
+            'instance_type_name': instance_type,
+            'ssh_key_names': ssh_key_names,
+            'name': name,
+            'quantity': quantity,
+        })
+        return list(body.get('data', {}).get('instance_ids', []))
+
+    def list_instances(self) -> List[Dict[str, Any]]:
+        return list(self._request('GET', '/instances').get('data', []))
+
+    def terminate(self, instance_ids: List[str]) -> None:
+        self._request('POST', '/instance-operations/terminate',
+                      {'instance_ids': instance_ids})
+
+    def list_ssh_keys(self) -> List[Dict[str, str]]:
+        return list(self._request('GET', '/ssh-keys').get('data', []))
+
+    def register_ssh_key(self, name: str, public_key: str) -> None:
+        self._request('POST', '/ssh-keys',
+                      {'name': name, 'public_key': public_key})
+
+    def list_firewall_rules(self) -> List[Dict[str, Any]]:
+        return list(self._request('GET', '/firewall-rules').get('data', []))
+
+    def put_firewall_rules(self, rules: List[Dict[str, Any]]) -> None:
+        # PUT replaces the account's full rule set (API semantics).
+        self._request('PUT', '/firewall-rules', {'data': rules})
+
+    def instance_types(self) -> Dict[str, Any]:
+        return dict(self._request('GET', '/instance-types').get('data', {}))
+
+
+_lambda_factory: Optional[Callable[[], Any]] = None
+
+
+def set_lambda_factory(factory: Optional[Callable[[], Any]]) -> None:
+    """Test seam: ``factory() -> fake Lambda client`` (account-global —
+    Lambda's API is not regional, unlike the Azure/AWS seams)."""
+    global _lambda_factory
+    _lambda_factory = factory
+
+
+def get_client() -> Any:
+    if _lambda_factory is not None:
+        return _lambda_factory()
+    return _RestClient()
+
+
+def call(client: Any, op: str, **kwargs) -> Any:
+    """Invoke a client op, normalizing errors to CloudError subclasses."""
+    try:
+        return getattr(client, op)(**kwargs)
+    except LambdaApiError as e:
+        raise classify_error(e) from e

@@ -1,0 +1,332 @@
+"""Lambda Cloud provisioner: GPU VM host groups (terminate-only lifecycle).
+
+Counterpart of reference ``sky/provision/lambda_cloud/instance.py`` —
+the fourth VM cloud exercising the functional provision API, and the
+first with a *reduced* lifecycle: Lambda cannot stop instances
+(terminate-only, reference instance.py:161-167 raises on stop), has no
+zones, no spot, and account-global firewall rules rather than per-cluster
+security groups (reference instance.py:330-351 skips cleanup for this
+reason).
+
+TPU-native deltas vs the reference module:
+- rank discovery is stateless via instance names ``{name}-r{rank}``
+  (Lambda has no tags; the reference keeps a local metadata file +
+  '-head'/'-worker' suffixes — a name-encoded rank needs no local state
+  and survives client-machine loss);
+- capacity errors (``insufficient-capacity`` codes) are classified into
+  ``InsufficientCapacityError`` so ``RetryingProvisioner`` region-failover
+  drives Lambda exactly like GCP/AWS/Azure.
+
+Cluster bookkeeping (region, name-on-cloud) lives in the client state
+kv, mirroring ``provision/azure.py``.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import authentication
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import provision as provision_lib
+from skypilot_tpu.provision import lambda_api
+from skypilot_tpu.utils import command_runner as runner_lib
+
+SSH_USER = 'ubuntu'  # canonical Lambda login
+
+# Lambda instance statuses -> the provision API's state words.
+_STATE_MAP = {
+    'booting': 'pending',
+    'active': 'running',
+    'unhealthy': 'pending',   # transient per API docs; wait_instances polls
+    'terminating': 'terminating',
+    'terminated': 'terminated',
+}
+
+# The firewall-rules API is not offered in this region (reference
+# instance.py:270-276): opening ports there is a warning, not an error.
+_NO_FIREWALL_REGIONS = ('us-south-1',)
+
+
+# ---- cluster record --------------------------------------------------------
+def _record_key(cluster_name: str) -> str:
+    return f'lambda_cluster/{cluster_name}'
+
+
+def _save_record(cluster_name: str, record: Dict[str, Any]) -> None:
+    global_user_state.set_kv(_record_key(cluster_name), json.dumps(record))
+
+
+def _load_record(cluster_name: str) -> Optional[Dict[str, Any]]:
+    raw = global_user_state.get_kv(_record_key(cluster_name))
+    return json.loads(raw) if raw else None
+
+
+def _delete_record(cluster_name: str) -> None:
+    global_user_state.set_kv(_record_key(cluster_name), '')
+
+
+def _require_record(cluster_name: str) -> Dict[str, Any]:
+    record = _load_record(cluster_name)
+    if not record:
+        raise exceptions.ClusterError(
+            f'No Lambda provisioning record for {cluster_name!r}')
+    return record
+
+
+def _rank_of(instance: Dict[str, Any], name: str) -> Optional[int]:
+    """Rank from an instance name ``{name}-r{rank}``; None if foreign."""
+    iname = instance.get('name') or ''
+    prefix = f'{name}-r'
+    if not iname.startswith(prefix):
+        return None
+    suffix = iname[len(prefix):]
+    return int(suffix) if suffix.isdigit() else None
+
+
+def _live_instances(client, name: str,
+                    region: Optional[str] = None
+                    ) -> Dict[int, Dict[str, Any]]:
+    """rank -> instance, excluding terminated/terminating. The API is
+    ACCOUNT-global (not region-scoped like the AWS/Azure clients), so a
+    region filter is required wherever a leaked instance from a
+    failed-over region must not be adopted into the current gang."""
+    out: Dict[int, Dict[str, Any]] = {}
+    for inst in lambda_api.call(client, 'list_instances'):
+        rank = _rank_of(inst, name)
+        if rank is None:
+            continue
+        if inst.get('status') in ('terminated', 'terminating'):
+            continue
+        if region is not None and (
+                (inst.get('region') or {}).get('name') or region) != region:
+            continue
+        out[rank] = inst
+    return out
+
+
+def _ensure_ssh_key(client) -> str:
+    """Register the local public key with Lambda if absent; returns the
+    key name to launch with (reference lambda_utils.get_unique_ssh_key_name
+    + register_ssh_key)."""
+    _, pub_path = authentication.get_or_generate_keys()
+    with open(pub_path, encoding='utf-8') as f:
+        pub_key = f.read().strip()
+    keys = lambda_api.call(client, 'list_ssh_keys')
+    for key in keys:
+        if (key.get('public_key') or '').strip() == pub_key:
+            return key['name']
+    taken = {key.get('name') for key in keys}
+    key_name = 'skytpu'
+    idx = 0
+    while key_name in taken:
+        idx += 1
+        key_name = f'skytpu-{idx}'
+    lambda_api.call(client, 'register_ssh_key', name=key_name,
+                    public_key=pub_key)
+    return key_name
+
+
+# ---- provision API ---------------------------------------------------------
+def run_instances(cluster_name: str, region: str, zone: Optional[str],
+                  num_hosts: int, deploy_vars: Dict[str, Any]) -> None:
+    del zone  # Lambda has no zones
+    name = deploy_vars['cluster_name_on_cloud']
+    record = {'region': region, 'zone': None, 'name_on_cloud': name,
+              'num_hosts': num_hosts, 'deploy_vars': deploy_vars}
+    # Record BEFORE creating (partial-failure resources must stay
+    # reachable by terminate_instances; same contract as provision/gcp.py).
+    _save_record(cluster_name, record)
+    client = lambda_api.get_client()
+    try:
+        key_name = _ensure_ssh_key(client)
+        existing = _live_instances(client, name, region)
+        for rank in range(num_hosts):
+            if rank in existing:
+                continue  # idempotent relaunch
+            lambda_api.call(
+                client, 'launch',
+                region=region,
+                instance_type=deploy_vars.get('instance_type',
+                                              'gpu_1x_a10'),
+                name=f'{name}-r{rank}',
+                ssh_key_names=[key_name],
+                quantity=1)
+    except exceptions.InsufficientCapacityError:
+        # Clean up partial hosts, then drop the record so region failover
+        # retries don't see a stale pointer. If cleanup itself failed,
+        # KEEP the record: a later terminate_instances must still be able
+        # to find and kill the leaked hosts.
+        try:
+            _terminate_all(client, name)
+        except exceptions.CloudError:
+            pass
+        else:
+            _delete_record(cluster_name)
+        raise
+
+
+def wait_instances(cluster_name: str, region: str, state: str = 'running',
+                   timeout: float = 1800) -> None:
+    if state != 'running':
+        raise exceptions.NotSupportedError(
+            'Lambda Cloud cannot stop instances (terminate-only).')
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        states = set(query_instances(cluster_name, region).values())
+        if states == {state}:
+            return
+        if (not states or 'terminating' in states
+                or 'terminated' in states):
+            # A rank hole (instance died while booting) must fail over,
+            # not wait out the timeout (parity with aws/azure).
+            raise exceptions.InsufficientCapacityError(
+                f'{cluster_name}: instance(s) disappeared while waiting '
+                f'for {state}', reason='capacity')
+        time.sleep(5)
+    raise exceptions.ProvisionError(
+        f'{cluster_name} did not reach {state!r} within {timeout}s')
+
+
+def query_instances(cluster_name: str, region: str) -> Dict[str, str]:
+    """Live host states. A PARTIALLY-dead cluster reports missing ranks
+    as 'terminated'; a fully-dead cluster returns {} ("terminated
+    cluster" contract in core.py)."""
+    del region
+    record = _load_record(cluster_name)
+    if not record:
+        return {}
+    client = lambda_api.get_client()
+    live = _live_instances(client, record['name_on_cloud'],
+                           record.get('region'))
+    if not live:
+        return {}
+    out: Dict[str, str] = {}
+    for rank, inst in live.items():
+        out[inst.get('name', f'r{rank}')] = _STATE_MAP.get(
+            inst.get('status', ''), 'unknown')
+    for rank in range(int(record.get('num_hosts') or 0)):
+        if rank not in live:
+            out[f'rank{rank}-missing'] = 'terminated'
+    return out
+
+
+def stop_instances(cluster_name: str, region: str) -> None:
+    raise exceptions.NotSupportedError(
+        'Lambda Cloud cannot stop instances (terminate-only); '
+        'use `skytpu down` instead.')
+
+
+def _terminate_all(client, name: str) -> None:
+    ids = [inst['id'] for inst in _live_instances(client, name).values()
+           if inst.get('id')]
+    if ids:
+        lambda_api.call(client, 'terminate', instance_ids=ids)
+
+
+def terminate_instances(cluster_name: str, region: str) -> None:
+    del region
+    record = _load_record(cluster_name)
+    if not record:
+        return
+    client = lambda_api.get_client()
+    _terminate_all(client, record['name_on_cloud'])
+    # Account-global firewall rules are left in place deliberately
+    # (other clusters may use them; reference instance.py:330-351).
+    _delete_record(cluster_name)
+
+
+def get_cluster_info(cluster_name: str,
+                     region: str) -> provision_lib.ClusterInfo:
+    del region
+    record = _require_record(cluster_name)
+    client = lambda_api.get_client()
+    live = _live_instances(client, record['name_on_cloud'],
+                           record.get('region'))
+    hosts: List[provision_lib.HostInfo] = []
+    # "Single host" is what was PROVISIONED, not what happens to be
+    # alive: a half-dead gang must not get the loopback fallback.
+    single = int(record.get('num_hosts') or 0) == 1
+    for rank in sorted(live):
+        inst = live[rank]
+        # The API may omit private_ip (reference instance.py:56-68):
+        # loopback is fine for a single host, fatal for a gang.
+        internal = inst.get('private_ip')
+        if internal is None:
+            if not single:
+                raise exceptions.ProvisionError(
+                    f'No private IP for {inst.get("name")!r} — multi-host '
+                    'rendezvous needs one.')
+            internal = '127.0.0.1'
+        hosts.append(provision_lib.HostInfo(
+            host_id=inst.get('id', f'r{rank}'), rank=rank,
+            internal_ip=internal,
+            external_ip=inst.get('ip'),
+            extra={}))
+    return provision_lib.ClusterInfo(
+        cluster_name=cluster_name, cloud='lambda',
+        region=record['region'], zone=None, hosts=hosts,
+        deploy_vars=record['deploy_vars'])
+
+
+def open_ports(cluster_name: str, region: str, ports: List[str]) -> None:
+    """Append tcp allow rules to the ACCOUNT-global firewall (PUT
+    replaces the whole rule set, so existing rules are re-sent).
+    Idempotent: already-open port ranges are skipped."""
+    if not ports:
+        return
+    record = _require_record(cluster_name)
+    if record['region'] in _NO_FIREWALL_REGIONS:
+        import logging
+        logging.getLogger(__name__).warning(
+            'Lambda region %s does not support firewall rules; ports %s '
+            'not opened.', record['region'], ports)
+        return
+    client = lambda_api.get_client()
+    existing = lambda_api.call(client, 'list_firewall_rules')
+    rules = []
+    have = set()
+    for rule in existing:
+        entry = {
+            'protocol': rule.get('protocol', 'tcp'),
+            'source_network': rule.get('source_network', '0.0.0.0/0'),
+            'description': rule.get('description', ''),
+        }
+        pr = rule.get('port_range')
+        if pr and rule.get('protocol') != 'icmp':
+            entry['port_range'] = list(pr)
+            have.add((entry['protocol'], tuple(pr)))
+        rules.append(entry)
+    changed = False
+    for port in sorted(ports, key=str):
+        if '-' in str(port):
+            lo, hi = (int(p) for p in str(port).split('-', 1))
+        else:
+            lo = hi = int(port)
+        if ('tcp', (lo, hi)) in have:
+            continue
+        rules.append({
+            'protocol': 'tcp',
+            'source_network': '0.0.0.0/0',
+            'description': f'skytpu port {lo}-{hi}',
+            'port_range': [lo, hi],
+        })
+        changed = True
+    if changed:
+        lambda_api.call(client, 'put_firewall_rules', rules=rules)
+
+
+def get_command_runners(cluster_info: provision_lib.ClusterInfo,
+                        ssh_credentials: Optional[Dict[str, str]] = None
+                        ) -> List[runner_lib.CommandRunner]:
+    creds = ssh_credentials or {}
+    key_path = creds.get('key_path')
+    if key_path is None:
+        key_path, _ = authentication.get_or_generate_keys()
+    user = creds.get('user', SSH_USER)
+    runners: List[runner_lib.CommandRunner] = []
+    for h in cluster_info.hosts:
+        ip = h.external_ip or h.internal_ip
+        runners.append(runner_lib.SSHCommandRunner(ip, user, key_path))
+    return runners

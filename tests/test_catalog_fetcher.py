@@ -271,3 +271,64 @@ def test_azure_online_override(tmp_path, monkeypatch):
     other = [r for r in rows if r['instance_type'] == 'Standard_D2s_v5'
              and r['region'] == 'westus2'][0]
     assert float(other['price']) == 0.096
+
+
+def test_committed_lambda_catalog_matches_regeneration(tmp_path,
+                                                       monkeypatch):
+    """Same drift guard as GCP/AWS/Azure: lambda_vms.csv must equal the
+    offline fetcher output."""
+    import csv as csv_lib
+    import os
+    from skypilot_tpu.catalog.fetchers import fetch_lambda
+
+    monkeypatch.setattr(fetch_lambda, 'DATA_DIR', str(tmp_path))
+    assert fetch_lambda.refresh(online=False) == 'offline'
+    committed_path = os.path.join(
+        os.path.dirname(os.path.abspath(fetch_lambda.__file__)), '..',
+        'data', 'lambda_vms.csv')
+    committed = open(committed_path).read()
+    assert committed == (tmp_path / 'lambda_vms.csv').read_text(), (
+        'lambda_vms.csv drifted from the fetcher: run '
+        'python -m skypilot_tpu.catalog.fetchers.fetch_lambda')
+    rows = list(csv_lib.DictReader(open(tmp_path / 'lambda_vms.csv')))
+    a10 = [r for r in rows if r['instance_type'] == 'gpu_1x_a10'
+           and r['region'] == 'us-east-1'][0]
+    assert float(a10['price']) == 0.75
+    # No spot market: the spot column mirrors on-demand.
+    assert a10['spot_price'] == a10['price']
+
+
+def test_lambda_fetcher_live_override(tmp_path, monkeypatch):
+    """Live /instance-types payloads override the static table, and a
+    type with no live capacity keeps its static region set."""
+    from skypilot_tpu.catalog.fetchers import fetch_lambda
+
+    live = {
+        'gpu_1x_a10': {
+            'instance_type': {
+                'price_cents_per_hour': 80,
+                'specs': {'vcpus': 30, 'memory_gib': 200},
+            },
+            'regions_with_capacity_available': [{'name': 'us-west-3'}],
+        },
+        'gpu_1x_h100_pcie': {
+            'instance_type': {
+                'price_cents_per_hour': 249,
+                'specs': {'vcpus': 26, 'memory_gib': 200},
+            },
+            'regions_with_capacity_available': [],  # sold out everywhere
+        },
+    }
+    monkeypatch.setattr(fetch_lambda, 'DATA_DIR', str(tmp_path))
+    assert fetch_lambda.refresh(online=True,
+                                types_fetcher=lambda: live) == 'online'
+    import csv as csv_lib
+    rows = list(csv_lib.DictReader(open(tmp_path / 'lambda_vms.csv')))
+    a10 = [r for r in rows if r['instance_type'] == 'gpu_1x_a10']
+    assert [r['region'] for r in a10] == ['us-west-3']
+    assert float(a10[0]['price']) == 0.8
+    h100 = [r for r in rows if r['instance_type'] == 'gpu_1x_h100_pcie']
+    # Catalog answers "where is it OFFERED": static regions survive a
+    # transient zero-capacity reading.
+    assert len(h100) == len(
+        fetch_lambda._INSTANCE_TYPES['gpu_1x_h100_pcie'][3])

@@ -1,0 +1,382 @@
+"""Lambda Cloud provisioner tests against an in-process fake client.
+
+The fake implements the flat client surface the provisioner calls
+(launch / list_instances / terminate / ssh keys / firewall rules),
+including capacity failures — so the terminate-only lifecycle, rank-hole
+detection, failover, and the account-global firewall logic run for real
+with no cloud and no network (same seam pattern as test_azure_provision
+and the reference's mocked lambda tests, SURVEY.md §4).
+"""
+import itertools
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import exceptions
+from skypilot_tpu.backends.slice_backend import RetryingProvisioner
+from skypilot_tpu.provision import lambda_api
+from skypilot_tpu.provision import lambda_impl
+
+
+class FakeLambda:
+    """In-memory Lambda Cloud account (the API is not regional)."""
+
+    def __init__(self):
+        self.instances = {}      # id -> instance dict
+        self.ssh_keys = []       # [{name, public_key}]
+        self.firewall = []       # [{protocol, source_network, port_range}]
+        self.fail_regions = set()
+        self.quota_error = False
+        self.launch_calls = []
+        self._ids = itertools.count(1)
+
+    # -- flat client surface -------------------------------------------------
+    def launch(self, region, instance_type, name, ssh_key_names,
+               quantity=1):
+        self.launch_calls.append((region, name))
+        if self.quota_error:
+            raise lambda_api.LambdaApiError(
+                'global/quota-exceeded',
+                'Instance quota exceeded for your account')
+        if region in self.fail_regions:
+            raise lambda_api.LambdaApiError(
+                'instance-operations/launch/insufficient-capacity',
+                f'Not enough capacity in {region}')
+        ids = []
+        for _ in range(quantity):
+            n = next(self._ids)
+            iid = f'lam-{n:04d}'
+            self.instances[iid] = {
+                'id': iid, 'name': name, 'status': 'active',
+                'region': {'name': region},
+                'instance_type': {'name': instance_type},
+                'ip': f'144.24.0.{n + 10}',
+                'private_ip': f'10.19.0.{n + 10}',
+                'ssh_key_names': list(ssh_key_names),
+            }
+            ids.append(iid)
+        return ids
+
+    def list_instances(self):
+        return [dict(i) for i in self.instances.values()
+                if i['status'] != 'terminated']
+
+    def terminate(self, instance_ids):
+        for iid in instance_ids:
+            if iid in self.instances:
+                self.instances[iid]['status'] = 'terminated'
+
+    def list_ssh_keys(self):
+        return [dict(k) for k in self.ssh_keys]
+
+    def register_ssh_key(self, name, public_key):
+        self.ssh_keys.append({'name': name, 'public_key': public_key})
+
+    def list_firewall_rules(self):
+        return [dict(r) for r in self.firewall]
+
+    def put_firewall_rules(self, rules):
+        # PUT replaces the account's entire rule set (API semantics).
+        self.firewall = [dict(r) for r in rules]
+
+
+@pytest.fixture
+def fake_lambda(monkeypatch, tmp_path):
+    account = FakeLambda()
+    lambda_api.set_lambda_factory(lambda: account)
+    monkeypatch.setenv('SKYTPU_FAKE_LAMBDA_CREDENTIALS', '1')
+    priv = tmp_path / 'key'
+    pub = tmp_path / 'key.pub'
+    priv.write_text('fake-private')
+    pub.write_text('ssh-ed25519 AAAA test')
+    monkeypatch.setattr('skypilot_tpu.authentication.get_or_generate_keys',
+                        lambda: (str(priv), str(pub)))
+    yield account
+    lambda_api.set_lambda_factory(None)
+
+
+def _deploy_vars(**over):
+    base = {
+        'cloud': 'lambda', 'mode': 'lambda_vm',
+        'cluster_name_on_cloud': 'c-lam1',
+        'instance_type': 'gpu_1x_a10', 'image_id': None,
+        'disk_size_gb': 128, 'use_spot': False, 'labels': {}, 'ports': [],
+    }
+    base.update(over)
+    return base
+
+
+class TestLifecycle:
+
+    def test_create_query_info_terminate(self, fake_lambda):
+        dv = _deploy_vars()
+        lambda_impl.run_instances('l1', 'us-east-1', None, 2, dv)
+        lambda_impl.wait_instances('l1', 'us-east-1', timeout=5)
+        states = lambda_impl.query_instances('l1', 'us-east-1')
+        assert set(states.values()) == {'running'} and len(states) == 2
+
+        info = lambda_impl.get_cluster_info('l1', 'us-east-1')
+        assert info.num_hosts == 2
+        assert [h.rank for h in info.hosts] == [0, 1]
+        assert info.head.internal_ip.startswith('10.19.')
+        assert info.head.external_ip.startswith('144.')
+
+        lambda_impl.terminate_instances('l1', 'us-east-1')
+        assert lambda_impl.query_instances('l1', 'us-east-1') == {}
+
+    def test_stop_is_not_supported(self, fake_lambda):
+        lambda_impl.run_instances('l2', 'us-east-1', None, 1,
+                                  _deploy_vars())
+        with pytest.raises(exceptions.NotSupportedError):
+            lambda_impl.stop_instances('l2', 'us-east-1')
+        with pytest.raises(exceptions.NotSupportedError):
+            lambda_impl.wait_instances('l2', 'us-east-1', state='stopped',
+                                       timeout=5)
+
+    def test_idempotent_relaunch_fills_rank_holes_only(self, fake_lambda):
+        dv = _deploy_vars()
+        lambda_impl.run_instances('l3', 'us-east-1', None, 2, dv)
+        assert len(fake_lambda.launch_calls) == 2
+        # Re-running with all hosts alive launches nothing new.
+        lambda_impl.run_instances('l3', 'us-east-1', None, 2, dv)
+        assert len(fake_lambda.launch_calls) == 2
+        # Kill rank 1; relaunch recreates only that rank.
+        victim = next(i for i in fake_lambda.instances.values()
+                      if i['name'].endswith('-r1'))
+        victim['status'] = 'terminated'
+        lambda_impl.run_instances('l3', 'us-east-1', None, 2, dv)
+        assert len(fake_lambda.launch_calls) == 3
+        assert fake_lambda.launch_calls[-1][1] == 'c-lam1-r1'
+
+    def test_partial_loss_reports_terminated_rank(self, fake_lambda):
+        lambda_impl.run_instances('l4', 'us-east-1', None, 2,
+                                  _deploy_vars())
+        victim = next(i for i in fake_lambda.instances.values()
+                      if i['name'].endswith('-r1'))
+        victim['status'] = 'terminated'
+        states = lambda_impl.query_instances('l4', 'us-east-1')
+        assert states.get('rank1-missing') == 'terminated'
+
+    def test_ssh_key_registered_once_and_reused(self, fake_lambda):
+        lambda_impl.run_instances('l5', 'us-east-1', None, 1,
+                                  _deploy_vars())
+        assert [k['name'] for k in fake_lambda.ssh_keys] == ['skytpu']
+        lambda_impl.terminate_instances('l5', 'us-east-1')
+        lambda_impl.run_instances('l5', 'us-east-1', None, 1,
+                                  _deploy_vars())
+        # Same pub key -> reused, not re-registered.
+        assert [k['name'] for k in fake_lambda.ssh_keys] == ['skytpu']
+        # A foreign key with our name but a different pub key forces a
+        # suffixed name.
+        fake_lambda.ssh_keys = [{'name': 'skytpu',
+                                 'public_key': 'ssh-ed25519 OTHER'}]
+        lambda_impl.terminate_instances('l5', 'us-east-1')
+        lambda_impl.run_instances('l5', 'us-east-1', None, 1,
+                                  _deploy_vars())
+        assert {k['name'] for k in fake_lambda.ssh_keys} == {
+            'skytpu', 'skytpu-1'}
+
+    def test_booting_maps_to_pending_then_running(self, fake_lambda):
+        lambda_impl.run_instances('l6', 'us-east-1', None, 1,
+                                  _deploy_vars())
+        inst = next(iter(fake_lambda.instances.values()))
+        inst['status'] = 'booting'
+        assert set(lambda_impl.query_instances(
+            'l6', 'us-east-1').values()) == {'pending'}
+        inst['status'] = 'active'
+        lambda_impl.wait_instances('l6', 'us-east-1', timeout=5)
+
+
+class TestOpenPorts:
+
+    def test_open_ports_appends_and_is_idempotent(self, fake_lambda):
+        lambda_impl.run_instances('p1', 'us-east-1', None, 1,
+                                  _deploy_vars())
+        lambda_impl.open_ports('p1', 'us-east-1', ['8080'])
+        lambda_impl.open_ports('p1', 'us-east-1', ['8080'])  # idempotent
+        lambda_impl.open_ports('p1', 'us-east-1', ['9000-9010'])
+        ranges = [tuple(r['port_range']) for r in fake_lambda.firewall]
+        assert ranges.count((8080, 8080)) == 1
+        assert (9000, 9010) in ranges
+
+    def test_existing_account_rules_are_preserved(self, fake_lambda):
+        # PUT replaces the WHOLE account rule set: rules some other
+        # cluster relies on must be re-sent, not dropped.
+        fake_lambda.firewall = [{
+            'protocol': 'tcp', 'source_network': '0.0.0.0/0',
+            'description': 'other-cluster ssh', 'port_range': [22, 22],
+        }]
+        lambda_impl.run_instances('p2', 'us-east-1', None, 1,
+                                  _deploy_vars())
+        lambda_impl.open_ports('p2', 'us-east-1', ['8080'])
+        ranges = [tuple(r['port_range']) for r in fake_lambda.firewall]
+        assert (22, 22) in ranges and (8080, 8080) in ranges
+
+    def test_us_south_1_skips_with_warning(self, fake_lambda, caplog):
+        lambda_impl.run_instances('p3', 'us-south-1', None, 1,
+                                  _deploy_vars())
+        lambda_impl.open_ports('p3', 'us-south-1', ['8080'])
+        assert fake_lambda.firewall == []  # unsupported region: no-op
+
+    def test_terminate_leaves_account_firewall(self, fake_lambda):
+        lambda_impl.run_instances('p4', 'us-east-1', None, 1,
+                                  _deploy_vars())
+        lambda_impl.open_ports('p4', 'us-east-1', ['8080'])
+        lambda_impl.terminate_instances('p4', 'us-east-1')
+        # Account-global rules survive cluster teardown by design.
+        assert len(fake_lambda.firewall) == 1
+
+
+class TestFailover:
+
+    def _task(self, *regions):
+        task = sky.Task(run='echo x')
+        rs = [sky.Resources(cloud='lambda', instance_type='gpu_1x_a10',
+                            region=r) for r in regions]
+        task.set_resources([rs[0]])
+        task.best_resources = rs[0]
+        task.candidate_resources = rs
+        return task
+
+    def test_capacity_error_fails_over_to_next_region(self, fake_lambda):
+        fake_lambda.fail_regions.add('us-east-1')
+        launched, info = RetryingProvisioner().provision(
+            self._task('us-east-1', 'us-west-1'), 'lam-fo')
+        assert launched.region == 'us-west-1'
+        assert info.num_hosts == 1
+        # Every live instance landed in the failover region.
+        live_regions = {i['region']['name']
+                        for i in fake_lambda.instances.values()
+                        if i['status'] == 'active'}
+        assert live_regions == {'us-west-1'}
+
+    def test_partial_gang_capacity_cleans_up(self, fake_lambda):
+        # Rank 0 lands, rank 1 hits capacity: the half-gang must be
+        # terminated before the region is declared failed.
+        real_launch = fake_lambda.launch
+
+        def flaky_launch(region, instance_type, name, ssh_key_names,
+                         quantity=1):
+            if name.endswith('-r1'):
+                raise lambda_api.LambdaApiError(
+                    'instance-operations/launch/insufficient-capacity',
+                    'Not enough capacity')
+            return real_launch(region, instance_type, name,
+                               ssh_key_names, quantity)
+        fake_lambda.launch = flaky_launch
+        with pytest.raises(exceptions.InsufficientCapacityError):
+            lambda_impl.run_instances('lam-fo2', 'us-east-1', None, 2,
+                                      _deploy_vars())
+        live = [i for i in fake_lambda.instances.values()
+                if i['status'] not in ('terminated', 'terminating')]
+        assert live == []
+
+    def test_quota_error_is_not_capacity(self, fake_lambda):
+        fake_lambda.quota_error = True
+        err = None
+        try:
+            lambda_api.call(fake_lambda, 'launch', region='us-east-1',
+                            instance_type='gpu_1x_a10', name='x-r0',
+                            ssh_key_names=['k'])
+        except exceptions.CloudError as e:
+            err = e
+        assert err is not None
+        assert not isinstance(err, exceptions.InsufficientCapacityError)
+        assert err.reason == 'quota'
+
+
+class TestCloudClass:
+
+    def test_feasibility_defaults_and_catalog(self, fake_lambda):
+        cloud = sky.clouds.get_cloud('lambda')
+        feas = cloud.get_feasible_resources(sky.Resources(cloud='lambda'))
+        assert feas.resources, feas.hint
+        assert feas.resources[0].instance_type is not None
+        regions = cloud.regions_for(feas.resources[0])
+        assert 'us-east-1' in regions
+
+    def test_spot_and_tpu_are_infeasible(self, fake_lambda):
+        cloud = sky.clouds.get_cloud('lambda')
+        spot = cloud.get_feasible_resources(
+            sky.Resources(cloud='lambda', use_spot=True))
+        assert spot.resources == [] and 'spot' in spot.hint
+        tpu = cloud.get_feasible_resources(
+            sky.Resources(accelerators='tpu-v5e-8'))
+        assert tpu.resources == []
+
+    def test_stop_feature_gated(self, fake_lambda):
+        from skypilot_tpu import clouds as clouds_lib
+        cloud = sky.clouds.get_cloud('lambda')
+        assert not cloud.supports(clouds_lib.CloudFeature.STOP)
+        with pytest.raises(exceptions.NotSupportedError):
+            cloud.check_features_are_supported(
+                {clouds_lib.CloudFeature.STOP})
+
+    def test_optimizer_places_pinned_lambda_task(self, fake_lambda):
+        from skypilot_tpu import optimizer
+        task = sky.Task(run='echo x')
+        task.set_resources([sky.Resources(cloud='lambda', cpus='4+')])
+        optimizer.optimize(task, quiet=True)
+        res = task.best_resources
+        assert res.cloud == 'lambda'
+        assert res.instance_type == 'gpu_1x_a10'  # cheapest >=4 vcpus
+
+
+class TestAccountGlobalApiHazards:
+    """Lambda's API is account-global: regressions for cross-region
+    instance adoption and half-gang loopback fallback (round-5 review)."""
+
+    def test_leaked_instance_in_failed_region_not_adopted(self,
+                                                          fake_lambda):
+        # A cleanup-survivor from a failed us-east-1 attempt must not be
+        # counted as rank 0 of the us-west-1 retry.
+        fake_lambda.launch('us-east-1', 'gpu_1x_a10', 'c-lam1-r0', ['k'])
+        lambda_impl.run_instances('g1', 'us-west-1', None, 1,
+                                  _deploy_vars())
+        west = [i for i in fake_lambda.instances.values()
+                if i['region']['name'] == 'us-west-1'
+                and i['status'] == 'active']
+        assert len(west) == 1  # freshly launched, not adopted
+        info = lambda_impl.get_cluster_info('g1', 'us-west-1')
+        assert info.num_hosts == 1
+        assert info.head.host_id == west[0]['id']
+
+    def test_failed_cleanup_keeps_record_for_terminate(self, fake_lambda):
+        real_launch = fake_lambda.launch
+        real_terminate = fake_lambda.terminate
+
+        def flaky_launch(region, instance_type, name, ssh_key_names,
+                         quantity=1):
+            if name.endswith('-r1'):
+                raise lambda_api.LambdaApiError(
+                    'instance-operations/launch/insufficient-capacity',
+                    'Not enough capacity')
+            return real_launch(region, instance_type, name,
+                               ssh_key_names, quantity)
+
+        def broken_terminate(instance_ids):
+            raise lambda_api.LambdaApiError('429', 'rate limited')
+        fake_lambda.launch = flaky_launch
+        fake_lambda.terminate = broken_terminate
+        with pytest.raises(exceptions.InsufficientCapacityError):
+            lambda_impl.run_instances('g2', 'us-east-1', None, 2,
+                                      _deploy_vars())
+        # Cleanup failed -> rank 0 leaked, record kept so a later
+        # terminate_instances can still find and kill it.
+        fake_lambda.terminate = real_terminate
+        lambda_impl.terminate_instances('g2', 'us-east-1')
+        live = [i for i in fake_lambda.instances.values()
+                if i['status'] == 'active']
+        assert live == []
+
+    def test_half_dead_gang_never_gets_loopback(self, fake_lambda):
+        lambda_impl.run_instances('g3', 'us-east-1', None, 2,
+                                  _deploy_vars())
+        victim = next(i for i in fake_lambda.instances.values()
+                      if i['name'].endswith('-r1'))
+        victim['status'] = 'terminated'
+        survivor = next(i for i in fake_lambda.instances.values()
+                        if i['name'].endswith('-r0'))
+        survivor['private_ip'] = None  # API sometimes omits it
+        with pytest.raises(exceptions.ProvisionError):
+            lambda_impl.get_cluster_info('g3', 'us-east-1')

@@ -197,6 +197,10 @@ def validate_region_zone(
     regions.update(azure_regions)
     lambda_regions = set(_vms('lambda')['region'].unique())
     regions.update(lambda_regions)
+    do_regions = set(_vms('do')['region'].unique())
+    regions.update(do_regions)
+    fs_regions = set(_vms('fluidstack')['region'].unique())
+    regions.update(fs_regions)
     zones = set(tpus['zone'])
     # AWS AZs: region + single-letter suffix; regions carry up to six
     # (us-east-1a..f), so accept any letter on a known region.

@@ -1,0 +1,124 @@
+"""Generate the DigitalOcean catalog CSV (do_vms.csv).
+
+Counterpart of the reference's DO catalog (sky/catalog fetch for DO —
+walks the authenticated ``/v2/sizes`` endpoint). Two sources, merged:
+
+1. **DO sizes API** (``GET /v2/sizes`` — needs an API token):
+   ``refresh(online=True)`` pulls live ``price_hourly`` + specs +
+   per-size region availability. A ``sizes_fetcher`` seam lets tests
+   fake the API without network.
+2. **Static table** below (public pricing; DO has NO spot market, so
+   ``spot_price`` mirrors ``price``): the offline fallback — this build
+   environment has zero egress.
+
+Run:  python -m skypilot_tpu.catalog.fetchers.fetch_do [--online]
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+DATA_DIR = os.path.join(_HERE, '..', 'data')
+
+_REGIONS = ('nyc1', 'nyc3', 'sfo3', 'ams3', 'lon1', 'fra1', 'sgp1')
+
+# (vcpus, memory_gb, $/h). Public DO pricing: s- basic, c- cpu-optimized,
+# g- general purpose, m- memory-optimized.
+_SIZES: Dict[str, Tuple[int, float, float]] = {
+    's-1vcpu-2gb': (1, 2, 0.018),
+    's-2vcpu-4gb': (2, 4, 0.036),
+    's-4vcpu-8gb': (4, 8, 0.071),
+    's-8vcpu-16gb': (8, 16, 0.143),
+    'c-4': (4, 8, 0.125),
+    'c-8': (8, 16, 0.25),
+    'g-2vcpu-8gb': (2, 8, 0.094),
+    'g-8vcpu-32gb': (8, 32, 0.376),
+    'm-2vcpu-16gb': (2, 16, 0.125),
+    'm-8vcpu-64gb': (8, 64, 0.499),
+}
+
+
+def fetch_sizes(
+        sizes_fetcher: Optional[Callable[[], List[Dict[str, Any]]]] = None
+) -> List[Dict[str, Any]]:
+    """Live /v2/sizes payload: [{slug, vcpus, memory (MB), price_hourly,
+    regions, available}]. ``sizes_fetcher`` is the test seam."""
+    if sizes_fetcher is not None:
+        return sizes_fetcher()
+    from skypilot_tpu.provision import do_api
+    client = do_api.get_client()
+    return list(client._request('GET', '/sizes?per_page=200')  # pylint: disable=protected-access
+                .get('sizes', []))
+
+
+def generate_vm_rows(live: Optional[List[Dict[str, Any]]] = None
+                     ) -> List[Dict[str, object]]:
+    rows: List[Dict[str, object]] = []
+    if live:
+        for size in sorted(live, key=lambda s: s.get('slug', '')):
+            slug = size.get('slug')
+            if not slug or not size.get('available', True):
+                continue
+            price = float(size.get('price_hourly') or 0)
+            for region in size.get('regions') or []:
+                rows.append({
+                    'instance_type': slug,
+                    'vcpus': int(size.get('vcpus') or 0),
+                    'memory_gb': float(size.get('memory') or 0) / 1024.0,
+                    'region': region,
+                    'price': round(price, 5),
+                    'spot_price': round(price, 5),
+                })
+        if rows:
+            return rows
+    for slug, (vcpus, mem, price) in _SIZES.items():
+        for region in _REGIONS:
+            rows.append({
+                'instance_type': slug,
+                'vcpus': vcpus,
+                'memory_gb': mem,
+                'region': region,
+                'price': price,
+                'spot_price': price,
+            })
+    return rows
+
+
+def refresh(online: bool = False,
+            sizes_fetcher: Optional[Callable[[], List[Dict[str, Any]]]] = None
+            ) -> str:
+    """Regenerate do_vms.csv; returns 'online'/'offline'/'stale'."""
+    live: List[Dict[str, Any]] = []
+    source = 'offline'
+    if online:
+        try:
+            live = fetch_sizes(sizes_fetcher)
+            if live:
+                source = 'online'
+        except Exception as e:  # noqa: BLE001 — any failure = fallback
+            print(f'sizes API unavailable ({type(e).__name__}: {e}); '
+                  'using static price table')
+    from skypilot_tpu.catalog.fetchers.fetch_gcp import write_csv
+    rows = generate_vm_rows(live)
+    try:
+        write_csv(os.path.join(DATA_DIR, 'do_vms.csv'), rows)
+    except OSError as e:
+        print(f'catalog dir not writable ({e}); keeping existing CSV')
+        return 'stale'
+    print(f'Wrote {len(rows)} DO droplet rows to '
+          f'{os.path.normpath(DATA_DIR)} ({source})')
+    return source
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    import argparse
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--online', action='store_true',
+                        help='fetch live sizes/prices from /v2/sizes')
+    args = parser.parse_args(argv)
+    refresh(online=args.online)
+
+
+if __name__ == '__main__':
+    main()

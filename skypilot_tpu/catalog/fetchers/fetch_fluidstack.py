@@ -1,0 +1,120 @@
+"""Generate the FluidStack catalog CSV (fluidstack_vms.csv).
+
+Counterpart of the reference's FluidStack catalog fetcher (walks the
+authenticated ``/list_available_configurations`` endpoint). Two sources,
+merged:
+
+1. **Plans API**: ``refresh(online=True)`` pulls live plans
+   ({gpu_type, gpu_counts, price_per_gpu_hr, regions, cpu/memory per
+   gpu}) via the REST client. A ``plans_fetcher`` seam lets tests fake
+   the API without network.
+2. **Static table** below (public pricing; no spot market, so
+   ``spot_price`` mirrors ``price``): the offline fallback.
+
+Instance types are ``{gpu_type}::{count}`` plans (the provisioner's
+launch unit, reference fluidstack_utils.py:90).
+
+Run:  python -m skypilot_tpu.catalog.fetchers.fetch_fluidstack [--online]
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+DATA_DIR = os.path.join(_HERE, '..', 'data')
+
+# gpu_type -> (counts, $/gpu/h, vcpus-per-gpu, mem_gb-per-gpu, regions)
+_PLANS: Dict[str, Tuple[Tuple[int, ...], float, int, float,
+                        Tuple[str, ...]]] = {
+    'RTX_A6000': ((1, 2, 4), 0.49, 8, 48, ('NORWAY_4', 'CANADA_1')),
+    'A100_80G': ((1, 2, 4, 8), 1.49, 12, 120,
+                 ('NORWAY_4', 'CANADA_1', 'ARIZONA_1')),
+    'H100': ((8,), 2.89, 20, 192, ('NORWAY_4', 'ARIZONA_1')),
+}
+
+
+def fetch_plans(
+        plans_fetcher: Optional[Callable[[], List[Dict[str, Any]]]] = None
+) -> List[Dict[str, Any]]:
+    """Live plans payload; ``plans_fetcher`` is the test seam."""
+    if plans_fetcher is not None:
+        return plans_fetcher()
+    from skypilot_tpu.provision import fluidstack_api
+    return fluidstack_api.get_client().list_plans()
+
+
+def generate_vm_rows(live: Optional[List[Dict[str, Any]]] = None
+                     ) -> List[Dict[str, object]]:
+    rows: List[Dict[str, object]] = []
+    if live:
+        for plan in sorted(live, key=lambda p: p.get('gpu_type', '')):
+            gpu_type = plan.get('gpu_type')
+            if not gpu_type:
+                continue
+            price = float(plan.get('price_per_gpu_hr') or 0)
+            vcpus = int(plan.get('cpus_per_gpu') or 8)
+            mem = float(plan.get('memory_gb_per_gpu') or 64)
+            for count in plan.get('gpu_counts') or [1]:
+                for region in plan.get('regions') or []:
+                    rows.append({
+                        'instance_type': f'{gpu_type}::{count}',
+                        'vcpus': vcpus * count,
+                        'memory_gb': mem * count,
+                        'region': region,
+                        'price': round(price * count, 4),
+                        'spot_price': round(price * count, 4),
+                    })
+        if rows:
+            return rows
+    for gpu_type, (counts, price, vcpus, mem, regions) in _PLANS.items():
+        for count in counts:
+            for region in regions:
+                rows.append({
+                    'instance_type': f'{gpu_type}::{count}',
+                    'vcpus': vcpus * count,
+                    'memory_gb': mem * count,
+                    'region': region,
+                    'price': round(price * count, 4),
+                    'spot_price': round(price * count, 4),
+                })
+    return rows
+
+
+def refresh(online: bool = False,
+            plans_fetcher: Optional[Callable[[], List[Dict[str, Any]]]] = None
+            ) -> str:
+    """Regenerate fluidstack_vms.csv; returns 'online'/'offline'/'stale'."""
+    live: List[Dict[str, Any]] = []
+    source = 'offline'
+    if online:
+        try:
+            live = fetch_plans(plans_fetcher)
+            if live:
+                source = 'online'
+        except Exception as e:  # noqa: BLE001 — any failure = fallback
+            print(f'plans API unavailable ({type(e).__name__}: {e}); '
+                  'using static price table')
+    from skypilot_tpu.catalog.fetchers.fetch_gcp import write_csv
+    rows = generate_vm_rows(live)
+    try:
+        write_csv(os.path.join(DATA_DIR, 'fluidstack_vms.csv'), rows)
+    except OSError as e:
+        print(f'catalog dir not writable ({e}); keeping existing CSV')
+        return 'stale'
+    print(f'Wrote {len(rows)} FluidStack plan rows to '
+          f'{os.path.normpath(DATA_DIR)} ({source})')
+    return source
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    import argparse
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--online', action='store_true',
+                        help='fetch live plans from the API')
+    args = parser.parse_args(argv)
+    refresh(online=args.online)
+
+
+if __name__ == '__main__':
+    main()

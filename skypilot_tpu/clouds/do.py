@@ -1,0 +1,133 @@
+"""DigitalOcean: droplets (controllers, CPU tasks; stop with a billing
+caveat).
+
+Counterpart of reference ``sky/clouds/do.py`` (feasibility, pricing,
+deploy vars, credential checks; unsupported-feature table at :25-35).
+Fifth VM cloud: full lifecycle except spot (DO has no spot market), with
+tag-scoped cluster discovery and a per-cluster firewall object.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu.clouds import cloud as cloud_lib
+
+
+@cloud_lib.CLOUD_REGISTRY.register(name='do')
+class DO(cloud_lib.Cloud):
+    NAME = 'do'
+    _FEATURES = frozenset({
+        cloud_lib.CloudFeature.STOP,      # power_off (still bills: no
+        cloud_lib.CloudFeature.AUTOSTOP,  # deallocate on DO)
+        cloud_lib.CloudFeature.MULTI_HOST,
+        cloud_lib.CloudFeature.STORAGE_MOUNTS,
+        cloud_lib.CloudFeature.OPEN_PORTS,
+        cloud_lib.CloudFeature.CUSTOM_IMAGES,
+    })
+
+    # ---- credentials ------------------------------------------------------
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        if os.environ.get('SKYTPU_FAKE_DO_CREDENTIALS'):
+            return True, None
+        from skypilot_tpu.provision import do_api
+        if do_api.read_api_token() is not None:
+            return True, None
+        return False, ('DigitalOcean credentials not found. Set '
+                       '$DIGITALOCEAN_ACCESS_TOKEN or run '
+                       '`doctl auth init`.')
+
+    @classmethod
+    def get_active_user_identity(cls) -> Optional[List[str]]:
+        if os.environ.get('SKYTPU_FAKE_DO_CREDENTIALS'):
+            return ['fake-identity@do.test']
+        from skypilot_tpu.provision import do_api
+        token = do_api.read_api_token()
+        return [f'do-token-{token[:8]}'] if token else None
+
+    # ---- topology ---------------------------------------------------------
+    def regions_for(self, resources) -> List[str]:
+        if resources.tpu is not None:
+            return []  # no TPUs on DO
+        if resources.use_spot:
+            return []  # no spot market
+        itype = resources.instance_type or 's-2vcpu-4gb'
+        regions = catalog.get_vm_regions(itype, cloud=self.NAME)
+        if resources.region is not None:
+            regions = [r for r in regions if r == resources.region]
+        return regions
+
+    def zones_for(self, resources, region: str) -> List[Optional[str]]:
+        if resources.zone is not None:
+            return []  # DO has no zones; a pinned zone can't match
+        return [None]
+
+    # ---- pricing ----------------------------------------------------------
+    def hourly_cost(self, resources, region=None, zone=None) -> float:
+        region = region or resources.region
+        assert resources.instance_type is not None, resources
+        return catalog.get_instance_hourly_cost(
+            resources.instance_type, resources.use_spot, region=region,
+            cloud=self.NAME)
+
+    def egress_cost_per_gb(self, dst_cloud: str, dst_region: str,
+                           src_region: Optional[str]) -> float:
+        # DO pools a free allowance then bills overage; use the public
+        # overage rate as the conservative planning number.
+        if src_region is not None and dst_cloud == self.NAME \
+                and src_region == dst_region:
+            return 0.0
+        return 0.01
+
+    # ---- feasibility ------------------------------------------------------
+    def get_feasible_resources(self,
+                               resources) -> cloud_lib.FeasibleResources:
+        if resources.tpu is not None:
+            return cloud_lib.FeasibleResources(
+                [], hint='DigitalOcean has no TPU accelerators; use '
+                         'cloud: gcp.')
+        if resources.use_spot:
+            return cloud_lib.FeasibleResources(
+                [], hint='DigitalOcean has no spot market.')
+        if resources.instance_type is not None:
+            if not catalog.get_vm_regions(resources.instance_type,
+                                          cloud=self.NAME):
+                return cloud_lib.FeasibleResources(
+                    [], hint=(f'{resources.instance_type} is not a '
+                              'DigitalOcean droplet size in the catalog.'))
+            return cloud_lib.FeasibleResources(
+                [resources.copy(cloud=self.NAME)])
+        itype = catalog.get_default_instance_type(
+            cpus=resources._cpus, cpus_plus=resources._cpus_plus,  # pylint: disable=protected-access
+            memory=resources._memory, memory_plus=resources._memory_plus,  # pylint: disable=protected-access
+            region=resources.region, cloud=self.NAME)
+        if itype is None:
+            return cloud_lib.FeasibleResources(
+                [], hint=(f'No droplet size with cpus={resources.cpus}, '
+                          f'memory={resources.memory}'))
+        return cloud_lib.FeasibleResources(
+            [resources.copy(cloud=self.NAME, instance_type=itype)])
+
+    # ---- deployment -------------------------------------------------------
+    def make_deploy_variables(self, resources, cluster_name_on_cloud: str,
+                              region: str,
+                              zone: Optional[str]) -> Dict[str, Any]:
+        from skypilot_tpu.provision import docker_utils
+        image_id = resources.image_id
+        if docker_utils.is_docker_image(image_id):
+            image_id = None  # stock image; ranks run in the container
+        return {
+            'cloud': self.NAME,
+            'mode': 'do_droplet',
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region,
+            'zone': None,
+            'use_spot': False,
+            'disk_size_gb': resources.disk_size,
+            'labels': dict(resources.labels or {}),
+            'ports': list(resources.ports or ()),
+            'instance_type': resources.instance_type,
+            'image_id': image_id,
+        }

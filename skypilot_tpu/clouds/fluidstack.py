@@ -1,0 +1,126 @@
+"""FluidStack: GPU instances (terminate-only, no ports API).
+
+Counterpart of reference ``sky/clouds/fluidstack.py`` (feasibility,
+pricing, deploy vars; unsupported-feature table at :40-53). Sixth VM
+cloud; like Lambda it is terminate-only with no spot, and additionally
+has NO firewall API — the first cloud omitting OPEN_PORTS, so
+serve/port-requiring tasks are refused up front by the feature gate.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu.clouds import cloud as cloud_lib
+
+
+@cloud_lib.CLOUD_REGISTRY.register(name='fluidstack')
+class Fluidstack(cloud_lib.Cloud):
+    NAME = 'fluidstack'
+    _FEATURES = frozenset({
+        cloud_lib.CloudFeature.AUTOSTOP,  # autodown only (no STOP)
+        cloud_lib.CloudFeature.MULTI_HOST,
+        cloud_lib.CloudFeature.STORAGE_MOUNTS,
+    })
+
+    # ---- credentials ------------------------------------------------------
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        if os.environ.get('SKYTPU_FAKE_FLUIDSTACK_CREDENTIALS'):
+            return True, None
+        from skypilot_tpu.provision import fluidstack_api
+        if fluidstack_api.read_api_key() is not None:
+            return True, None
+        return False, ('FluidStack credentials not found. Set '
+                       '$FLUIDSTACK_API_KEY or write the key to '
+                       '~/.fluidstack/api_key.')
+
+    @classmethod
+    def get_active_user_identity(cls) -> Optional[List[str]]:
+        if os.environ.get('SKYTPU_FAKE_FLUIDSTACK_CREDENTIALS'):
+            return ['fake-identity@fluidstack.test']
+        from skypilot_tpu.provision import fluidstack_api
+        key = fluidstack_api.read_api_key()
+        return [f'fluidstack-key-{key[:8]}'] if key else None
+
+    # ---- topology ---------------------------------------------------------
+    def regions_for(self, resources) -> List[str]:
+        if resources.tpu is not None:
+            return []  # no TPUs on FluidStack
+        if resources.use_spot:
+            return []  # no spot market
+        itype = resources.instance_type or 'A100_80G::1'
+        regions = catalog.get_vm_regions(itype, cloud=self.NAME)
+        if resources.region is not None:
+            regions = [r for r in regions if r == resources.region]
+        return regions
+
+    def zones_for(self, resources, region: str) -> List[Optional[str]]:
+        if resources.zone is not None:
+            return []  # no zones
+        return [None]
+
+    # ---- pricing ----------------------------------------------------------
+    def hourly_cost(self, resources, region=None, zone=None) -> float:
+        region = region or resources.region
+        assert resources.instance_type is not None, resources
+        return catalog.get_instance_hourly_cost(
+            resources.instance_type, resources.use_spot, region=region,
+            cloud=self.NAME)
+
+    def egress_cost_per_gb(self, dst_cloud: str, dst_region: str,
+                           src_region: Optional[str]) -> float:
+        return 0.0  # FluidStack does not bill egress
+
+    # ---- feasibility ------------------------------------------------------
+    def get_feasible_resources(self,
+                               resources) -> cloud_lib.FeasibleResources:
+        if resources.tpu is not None:
+            return cloud_lib.FeasibleResources(
+                [], hint='FluidStack has no TPU accelerators; use '
+                         'cloud: gcp.')
+        if resources.use_spot:
+            return cloud_lib.FeasibleResources(
+                [], hint='FluidStack has no spot market.')
+        if resources.ports:
+            return cloud_lib.FeasibleResources(
+                [], hint='FluidStack has no firewall API; tasks needing '
+                         'open ports cannot run there.')
+        if resources.instance_type is not None:
+            if not catalog.get_vm_regions(resources.instance_type,
+                                          cloud=self.NAME):
+                return cloud_lib.FeasibleResources(
+                    [], hint=(f'{resources.instance_type} is not a '
+                              'FluidStack plan in the catalog '
+                              '(format: GPU_TYPE::count).'))
+            return cloud_lib.FeasibleResources(
+                [resources.copy(cloud=self.NAME)])
+        itype = catalog.get_default_instance_type(
+            cpus=resources._cpus, cpus_plus=resources._cpus_plus,  # pylint: disable=protected-access
+            memory=resources._memory, memory_plus=resources._memory_plus,  # pylint: disable=protected-access
+            region=resources.region, cloud=self.NAME)
+        if itype is None:
+            return cloud_lib.FeasibleResources(
+                [], hint=(f'No FluidStack plan with cpus={resources.cpus},'
+                          f' memory={resources.memory}'))
+        return cloud_lib.FeasibleResources(
+            [resources.copy(cloud=self.NAME, instance_type=itype)])
+
+    # ---- deployment -------------------------------------------------------
+    def make_deploy_variables(self, resources, cluster_name_on_cloud: str,
+                              region: str,
+                              zone: Optional[str]) -> Dict[str, Any]:
+        return {
+            'cloud': self.NAME,
+            'mode': 'fluidstack_vm',
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region,
+            'zone': None,
+            'use_spot': False,
+            'disk_size_gb': resources.disk_size,
+            'labels': dict(resources.labels or {}),
+            'ports': [],
+            'instance_type': resources.instance_type,
+            'image_id': None,  # stock ubuntu_22_04_lts_nvidia
+        }

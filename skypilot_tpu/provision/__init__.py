@@ -26,6 +26,8 @@ _CLOUD_MODULES = {
     'azure': 'skypilot_tpu.provision.azure',
     'kubernetes': 'skypilot_tpu.provision.kubernetes',
     'lambda': 'skypilot_tpu.provision.lambda_impl',
+    'do': 'skypilot_tpu.provision.do_impl',
+    'fluidstack': 'skypilot_tpu.provision.fluidstack_impl',
 }
 
 

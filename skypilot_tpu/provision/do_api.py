@@ -1,0 +1,219 @@
+"""Thin DigitalOcean REST client with a test seam.
+
+Counterpart of the reference's ``sky/provision/do/utils.py`` (pydo
+client wrapper: droplet CRUD, ssh keys, per-error classification). The
+real transport is a tiny urllib client over the public v2 REST API —
+no pydo SDK needed; tests install an in-process fake via
+``set_do_factory`` implementing the same flat surface
+(``create_droplet``, ``list_droplets``, ``droplet_action``,
+``delete_droplet``, ssh keys, firewalls), so lifecycle + failover logic
+runs for real with no cloud.
+
+Auth (reference utils.py:23-94): ``$DIGITALOCEAN_ACCESS_TOKEN`` first,
+then doctl config files (``access-token`` / ``auth-contexts``).
+
+Error classification: 422 capacity wording ("currently unavailable",
+"not enough available capacity") -> zone/region failover;
+droplet-limit wording -> quota; everything else -> plain CloudError.
+"""
+from __future__ import annotations
+
+import json
+import os
+import urllib.parse
+from typing import Any, Callable, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import rest_cloud
+
+API_ENDPOINT = 'https://api.digitalocean.com/v2'
+
+DOCTL_CONFIG_PATHS = [
+    '~/Library/Application Support/doctl/config.yaml',  # macOS
+    os.path.join(os.environ.get('XDG_CONFIG_HOME', '~/.config'),
+                 'doctl/config.yaml'),                  # Linux
+]
+
+_CAPACITY_MARKERS = (
+    'currently unavailable',
+    'not enough available capacity',
+    'is not available in',
+    'out of capacity',
+)
+_QUOTA_MARKERS = (
+    'droplet limit',
+    'will exceed your',
+    'limit exceeded',
+)
+
+
+class DoApiError(Exception):
+    """Fake/real client error carrying an HTTP status + message."""
+
+    def __init__(self, status: int, message: str = ''):
+        super().__init__(message or str(status))
+        self.status = status
+        self.message = message or str(status)
+
+
+def classify_error(exc: Exception) -> exceptions.CloudError:
+    msg = str(exc).lower()
+    if any(m in msg for m in _CAPACITY_MARKERS):
+        return exceptions.InsufficientCapacityError(str(exc),
+                                                    reason='capacity')
+    if any(m in msg for m in _QUOTA_MARKERS):
+        return exceptions.CloudError(str(exc), reason='quota')
+    return exceptions.CloudError(str(exc))
+
+
+def read_api_token() -> Optional[str]:
+    env = os.environ.get('DIGITALOCEAN_ACCESS_TOKEN')
+    if env:
+        return env
+    for p in DOCTL_CONFIG_PATHS:
+        path = os.path.expanduser(p)
+        if not os.path.exists(path):
+            continue
+        try:
+            import yaml
+            with open(path, encoding='utf-8') as f:
+                cfg = yaml.safe_load(f) or {}
+        except Exception:  # noqa: BLE001 — malformed config = no creds
+            continue
+        token = cfg.get('access-token')
+        if token:
+            return str(token)
+        contexts = cfg.get('auth-contexts') or {}
+        for tok in contexts.values():
+            if tok:
+                return str(tok)
+    return None
+
+
+def _parse_error(status: int, raw: bytes) -> Exception:
+    """DO's error envelope: {'id': ..., 'message': ...}."""
+    try:
+        err = json.loads(raw.decode())
+        return DoApiError(status, err.get('message', raw.decode()))
+    except (ValueError, AttributeError):
+        return DoApiError(status,
+                          raw.decode(errors='replace') or str(status))
+
+
+class _RestClient:
+    """Flat op surface over the shared retrying urllib transport."""
+
+    def __init__(self):
+        token = read_api_token()
+        if token is None:
+            raise exceptions.CloudError(
+                'DigitalOcean credentials not found: set '
+                '$DIGITALOCEAN_ACCESS_TOKEN or run `doctl auth init`.')
+        self._headers = {'Authorization': f'Bearer {token}',
+                         'Content-Type': 'application/json'}
+
+    def _request(self, method: str, path: str,
+                 payload: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        return rest_cloud.retrying_request(
+            method, f'{API_ENDPOINT}{path}', self._headers, payload,
+            _parse_error)
+
+    # -- flat op surface (mirrored by test fakes) ---------------------------
+    def create_droplet(self, name: str, region: str, size: str, image: str,
+                       ssh_key_ids: List[int], tags: List[str],
+                       user_data: Optional[str] = None) -> Dict[str, Any]:
+        body: Dict[str, Any] = {
+            'name': name, 'region': region, 'size': size, 'image': image,
+            'ssh_keys': ssh_key_ids, 'tags': tags,
+        }
+        if user_data:
+            body['user_data'] = user_data
+        return dict(self._request('POST', '/droplets', body)
+                    .get('droplet', {}))
+
+    def list_droplets(self, tag: Optional[str] = None
+                      ) -> List[Dict[str, Any]]:
+        path = '/droplets'
+        if tag:
+            path += f'?tag_name={urllib.parse.quote(tag)}'
+        return self._paginate(path, 'droplets')
+
+    def droplet_action(self, droplet_id: int, action: str) -> None:
+        # 'power_off' / 'power_on' (droplet actions API).
+        self._request('POST', f'/droplets/{droplet_id}/actions',
+                      {'type': action})
+
+    def delete_droplet(self, droplet_id: int) -> None:
+        self._request('DELETE', f'/droplets/{droplet_id}')
+
+    def _paginate(self, path: str, key: str) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        page = 1
+        sep = '&' if '?' in path else '?'
+        while True:
+            resp = self._request('GET',
+                                 f'{path}{sep}per_page=200&page={page}')
+            out.extend(resp.get(key, []))
+            links = (resp.get('links') or {}).get('pages') or {}
+            if 'next' not in links:
+                return out
+            page += 1
+
+    def list_ssh_keys(self) -> List[Dict[str, Any]]:
+        return self._paginate('/account/keys', 'ssh_keys')
+
+    def register_ssh_key(self, name: str, public_key: str
+                         ) -> Dict[str, Any]:
+        return dict(self._request('POST', '/account/keys',
+                                  {'name': name, 'public_key': public_key})
+                    .get('ssh_key', {}))
+
+    def list_firewalls(self) -> List[Dict[str, Any]]:
+        return self._paginate('/firewalls', 'firewalls')
+
+    def create_firewall(self, name: str, inbound_rules: List[Dict[str, Any]],
+                        tags: List[str]) -> Dict[str, Any]:
+        body = {
+            'name': name,
+            'inbound_rules': inbound_rules,
+            # Allow all outbound (provisioning needs package installs).
+            'outbound_rules': [
+                {'protocol': p, 'ports': '0',
+                 'destinations': {'addresses': ['0.0.0.0/0', '::/0']}}
+                for p in ('tcp', 'udp', 'icmp')
+            ],
+            'tags': tags,
+        }
+        return dict(self._request('POST', '/firewalls', body)
+                    .get('firewall', {}))
+
+    def update_firewall(self, firewall_id: str,
+                        body: Dict[str, Any]) -> None:
+        self._request('PUT', f'/firewalls/{firewall_id}', body)
+
+    def delete_firewall(self, firewall_id: str) -> None:
+        self._request('DELETE', f'/firewalls/{firewall_id}')
+
+
+_do_factory: Optional[Callable[[], Any]] = None
+
+
+def set_do_factory(factory: Optional[Callable[[], Any]]) -> None:
+    """Test seam: ``factory() -> fake DO client`` (account-global, like
+    the Lambda seam — the v2 API is not region-scoped)."""
+    global _do_factory
+    _do_factory = factory
+
+
+def get_client() -> Any:
+    if _do_factory is not None:
+        return _do_factory()
+    return _RestClient()
+
+
+def call(client: Any, op: str, **kwargs) -> Any:
+    """Invoke a client op, normalizing errors to CloudError subclasses."""
+    try:
+        return getattr(client, op)(**kwargs)
+    except DoApiError as e:
+        raise classify_error(e) from e

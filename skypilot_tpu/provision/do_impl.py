@@ -1,0 +1,295 @@
+"""DigitalOcean provisioner: droplet host groups (tag-scoped clusters).
+
+Counterpart of reference ``sky/provision/do/instance.py`` (droplet ops
+over pydo) — the fifth VM cloud. Same record/classification/failover
+shape as GCP/AWS/Azure/Lambda so ``RetryingProvisioner`` drives all of
+them identically.
+
+DO-isms (mirrored from the reference's handling):
+- droplets are found by a per-cluster TAG (DO tags are first-class API
+  filters — cheaper and safer than name parsing on an account-global
+  list); rank is encoded in the droplet name ``{name}-r{rank}``;
+- stop is ``power_off`` — NOTE a powered-off droplet still bills on DO
+  (like Azure's non-deallocated 'stopped'; DO has no deallocate, so
+  stop support is billing-caveated, documented in docs/clouds.md);
+- no spot market;
+- ports are ONE per-cluster firewall object applied by tag, whose
+  inbound rule list is replaced on update (PUT semantics).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import authentication
+from skypilot_tpu import exceptions
+from skypilot_tpu import provision as provision_lib
+from skypilot_tpu.provision import do_api
+from skypilot_tpu.provision import rest_cloud
+from skypilot_tpu.utils import command_runner as runner_lib
+
+SSH_USER = 'root'  # DO's stock images log in as root
+
+DEFAULT_IMAGE = 'ubuntu-24-04-x64'
+
+# Droplet statuses -> the provision API's state words. 'off' means
+# powered off (still billing — DO has no deallocate).
+_STATE_MAP = {
+    'new': 'pending',
+    'active': 'running',
+    'off': 'stopped',
+    'archive': 'terminated',
+}
+
+
+# Cluster bookkeeping + rank decoding via the shared REST-cloud
+# scaffolding (rest_cloud.py).
+_records = rest_cloud.ClusterRecords('do_cluster')
+
+
+def _cluster_tag(name_on_cloud: str) -> str:
+    return f'skytpu-{name_on_cloud}'
+
+
+def _live_droplets(client, name: str,
+                   region: Optional[str] = None
+                   ) -> Dict[int, Dict[str, Any]]:
+    """rank -> droplet for the cluster tag. Tags scope to the CLUSTER,
+    but DO tags are account-global, not regional — so a region filter is
+    still required wherever a cleanup-survivor from a failed-over region
+    must not be adopted into the current gang (same hazard as Lambda)."""
+    out: Dict[int, Dict[str, Any]] = {}
+    for d in do_api.call(client, 'list_droplets', tag=_cluster_tag(name)):
+        rank = rest_cloud.rank_of(d.get('name') or '', name)
+        if rank is None:
+            continue
+        if d.get('status') == 'archive':
+            continue
+        if region is not None and (
+                (d.get('region') or {}).get('slug') or region) != region:
+            continue
+        out[rank] = d
+    return out
+
+
+def _tagify(text: str) -> str:
+    """DO tags allow only letters, digits, ':', '-', '_': anything else
+    in a user label becomes '-' so create_droplet never 422s on a label
+    like version:1.2."""
+    return ''.join(c if (c.isalnum() or c in ':-_') else '-'
+                   for c in text)
+
+
+def _ensure_ssh_key(client) -> int:
+    """Register the local public key if absent; returns the DO key id."""
+    _, pub_path = authentication.get_or_generate_keys()
+    with open(pub_path, encoding='utf-8') as f:
+        pub_key = f.read().strip()
+    for key in do_api.call(client, 'list_ssh_keys'):
+        if (key.get('public_key') or '').strip() == pub_key:
+            return int(key['id'])
+    created = do_api.call(client, 'register_ssh_key', name='skytpu',
+                          public_key=pub_key)
+    return int(created['id'])
+
+
+def _ips(droplet: Dict[str, Any]) -> Dict[str, Optional[str]]:
+    v4 = (droplet.get('networks') or {}).get('v4') or []
+    out: Dict[str, Optional[str]] = {'public': None, 'private': None}
+    for net in v4:
+        if net.get('type') in out and out[net['type']] is None:
+            out[net['type']] = net.get('ip_address')
+    return out
+
+
+# ---- provision API ---------------------------------------------------------
+def run_instances(cluster_name: str, region: str, zone: Optional[str],
+                  num_hosts: int, deploy_vars: Dict[str, Any]) -> None:
+    del zone  # DO has no zones
+    name = deploy_vars['cluster_name_on_cloud']
+    record = {'region': region, 'zone': None, 'name_on_cloud': name,
+              'num_hosts': num_hosts, 'deploy_vars': deploy_vars}
+    # Record BEFORE creating (partial-failure resources must stay
+    # reachable by terminate_instances; same contract as provision/gcp.py).
+    _records.save(cluster_name, record)
+    client = do_api.get_client()
+    try:
+        key_id = _ensure_ssh_key(client)
+        existing = _live_droplets(client, name, region)
+        for rank, d in existing.items():
+            if d.get('status') == 'off':
+                do_api.call(client, 'droplet_action',
+                            droplet_id=d['id'], action='power_on')
+        for rank in range(num_hosts):
+            if rank in existing:
+                continue  # idempotent relaunch
+            do_api.call(
+                client, 'create_droplet',
+                name=f'{name}-r{rank}',
+                region=region,
+                size=deploy_vars.get('instance_type', 's-2vcpu-4gb'),
+                image=deploy_vars.get('image_id') or DEFAULT_IMAGE,
+                ssh_key_ids=[key_id],
+                tags=[_cluster_tag(name)] + [
+                    _tagify(f'{k}:{v}') for k, v in
+                    (deploy_vars.get('labels') or {}).items()])
+    except exceptions.InsufficientCapacityError:
+        # Clean up partial hosts, then drop the record so region
+        # failover retries don't see a stale pointer. If cleanup itself
+        # failed, KEEP the record so terminate_instances can retry.
+        try:
+            _terminate_all(client, name)
+        except exceptions.CloudError:
+            pass
+        else:
+            _records.delete(cluster_name)
+        raise
+
+
+def wait_instances(cluster_name: str, region: str, state: str = 'running',
+                   timeout: float = 1800) -> None:
+    # No spot on DO: no eviction heuristics, just converge-or-hole.
+    rest_cloud.poll_for_state(
+        cluster_name, lambda: query_instances(cluster_name, region),
+        state, timeout)
+
+
+def query_instances(cluster_name: str, region: str) -> Dict[str, str]:
+    """Live host states. A PARTIALLY-dead cluster reports missing ranks
+    as 'terminated'; a fully-dead cluster returns {} ("terminated
+    cluster" contract in core.py)."""
+    del region
+    record = _records.load(cluster_name)
+    if not record:
+        return {}
+    client = do_api.get_client()
+    live = _live_droplets(client, record['name_on_cloud'],
+                          record.get('region'))
+    if not live:
+        return {}
+    out: Dict[str, str] = {}
+    for rank, d in live.items():
+        out[d.get('name', f'r{rank}')] = _STATE_MAP.get(
+            d.get('status', ''), 'unknown')
+    for rank in range(int(record.get('num_hosts') or 0)):
+        if rank not in live:
+            out[f'rank{rank}-missing'] = 'terminated'
+    return out
+
+
+def stop_instances(cluster_name: str, region: str) -> None:
+    """power_off every droplet. NOTE: a powered-off droplet still bills
+    on DO (no deallocate); `skytpu down` is the only way to stop the
+    meter — documented in docs/clouds.md."""
+    record = _records.require(cluster_name, 'DO')
+    client = do_api.get_client()
+    for d in _live_droplets(client, record['name_on_cloud']).values():
+        if d.get('status') in ('new', 'active'):
+            do_api.call(client, 'droplet_action', droplet_id=d['id'],
+                        action='power_off')
+
+
+def _terminate_all(client, name: str) -> None:
+    for d in _live_droplets(client, name).values():
+        do_api.call(client, 'delete_droplet', droplet_id=d['id'])
+
+
+def terminate_instances(cluster_name: str, region: str) -> None:
+    del region
+    record = _records.load(cluster_name)
+    if not record:
+        return
+    client = do_api.get_client()
+    name = record['name_on_cloud']
+    _terminate_all(client, name)
+    # The per-cluster firewall object is cluster-scoped: delete it
+    # (unlike Lambda's account-global rules).
+    fw_name = _firewall_name(name)
+    for fw in do_api.call(client, 'list_firewalls'):
+        if fw.get('name') == fw_name:
+            try:
+                do_api.call(client, 'delete_firewall',
+                            firewall_id=fw['id'])
+            except exceptions.CloudError:
+                pass  # best-effort; orphan firewalls hold no billing
+    _records.delete(cluster_name)
+
+
+def get_cluster_info(cluster_name: str,
+                     region: str) -> provision_lib.ClusterInfo:
+    del region
+    record = _records.require(cluster_name, 'DO')
+    client = do_api.get_client()
+    live = _live_droplets(client, record['name_on_cloud'],
+                          record.get('region'))
+    hosts: List[provision_lib.HostInfo] = []
+    for rank in sorted(live):
+        d = live[rank]
+        ips = _ips(d)
+        internal = ips['private'] or ips['public']
+        if internal is None:
+            raise exceptions.ProvisionError(
+                f'No IP on droplet {d.get("name")!r} yet.')
+        hosts.append(provision_lib.HostInfo(
+            host_id=str(d.get('id', f'r{rank}')), rank=rank,
+            internal_ip=internal,
+            external_ip=ips['public'],
+            extra={}))
+    return provision_lib.ClusterInfo(
+        cluster_name=cluster_name, cloud='do',
+        region=record['region'], zone=None, hosts=hosts,
+        deploy_vars=record['deploy_vars'])
+
+
+def _firewall_name(name_on_cloud: str) -> str:
+    return f'skytpu-{name_on_cloud}-fw'
+
+
+def open_ports(cluster_name: str, region: str, ports: List[str]) -> None:
+    """One per-cluster firewall object applied by the cluster tag; its
+    inbound rule list is REPLACED on update, so re-opening is idempotent
+    and a tightened ``do.firewall_source_ranges`` re-applies."""
+    if not ports:
+        return
+    record = _records.require(cluster_name, 'DO')
+    client = do_api.get_client()
+    name = record['name_on_cloud']
+    from skypilot_tpu import config as config_lib
+    ranges = config_lib.get_nested(('do', 'firewall_source_ranges'),
+                                   ['0.0.0.0/0'])
+    tag = _cluster_tag(name)
+    fw_name = _firewall_name(name)
+    existing = None
+    for fw in do_api.call(client, 'list_firewalls'):
+        if fw.get('name') == fw_name:
+            existing = fw
+            break
+    wanted: Dict[str, Dict[str, Any]] = {}
+    if existing is not None:
+        for rule in existing.get('inbound_rules', []):
+            wanted[f"{rule['protocol']}:{rule['ports']}"] = dict(rule)
+    # SSH must stay reachable through the cluster firewall.
+    wanted.setdefault('tcp:22', {
+        'protocol': 'tcp', 'ports': '22',
+        'sources': {'addresses': ['0.0.0.0/0', '::/0']}})
+    for port in sorted(ports, key=str):
+        spec = str(port)  # DO accepts '8080' and '9000-9010' verbatim
+        wanted[f'tcp:{spec}'] = {
+            'protocol': 'tcp', 'ports': spec,
+            'sources': {'addresses': list(ranges)}}
+    rules = sorted(wanted.values(), key=lambda r: r['ports'])
+    if existing is None:
+        do_api.call(client, 'create_firewall', name=fw_name,
+                    inbound_rules=rules, tags=[tag])
+    else:
+        do_api.call(client, 'update_firewall',
+                    firewall_id=existing['id'],
+                    body={'name': fw_name, 'inbound_rules': rules,
+                          'outbound_rules': existing.get(
+                              'outbound_rules', []),
+                          'tags': [tag]})
+
+
+def get_command_runners(cluster_info: provision_lib.ClusterInfo,
+                        ssh_credentials: Optional[Dict[str, str]] = None
+                        ) -> List[runner_lib.CommandRunner]:
+    return rest_cloud.ssh_runners(cluster_info, SSH_USER, ssh_credentials)

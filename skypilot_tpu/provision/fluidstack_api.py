@@ -1,0 +1,148 @@
+"""Thin FluidStack REST client with a test seam.
+
+Counterpart of the reference's
+``sky/provision/fluidstack/fluidstack_utils.py`` (FluidstackClient over
+``https://platform.fluidstack.io`` with an ``api-key`` header). The real
+transport is a tiny urllib client; tests install an in-process fake via
+``set_fluidstack_factory`` implementing the same flat surface
+(``create_instance``, ``list_instances``, ``delete_instance``,
+``list_plans``, ssh keys), so lifecycle + failover logic runs for real
+with no cloud.
+
+Error classification: out-of-stock wording ("out of stock", reference
+fluidstack_utils.py:98-99) -> capacity failover; quota wording ->
+quota; everything else -> plain CloudError.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import rest_cloud
+
+API_ENDPOINT = 'https://platform.fluidstack.io'
+API_KEY_PATH = '~/.fluidstack/api_key'
+
+_CAPACITY_MARKERS = (
+    'out of stock',
+    'no capacity',
+    'not available in',
+)
+_QUOTA_MARKERS = (
+    'quota',
+    'limit reached',
+)
+
+
+class FluidstackApiError(Exception):
+    """Fake/real client error carrying an HTTP status + message."""
+
+    def __init__(self, status: int, message: str = ''):
+        super().__init__(message or str(status))
+        self.status = status
+        self.message = message or str(status)
+
+
+def classify_error(exc: Exception) -> exceptions.CloudError:
+    msg = str(exc).lower()
+    if any(m in msg for m in _CAPACITY_MARKERS):
+        return exceptions.InsufficientCapacityError(str(exc),
+                                                    reason='capacity')
+    if any(m in msg for m in _QUOTA_MARKERS):
+        return exceptions.CloudError(str(exc), reason='quota')
+    return exceptions.CloudError(str(exc))
+
+
+def read_api_key() -> Optional[str]:
+    env = os.environ.get('FLUIDSTACK_API_KEY')
+    if env:
+        return env
+    path = os.path.expanduser(API_KEY_PATH)
+    if os.path.exists(path):
+        with open(path, encoding='utf-8') as f:
+            key = f.read().strip()
+        return key or None
+    return None
+
+
+def _parse_error(status: int, raw: bytes) -> Exception:
+    """FluidStack's error envelope: {'message': ...} or {'error': ...}."""
+    try:
+        err = json.loads(raw.decode())
+        msg = err.get('message') or err.get('error') or raw.decode()
+        return FluidstackApiError(status, str(msg))
+    except (ValueError, AttributeError):
+        return FluidstackApiError(
+            status, raw.decode(errors='replace') or str(status))
+
+
+class _RestClient:
+    """Flat op surface over the shared retrying urllib transport."""
+
+    def __init__(self):
+        api_key = read_api_key()
+        if api_key is None:
+            raise exceptions.CloudError(
+                'FluidStack credentials not found: set '
+                f'$FLUIDSTACK_API_KEY or write the key to {API_KEY_PATH}.')
+        self._headers = {'api-key': api_key,
+                         'Content-Type': 'application/json'}
+
+    def _request(self, method: str, path: str,
+                 payload: Optional[Dict[str, Any]] = None) -> Any:
+        return rest_cloud.retrying_request(
+            method, f'{API_ENDPOINT}{path}', self._headers, payload,
+            _parse_error)
+
+    # -- flat op surface (mirrored by test fakes) ---------------------------
+    def create_instance(self, gpu_type: str, gpu_count: int, region: str,
+                        name: str, ssh_key_name: str) -> str:
+        body = self._request('POST', '/instances', {
+            'gpu_type': gpu_type, 'gpu_count': gpu_count,
+            'region': region, 'name': name,
+            'operating_system_label': 'ubuntu_22_04_lts_nvidia',
+            'ssh_key': ssh_key_name,
+        })
+        return str(body.get('id'))
+
+    def list_instances(self) -> List[Dict[str, Any]]:
+        return list(self._request('GET', '/instances') or [])
+
+    def delete_instance(self, instance_id: str) -> None:
+        self._request('DELETE', f'/instances/{instance_id}')
+
+    def list_plans(self) -> List[Dict[str, Any]]:
+        return list(self._request(
+            'GET', '/list_available_configurations') or [])
+
+    def list_ssh_keys(self) -> List[Dict[str, str]]:
+        return list(self._request('GET', '/ssh_keys') or [])
+
+    def register_ssh_key(self, name: str, public_key: str) -> None:
+        self._request('POST', '/ssh_keys',
+                      {'name': name, 'public_key': public_key})
+
+
+_fluidstack_factory: Optional[Callable[[], Any]] = None
+
+
+def set_fluidstack_factory(factory: Optional[Callable[[], Any]]) -> None:
+    """Test seam: ``factory() -> fake FluidStack client``."""
+    global _fluidstack_factory
+    _fluidstack_factory = factory
+
+
+def get_client() -> Any:
+    if _fluidstack_factory is not None:
+        return _fluidstack_factory()
+    return _RestClient()
+
+
+def call(client: Any, op: str, **kwargs) -> Any:
+    """Invoke a client op, normalizing errors to CloudError subclasses."""
+    try:
+        return getattr(client, op)(**kwargs)
+    except FluidstackApiError as e:
+        raise classify_error(e) from e

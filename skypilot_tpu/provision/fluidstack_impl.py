@@ -1,0 +1,228 @@
+"""FluidStack provisioner: GPU instance host groups (terminate-only).
+
+Counterpart of reference ``sky/provision/fluidstack/instance.py`` —
+same reduced lifecycle class as Lambda (no stop, no spot, no zones) but
+with FluidStack-isms:
+
+- instance types are ``{gpu_type}::{gpu_count}`` plans (reference
+  fluidstack_utils.py:90-99); availability is checked against the
+  plans list BEFORE launching, so a sold-out plan classifies as
+  capacity without burning a launch call;
+- there is NO ports API: the cloud class simply omits the OPEN_PORTS
+  feature and serve/port tasks are refused up front;
+- rank discovery is stateless via instance names ``{name}-r{rank}``
+  (same as Lambda; FluidStack has no tags either).
+
+Cluster bookkeeping lives in the client state kv, mirroring the other
+REST clouds.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import authentication
+from skypilot_tpu import exceptions
+from skypilot_tpu import provision as provision_lib
+from skypilot_tpu.provision import fluidstack_api
+from skypilot_tpu.provision import rest_cloud
+from skypilot_tpu.utils import command_runner as runner_lib
+
+SSH_USER = 'ubuntu'
+
+# FluidStack statuses -> provision API state words (reference
+# instance.py:84 pending set + :100 running filter).
+_STATE_MAP = {
+    'pending': 'pending',
+    'provisioning': 'pending',
+    'running': 'running',
+    'unhealthy': 'pending',
+    'terminating': 'terminating',
+    'terminated': 'terminated',
+}
+
+
+def split_plan(instance_type: str) -> tuple:
+    """'A100_80G::8' -> ('A100_80G', 8)."""
+    gpu_type, _, count = instance_type.partition('::')
+    return gpu_type, int(count or 1)
+
+
+# Cluster bookkeeping + rank decoding via the shared REST-cloud
+# scaffolding (rest_cloud.py).
+_records = rest_cloud.ClusterRecords('fluidstack_cluster')
+
+
+def _live_instances(client, name: str,
+                    region: Optional[str] = None
+                    ) -> Dict[int, Dict[str, Any]]:
+    """rank -> instance. Region-filtered: the API is account-global, so
+    a leaked instance from a failed-over region must not be adopted
+    (same hazard as Lambda)."""
+    out: Dict[int, Dict[str, Any]] = {}
+    for inst in fluidstack_api.call(client, 'list_instances'):
+        rank = rest_cloud.rank_of(inst.get('name') or '', name)
+        if rank is None:
+            continue
+        if inst.get('status') in ('terminated', 'terminating'):
+            continue
+        if region is not None and (inst.get('region') or region) != region:
+            continue
+        out[rank] = inst
+    return out
+
+
+def _ensure_ssh_key(client) -> str:
+    _, pub_path = authentication.get_or_generate_keys()
+    with open(pub_path, encoding='utf-8') as f:
+        pub_key = f.read().strip()
+    keys = fluidstack_api.call(client, 'list_ssh_keys')
+    for key in keys:
+        if (key.get('public_key') or '').strip() == pub_key:
+            return key['name']
+    taken = {key.get('name') for key in keys}
+    key_name = 'skytpu'
+    idx = 0
+    while key_name in taken:
+        idx += 1
+        key_name = f'skytpu-{idx}'
+    fluidstack_api.call(client, 'register_ssh_key', name=key_name,
+                        public_key=pub_key)
+    return key_name
+
+
+def _check_stock(client, instance_type: str, region: str) -> None:
+    """Sold-out plans classify as capacity BEFORE a launch call
+    (reference fluidstack_utils.py:90-99)."""
+    gpu_type, gpu_count = split_plan(instance_type)
+    for plan in fluidstack_api.call(client, 'list_plans'):
+        if (plan.get('gpu_type') == gpu_type
+                and gpu_count in (plan.get('gpu_counts') or [])
+                and region in (plan.get('regions') or [])):
+            return
+    raise exceptions.InsufficientCapacityError(
+        f'Plan {instance_type} out of stock in region {region}',
+        reason='capacity')
+
+
+# ---- provision API ---------------------------------------------------------
+def run_instances(cluster_name: str, region: str, zone: Optional[str],
+                  num_hosts: int, deploy_vars: Dict[str, Any]) -> None:
+    del zone  # FluidStack has no zones
+    name = deploy_vars['cluster_name_on_cloud']
+    record = {'region': region, 'zone': None, 'name_on_cloud': name,
+              'num_hosts': num_hosts, 'deploy_vars': deploy_vars}
+    _records.save(cluster_name, record)
+    client = fluidstack_api.get_client()
+    instance_type = deploy_vars.get('instance_type', 'A100_80G::1')
+    try:
+        _check_stock(client, instance_type, region)
+        key_name = _ensure_ssh_key(client)
+        gpu_type, gpu_count = split_plan(instance_type)
+        existing = _live_instances(client, name, region)
+        for rank in range(num_hosts):
+            if rank in existing:
+                continue  # idempotent relaunch
+            fluidstack_api.call(
+                client, 'create_instance',
+                gpu_type=gpu_type, gpu_count=gpu_count, region=region,
+                name=f'{name}-r{rank}', ssh_key_name=key_name)
+    except exceptions.InsufficientCapacityError:
+        try:
+            _terminate_all(client, name)
+        except exceptions.CloudError:
+            pass
+        else:
+            _records.delete(cluster_name)
+        raise
+
+
+def wait_instances(cluster_name: str, region: str, state: str = 'running',
+                   timeout: float = 1800) -> None:
+    if state != 'running':
+        raise exceptions.NotSupportedError(
+            'FluidStack cannot stop instances (terminate-only).')
+    rest_cloud.poll_for_state(
+        cluster_name, lambda: query_instances(cluster_name, region),
+        state, timeout)
+
+
+def query_instances(cluster_name: str, region: str) -> Dict[str, str]:
+    del region
+    record = _records.load(cluster_name)
+    if not record:
+        return {}
+    client = fluidstack_api.get_client()
+    live = _live_instances(client, record['name_on_cloud'],
+                           record.get('region'))
+    if not live:
+        return {}
+    out: Dict[str, str] = {}
+    for rank, inst in live.items():
+        out[inst.get('name', f'r{rank}')] = _STATE_MAP.get(
+            inst.get('status', ''), 'unknown')
+    for rank in range(int(record.get('num_hosts') or 0)):
+        if rank not in live:
+            out[f'rank{rank}-missing'] = 'terminated'
+    return out
+
+
+def stop_instances(cluster_name: str, region: str) -> None:
+    raise exceptions.NotSupportedError(
+        'FluidStack cannot stop instances (terminate-only); '
+        'use `skytpu down` instead.')
+
+
+def _terminate_all(client, name: str) -> None:
+    for inst in _live_instances(client, name).values():
+        if inst.get('id'):
+            fluidstack_api.call(client, 'delete_instance',
+                                instance_id=inst['id'])
+
+
+def terminate_instances(cluster_name: str, region: str) -> None:
+    del region
+    record = _records.load(cluster_name)
+    if not record:
+        return
+    client = fluidstack_api.get_client()
+    _terminate_all(client, record['name_on_cloud'])
+    _records.delete(cluster_name)
+
+
+def get_cluster_info(cluster_name: str,
+                     region: str) -> provision_lib.ClusterInfo:
+    del region
+    record = _records.require(cluster_name, 'FluidStack')
+    client = fluidstack_api.get_client()
+    live = _live_instances(client, record['name_on_cloud'],
+                           record.get('region'))
+    hosts: List[provision_lib.HostInfo] = []
+    single = int(record.get('num_hosts') or 0) == 1
+    for rank in sorted(live):
+        inst = live[rank]
+        internal = inst.get('private_ip')
+        if internal is None:
+            if not single:
+                raise exceptions.ProvisionError(
+                    f'No private IP for {inst.get("name")!r} — multi-host '
+                    'rendezvous needs one.')
+            internal = '127.0.0.1'
+        hosts.append(provision_lib.HostInfo(
+            host_id=str(inst.get('id', f'r{rank}')), rank=rank,
+            internal_ip=internal,
+            external_ip=inst.get('ip_address'),
+            extra={}))
+    return provision_lib.ClusterInfo(
+        cluster_name=cluster_name, cloud='fluidstack',
+        region=record['region'], zone=None, hosts=hosts,
+        deploy_vars=record['deploy_vars'])
+
+
+# No open_ports: FluidStack has no firewall API; the cloud class omits
+# the OPEN_PORTS feature so port-requiring tasks are refused up front.
+
+
+def get_command_runners(cluster_info: provision_lib.ClusterInfo,
+                        ssh_credentials: Optional[Dict[str, str]] = None
+                        ) -> List[runner_lib.CommandRunner]:
+    return rest_cloud.ssh_runners(cluster_info, SSH_USER, ssh_credentials)

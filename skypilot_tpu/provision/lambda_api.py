@@ -22,12 +22,10 @@ from __future__ import annotations
 
 import json
 import os
-import time
-import urllib.error
-import urllib.request
 from typing import Any, Callable, Dict, List, Optional
 
 from skypilot_tpu import exceptions
+from skypilot_tpu.provision import rest_cloud
 
 API_ENDPOINT = 'https://cloud.lambdalabs.com/api/v1'
 CREDENTIALS_PATH = '~/.lambda_cloud/lambda_keys'
@@ -81,10 +79,20 @@ def read_api_key() -> Optional[str]:
     return None
 
 
-class _RestClient:
-    """Minimal urllib client implementing the flat op surface."""
+def _parse_error(status: int, raw: bytes) -> Exception:
+    """Lambda's error envelope: {'error': {'code', 'message'}}."""
+    try:
+        body = json.loads(raw.decode())
+        err = body.get('error', {})
+        return LambdaApiError(err.get('code', str(status)),
+                              err.get('message', raw.decode()))
+    except (ValueError, AttributeError):
+        return LambdaApiError(str(status),
+                              raw.decode(errors='replace') or str(status))
 
-    _MAX_ATTEMPTS = 6
+
+class _RestClient:
+    """Flat op surface over the shared retrying urllib transport."""
 
     def __init__(self):
         api_key = read_api_key()
@@ -97,28 +105,9 @@ class _RestClient:
 
     def _request(self, method: str, path: str,
                  payload: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
-        url = f'{API_ENDPOINT}{path}'
-        data = json.dumps(payload).encode() if payload is not None else None
-        backoff = 5.0
-        for attempt in range(self._MAX_ATTEMPTS):
-            req = urllib.request.Request(url, data=data, method=method,
-                                         headers=self._headers)
-            try:
-                with urllib.request.urlopen(req, timeout=60) as resp:
-                    return json.loads(resp.read().decode() or '{}')
-            except urllib.error.HTTPError as e:
-                if e.code == 429 and attempt < self._MAX_ATTEMPTS - 1:
-                    time.sleep(backoff)  # rate limited: retry with backoff
-                    backoff = min(backoff * 2, 60)
-                    continue
-                try:
-                    body = json.loads(e.read().decode())
-                    err = body.get('error', {})
-                    raise LambdaApiError(err.get('code', str(e.code)),
-                                         err.get('message', str(e)))
-                except (ValueError, AttributeError):
-                    raise LambdaApiError(str(e.code), str(e)) from e
-        raise LambdaApiError('429', 'rate limited after retries')
+        return rest_cloud.retrying_request(
+            method, f'{API_ENDPOINT}{path}', self._headers, payload,
+            _parse_error)
 
     # -- flat op surface (mirrored by test fakes) ---------------------------
     def launch(self, region: str, instance_type: str, name: str,

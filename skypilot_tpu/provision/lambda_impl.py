@@ -22,15 +22,13 @@ kv, mirroring ``provision/azure.py``.
 """
 from __future__ import annotations
 
-import json
-import time
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import authentication
 from skypilot_tpu import exceptions
-from skypilot_tpu import global_user_state
 from skypilot_tpu import provision as provision_lib
 from skypilot_tpu.provision import lambda_api
+from skypilot_tpu.provision import rest_cloud
 from skypilot_tpu.utils import command_runner as runner_lib
 
 SSH_USER = 'ubuntu'  # canonical Lambda login
@@ -49,40 +47,9 @@ _STATE_MAP = {
 _NO_FIREWALL_REGIONS = ('us-south-1',)
 
 
-# ---- cluster record --------------------------------------------------------
-def _record_key(cluster_name: str) -> str:
-    return f'lambda_cluster/{cluster_name}'
-
-
-def _save_record(cluster_name: str, record: Dict[str, Any]) -> None:
-    global_user_state.set_kv(_record_key(cluster_name), json.dumps(record))
-
-
-def _load_record(cluster_name: str) -> Optional[Dict[str, Any]]:
-    raw = global_user_state.get_kv(_record_key(cluster_name))
-    return json.loads(raw) if raw else None
-
-
-def _delete_record(cluster_name: str) -> None:
-    global_user_state.set_kv(_record_key(cluster_name), '')
-
-
-def _require_record(cluster_name: str) -> Dict[str, Any]:
-    record = _load_record(cluster_name)
-    if not record:
-        raise exceptions.ClusterError(
-            f'No Lambda provisioning record for {cluster_name!r}')
-    return record
-
-
-def _rank_of(instance: Dict[str, Any], name: str) -> Optional[int]:
-    """Rank from an instance name ``{name}-r{rank}``; None if foreign."""
-    iname = instance.get('name') or ''
-    prefix = f'{name}-r'
-    if not iname.startswith(prefix):
-        return None
-    suffix = iname[len(prefix):]
-    return int(suffix) if suffix.isdigit() else None
+# Cluster bookkeeping + rank decoding via the shared REST-cloud
+# scaffolding (rest_cloud.py).
+_records = rest_cloud.ClusterRecords('lambda_cluster')
 
 
 def _live_instances(client, name: str,
@@ -94,7 +61,7 @@ def _live_instances(client, name: str,
     failed-over region must not be adopted into the current gang."""
     out: Dict[int, Dict[str, Any]] = {}
     for inst in lambda_api.call(client, 'list_instances'):
-        rank = _rank_of(inst, name)
+        rank = rest_cloud.rank_of(inst.get('name') or '', name)
         if rank is None:
             continue
         if inst.get('status') in ('terminated', 'terminating'):
@@ -137,7 +104,7 @@ def run_instances(cluster_name: str, region: str, zone: Optional[str],
               'num_hosts': num_hosts, 'deploy_vars': deploy_vars}
     # Record BEFORE creating (partial-failure resources must stay
     # reachable by terminate_instances; same contract as provision/gcp.py).
-    _save_record(cluster_name, record)
+    _records.save(cluster_name, record)
     client = lambda_api.get_client()
     try:
         key_name = _ensure_ssh_key(client)
@@ -163,7 +130,7 @@ def run_instances(cluster_name: str, region: str, zone: Optional[str],
         except exceptions.CloudError:
             pass
         else:
-            _delete_record(cluster_name)
+            _records.delete(cluster_name)
         raise
 
 
@@ -172,21 +139,9 @@ def wait_instances(cluster_name: str, region: str, state: str = 'running',
     if state != 'running':
         raise exceptions.NotSupportedError(
             'Lambda Cloud cannot stop instances (terminate-only).')
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        states = set(query_instances(cluster_name, region).values())
-        if states == {state}:
-            return
-        if (not states or 'terminating' in states
-                or 'terminated' in states):
-            # A rank hole (instance died while booting) must fail over,
-            # not wait out the timeout (parity with aws/azure).
-            raise exceptions.InsufficientCapacityError(
-                f'{cluster_name}: instance(s) disappeared while waiting '
-                f'for {state}', reason='capacity')
-        time.sleep(5)
-    raise exceptions.ProvisionError(
-        f'{cluster_name} did not reach {state!r} within {timeout}s')
+    rest_cloud.poll_for_state(
+        cluster_name, lambda: query_instances(cluster_name, region),
+        state, timeout)
 
 
 def query_instances(cluster_name: str, region: str) -> Dict[str, str]:
@@ -194,7 +149,7 @@ def query_instances(cluster_name: str, region: str) -> Dict[str, str]:
     as 'terminated'; a fully-dead cluster returns {} ("terminated
     cluster" contract in core.py)."""
     del region
-    record = _load_record(cluster_name)
+    record = _records.load(cluster_name)
     if not record:
         return {}
     client = lambda_api.get_client()
@@ -227,20 +182,20 @@ def _terminate_all(client, name: str) -> None:
 
 def terminate_instances(cluster_name: str, region: str) -> None:
     del region
-    record = _load_record(cluster_name)
+    record = _records.load(cluster_name)
     if not record:
         return
     client = lambda_api.get_client()
     _terminate_all(client, record['name_on_cloud'])
     # Account-global firewall rules are left in place deliberately
     # (other clusters may use them; reference instance.py:330-351).
-    _delete_record(cluster_name)
+    _records.delete(cluster_name)
 
 
 def get_cluster_info(cluster_name: str,
                      region: str) -> provision_lib.ClusterInfo:
     del region
-    record = _require_record(cluster_name)
+    record = _records.require(cluster_name, 'Lambda')
     client = lambda_api.get_client()
     live = _live_instances(client, record['name_on_cloud'],
                            record.get('region'))
@@ -276,7 +231,7 @@ def open_ports(cluster_name: str, region: str, ports: List[str]) -> None:
     Idempotent: already-open port ranges are skipped."""
     if not ports:
         return
-    record = _require_record(cluster_name)
+    record = _records.require(cluster_name, 'Lambda')
     if record['region'] in _NO_FIREWALL_REGIONS:
         import logging
         logging.getLogger(__name__).warning(
@@ -320,13 +275,4 @@ def open_ports(cluster_name: str, region: str, ports: List[str]) -> None:
 def get_command_runners(cluster_info: provision_lib.ClusterInfo,
                         ssh_credentials: Optional[Dict[str, str]] = None
                         ) -> List[runner_lib.CommandRunner]:
-    creds = ssh_credentials or {}
-    key_path = creds.get('key_path')
-    if key_path is None:
-        key_path, _ = authentication.get_or_generate_keys()
-    user = creds.get('user', SSH_USER)
-    runners: List[runner_lib.CommandRunner] = []
-    for h in cluster_info.hosts:
-        ip = h.external_ip or h.internal_ip
-        runners.append(runner_lib.SSHCommandRunner(ip, user, key_path))
-    return runners
+    return rest_cloud.ssh_runners(cluster_info, SSH_USER, ssh_credentials)

@@ -1,0 +1,156 @@
+"""Shared scaffolding for the REST-API clouds (Lambda, DO, FluidStack…).
+
+These providers share a shape the hyperscaler clouds don't: a flat
+account-global JSON REST API (no SDK), name- or tag-encoded cluster
+membership, and a client-side kv record as the only durable pointer.
+The pieces every such provisioner needs live here ONCE so an invariant
+fixed in one cloud (e.g. "never adopt an instance from a failed-over
+region") cannot silently be lost in the next copy:
+
+- :class:`ClusterRecords` — the kv bookkeeping contract (save BEFORE
+  create; keep the record when cleanup fails so terminate can retry);
+- :func:`rank_of` — stateless ``{name}-r{rank}`` rank decoding;
+- :func:`poll_for_state` — the wait loop with rank-hole-as-capacity
+  semantics (a dead gang must fail over, not wait out the timeout);
+- :func:`ssh_runners` — per-host SSHCommandRunner construction;
+- :func:`retrying_request` — urllib transport with 429 backoff.
+
+Per-cloud error *classification* stays in each ``<cloud>_api`` module:
+the marker strings and status shapes genuinely differ per provider.
+"""
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Callable, Dict, List, Optional
+
+from skypilot_tpu import authentication
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu.utils import command_runner as runner_lib
+
+
+class ClusterRecords:
+    """Client-side kv record per cluster (region, name-on-cloud,
+    num_hosts, deploy_vars). The record is written BEFORE any create
+    call so partially-created resources stay reachable by
+    terminate_instances (contract shared with provision/gcp.py)."""
+
+    def __init__(self, prefix: str):
+        self._prefix = prefix
+
+    def _key(self, cluster_name: str) -> str:
+        return f'{self._prefix}/{cluster_name}'
+
+    def save(self, cluster_name: str, record: Dict[str, Any]) -> None:
+        global_user_state.set_kv(self._key(cluster_name),
+                                 json.dumps(record))
+
+    def load(self, cluster_name: str) -> Optional[Dict[str, Any]]:
+        raw = global_user_state.get_kv(self._key(cluster_name))
+        return json.loads(raw) if raw else None
+
+    def delete(self, cluster_name: str) -> None:
+        global_user_state.set_kv(self._key(cluster_name), '')
+
+    def require(self, cluster_name: str,
+                cloud_repr: str) -> Dict[str, Any]:
+        record = self.load(cluster_name)
+        if not record:
+            raise exceptions.ClusterError(
+                f'No {cloud_repr} provisioning record for '
+                f'{cluster_name!r}')
+        return record
+
+
+def rank_of(instance_name: str, name_on_cloud: str) -> Optional[int]:
+    """Rank from ``{name_on_cloud}-r{rank}``; None if foreign."""
+    prefix = f'{name_on_cloud}-r'
+    if not instance_name.startswith(prefix):
+        return None
+    suffix = instance_name[len(prefix):]
+    return int(suffix) if suffix.isdigit() else None
+
+
+def poll_for_state(cluster_name: str,
+                   query: Callable[[], Dict[str, str]],
+                   state: str,
+                   timeout: float,
+                   interval: float = 5.0,
+                   extra_check: Optional[
+                       Callable[[set], Optional[Exception]]] = None
+                   ) -> None:
+    """Poll ``query()`` until every host reports ``state``.
+
+    A rank hole ('terminated' in the states, reported by the shared
+    query contract for missing ranks) or a fully-vanished cluster
+    raises InsufficientCapacityError so the provisioner fails over
+    instead of waiting out the timeout. ``extra_check(states)`` lets a
+    cloud add its own mid-wait hazard (e.g. Azure's spot-deallocation
+    detection) by returning an exception to raise.
+    """
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        states = set(query().values())
+        if states == {state}:
+            return
+        if (not states or 'terminating' in states
+                or 'terminated' in states):
+            raise exceptions.InsufficientCapacityError(
+                f'{cluster_name}: host(s) disappeared while waiting for '
+                f'{state}', reason='capacity')
+        if extra_check is not None:
+            exc = extra_check(states)
+            if exc is not None:
+                raise exc
+        time.sleep(interval)
+    raise exceptions.ProvisionError(
+        f'{cluster_name} did not reach {state!r} within {timeout}s')
+
+
+def ssh_runners(cluster_info, default_user: str,
+                ssh_credentials: Optional[Dict[str, str]] = None
+                ) -> List[runner_lib.CommandRunner]:
+    """One SSHCommandRunner per host, rank order (head first)."""
+    creds = ssh_credentials or {}
+    key_path = creds.get('key_path')
+    if key_path is None:
+        key_path, _ = authentication.get_or_generate_keys()
+    user = creds.get('user', default_user)
+    runners: List[runner_lib.CommandRunner] = []
+    for h in cluster_info.hosts:
+        ip = h.external_ip or h.internal_ip
+        runners.append(runner_lib.SSHCommandRunner(ip, user, key_path))
+    return runners
+
+
+def retrying_request(method: str, url: str, headers: Dict[str, str],
+                     payload: Optional[Dict[str, Any]],
+                     parse_error: Callable[[int, bytes], Exception],
+                     max_attempts: int = 6,
+                     timeout: float = 60.0) -> Any:
+    """One urllib call with 429 backoff. ``parse_error(status, body)``
+    builds the cloud's typed API error from a failure response (each
+    provider has its own error envelope)."""
+    data = json.dumps(payload).encode() if payload is not None else None
+    backoff = 5.0
+    for attempt in range(max_attempts):
+        req = urllib.request.Request(url, data=data, method=method,
+                                     headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                body = resp.read().decode()
+                return json.loads(body) if body else {}
+        except urllib.error.HTTPError as e:
+            if e.code == 429 and attempt < max_attempts - 1:
+                time.sleep(backoff)  # rate limited: retry with backoff
+                backoff = min(backoff * 2, 60)
+                continue
+            try:
+                raw = e.read()
+            except Exception:  # noqa: BLE001 — body read is best-effort
+                raw = b''
+            raise parse_error(e.code, raw) from e
+    raise parse_error(429, b'rate limited after retries')

@@ -332,3 +332,93 @@ def test_lambda_fetcher_live_override(tmp_path, monkeypatch):
     # transient zero-capacity reading.
     assert len(h100) == len(
         fetch_lambda._INSTANCE_TYPES['gpu_1x_h100_pcie'][3])
+
+
+def test_committed_do_catalog_matches_regeneration(tmp_path, monkeypatch):
+    """Same drift guard as the other clouds: do_vms.csv must equal the
+    offline fetcher output."""
+    import csv as csv_lib
+    import os
+    from skypilot_tpu.catalog.fetchers import fetch_do
+
+    monkeypatch.setattr(fetch_do, 'DATA_DIR', str(tmp_path))
+    assert fetch_do.refresh(online=False) == 'offline'
+    committed_path = os.path.join(
+        os.path.dirname(os.path.abspath(fetch_do.__file__)), '..',
+        'data', 'do_vms.csv')
+    committed = open(committed_path).read()
+    assert committed == (tmp_path / 'do_vms.csv').read_text(), (
+        'do_vms.csv drifted from the fetcher: run '
+        'python -m skypilot_tpu.catalog.fetchers.fetch_do')
+    rows = list(csv_lib.DictReader(open(tmp_path / 'do_vms.csv')))
+    s2 = [r for r in rows if r['instance_type'] == 's-2vcpu-4gb'
+          and r['region'] == 'nyc3'][0]
+    assert float(s2['price']) == 0.036
+    assert s2['spot_price'] == s2['price']  # no spot market
+
+
+def test_do_fetcher_live_override(tmp_path, monkeypatch):
+    """Live /v2/sizes payloads replace the static table; unavailable
+    sizes are dropped."""
+    from skypilot_tpu.catalog.fetchers import fetch_do
+
+    live = [
+        {'slug': 's-2vcpu-4gb', 'vcpus': 2, 'memory': 4096,
+         'price_hourly': 0.04, 'regions': ['nyc3', 'tor1'],
+         'available': True},
+        {'slug': 'c-4', 'vcpus': 4, 'memory': 8192,
+         'price_hourly': 0.125, 'regions': ['nyc3'],
+         'available': False},  # sold/retired: dropped
+    ]
+    monkeypatch.setattr(fetch_do, 'DATA_DIR', str(tmp_path))
+    assert fetch_do.refresh(online=True,
+                            sizes_fetcher=lambda: live) == 'online'
+    import csv as csv_lib
+    rows = list(csv_lib.DictReader(open(tmp_path / 'do_vms.csv')))
+    assert {r['instance_type'] for r in rows} == {'s-2vcpu-4gb'}
+    assert sorted(r['region'] for r in rows) == ['nyc3', 'tor1']
+    assert float(rows[0]['price']) == 0.04
+    assert float(rows[0]['memory_gb']) == 4.0
+
+
+def test_committed_fluidstack_catalog_matches_regeneration(tmp_path,
+                                                           monkeypatch):
+    """Drift guard: fluidstack_vms.csv must equal the offline fetcher
+    output."""
+    import csv as csv_lib
+    import os
+    from skypilot_tpu.catalog.fetchers import fetch_fluidstack
+
+    monkeypatch.setattr(fetch_fluidstack, 'DATA_DIR', str(tmp_path))
+    assert fetch_fluidstack.refresh(online=False) == 'offline'
+    committed_path = os.path.join(
+        os.path.dirname(os.path.abspath(fetch_fluidstack.__file__)), '..',
+        'data', 'fluidstack_vms.csv')
+    committed = open(committed_path).read()
+    assert committed == (tmp_path / 'fluidstack_vms.csv').read_text(), (
+        'fluidstack_vms.csv drifted from the fetcher: run '
+        'python -m skypilot_tpu.catalog.fetchers.fetch_fluidstack')
+    rows = list(csv_lib.DictReader(open(tmp_path / 'fluidstack_vms.csv')))
+    a100x8 = [r for r in rows if r['instance_type'] == 'A100_80G::8'
+              and r['region'] == 'NORWAY_4'][0]
+    # Per-GPU pricing scales linearly with the plan's GPU count.
+    assert float(a100x8['price']) == pytest.approx(8 * 1.49)
+    assert int(a100x8['vcpus']) == 8 * 12
+
+
+def test_fluidstack_fetcher_live_override(tmp_path, monkeypatch):
+    """Live plans replace the static table."""
+    from skypilot_tpu.catalog.fetchers import fetch_fluidstack
+
+    live = [{'gpu_type': 'B200', 'gpu_counts': [4],
+             'price_per_gpu_hr': 4.99, 'cpus_per_gpu': 24,
+             'memory_gb_per_gpu': 256, 'regions': ['TEXAS_1']}]
+    monkeypatch.setattr(fetch_fluidstack, 'DATA_DIR', str(tmp_path))
+    assert fetch_fluidstack.refresh(
+        online=True, plans_fetcher=lambda: live) == 'online'
+    import csv as csv_lib
+    rows = list(csv_lib.DictReader(
+        open(tmp_path / 'fluidstack_vms.csv')))
+    assert len(rows) == 1
+    assert rows[0]['instance_type'] == 'B200::4'
+    assert float(rows[0]['price']) == pytest.approx(4 * 4.99)

@@ -1,0 +1,312 @@
+"""DigitalOcean provisioner tests against an in-process fake client.
+
+The fake implements the flat client surface the provisioner calls
+(create_droplet / list_droplets / droplet_action / firewalls / ssh
+keys), including per-region capacity failures — so the tag-scoped
+lifecycle, power_off/power_on stop-start, per-cluster firewall object,
+and failover logic run for real with no cloud and no network (same seam
+pattern as test_lambda_provision / test_azure_provision).
+"""
+import itertools
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import exceptions
+from skypilot_tpu.backends.slice_backend import RetryingProvisioner
+from skypilot_tpu.provision import do_api
+from skypilot_tpu.provision import do_impl
+
+
+class FakeDO:
+    """In-memory DigitalOcean account (v2 API is account-global)."""
+
+    def __init__(self):
+        self.droplets = {}       # id -> droplet dict
+        self.ssh_keys = []       # [{id, name, public_key}]
+        self.firewalls = {}      # id -> firewall dict
+        self.fail_regions = set()
+        self.quota_error = False
+        self.create_calls = []
+        self._ids = itertools.count(1000)
+
+    # -- flat client surface -------------------------------------------------
+    def create_droplet(self, name, region, size, image, ssh_key_ids,
+                       tags, user_data=None):
+        self.create_calls.append((region, name))
+        if self.quota_error:
+            raise do_api.DoApiError(
+                422, 'creating this droplet will exceed your droplet '
+                'limit')
+        if region in self.fail_regions:
+            raise do_api.DoApiError(
+                422, f'{size} is currently unavailable in {region}')
+        n = next(self._ids)
+        d = {
+            'id': n, 'name': name, 'status': 'active',
+            'region': {'slug': region}, 'size_slug': size,
+            'image': {'slug': image}, 'tags': list(tags),
+            'networks': {'v4': [
+                {'type': 'public', 'ip_address': f'164.90.0.{n % 250}'},
+                {'type': 'private', 'ip_address': f'10.17.0.{n % 250}'},
+            ]},
+        }
+        self.droplets[n] = d
+        return dict(d)
+
+    def list_droplets(self, tag=None):
+        out = []
+        for d in self.droplets.values():
+            if tag is not None and tag not in d['tags']:
+                continue
+            out.append(dict(d))
+        return out
+
+    def droplet_action(self, droplet_id, action):
+        d = self.droplets[droplet_id]
+        if action == 'power_off':
+            d['status'] = 'off'
+        elif action == 'power_on':
+            d['status'] = 'active'
+        else:
+            raise do_api.DoApiError(422, f'unknown action {action}')
+
+    def delete_droplet(self, droplet_id):
+        self.droplets.pop(droplet_id, None)
+
+    def list_ssh_keys(self):
+        return [dict(k) for k in self.ssh_keys]
+
+    def register_ssh_key(self, name, public_key):
+        key = {'id': next(self._ids), 'name': name,
+               'public_key': public_key}
+        self.ssh_keys.append(key)
+        return dict(key)
+
+    def list_firewalls(self):
+        return [dict(f) for f in self.firewalls.values()]
+
+    def create_firewall(self, name, inbound_rules, tags):
+        fid = f'fw-{next(self._ids)}'
+        self.firewalls[fid] = {
+            'id': fid, 'name': name,
+            'inbound_rules': [dict(r) for r in inbound_rules],
+            'outbound_rules': [], 'tags': list(tags),
+        }
+        return dict(self.firewalls[fid])
+
+    def update_firewall(self, firewall_id, body):
+        fw = self.firewalls[firewall_id]
+        fw.update({k: v for k, v in body.items()})
+
+    def delete_firewall(self, firewall_id):
+        self.firewalls.pop(firewall_id, None)
+
+
+@pytest.fixture
+def fake_do(monkeypatch, tmp_path):
+    account = FakeDO()
+    do_api.set_do_factory(lambda: account)
+    monkeypatch.setenv('SKYTPU_FAKE_DO_CREDENTIALS', '1')
+    priv = tmp_path / 'key'
+    pub = tmp_path / 'key.pub'
+    priv.write_text('fake-private')
+    pub.write_text('ssh-ed25519 AAAA test')
+    monkeypatch.setattr('skypilot_tpu.authentication.get_or_generate_keys',
+                        lambda: (str(priv), str(pub)))
+    yield account
+    do_api.set_do_factory(None)
+
+
+def _deploy_vars(**over):
+    base = {
+        'cloud': 'do', 'mode': 'do_droplet',
+        'cluster_name_on_cloud': 'c-do1',
+        'instance_type': 's-2vcpu-4gb', 'image_id': None,
+        'disk_size_gb': 128, 'use_spot': False, 'labels': {}, 'ports': [],
+    }
+    base.update(over)
+    return base
+
+
+class TestLifecycle:
+
+    def test_create_query_info_stop_start_terminate(self, fake_do):
+        dv = _deploy_vars()
+        do_impl.run_instances('d1', 'nyc3', None, 2, dv)
+        do_impl.wait_instances('d1', 'nyc3', timeout=5)
+        states = do_impl.query_instances('d1', 'nyc3')
+        assert set(states.values()) == {'running'} and len(states) == 2
+
+        info = do_impl.get_cluster_info('d1', 'nyc3')
+        assert info.num_hosts == 2
+        assert [h.rank for h in info.hosts] == [0, 1]
+        assert info.head.internal_ip.startswith('10.17.')
+        assert info.head.external_ip.startswith('164.')
+
+        do_impl.stop_instances('d1', 'nyc3')
+        assert set(do_impl.query_instances(
+            'd1', 'nyc3').values()) == {'stopped'}
+        assert all(d['status'] == 'off'
+                   for d in fake_do.droplets.values())
+
+        # run_instances on an off cluster powers it back on, creating
+        # nothing new.
+        n_before = len(fake_do.droplets)
+        do_impl.run_instances('d1', 'nyc3', None, 2, dv)
+        assert len(fake_do.droplets) == n_before
+        assert set(do_impl.query_instances(
+            'd1', 'nyc3').values()) == {'running'}
+
+        do_impl.terminate_instances('d1', 'nyc3')
+        assert do_impl.query_instances('d1', 'nyc3') == {}
+        assert fake_do.droplets == {}
+
+    def test_tag_scoped_discovery(self, fake_do):
+        # A droplet with the right NAME but no cluster tag (e.g. user's
+        # own droplet) is never adopted.
+        fake_do.create_droplet('c-do1-r0', 'nyc3', 's-2vcpu-4gb',
+                               'ubuntu-24-04-x64', [], ['user-owned'])
+        do_impl.run_instances('d2', 'nyc3', None, 1, _deploy_vars())
+        tagged = [d for d in fake_do.droplets.values()
+                  if 'skytpu-c-do1' in d['tags']]
+        assert len(tagged) == 1
+        info = do_impl.get_cluster_info('d2', 'nyc3')
+        assert info.num_hosts == 1
+        assert info.head.host_id == str(tagged[0]['id'])
+
+    def test_partial_loss_reports_terminated_rank(self, fake_do):
+        do_impl.run_instances('d3', 'nyc3', None, 2, _deploy_vars())
+        victim = next(i for i, d in fake_do.droplets.items()
+                      if d['name'].endswith('-r1'))
+        fake_do.droplets.pop(victim)
+        states = do_impl.query_instances('d3', 'nyc3')
+        assert states.get('rank1-missing') == 'terminated'
+
+    def test_ssh_key_registered_once(self, fake_do):
+        do_impl.run_instances('d4', 'nyc3', None, 1, _deploy_vars())
+        do_impl.terminate_instances('d4', 'nyc3')
+        do_impl.run_instances('d4', 'nyc3', None, 1, _deploy_vars())
+        assert len(fake_do.ssh_keys) == 1
+
+
+class TestOpenPorts:
+
+    def test_firewall_created_updated_and_deleted(self, fake_do):
+        do_impl.run_instances('p1', 'nyc3', None, 1, _deploy_vars())
+        do_impl.open_ports('p1', 'nyc3', ['8080'])
+        assert len(fake_do.firewalls) == 1
+        fw = next(iter(fake_do.firewalls.values()))
+        ports = {r['ports'] for r in fw['inbound_rules']}
+        assert ports == {'22', '8080'}  # ssh always kept reachable
+        assert fw['tags'] == ['skytpu-c-do1']
+
+        do_impl.open_ports('p1', 'nyc3', ['8080'])  # idempotent
+        do_impl.open_ports('p1', 'nyc3', ['9000-9010'])
+        assert len(fake_do.firewalls) == 1
+        fw = next(iter(fake_do.firewalls.values()))
+        ports = {r['ports'] for r in fw['inbound_rules']}
+        assert ports == {'22', '8080', '9000-9010'}
+
+        # Cluster-scoped firewall object: deleted on terminate (unlike
+        # Lambda's account-global rules).
+        do_impl.terminate_instances('p1', 'nyc3')
+        assert fake_do.firewalls == {}
+
+    def test_tightened_source_ranges_reapply(self, fake_do):
+        from skypilot_tpu import config as config_lib
+        do_impl.run_instances('p2', 'nyc3', None, 1, _deploy_vars())
+        do_impl.open_ports('p2', 'nyc3', ['8080'])
+        with config_lib.override(
+                {'do': {'firewall_source_ranges': ['10.0.0.0/8']}}):
+            do_impl.open_ports('p2', 'nyc3', ['8080'])
+        fw = next(iter(fake_do.firewalls.values()))
+        rule = next(r for r in fw['inbound_rules']
+                    if r['ports'] == '8080')
+        assert rule['sources']['addresses'] == ['10.0.0.0/8']
+
+
+class TestFailover:
+
+    def _task(self, *regions):
+        task = sky.Task(run='echo x')
+        rs = [sky.Resources(cloud='do', instance_type='s-2vcpu-4gb',
+                            region=r) for r in regions]
+        task.set_resources([rs[0]])
+        task.best_resources = rs[0]
+        task.candidate_resources = rs
+        return task
+
+    def test_capacity_error_fails_over_to_next_region(self, fake_do):
+        fake_do.fail_regions.add('nyc3')
+        launched, info = RetryingProvisioner().provision(
+            self._task('nyc3', 'sfo3'), 'do-fo')
+        assert launched.region == 'sfo3'
+        assert info.num_hosts == 1
+        live_regions = {d['region']['slug']
+                        for d in fake_do.droplets.values()}
+        assert live_regions == {'sfo3'}
+
+    def test_partial_gang_capacity_cleans_up(self, fake_do):
+        real_create = fake_do.create_droplet
+
+        def flaky_create(name, region, size, image, ssh_key_ids, tags,
+                         user_data=None):
+            if name.endswith('-r1'):
+                raise do_api.DoApiError(
+                    422, f'{size} is currently unavailable in {region}')
+            return real_create(name, region, size, image, ssh_key_ids,
+                               tags, user_data)
+        fake_do.create_droplet = flaky_create
+        with pytest.raises(exceptions.InsufficientCapacityError):
+            do_impl.run_instances('do-fo2', 'nyc3', None, 2,
+                                  _deploy_vars())
+        assert fake_do.droplets == {}
+
+    def test_quota_error_is_not_capacity(self, fake_do):
+        fake_do.quota_error = True
+        err = None
+        try:
+            do_api.call(fake_do, 'create_droplet', name='x-r0',
+                        region='nyc3', size='s-2vcpu-4gb',
+                        image='ubuntu-24-04-x64', ssh_key_ids=[],
+                        tags=[])
+        except exceptions.CloudError as e:
+            err = e
+        assert err is not None
+        assert not isinstance(err, exceptions.InsufficientCapacityError)
+        assert err.reason == 'quota'
+
+
+class TestCloudClass:
+
+    def test_feasibility_defaults_and_catalog(self, fake_do):
+        cloud = sky.clouds.get_cloud('do')
+        feas = cloud.get_feasible_resources(sky.Resources(cloud='do'))
+        assert feas.resources, feas.hint
+        assert feas.resources[0].instance_type is not None
+        regions = cloud.regions_for(feas.resources[0])
+        assert 'nyc3' in regions
+
+    def test_spot_and_tpu_are_infeasible(self, fake_do):
+        cloud = sky.clouds.get_cloud('do')
+        spot = cloud.get_feasible_resources(
+            sky.Resources(cloud='do', use_spot=True))
+        assert spot.resources == [] and 'spot' in spot.hint
+        tpu = cloud.get_feasible_resources(
+            sky.Resources(accelerators='tpu-v5e-8'))
+        assert tpu.resources == []
+
+    def test_stop_feature_supported(self, fake_do):
+        from skypilot_tpu import clouds as clouds_lib
+        cloud = sky.clouds.get_cloud('do')
+        assert cloud.supports(clouds_lib.CloudFeature.STOP)
+
+    def test_optimizer_places_pinned_do_task(self, fake_do):
+        from skypilot_tpu import optimizer
+        task = sky.Task(run='echo x')
+        task.set_resources([sky.Resources(cloud='do', cpus='2+')])
+        optimizer.optimize(task, quiet=True)
+        res = task.best_resources
+        assert res.cloud == 'do'
+        assert res.instance_type == 's-2vcpu-4gb'  # cheapest >=2 vcpus

@@ -1,0 +1,191 @@
+"""FluidStack provisioner tests against an in-process fake client.
+
+The fake implements the flat client surface (create_instance /
+list_instances / delete_instance / list_plans / ssh keys), including
+plan stock — so the stock-check-before-launch capacity path, the
+terminate-only lifecycle, and the no-ports feature gate run for real
+with no cloud and no network.
+"""
+import itertools
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import exceptions
+from skypilot_tpu.backends.slice_backend import RetryingProvisioner
+from skypilot_tpu.provision import fluidstack_api
+from skypilot_tpu.provision import fluidstack_impl
+
+
+class FakeFluidstack:
+    """In-memory FluidStack account."""
+
+    def __init__(self):
+        self.instances = {}
+        self.ssh_keys = []
+        self.plans = [
+            {'gpu_type': 'A100_80G', 'gpu_counts': [1, 2, 4, 8],
+             'price_per_gpu_hr': 1.49,
+             'regions': ['NORWAY_4', 'CANADA_1', 'ARIZONA_1']},
+            {'gpu_type': 'H100', 'gpu_counts': [8],
+             'price_per_gpu_hr': 2.89, 'regions': ['NORWAY_4']},
+        ]
+        self.create_calls = []
+        self._ids = itertools.count(1)
+
+    def create_instance(self, gpu_type, gpu_count, region, name,
+                        ssh_key_name):
+        self.create_calls.append((region, name))
+        n = next(self._ids)
+        iid = f'fs-{n:04d}'
+        self.instances[iid] = {
+            'id': iid, 'name': name, 'status': 'running',
+            'region': region, 'gpu_type': gpu_type,
+            'gpu_count': gpu_count,
+            'ip_address': f'185.12.0.{n + 10}',
+            'private_ip': f'10.23.0.{n + 10}',
+        }
+        return iid
+
+    def list_instances(self):
+        return [dict(i) for i in self.instances.values()
+                if i['status'] != 'terminated']
+
+    def delete_instance(self, instance_id):
+        if instance_id in self.instances:
+            self.instances[instance_id]['status'] = 'terminated'
+
+    def list_plans(self):
+        return [dict(p) for p in self.plans]
+
+    def list_ssh_keys(self):
+        return [dict(k) for k in self.ssh_keys]
+
+    def register_ssh_key(self, name, public_key):
+        self.ssh_keys.append({'name': name, 'public_key': public_key})
+
+
+@pytest.fixture
+def fake_fluidstack(monkeypatch, tmp_path):
+    account = FakeFluidstack()
+    fluidstack_api.set_fluidstack_factory(lambda: account)
+    monkeypatch.setenv('SKYTPU_FAKE_FLUIDSTACK_CREDENTIALS', '1')
+    priv = tmp_path / 'key'
+    pub = tmp_path / 'key.pub'
+    priv.write_text('fake-private')
+    pub.write_text('ssh-ed25519 AAAA test')
+    monkeypatch.setattr('skypilot_tpu.authentication.get_or_generate_keys',
+                        lambda: (str(priv), str(pub)))
+    yield account
+    fluidstack_api.set_fluidstack_factory(None)
+
+
+def _deploy_vars(**over):
+    base = {
+        'cloud': 'fluidstack', 'mode': 'fluidstack_vm',
+        'cluster_name_on_cloud': 'c-fs1',
+        'instance_type': 'A100_80G::1', 'image_id': None,
+        'disk_size_gb': 128, 'use_spot': False, 'labels': {}, 'ports': [],
+    }
+    base.update(over)
+    return base
+
+
+class TestLifecycle:
+
+    def test_create_query_info_terminate(self, fake_fluidstack):
+        dv = _deploy_vars()
+        fluidstack_impl.run_instances('f1', 'NORWAY_4', None, 2, dv)
+        fluidstack_impl.wait_instances('f1', 'NORWAY_4', timeout=5)
+        states = fluidstack_impl.query_instances('f1', 'NORWAY_4')
+        assert set(states.values()) == {'running'} and len(states) == 2
+
+        info = fluidstack_impl.get_cluster_info('f1', 'NORWAY_4')
+        assert info.num_hosts == 2
+        assert [h.rank for h in info.hosts] == [0, 1]
+        assert info.head.internal_ip.startswith('10.23.')
+
+        fluidstack_impl.terminate_instances('f1', 'NORWAY_4')
+        assert fluidstack_impl.query_instances('f1', 'NORWAY_4') == {}
+
+    def test_stop_is_not_supported(self, fake_fluidstack):
+        fluidstack_impl.run_instances('f2', 'NORWAY_4', None, 1,
+                                      _deploy_vars())
+        with pytest.raises(exceptions.NotSupportedError):
+            fluidstack_impl.stop_instances('f2', 'NORWAY_4')
+
+    def test_sold_out_plan_is_capacity_without_launch_call(
+            self, fake_fluidstack):
+        # H100 is only stocked in NORWAY_4: CANADA_1 classifies as
+        # capacity BEFORE any create call is burned.
+        with pytest.raises(exceptions.InsufficientCapacityError):
+            fluidstack_impl.run_instances(
+                'f3', 'CANADA_1', None, 1,
+                _deploy_vars(instance_type='H100::8'))
+        assert fake_fluidstack.create_calls == []
+
+    def test_partial_loss_reports_terminated_rank(self, fake_fluidstack):
+        fluidstack_impl.run_instances('f4', 'NORWAY_4', None, 2,
+                                      _deploy_vars())
+        victim = next(i for i in fake_fluidstack.instances.values()
+                      if i['name'].endswith('-r1'))
+        victim['status'] = 'terminated'
+        states = fluidstack_impl.query_instances('f4', 'NORWAY_4')
+        assert states.get('rank1-missing') == 'terminated'
+
+
+class TestFailover:
+
+    def _task(self, *regions):
+        task = sky.Task(run='echo x')
+        rs = [sky.Resources(cloud='fluidstack',
+                            instance_type='A100_80G::1',
+                            region=r) for r in regions]
+        task.set_resources([rs[0]])
+        task.best_resources = rs[0]
+        task.candidate_resources = rs
+        return task
+
+    def test_stock_failover_to_next_region(self, fake_fluidstack):
+        # Remove NORWAY_4 from A100 stock: provisioner fails over.
+        fake_fluidstack.plans[0]['regions'] = ['CANADA_1']
+        launched, info = RetryingProvisioner().provision(
+            self._task('NORWAY_4', 'CANADA_1'), 'fs-fo')
+        assert launched.region == 'CANADA_1'
+        assert info.num_hosts == 1
+        live_regions = {i['region']
+                        for i in fake_fluidstack.instances.values()
+                        if i['status'] == 'running'}
+        assert live_regions == {'CANADA_1'}
+
+
+class TestCloudClass:
+
+    def test_feasibility_and_plan_catalog(self, fake_fluidstack):
+        cloud = sky.clouds.get_cloud('fluidstack')
+        feas = cloud.get_feasible_resources(
+            sky.Resources(cloud='fluidstack', cpus='8+'))
+        assert feas.resources, feas.hint
+        assert '::' in feas.resources[0].instance_type
+
+    def test_ports_are_infeasible(self, fake_fluidstack):
+        # No firewall API: a task needing open ports is refused at
+        # feasibility time, and the feature gate backs it up.
+        from skypilot_tpu import clouds as clouds_lib
+        cloud = sky.clouds.get_cloud('fluidstack')
+        feas = cloud.get_feasible_resources(
+            sky.Resources(cloud='fluidstack', ports=['8080']))
+        assert feas.resources == [] and 'port' in feas.hint
+        assert not cloud.supports(clouds_lib.CloudFeature.OPEN_PORTS)
+        assert not cloud.supports(clouds_lib.CloudFeature.STOP)
+
+    def test_optimizer_places_pinned_fluidstack_task(self,
+                                                     fake_fluidstack):
+        from skypilot_tpu import optimizer
+        task = sky.Task(run='echo x')
+        task.set_resources([sky.Resources(cloud='fluidstack',
+                                          cpus='8+')])
+        optimizer.optimize(task, quiet=True)
+        res = task.best_resources
+        assert res.cloud == 'fluidstack'
+        assert res.instance_type == 'RTX_A6000::1'  # cheapest >=8 vcpus

@@ -183,13 +183,19 @@ def run(*, preset: str = 'llama-1b', batch_slots: int = 16,
         for i in range(max(1, warmup_requests)):
             tokens = [rnd.randrange(config.vocab_size)
                       for _ in range(prompt_len)]
+            # Last warmup request goes through the STREAMING path — the
+            # sweep measures streaming, so its first-hit costs (chunked
+            # response plumbing, emitter flush cadence) must be paid
+            # here, not inside the first measured window.
+            stream = i == max(1, warmup_requests) - 1
             for attempt in range(30):
                 if time.time() > warm_deadline:
                     raise TimeoutError('serve warmup never completed '
                                        '(chip wedged or replica hung)')
                 try:
                     with _post_generate(endpoint, tokens,
-                                        min(output_len, 16), stream=False,
+                                        min(output_len, 16),
+                                        stream=stream,
                                         timeout=180) as resp:
                         resp.read()
                     warmed = True
@@ -204,6 +210,22 @@ def run(*, preset: str = 'llama-1b', batch_slots: int = 16,
             print('serve bench WARNING: warmup exhausted all attempts '
                   'without a successful request; sweep numbers include '
                   'compile time', file=sys.stderr)
+
+        if warmed and concurrencies:
+            # Discarded burn-in at the first sweep's concurrency: the
+            # r5 full run showed the FIRST measured window absorbing
+            # one-time costs the single-request warmup can't reach
+            # (per-client LB connections, admission queue filling to
+            # steady state) — c24-first read TTFT p50 3.0s + 2 errors
+            # while c48-second read 2.2s + 0. ~15s of load washes that
+            # out of the measured numbers.
+            burn = drive_load(endpoint, vocab_size=config.vocab_size,
+                              prompt_len=prompt_len,
+                              output_len=output_len,
+                              concurrency=concurrencies[0],
+                              window_s=15.0, seed=999)
+            print(f'serve bench burn-in (discarded): {burn}',
+                  file=sys.stderr)
 
         sweep = []
         for conc in concurrencies:

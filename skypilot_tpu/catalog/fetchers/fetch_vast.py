@@ -1,0 +1,134 @@
+"""Generate the Vast.ai catalog CSV (vast_vms.csv).
+
+The marketplace has no price list — every host names its own rate — so
+catalog rows are MEDIAN observed prices per synthetic plan
+(``{n}x_{GPU_NAME}``, the same invention as the reference's
+vast_catalog.py) and per country code. Two sources, merged:
+
+1. **Offer search** (``refresh(online=True)``): samples live offers per
+   plan via the REST client and writes median dph_total / min_bid. An
+   ``offers_fetcher`` seam lets tests fake the API without network.
+2. **Static table** below (typical marketplace rates): the offline
+   fallback.
+
+``spot_price`` is the typical winning interruptible bid (~40% of
+on-demand — marketplace data, conservative).
+
+Run:  python -m skypilot_tpu.catalog.fetchers.fetch_vast [--online]
+"""
+from __future__ import annotations
+
+import os
+import statistics
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+DATA_DIR = os.path.join(_HERE, '..', 'data')
+
+_REGIONS = ('US', 'CA', 'DE', 'NL', 'SE')
+
+# plan -> (vcpus, memory_gb, median $/h). Typical marketplace medians.
+_PLANS: Dict[str, Tuple[int, float, float]] = {
+    '1x_RTX_3090': (8, 32, 0.22),
+    '1x_RTX_4090': (12, 64, 0.42),
+    '4x_RTX_4090': (48, 256, 1.68),
+    '1x_A100_SXM4': (16, 120, 0.95),
+    '8x_A100_SXM4': (128, 960, 7.60),
+    '8x_H100_SXM': (160, 1536, 18.40),
+}
+
+_SPOT_FRACTION = 0.4
+
+
+def fetch_offer_medians(
+        offers_fetcher: Optional[
+            Callable[[str, int, str], List[Dict[str, Any]]]] = None
+) -> Dict[Tuple[str, str], Tuple[float, float]]:
+    """(plan, region) -> (median dph_total, median min_bid) from live
+    offer samples. ``offers_fetcher(gpu_name, num_gpus, region)`` is the
+    test seam; the default uses the REST client."""
+    if offers_fetcher is None:
+        from skypilot_tpu.provision import vast_api
+        client = vast_api.get_client()
+
+        def offers_fetcher(gpu_name, num_gpus, region):  # noqa: F811
+            return client.search_offers(gpu_name=gpu_name,
+                                        num_gpus=num_gpus,
+                                        geolocation=region,
+                                        min_disk_gb=50)
+    from skypilot_tpu.provision import vast_impl
+    out: Dict[Tuple[str, str], Tuple[float, float]] = {}
+    for plan in _PLANS:
+        num_gpus, gpu_name = vast_impl.split_plan(plan)
+        for region in _REGIONS:
+            offers = offers_fetcher(gpu_name, num_gpus, region)
+            prices = [float(o['dph_total']) for o in offers
+                      if o.get('dph_total')]
+            bids = [float(o['min_bid']) for o in offers
+                    if o.get('min_bid')]
+            if prices:
+                out[(plan, region)] = (
+                    statistics.median(prices),
+                    statistics.median(bids) if bids
+                    else statistics.median(prices) * _SPOT_FRACTION)
+    return out
+
+
+def generate_vm_rows(
+        live: Optional[Dict[Tuple[str, str], Tuple[float, float]]] = None
+) -> List[Dict[str, object]]:
+    live = live or {}
+    rows: List[Dict[str, object]] = []
+    for plan, (vcpus, mem, base) in _PLANS.items():
+        for region in _REGIONS:
+            price, bid = live.get(
+                (plan, region), (base, round(base * _SPOT_FRACTION, 4)))
+            rows.append({
+                'instance_type': plan,
+                'vcpus': vcpus,
+                'memory_gb': mem,
+                'region': region,
+                'price': round(price, 4),
+                'spot_price': round(bid, 4),
+            })
+    return rows
+
+
+def refresh(online: bool = False,
+            offers_fetcher: Optional[
+                Callable[[str, int, str], List[Dict[str, Any]]]] = None
+            ) -> str:
+    """Regenerate vast_vms.csv; returns 'online'/'offline'/'stale'."""
+    live: Dict[Tuple[str, str], Tuple[float, float]] = {}
+    source = 'offline'
+    if online:
+        try:
+            live = fetch_offer_medians(offers_fetcher)
+            if live:
+                source = 'online'
+        except Exception as e:  # noqa: BLE001 — any failure = fallback
+            print(f'offer search unavailable ({type(e).__name__}: {e}); '
+                  'using static price table')
+    from skypilot_tpu.catalog.fetchers.fetch_gcp import write_csv
+    rows = generate_vm_rows(live)
+    try:
+        write_csv(os.path.join(DATA_DIR, 'vast_vms.csv'), rows)
+    except OSError as e:
+        print(f'catalog dir not writable ({e}); keeping existing CSV')
+        return 'stale'
+    print(f'Wrote {len(rows)} Vast plan rows to '
+          f'{os.path.normpath(DATA_DIR)} ({source})')
+    return source
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    import argparse
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--online', action='store_true',
+                        help='sample live offers for median prices')
+    args = parser.parse_args(argv)
+    refresh(online=args.online)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,132 @@
+"""Vast.ai: marketplace GPU instances (first REST cloud with SPOT).
+
+Counterpart of reference ``sky/clouds/vast.py``. ``use_spot`` maps to
+an interruptible bid on the marketplace; preemption (outbid / host
+reclaim) pauses the instance and is detected by the provisioner, so
+managed-jobs recovery works exactly as on GCP/AWS spot. Regions are
+two-letter country codes (the marketplace's only stable geography).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu.clouds import cloud as cloud_lib
+
+
+@cloud_lib.CLOUD_REGISTRY.register(name='vast')
+class Vast(cloud_lib.Cloud):
+    NAME = 'vast'
+    _FEATURES = frozenset({
+        cloud_lib.CloudFeature.STOP,
+        cloud_lib.CloudFeature.AUTOSTOP,
+        cloud_lib.CloudFeature.SPOT,       # interruptible bids
+        cloud_lib.CloudFeature.MULTI_HOST,
+        cloud_lib.CloudFeature.STORAGE_MOUNTS,
+        cloud_lib.CloudFeature.CUSTOM_IMAGES,  # any docker image
+    })
+
+    # ---- credentials ------------------------------------------------------
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        if os.environ.get('SKYTPU_FAKE_VAST_CREDENTIALS'):
+            return True, None
+        from skypilot_tpu.provision import vast_api
+        if vast_api.read_api_key() is not None:
+            return True, None
+        return False, ('Vast.ai credentials not found. Set $VAST_API_KEY '
+                       'or write the key to ~/.vast_api_key.')
+
+    @classmethod
+    def get_active_user_identity(cls) -> Optional[List[str]]:
+        if os.environ.get('SKYTPU_FAKE_VAST_CREDENTIALS'):
+            return ['fake-identity@vast.test']
+        from skypilot_tpu.provision import vast_api
+        key = vast_api.read_api_key()
+        return [f'vast-key-{key[:8]}'] if key else None
+
+    # ---- topology ---------------------------------------------------------
+    def regions_for(self, resources) -> List[str]:
+        if resources.tpu is not None:
+            return []  # no TPUs on the marketplace
+        itype = resources.instance_type or '1x_RTX_4090'
+        regions = catalog.get_vm_regions(itype, cloud=self.NAME)
+        if resources.region is not None:
+            regions = [r for r in regions if r == resources.region]
+        return regions
+
+    def zones_for(self, resources, region: str) -> List[Optional[str]]:
+        if resources.zone is not None:
+            return []  # no zones
+        return [None]
+
+    # ---- pricing ----------------------------------------------------------
+    def hourly_cost(self, resources, region=None, zone=None) -> float:
+        # Catalog prices are MEDIAN marketplace rates (the live offer
+        # price is only known at provision time); spot_price is the
+        # typical winning interruptible bid.
+        region = region or resources.region
+        assert resources.instance_type is not None, resources
+        return catalog.get_instance_hourly_cost(
+            resources.instance_type, resources.use_spot, region=region,
+            cloud=self.NAME)
+
+    def egress_cost_per_gb(self, dst_cloud: str, dst_region: str,
+                           src_region: Optional[str]) -> float:
+        return 0.0  # hosts set their own (usually zero) transfer rates
+
+    # ---- feasibility ------------------------------------------------------
+    def get_feasible_resources(self,
+                               resources) -> cloud_lib.FeasibleResources:
+        if resources.tpu is not None:
+            return cloud_lib.FeasibleResources(
+                [], hint='Vast.ai has no TPU accelerators; use '
+                         'cloud: gcp.')
+        if resources.ports:
+            return cloud_lib.FeasibleResources(
+                [], hint='Vast.ai exposes only host-mapped ports; tasks '
+                         'needing arbitrary open ports cannot run there.')
+        if resources.instance_type is not None:
+            if not catalog.get_vm_regions(resources.instance_type,
+                                          cloud=self.NAME):
+                return cloud_lib.FeasibleResources(
+                    [], hint=(f'{resources.instance_type} is not a Vast '
+                              'plan in the catalog (format: '
+                              '{n}x_{GPU_NAME}).'))
+            return cloud_lib.FeasibleResources(
+                [resources.copy(cloud=self.NAME)])
+        itype = catalog.get_default_instance_type(
+            cpus=resources._cpus, cpus_plus=resources._cpus_plus,  # pylint: disable=protected-access
+            memory=resources._memory, memory_plus=resources._memory_plus,  # pylint: disable=protected-access
+            region=resources.region, cloud=self.NAME)
+        if itype is None:
+            return cloud_lib.FeasibleResources(
+                [], hint=(f'No Vast plan with cpus={resources.cpus}, '
+                          f'memory={resources.memory}'))
+        return cloud_lib.FeasibleResources(
+            [resources.copy(cloud=self.NAME, instance_type=itype)])
+
+    # ---- deployment -------------------------------------------------------
+    def make_deploy_variables(self, resources, cluster_name_on_cloud: str,
+                              region: str,
+                              zone: Optional[str]) -> Dict[str, Any]:
+        from skypilot_tpu.provision import docker_utils
+        image_id = resources.image_id
+        if docker_utils.is_docker_image(image_id):
+            # Vast instances ARE containers: the task image becomes the
+            # instance image directly (like kubernetes, not docker-in-VM).
+            image_id = docker_utils.image_name(image_id)
+        return {
+            'cloud': self.NAME,
+            'mode': 'vast_marketplace',
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region,
+            'zone': None,
+            'use_spot': resources.use_spot,
+            'disk_size_gb': resources.disk_size,
+            'labels': dict(resources.labels or {}),
+            'ports': [],
+            'instance_type': resources.instance_type,
+            'image_id': image_id,
+        }

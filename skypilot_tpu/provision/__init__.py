@@ -28,6 +28,7 @@ _CLOUD_MODULES = {
     'lambda': 'skypilot_tpu.provision.lambda_impl',
     'do': 'skypilot_tpu.provision.do_impl',
     'fluidstack': 'skypilot_tpu.provision.fluidstack_impl',
+    'vast': 'skypilot_tpu.provision.vast_impl',
 }
 
 

@@ -56,14 +56,8 @@ class DoApiError(Exception):
         self.message = message or str(status)
 
 
-def classify_error(exc: Exception) -> exceptions.CloudError:
-    msg = str(exc).lower()
-    if any(m in msg for m in _CAPACITY_MARKERS):
-        return exceptions.InsufficientCapacityError(str(exc),
-                                                    reason='capacity')
-    if any(m in msg for m in _QUOTA_MARKERS):
-        return exceptions.CloudError(str(exc), reason='quota')
-    return exceptions.CloudError(str(exc))
+classify_error = rest_cloud.marker_classifier(_CAPACITY_MARKERS,
+                                              _QUOTA_MARKERS)
 
 
 def read_api_token() -> Optional[str]:
@@ -195,25 +189,9 @@ class _RestClient:
         self._request('DELETE', f'/firewalls/{firewall_id}')
 
 
-_do_factory: Optional[Callable[[], Any]] = None
-
-
-def set_do_factory(factory: Optional[Callable[[], Any]]) -> None:
-    """Test seam: ``factory() -> fake DO client`` (account-global, like
-    the Lambda seam — the v2 API is not region-scoped)."""
-    global _do_factory
-    _do_factory = factory
-
-
-def get_client() -> Any:
-    if _do_factory is not None:
-        return _do_factory()
-    return _RestClient()
-
-
-def call(client: Any, op: str, **kwargs) -> Any:
-    """Invoke a client op, normalizing errors to CloudError subclasses."""
-    try:
-        return getattr(client, op)(**kwargs)
-    except DoApiError as e:
-        raise classify_error(e) from e
+# Test seam (``set_do_factory(lambda: fake)``), client construction and
+# error-normalizing ``call`` via the shared ClientSeam.
+_seam = rest_cloud.ClientSeam(_RestClient, DoApiError, classify_error)
+set_do_factory = _seam.set_factory
+get_client = _seam.get_client
+call = _seam.call

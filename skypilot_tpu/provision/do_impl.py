@@ -266,7 +266,10 @@ def open_ports(cluster_name: str, region: str, ports: List[str]) -> None:
     wanted: Dict[str, Dict[str, Any]] = {}
     if existing is not None:
         for rule in existing.get('inbound_rules', []):
-            wanted[f"{rule['protocol']}:{rule['ports']}"] = dict(rule)
+            # icmp rules legitimately omit 'ports' (DO only requires it
+            # for tcp/udp): preserve them under a portless key.
+            wanted[f"{rule['protocol']}:{rule.get('ports', '')}"] = \
+                dict(rule)
     # SSH must stay reachable through the cluster firewall.
     wanted.setdefault('tcp:22', {
         'protocol': 'tcp', 'ports': '22',

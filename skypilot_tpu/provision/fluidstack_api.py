@@ -45,14 +45,8 @@ class FluidstackApiError(Exception):
         self.message = message or str(status)
 
 
-def classify_error(exc: Exception) -> exceptions.CloudError:
-    msg = str(exc).lower()
-    if any(m in msg for m in _CAPACITY_MARKERS):
-        return exceptions.InsufficientCapacityError(str(exc),
-                                                    reason='capacity')
-    if any(m in msg for m in _QUOTA_MARKERS):
-        return exceptions.CloudError(str(exc), reason='quota')
-    return exceptions.CloudError(str(exc))
+classify_error = rest_cloud.marker_classifier(_CAPACITY_MARKERS,
+                                              _QUOTA_MARKERS)
 
 
 def read_api_key() -> Optional[str]:
@@ -125,24 +119,10 @@ class _RestClient:
                       {'name': name, 'public_key': public_key})
 
 
-_fluidstack_factory: Optional[Callable[[], Any]] = None
-
-
-def set_fluidstack_factory(factory: Optional[Callable[[], Any]]) -> None:
-    """Test seam: ``factory() -> fake FluidStack client``."""
-    global _fluidstack_factory
-    _fluidstack_factory = factory
-
-
-def get_client() -> Any:
-    if _fluidstack_factory is not None:
-        return _fluidstack_factory()
-    return _RestClient()
-
-
-def call(client: Any, op: str, **kwargs) -> Any:
-    """Invoke a client op, normalizing errors to CloudError subclasses."""
-    try:
-        return getattr(client, op)(**kwargs)
-    except FluidstackApiError as e:
-        raise classify_error(e) from e
+# Test seam (``set_fluidstack_factory(lambda: fake)``), client
+# construction and error-normalizing ``call`` via the shared ClientSeam.
+_seam = rest_cloud.ClientSeam(_RestClient, FluidstackApiError,
+                              classify_error)
+set_fluidstack_factory = _seam.set_factory
+get_client = _seam.get_client
+call = _seam.call

@@ -49,15 +49,8 @@ class LambdaApiError(Exception):
         self.message = message or code
 
 
-def classify_error(exc: Exception) -> exceptions.CloudError:
-    code = str(getattr(exc, 'code', '') or '')
-    msg = str(exc)
-    blob = f'{code} {msg}'.lower()
-    if any(m in blob for m in _CAPACITY_MARKERS):
-        return exceptions.InsufficientCapacityError(msg, reason='capacity')
-    if any(m in blob for m in _QUOTA_MARKERS):
-        return exceptions.CloudError(msg, reason='quota')
-    return exceptions.CloudError(msg)
+classify_error = rest_cloud.marker_classifier(_CAPACITY_MARKERS,
+                                              _QUOTA_MARKERS)
 
 
 # ---- real transport --------------------------------------------------------
@@ -146,25 +139,9 @@ class _RestClient:
         return dict(self._request('GET', '/instance-types').get('data', {}))
 
 
-_lambda_factory: Optional[Callable[[], Any]] = None
-
-
-def set_lambda_factory(factory: Optional[Callable[[], Any]]) -> None:
-    """Test seam: ``factory() -> fake Lambda client`` (account-global —
-    Lambda's API is not regional, unlike the Azure/AWS seams)."""
-    global _lambda_factory
-    _lambda_factory = factory
-
-
-def get_client() -> Any:
-    if _lambda_factory is not None:
-        return _lambda_factory()
-    return _RestClient()
-
-
-def call(client: Any, op: str, **kwargs) -> Any:
-    """Invoke a client op, normalizing errors to CloudError subclasses."""
-    try:
-        return getattr(client, op)(**kwargs)
-    except LambdaApiError as e:
-        raise classify_error(e) from e
+# Test seam (``set_lambda_factory(lambda: fake)``), client construction
+# and error-normalizing ``call`` via the shared ClientSeam.
+_seam = rest_cloud.ClientSeam(_RestClient, LambdaApiError, classify_error)
+set_lambda_factory = _seam.set_factory
+get_client = _seam.get_client
+call = _seam.call

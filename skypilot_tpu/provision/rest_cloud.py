@@ -113,7 +113,9 @@ def poll_for_state(cluster_name: str,
 def ssh_runners(cluster_info, default_user: str,
                 ssh_credentials: Optional[Dict[str, str]] = None
                 ) -> List[runner_lib.CommandRunner]:
-    """One SSHCommandRunner per host, rank order (head first)."""
+    """One SSHCommandRunner per host, rank order (head first). Honors
+    HostInfo.ssh_port (Vast maps ssh onto host-chosen ports; everyone
+    else leaves the default 22)."""
     creds = ssh_credentials or {}
     key_path = creds.get('key_path')
     if key_path is None:
@@ -122,8 +124,59 @@ def ssh_runners(cluster_info, default_user: str,
     runners: List[runner_lib.CommandRunner] = []
     for h in cluster_info.hosts:
         ip = h.external_ip or h.internal_ip
-        runners.append(runner_lib.SSHCommandRunner(ip, user, key_path))
+        runners.append(runner_lib.SSHCommandRunner(ip, user, key_path,
+                                                   port=h.ssh_port))
     return runners
+
+
+def marker_classifier(capacity_markers=(), quota_markers=()):
+    """Build a classify_error(exc) from provider-specific marker
+    strings: capacity wording -> InsufficientCapacityError (failover
+    fires), quota wording -> CloudError(reason='quota') (blocklist, no
+    retry), everything else -> plain CloudError. Matches against the
+    error's code attribute AND message so both code-carrying (Lambda)
+    and wording-only (DO) providers work."""
+    def classify(exc: Exception) -> exceptions.CloudError:
+        blob = f'{getattr(exc, "code", "")} {exc}'.lower()
+        if any(m in blob for m in capacity_markers):
+            return exceptions.InsufficientCapacityError(str(exc),
+                                                        reason='capacity')
+        if any(m in blob for m in quota_markers):
+            return exceptions.CloudError(str(exc), reason='quota')
+        return exceptions.CloudError(str(exc))
+    return classify
+
+
+class ClientSeam:
+    """Per-cloud client construction with the in-process-fake test seam
+    and error-normalizing call() — identical mechanics for every REST
+    cloud, so a hardening fix lands once.
+
+    ``ClientSeam(real_factory, api_error_types, classify)`` exposes
+    ``set_factory`` (tests install a fake), ``get_client`` and
+    ``call`` — bind them to the api module's public names.
+    """
+
+    def __init__(self, real_factory: Callable[[], Any],
+                 api_error_types, classify):
+        self._factory: Optional[Callable[[], Any]] = None
+        self._real_factory = real_factory
+        self._api_error_types = api_error_types
+        self._classify = classify
+
+    def set_factory(self, factory: Optional[Callable[[], Any]]) -> None:
+        self._factory = factory
+
+    def get_client(self) -> Any:
+        if self._factory is not None:
+            return self._factory()
+        return self._real_factory()
+
+    def call(self, client: Any, op: str, **kwargs) -> Any:
+        try:
+            return getattr(client, op)(**kwargs)
+        except self._api_error_types as e:
+            raise self._classify(e) from e
 
 
 def retrying_request(method: str, url: str, headers: Dict[str, str],

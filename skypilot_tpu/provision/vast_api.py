@@ -1,0 +1,147 @@
+"""Thin Vast.ai REST client with a test seam.
+
+Counterpart of the reference's ``sky/provision/vast/utils.py`` (vast
+SDK wrapper: search_offers / create_instance / show_instances). The
+real transport is a tiny urllib client over the public v0 REST API
+(``https://console.vast.ai/api/v0``, ``Authorization: Bearer`` with the
+account API key); tests install an in-process fake via
+``set_vast_factory`` implementing the same flat surface
+(``search_offers``, ``create_instance``, ``list_instances``,
+``start_instance``, ``stop_instance``, ``destroy_instance``), so the
+marketplace-offer lifecycle and bid/preemption logic run for real with
+no cloud.
+
+Vast is a MARKETPLACE: capacity is "no matching offer right now", not a
+cloud error code — the provisioner classifies an empty offer search as
+InsufficientCapacityError itself. API errors here are plumbing
+(auth/rate limits), classified as plain CloudError except quota
+wording.
+"""
+from __future__ import annotations
+
+import json
+import os
+import urllib.parse
+from typing import Any, Callable, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import rest_cloud
+
+API_ENDPOINT = 'https://console.vast.ai/api/v0'
+API_KEY_PATH = '~/.vast_api_key'
+
+_QUOTA_MARKERS = ('quota', 'credit', 'balance too low')
+
+
+class VastApiError(Exception):
+    """Fake/real client error carrying an HTTP status + message."""
+
+    def __init__(self, status: int, message: str = ''):
+        super().__init__(message or str(status))
+        self.status = status
+        self.message = message or str(status)
+
+
+classify_error = rest_cloud.marker_classifier(
+    quota_markers=_QUOTA_MARKERS)
+
+
+def read_api_key() -> Optional[str]:
+    env = os.environ.get('VAST_API_KEY')
+    if env:
+        return env
+    path = os.path.expanduser(API_KEY_PATH)
+    if os.path.exists(path):
+        with open(path, encoding='utf-8') as f:
+            key = f.read().strip()
+        return key or None
+    return None
+
+
+def _parse_error(status: int, raw: bytes) -> Exception:
+    """Vast's error envelope: {'error': ..., 'msg': ...}."""
+    try:
+        err = json.loads(raw.decode())
+        msg = err.get('msg') or err.get('error') or raw.decode()
+        return VastApiError(status, str(msg))
+    except (ValueError, AttributeError):
+        return VastApiError(status,
+                            raw.decode(errors='replace') or str(status))
+
+
+class _RestClient:
+    """Flat op surface over the shared retrying urllib transport."""
+
+    def __init__(self):
+        api_key = read_api_key()
+        if api_key is None:
+            raise exceptions.CloudError(
+                'Vast.ai credentials not found: set $VAST_API_KEY or '
+                f'write the key to {API_KEY_PATH}.')
+        self._headers = {'Authorization': f'Bearer {api_key}',
+                         'Content-Type': 'application/json'}
+
+    def _request(self, method: str, path: str,
+                 payload: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        return rest_cloud.retrying_request(
+            method, f'{API_ENDPOINT}{path}', self._headers, payload,
+            _parse_error)
+
+    # -- flat op surface (mirrored by test fakes) ---------------------------
+    def search_offers(self, gpu_name: str, num_gpus: int, geolocation: str,
+                      min_disk_gb: float) -> List[Dict[str, Any]]:
+        """Rentable offers matching the spec, as the marketplace sees
+        them right now. Each offer carries id, dph_total ($/h on-demand),
+        min_bid ($/h floor for interruptible), cpu_cores, cpu_ram."""
+        query = {
+            'verified': {'eq': True},
+            'rentable': {'eq': True},
+            'gpu_name': {'eq': gpu_name},
+            'num_gpus': {'eq': num_gpus},
+            'geolocation': {'in': [geolocation]},
+            'disk_space': {'gte': min_disk_gb},
+            'order': [['dph_total', 'asc']],
+            'type': 'on-demand',
+        }
+        # The query JSON carries spaces ('RTX 4090') and braces: it MUST
+        # be percent-encoded or urllib refuses the URL outright.
+        encoded = urllib.parse.quote(
+            json.dumps(query, separators=(',', ':')))
+        body = self._request('GET', f'/bundles?q={encoded}')
+        return list(body.get('offers', []))
+
+    def create_instance(self, offer_id: int, label: str, image: str,
+                        disk_gb: float, onstart_cmd: str,
+                        bid_per_hour: Optional[float] = None
+                        ) -> Dict[str, Any]:
+        payload: Dict[str, Any] = {
+            'client_id': 'me', 'image': image, 'disk': disk_gb,
+            'label': label, 'onstart': onstart_cmd,
+            'runtype': 'ssh', 'direct': True,
+        }
+        if bid_per_hour is not None:
+            payload['price'] = bid_per_hour  # interruptible bid
+        return dict(self._request('PUT', f'/asks/{offer_id}/', payload))
+
+    def list_instances(self) -> List[Dict[str, Any]]:
+        return list(self._request('GET', '/instances')
+                    .get('instances', []))
+
+    def start_instance(self, instance_id: int) -> None:
+        self._request('PUT', f'/instances/{instance_id}/',
+                      {'state': 'running'})
+
+    def stop_instance(self, instance_id: int) -> None:
+        self._request('PUT', f'/instances/{instance_id}/',
+                      {'state': 'stopped'})
+
+    def destroy_instance(self, instance_id: int) -> None:
+        self._request('DELETE', f'/instances/{instance_id}/')
+
+
+# Test seam (``set_vast_factory(lambda: fake)``), client construction
+# and error-normalizing ``call`` via the shared ClientSeam.
+_seam = rest_cloud.ClientSeam(_RestClient, VastApiError, classify_error)
+set_vast_factory = _seam.set_factory
+get_client = _seam.get_client
+call = _seam.call

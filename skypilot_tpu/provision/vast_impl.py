@@ -1,0 +1,271 @@
+"""Vast.ai provisioner: marketplace GPU instances with interruptible
+bids.
+
+Counterpart of reference ``sky/provision/vast/instance.py`` +
+``utils.py`` (offer search -> create from offer; '-head'/'-worker'
+labels; min_bid for preemptible). The seventh VM cloud, and the first
+REST cloud with real SPOT semantics: ``use_spot`` becomes an
+interruptible bid, and an instance the marketplace pauses (outbid /
+host reclaim) is detected as a preemption — driving the same
+managed-jobs recovery machinery as GCP/AWS spot.
+
+Vast-isms:
+- capacity is an EMPTY OFFER SEARCH, not an error code: the
+  marketplace either has a matching machine right now or it doesn't
+  (reference utils.py:101-103 raises on empty search);
+- instance types are synthetic ``{n}x_{GPU_NAME}`` plans (the
+  marketplace has no instance types; reference invents the same,
+  utils.py:80-87); 'regions' are two-letter country codes snipped from
+  host geolocations (utils.py:61-69);
+- SSH lands on a host-mapped port (``ssh_host:ssh_port``), not 22 —
+  the one cloud here exercising HostInfo.ssh_port;
+- interruptible instances PAUSE when outbid (status 'stopped' without
+  us stopping them): the wait loop's extra_check flags that as
+  capacity so failover/recovery fires (same shape as Azure's
+  spot-deallocate detection).
+
+On-demand instances also support clean stop/start.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import provision as provision_lib
+from skypilot_tpu.provision import rest_cloud
+from skypilot_tpu.provision import vast_api
+from skypilot_tpu.utils import command_runner as runner_lib
+
+SSH_USER = 'root'  # Vast containers log in as root
+
+DEFAULT_IMAGE = 'ubuntu:22.04'
+
+# Bid margin over the offer's min_bid for interruptible rentals: high
+# enough to not be instantly outbid, far below on-demand dph.
+BID_MARGIN = 1.25
+
+# Polls of persistent 'stopped' before an interruptible cluster that
+# never reached running is declared preempted (~30s at the 5s poll
+# interval): start_instance lands asynchronously on the real API, so a
+# restart must not be misread as an outbid pause.
+OUTBID_GRACE_POLLS = 6
+
+# Vast actual_status -> provision API state words.
+_STATE_MAP = {
+    'created': 'pending',
+    'loading': 'pending',
+    'connecting': 'pending',
+    'running': 'running',
+    'stopping': 'stopping',
+    'stopped': 'stopped',   # on-demand stop OR interruptible pause
+    'exited': 'stopped',
+    'offline': 'pending',
+    'destroyed': 'terminated',
+}
+
+# Cluster bookkeeping + rank decoding via the shared REST-cloud
+# scaffolding (rest_cloud.py).
+_records = rest_cloud.ClusterRecords('vast_cluster')
+
+
+def split_plan(instance_type: str) -> tuple:
+    """'4x_RTX_4090' -> (4, 'RTX 4090')."""
+    count, _, gpu = instance_type.partition('x_')
+    return int(count or 1), gpu.replace('_', ' ')
+
+
+def _live_instances(client, name: str) -> Dict[int, Dict[str, Any]]:
+    """rank -> instance by label. Offers are machine-specific, so a
+    region filter is unnecessary: ranks are only created from offers in
+    the record's region, and labels are cluster-scoped."""
+    out: Dict[int, Dict[str, Any]] = {}
+    for inst in vast_api.call(client, 'list_instances'):
+        rank = rest_cloud.rank_of(inst.get('label') or '', name)
+        if rank is None:
+            continue
+        if inst.get('actual_status') in ('destroyed',):
+            continue
+        out[rank] = inst
+    return out
+
+
+def _onstart_cmd() -> str:
+    """Container bootstrap: install the local public key for root ssh
+    (Vast images start sshd; the key lands via the API's onstart)."""
+    from skypilot_tpu import authentication
+    _, pub_path = authentication.get_or_generate_keys()
+    with open(pub_path, encoding='utf-8') as f:
+        pub_key = f.read().strip()
+    return ('mkdir -p ~/.ssh && '
+            f'grep -qF "{pub_key}" ~/.ssh/authorized_keys 2>/dev/null || '
+            f'echo "{pub_key}" >> ~/.ssh/authorized_keys')
+
+
+# ---- provision API ---------------------------------------------------------
+def run_instances(cluster_name: str, region: str, zone: Optional[str],
+                  num_hosts: int, deploy_vars: Dict[str, Any]) -> None:
+    del zone  # marketplace has no zones
+    name = deploy_vars['cluster_name_on_cloud']
+    use_spot = bool(deploy_vars.get('use_spot'))
+    record = {'region': region, 'zone': None, 'name_on_cloud': name,
+              'num_hosts': num_hosts, 'deploy_vars': deploy_vars,
+              'interruptible': use_spot}
+    _records.save(cluster_name, record)
+    client = vast_api.get_client()
+    num_gpus, gpu_name = split_plan(
+        deploy_vars.get('instance_type', '1x_RTX_4090'))
+    disk_gb = float(deploy_vars.get('disk_size_gb') or 100)
+    try:
+        existing = _live_instances(client, name)
+        for rank, inst in existing.items():
+            if _STATE_MAP.get(inst.get('actual_status', '')) == 'stopped':
+                vast_api.call(client, 'start_instance',
+                              instance_id=inst['id'])
+        missing = [r for r in range(num_hosts) if r not in existing]
+        if missing:
+            offers = vast_api.call(
+                client, 'search_offers', gpu_name=gpu_name,
+                num_gpus=num_gpus, geolocation=region,
+                min_disk_gb=disk_gb)
+            if len(offers) < len(missing):
+                # The marketplace has no matching machines right now:
+                # that IS the capacity signal (reference utils.py:101).
+                raise exceptions.InsufficientCapacityError(
+                    f'Vast marketplace has {len(offers)} offer(s) for '
+                    f'{num_gpus}x {gpu_name} in {region}, need '
+                    f'{len(missing)}', reason='capacity')
+            onstart = _onstart_cmd()
+            for rank, offer in zip(missing, offers):
+                bid = (round(float(offer.get('min_bid', 0.0))
+                             * BID_MARGIN, 4) if use_spot else None)
+                vast_api.call(
+                    client, 'create_instance',
+                    offer_id=offer['id'],
+                    label=f'{name}-r{rank}',
+                    image=deploy_vars.get('image_id') or DEFAULT_IMAGE,
+                    disk_gb=disk_gb,
+                    onstart_cmd=onstart,
+                    bid_per_hour=bid)
+    except exceptions.InsufficientCapacityError:
+        try:
+            _terminate_all(client, name)
+        except exceptions.CloudError:
+            pass
+        else:
+            _records.delete(cluster_name)
+        raise
+
+
+def wait_instances(cluster_name: str, region: str, state: str = 'running',
+                   timeout: float = 1800) -> None:
+    record = _records.load(cluster_name) or {}
+    interruptible = bool(record.get('interruptible'))
+    saw_running = [False]
+    stopped_polls = [0]
+    grace_polls = OUTBID_GRACE_POLLS
+
+    def outbid_check(states: set) -> Optional[Exception]:
+        # An interruptible instance PAUSES when outbid: persistent
+        # 'stopped' while waiting for running means the bid lost —
+        # classify as capacity so failover/recovery fires (same shape
+        # as azure.py's spot-deallocation detection). On-demand
+        # clusters only flag it after a seen running state, so a
+        # stopped cluster being restarted is never misread.
+        saw_running[0] = saw_running[0] or 'running' in states
+        if state != 'running' or 'stopped' not in states:
+            stopped_polls[0] = 0
+            return None
+        stopped_polls[0] += 1
+        if saw_running[0] or (interruptible
+                              and stopped_polls[0] > grace_polls):
+            return exceptions.InsufficientCapacityError(
+                f'{cluster_name}: instance paused while waiting for '
+                'running (outbid / host reclaim?)', reason='capacity')
+        return None
+
+    rest_cloud.poll_for_state(
+        cluster_name, lambda: query_instances(cluster_name, region),
+        state, timeout, extra_check=outbid_check)
+
+
+def query_instances(cluster_name: str, region: str) -> Dict[str, str]:
+    del region
+    record = _records.load(cluster_name)
+    if not record:
+        return {}
+    client = vast_api.get_client()
+    live = _live_instances(client, record['name_on_cloud'])
+    if not live:
+        return {}
+    out: Dict[str, str] = {}
+    for rank, inst in live.items():
+        out[inst.get('label', f'r{rank}')] = _STATE_MAP.get(
+            inst.get('actual_status', ''), 'unknown')
+    for rank in range(int(record.get('num_hosts') or 0)):
+        if rank not in live:
+            out[f'rank{rank}-missing'] = 'terminated'
+    return out
+
+
+def stop_instances(cluster_name: str, region: str) -> None:
+    record = _records.require(cluster_name, 'Vast')
+    client = vast_api.get_client()
+    for inst in _live_instances(client, record['name_on_cloud']).values():
+        if _STATE_MAP.get(inst.get('actual_status', '')) in ('pending',
+                                                             'running'):
+            vast_api.call(client, 'stop_instance',
+                          instance_id=inst['id'])
+
+
+def _terminate_all(client, name: str) -> None:
+    for inst in _live_instances(client, name).values():
+        vast_api.call(client, 'destroy_instance', instance_id=inst['id'])
+
+
+def terminate_instances(cluster_name: str, region: str) -> None:
+    del region
+    record = _records.load(cluster_name)
+    if not record:
+        return
+    client = vast_api.get_client()
+    _terminate_all(client, record['name_on_cloud'])
+    _records.delete(cluster_name)
+
+
+def get_cluster_info(cluster_name: str,
+                     region: str) -> provision_lib.ClusterInfo:
+    del region
+    record = _records.require(cluster_name, 'Vast')
+    client = vast_api.get_client()
+    live = _live_instances(client, record['name_on_cloud'])
+    hosts: List[provision_lib.HostInfo] = []
+    for rank in sorted(live):
+        inst = live[rank]
+        ssh_host = inst.get('ssh_host') or inst.get('public_ipaddr')
+        if not ssh_host:
+            raise exceptions.ProvisionError(
+                f'No ssh host on instance {inst.get("label")!r} yet.')
+        hosts.append(provision_lib.HostInfo(
+            host_id=str(inst['id']), rank=rank,
+            # Rendezvous inside Vast's overlay uses the instance's own
+            # address; control-plane ssh goes through the host-mapped
+            # port below.
+            internal_ip=inst.get('local_ipaddr') or ssh_host,
+            external_ip=ssh_host,
+            ssh_port=int(inst.get('ssh_port') or 22),
+            extra={}))
+    return provision_lib.ClusterInfo(
+        cluster_name=cluster_name, cloud='vast',
+        region=record['region'], zone=None, hosts=hosts,
+        deploy_vars=record['deploy_vars'])
+
+
+# No open_ports: Vast exposes host-mapped ports chosen by the host, not
+# arbitrary firewall rules; the cloud class omits OPEN_PORTS.
+
+
+def get_command_runners(cluster_info: provision_lib.ClusterInfo,
+                        ssh_credentials: Optional[Dict[str, str]] = None
+                        ) -> List[runner_lib.CommandRunner]:
+    # ssh_runners honors each HostInfo's host-mapped ssh_port.
+    return rest_cloud.ssh_runners(cluster_info, SSH_USER, ssh_credentials)

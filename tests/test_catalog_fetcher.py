@@ -422,3 +422,51 @@ def test_fluidstack_fetcher_live_override(tmp_path, monkeypatch):
     assert len(rows) == 1
     assert rows[0]['instance_type'] == 'B200::4'
     assert float(rows[0]['price']) == pytest.approx(4 * 4.99)
+
+
+def test_committed_vast_catalog_matches_regeneration(tmp_path,
+                                                     monkeypatch):
+    """Drift guard: vast_vms.csv must equal the offline fetcher output."""
+    import csv as csv_lib
+    import os
+    from skypilot_tpu.catalog.fetchers import fetch_vast
+
+    monkeypatch.setattr(fetch_vast, 'DATA_DIR', str(tmp_path))
+    assert fetch_vast.refresh(online=False) == 'offline'
+    committed_path = os.path.join(
+        os.path.dirname(os.path.abspath(fetch_vast.__file__)), '..',
+        'data', 'vast_vms.csv')
+    committed = open(committed_path).read()
+    assert committed == (tmp_path / 'vast_vms.csv').read_text(), (
+        'vast_vms.csv drifted from the fetcher: run '
+        'python -m skypilot_tpu.catalog.fetchers.fetch_vast')
+    rows = list(csv_lib.DictReader(open(tmp_path / 'vast_vms.csv')))
+    r4090 = [r for r in rows if r['instance_type'] == '1x_RTX_4090'
+             and r['region'] == 'US'][0]
+    # Marketplace spot (typical winning bid) undercuts median on-demand.
+    assert float(r4090['spot_price']) < float(r4090['price'])
+
+
+def test_vast_fetcher_live_medians(tmp_path, monkeypatch):
+    """Live offer samples override the static medians per plan/region."""
+    from skypilot_tpu.catalog.fetchers import fetch_vast
+
+    def offers(gpu_name, num_gpus, region):
+        if gpu_name == 'RTX 4090' and num_gpus == 1 and region == 'US':
+            return [{'dph_total': 0.30, 'min_bid': 0.10},
+                    {'dph_total': 0.50, 'min_bid': 0.20},
+                    {'dph_total': 0.40, 'min_bid': 0.12}]
+        return []
+    monkeypatch.setattr(fetch_vast, 'DATA_DIR', str(tmp_path))
+    assert fetch_vast.refresh(online=True,
+                              offers_fetcher=offers) == 'online'
+    import csv as csv_lib
+    rows = list(csv_lib.DictReader(open(tmp_path / 'vast_vms.csv')))
+    us = [r for r in rows if r['instance_type'] == '1x_RTX_4090'
+          and r['region'] == 'US'][0]
+    assert float(us['price']) == 0.4    # median of sampled offers
+    assert float(us['spot_price']) == 0.12
+    # Plans with no live sample keep the static fallback.
+    ca = [r for r in rows if r['instance_type'] == '1x_RTX_4090'
+          and r['region'] == 'CA'][0]
+    assert float(ca['price']) == 0.42

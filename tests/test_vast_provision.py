@@ -1,0 +1,256 @@
+"""Vast.ai provisioner tests against an in-process fake marketplace.
+
+The fake implements the flat client surface (search_offers /
+create_instance / list_instances / start/stop/destroy), with a mutable
+offer book — so the offer-search capacity path, interruptible bids,
+outbid-pause preemption detection, host-mapped ssh ports, and
+stop/start all run for real with no cloud and no network.
+"""
+import itertools
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import exceptions
+from skypilot_tpu.backends.slice_backend import RetryingProvisioner
+from skypilot_tpu.provision import vast_api
+from skypilot_tpu.provision import vast_impl
+
+
+class FakeVast:
+    """In-memory Vast marketplace + account."""
+
+    def __init__(self):
+        self.instances = {}
+        # Offer book: list of dicts the search filters against.
+        self.offers = [
+            {'id': 101, 'gpu_name': 'RTX 4090', 'num_gpus': 1,
+             'geolocation': 'US', 'disk_space': 500,
+             'dph_total': 0.40, 'min_bid': 0.12,
+             'ssh_host': 'h101.vast.example', 'ssh_port': 40101},
+            {'id': 102, 'gpu_name': 'RTX 4090', 'num_gpus': 1,
+             'geolocation': 'US', 'disk_space': 500,
+             'dph_total': 0.45, 'min_bid': 0.15,
+             'ssh_host': 'h102.vast.example', 'ssh_port': 40102},
+            {'id': 201, 'gpu_name': 'RTX 4090', 'num_gpus': 1,
+             'geolocation': 'CA', 'disk_space': 500,
+             'dph_total': 0.39, 'min_bid': 0.11,
+             'ssh_host': 'h201.vast.example', 'ssh_port': 40201},
+        ]
+        self.create_calls = []
+        self._ids = itertools.count(9000)
+
+    def search_offers(self, gpu_name, num_gpus, geolocation, min_disk_gb):
+        taken = {i.get('offer_id') for i in self.instances.values()
+                 if i['actual_status'] != 'destroyed'}
+        return [dict(o) for o in self.offers
+                if o['gpu_name'] == gpu_name
+                and o['num_gpus'] == num_gpus
+                and o['geolocation'] == geolocation
+                and o['disk_space'] >= min_disk_gb
+                and o['id'] not in taken]
+
+    def create_instance(self, offer_id, label, image, disk_gb,
+                        onstart_cmd, bid_per_hour=None):
+        self.create_calls.append((offer_id, label, bid_per_hour))
+        offer = next(o for o in self.offers if o['id'] == offer_id)
+        n = next(self._ids)
+        self.instances[n] = {
+            'id': n, 'label': label, 'actual_status': 'running',
+            'offer_id': offer_id, 'image': image,
+            'interruptible': bid_per_hour is not None,
+            'bid': bid_per_hour,
+            'ssh_host': offer['ssh_host'],
+            'ssh_port': offer['ssh_port'],
+            'public_ipaddr': f'100.64.0.{n % 250}',
+            'local_ipaddr': f'172.16.0.{n % 250}',
+        }
+        return {'new_contract': n}
+
+    def list_instances(self):
+        return [dict(i) for i in self.instances.values()
+                if i['actual_status'] != 'destroyed']
+
+    def start_instance(self, instance_id):
+        self.instances[instance_id]['actual_status'] = 'running'
+
+    def stop_instance(self, instance_id):
+        self.instances[instance_id]['actual_status'] = 'stopped'
+
+    def destroy_instance(self, instance_id):
+        self.instances[instance_id]['actual_status'] = 'destroyed'
+
+
+@pytest.fixture
+def fake_vast(monkeypatch, tmp_path):
+    account = FakeVast()
+    vast_api.set_vast_factory(lambda: account)
+    monkeypatch.setenv('SKYTPU_FAKE_VAST_CREDENTIALS', '1')
+    priv = tmp_path / 'key'
+    pub = tmp_path / 'key.pub'
+    priv.write_text('fake-private')
+    pub.write_text('ssh-ed25519 AAAA test')
+    monkeypatch.setattr('skypilot_tpu.authentication.get_or_generate_keys',
+                        lambda: (str(priv), str(pub)))
+    yield account
+    vast_api.set_vast_factory(None)
+
+
+def _deploy_vars(**over):
+    base = {
+        'cloud': 'vast', 'mode': 'vast_marketplace',
+        'cluster_name_on_cloud': 'c-va1',
+        'instance_type': '1x_RTX_4090', 'image_id': None,
+        'disk_size_gb': 100, 'use_spot': False, 'labels': {}, 'ports': [],
+    }
+    base.update(over)
+    return base
+
+
+class TestLifecycle:
+
+    def test_create_query_info_stop_start_terminate(self, fake_vast):
+        dv = _deploy_vars()
+        vast_impl.run_instances('v1', 'US', None, 2, dv)
+        vast_impl.wait_instances('v1', 'US', timeout=5)
+        states = vast_impl.query_instances('v1', 'US')
+        assert set(states.values()) == {'running'} and len(states) == 2
+
+        info = vast_impl.get_cluster_info('v1', 'US')
+        assert info.num_hosts == 2
+        # Cheapest offer first: rank 0 got offer 101.
+        assert info.head.external_ip == 'h101.vast.example'
+        assert info.head.ssh_port == 40101  # host-mapped, not 22
+
+        vast_impl.stop_instances('v1', 'US')
+        assert set(vast_impl.query_instances(
+            'v1', 'US').values()) == {'stopped'}
+        vast_impl.run_instances('v1', 'US', None, 2, dv)
+        assert set(vast_impl.query_instances(
+            'v1', 'US').values()) == {'running'}
+
+        vast_impl.terminate_instances('v1', 'US')
+        assert vast_impl.query_instances('v1', 'US') == {}
+
+    def test_cheapest_offer_wins(self, fake_vast):
+        vast_impl.run_instances('v2', 'US', None, 1, _deploy_vars())
+        assert fake_vast.create_calls[0][0] == 101  # dph 0.40 < 0.45
+
+    def test_ssh_runner_uses_host_mapped_port(self, fake_vast):
+        vast_impl.run_instances('v3', 'US', None, 1, _deploy_vars())
+        info = vast_impl.get_cluster_info('v3', 'US')
+        runner = vast_impl.get_command_runners(info)[0]
+        assert runner.port == 40101
+        assert runner.ip == 'h101.vast.example'
+
+    def test_onstart_installs_public_key(self, fake_vast):
+        vast_impl.run_instances('v4', 'US', None, 1, _deploy_vars())
+        inst = next(iter(fake_vast.instances.values()))
+        # The create payload carried the key-install onstart command.
+        assert 'authorized_keys' in vast_impl._onstart_cmd()
+
+
+class TestSpot:
+
+    def test_interruptible_bid_over_min(self, fake_vast):
+        vast_impl.run_instances('s1', 'US', None, 1,
+                                _deploy_vars(use_spot=True))
+        offer_id, _, bid = fake_vast.create_calls[0]
+        assert offer_id == 101
+        assert bid == pytest.approx(0.12 * vast_impl.BID_MARGIN)
+        assert next(iter(fake_vast.instances.values()))['interruptible']
+
+    def test_on_demand_has_no_bid(self, fake_vast):
+        vast_impl.run_instances('s2', 'US', None, 1, _deploy_vars())
+        assert fake_vast.create_calls[0][2] is None
+
+    def test_outbid_pause_is_detected_as_capacity(self, fake_vast,
+                                                  monkeypatch):
+        monkeypatch.setattr(vast_impl, 'OUTBID_GRACE_POLLS', 0)
+        vast_impl.run_instances('s3', 'US', None, 1,
+                                _deploy_vars(use_spot=True))
+        vast_impl.wait_instances('s3', 'US', timeout=5)
+        # The marketplace pauses the instance (outbid).
+        for inst in fake_vast.instances.values():
+            inst['actual_status'] = 'stopped'
+        with pytest.raises(exceptions.InsufficientCapacityError):
+            vast_impl.wait_instances('s3', 'US', timeout=5)
+
+    def test_restarting_spot_cluster_grace_is_not_preemption(
+            self, fake_vast):
+        # An interruptible cluster being restarted reports 'stopped'
+        # for a few polls while start_instance lands: within the grace
+        # window that must NOT be misread as an outbid pause.
+        vast_impl.run_instances('s5', 'US', None, 1,
+                                _deploy_vars(use_spot=True))
+        vast_impl.stop_instances('s5', 'US')
+        # Async start: status stays stopped; one poll happens inside a
+        # 3s wait, well under OUTBID_GRACE_POLLS.
+        with pytest.raises(exceptions.ProvisionError):
+            vast_impl.wait_instances('s5', 'US', timeout=3)
+
+    def test_on_demand_stop_is_not_preemption(self, fake_vast):
+        # A non-interruptible cluster passing through 'stopped' while
+        # being restarted must NOT be misread as preempted: the check is
+        # gated on the interruptible flag.
+        vast_impl.run_instances('s4', 'US', None, 1, _deploy_vars())
+        vast_impl.stop_instances('s4', 'US')
+        with pytest.raises(exceptions.ProvisionError):
+            # stays stopped: times out (ProvisionError), never the
+            # capacity misclassification
+            vast_impl.wait_instances('s4', 'US', timeout=3)
+
+
+class TestCapacityAndFailover:
+
+    def _task(self, *regions, spot=False):
+        task = sky.Task(run='echo x')
+        rs = [sky.Resources(cloud='vast', instance_type='1x_RTX_4090',
+                            region=r, use_spot=spot) for r in regions]
+        task.set_resources([rs[0]])
+        task.best_resources = rs[0]
+        task.candidate_resources = rs
+        return task
+
+    def test_empty_offer_book_is_capacity(self, fake_vast):
+        with pytest.raises(exceptions.InsufficientCapacityError):
+            vast_impl.run_instances(
+                'c1', 'DE', None, 1, _deploy_vars())  # no DE offers
+
+    def test_not_enough_offers_for_gang_is_capacity(self, fake_vast):
+        # Two US offers, three hosts wanted.
+        with pytest.raises(exceptions.InsufficientCapacityError):
+            vast_impl.run_instances('c2', 'US', None, 3, _deploy_vars())
+        # Nothing half-created was left behind.
+        live = [i for i in fake_vast.instances.values()
+                if i['actual_status'] != 'destroyed']
+        assert live == []
+
+    def test_region_failover_when_marketplace_dry(self, fake_vast):
+        fake_vast.offers = [o for o in fake_vast.offers
+                            if o['geolocation'] == 'CA']
+        launched, info = RetryingProvisioner().provision(
+            self._task('US', 'CA'), 'va-fo')
+        assert launched.region == 'CA'
+        assert info.head.ssh_port == 40201
+
+
+class TestCloudClass:
+
+    def test_spot_is_feasible_and_cheaper(self, fake_vast):
+        from skypilot_tpu import clouds as clouds_lib
+        cloud = sky.clouds.get_cloud('vast')
+        assert cloud.supports(clouds_lib.CloudFeature.SPOT)
+        res = sky.Resources(cloud='vast', instance_type='1x_RTX_4090',
+                            region='US')
+        on_demand = cloud.hourly_cost(res, region='US')
+        spot = cloud.hourly_cost(res.copy(use_spot=True), region='US')
+        assert spot < on_demand
+
+    def test_optimizer_places_pinned_vast_task(self, fake_vast):
+        from skypilot_tpu import optimizer
+        task = sky.Task(run='echo x')
+        task.set_resources([sky.Resources(cloud='vast', cpus='8+')])
+        optimizer.optimize(task, quiet=True)
+        assert task.best_resources.cloud == 'vast'
+        assert task.best_resources.instance_type == '1x_RTX_3090'

@@ -67,6 +67,8 @@ class DecodeEngine:
         self._prefill = jax.jit(self._prefill_impl)
         self._insert = jax.jit(self._insert_impl, donate_argnums=(0,))
         self._admit = jax.jit(self._admit_impl, donate_argnums=(0,))
+        self._admit_many = jax.jit(self._admit_many_impl,
+                                   donate_argnums=(0,))
         # temperature/top_k are *traced* [B] args — any per-request sampling
         # settings reuse the one compiled step (no recompile DoS).
         self._step = jax.jit(self._step_impl, donate_argnums=(1,))
@@ -187,6 +189,69 @@ class DecodeEngine:
         first = _sample(logits[None], sub, temperature, top_k)[0]
         new_state = self._insert_impl(state, ks, vs, true_len, first, slot)
         return new_state, first, rng
+
+    def admit_many(self, params: Params, state: DecodeState,
+                   tokens: jax.Array, true_lens, slots, rng: jax.Array,
+                   temperatures, top_ks
+                   ) -> Tuple[DecodeState, jax.Array, jax.Array]:
+        """Fused BATCHED prefill + first-token sample + insert for N
+        same-bucket prompts: ONE device dispatch admits all of them.
+        Returns (state, first_tokens [N], next_rng).
+
+        Why this exists beyond ``admit``: a thundering herd of arrivals
+        (closed-loop serving waves) admits back-to-back, and each admit
+        is a full dispatch round-trip; batching divides those RTTs by N
+        and streams each layer's weights once for N prompts instead of
+        N times. Compile variants are (N, bucket) pairs — the scheduler
+        caps N (ADMIT_BATCH_MAX) and groups same-bucket prompts only.
+        """
+        return self._admit_many(
+            state, params, tokens,
+            jnp.asarray(true_lens, jnp.int32),
+            jnp.asarray(slots, jnp.int32), rng,
+            jnp.asarray(temperatures, jnp.float32),
+            jnp.asarray(top_ks, jnp.int32))
+
+    def _admit_many_impl(self, state, params, tokens, true_lens, slots,
+                         rng, temperatures, top_ks):
+        c = self.config
+        n, t = tokens.shape
+        positions = jnp.arange(t)
+        cos, sin = precompute_rotary(c.head_dim, c.max_seq_len, c.rope_theta)
+        x = params['embed'][tokens].astype(c.dtype)  # [N, T, e]
+        model = self.model
+
+        def layer(x, lp):
+            q, k, v = model._qkv(lp, x, cos, sin, positions, constrain=False)
+            attn = attention_ops.attention(q, k, v, causal=True)
+            x = x + jnp.einsum('bshd,hde->bse', attn, lp['wo'])
+            x = x + model._mlp_delta(lp, x, constrain=False)[0]
+            # [N, T, kvh, d] -> [N, kvh, T, d]: the cache's head-major
+            # layout, batch leading for the scatter below.
+            return x, (k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3))
+
+        x, (ks, vs) = lax.scan(layer, x, params['layers'])
+        # ks: [L, N, kvh, T, d]
+        x = rms_norm(x, params['final_norm'], c.norm_eps)
+        head = (params['embed'].T if c.tie_embeddings else params['lm_head'])
+        rows = jnp.arange(n)
+        last = x[rows, true_lens - 1].astype(jnp.float32)   # [N, e]
+        logits = last @ head.astype(jnp.float32)            # [N, V]
+        rng, sub = jax.random.split(rng)
+        firsts = _sample(logits, sub, temperatures, top_ks)  # [N]
+        pad_m = self.max_len - t
+        kf = jnp.pad(ks, ((0, 0), (0, 0), (0, 0), (0, pad_m), (0, 0)))
+        vf = jnp.pad(vs, ((0, 0), (0, 0), (0, 0), (0, pad_m), (0, 0)))
+        # One scatter per cache half writes all N slots' [L, kvh, M, d]
+        # blocks (in-place: donated state).
+        new_k = state.k.at[:, slots].set(kf.astype(state.k.dtype))
+        new_v = state.v.at[:, slots].set(vf.astype(state.v.dtype))
+        return DecodeState(
+            k=new_k, v=new_v,
+            lengths=state.lengths.at[slots].set(true_lens),
+            last_tokens=state.last_tokens.at[slots].set(firsts),
+            active=state.active.at[slots].set(True),
+        ), firsts, rng
 
     def release(self, state: DecodeState, slot: int) -> DecodeState:
         """Mark a slot free (cache contents are dead; lengths gate reads).

@@ -23,6 +23,7 @@ API (JSON over stdlib http.server, threaded):
 from __future__ import annotations
 
 import json
+import os
 import queue
 import threading
 import time
@@ -99,6 +100,21 @@ class GenerationScheduler:
 
     # Dispatch-ahead bound: caps emitter lag (and wasted steps past EOS).
     MAX_BACKLOG = 32
+
+    # Same-bucket admissions fused into one admit_many dispatch
+    # ($SKYTPU_ADMIT_BATCH, default 1 = solo). Fusion divides admission
+    # dispatch round-trips by N — but the fused N-prompt prefill is one
+    # LONG dispatch during which no decode step runs, so every occupied
+    # slot stalls ~N x prefill_time at once. Measured on the v5e serve
+    # bench (2500-tok prompts, 32 slots): N=4 cut TTFT p50 up to ~30%
+    # in herd waves but nearly doubled TPOT p99 (decode stalls) and
+    # lost ~10% req/s; solo admits won overall. Fusion stays available
+    # for links where dispatch RTT dominates prefill time (RTT >> 150ms
+    # per 2.5k-token prefill). When enabled, fusion fires ONLY at
+    # exactly this group size so each traffic bucket compiles exactly
+    # ONE extra variant (free N would compile N=2/N=3 variants
+    # mid-traffic, each a multi-10s XLA stall).
+    ADMIT_BATCH_MAX = int(os.environ.get('SKYTPU_ADMIT_BATCH', '1') or 1)
 
     def __init__(self, config: LlamaConfig, params: Any,
                  batch_slots: int = 8, max_len: Optional[int] = None,
@@ -194,7 +210,9 @@ class GenerationScheduler:
 
         No host sync: the first generated token (sampled from the prefill
         logits — the TTFT token) stays on device and rides the emission
-        pipeline; ``insert`` takes it as a traced scalar.
+        pipeline. Same-bucket requests are FUSED into one admit_many
+        dispatch (up to ADMIT_BATCH_MAX): under a wave of arrivals this
+        divides admission round-trips by the group size.
         """
         import jax.numpy as jnp
 
@@ -203,32 +221,85 @@ class GenerationScheduler:
             free = [i for i, r in enumerate(self._slots) if r is None]
             if not free or self._pending.empty():
                 return
-            req = self._pending.get()
-            slot = free[0]
-            try:
+            # Drain up to the batchable window; group by prefill bucket.
+            # Bucket minorities admit SOLO in this same round (no
+            # requeue: a put-to-back would reset a minority request's
+            # queue position every bounce and can starve it).
+            reqs: List[_Request] = []
+            while (len(reqs) < min(len(free), max(self.ADMIT_BATCH_MAX, 1))
+                   and not self._pending.empty()):
+                reqs.append(self._pending.get())
+            group: List[tuple] = []  # (req, prompt) — same bucket
+            solo: List[tuple] = []   # (req, prompt, bucket)
+            group_bucket = None
+            for req in reqs:
                 prompt = req.tokens[:eng.max_len - 1]
                 bucket = prefill_bucket(len(prompt), eng.max_len)
-                padded = jnp.asarray(
-                    prompt + [0] * (bucket - len(prompt)), jnp.int32)
                 req.prompt_len = len(prompt)
                 if req.max_tokens <= 1:
                     # Never joins the batch; emitter finishes it.
-                    _, _, logits = eng.prefill(self.params, padded,
-                                               len(prompt))
-                    first_tok, self._rng = eng.sample_first(
-                        logits, self._rng, req.temperature, req.top_k)
-                    self._queue_emission(('first', first_tok, req, None))
+                    try:
+                        padded = jnp.asarray(
+                            prompt + [0] * (bucket - len(prompt)),
+                            jnp.int32)
+                        _, _, logits = eng.prefill(self.params, padded,
+                                                   len(prompt))
+                        first_tok, self._rng = eng.sample_first(
+                            logits, self._rng, req.temperature, req.top_k)
+                        self._queue_emission(('first', first_tok, req,
+                                              None))
+                    except Exception as e:  # noqa: BLE001
+                        req.fail(f'prefill failed: {e!r}')
                     continue
-                # Fused prefill+sample+insert: one dispatch per admission.
-                self.state, first_tok, self._rng = eng.admit(
-                    self.params, self.state, padded, len(prompt), slot,
-                    self._rng, req.temperature, req.top_k)
-            except Exception as e:  # noqa: BLE001 — fail THIS request only
-                req.fail(f'prefill failed: {e!r}')
-                continue
-            self._slots[slot] = req
-            self._dispatched[slot] = 0
-            self._queue_emission(('first', first_tok, req, slot))
+                if group_bucket is None or bucket == group_bucket:
+                    group_bucket = bucket
+                    group.append((req, prompt))
+                else:
+                    solo.append((req, prompt, bucket))
+            # Fusion fires ONLY at exactly ADMIT_BATCH_MAX (> 1): each
+            # traffic bucket compiles exactly one extra variant, and the
+            # default N=1 keeps the measured solo admit path.
+            if (self.ADMIT_BATCH_MAX > 1
+                    and len(group) == self.ADMIT_BATCH_MAX):
+                slots = free[:len(group)]
+                free = free[len(group):]
+                try:
+                    toks = jnp.asarray(
+                        [p + [0] * (group_bucket - len(p))
+                         for _, p in group], jnp.int32)
+                    self.state, firsts, self._rng = eng.admit_many(
+                        self.params, self.state, toks,
+                        [len(p) for _, p in group], slots, self._rng,
+                        [r.temperature for r, _ in group],
+                        [r.top_k for r, _ in group])
+                    # ONE emission item carries the whole [N] device
+                    # array: slicing it per request here would issue N
+                    # gather dispatches on the path that exists to
+                    # minimize dispatches.
+                    for (req, _), slot in zip(group, slots):
+                        self._slots[slot] = req
+                        self._dispatched[slot] = 0
+                    self._queue_emission(
+                        ('firsts', firsts, [r for r, _ in group],
+                         list(slots)))
+                except Exception as e:  # noqa: BLE001 — fail the group
+                    for req, _ in group:
+                        req.fail(f'prefill failed: {e!r}')
+            else:
+                solo = [(r, p, group_bucket) for r, p in group] + solo
+            for (req, prompt, bucket), slot in zip(solo, free):
+                try:
+                    padded = jnp.asarray(
+                        prompt + [0] * (bucket - len(prompt)), jnp.int32)
+                    self.state, first_tok, self._rng = eng.admit(
+                        self.params, self.state, padded, len(prompt),
+                        slot, self._rng, req.temperature, req.top_k)
+                except Exception as e:  # noqa: BLE001 — fail THIS req
+                    req.fail(f'prefill failed: {e!r}')
+                    continue
+                self._slots[slot] = req
+                self._dispatched[slot] = 0
+                self._queue_emission(('first', first_tok, req, slot))
 
     def _queue_emission(self, item: tuple) -> None:
         with self._emit_lock:
@@ -371,6 +442,8 @@ class GenerationScheduler:
                 for item in batch:
                     if item[0] == 'first':
                         failed.append((item[2], item[3]))
+                    elif item[0] == 'firsts':
+                        failed.extend(zip(item[2], item[3]))
                     else:
                         failed.extend(
                             (req, slot)
@@ -387,7 +460,7 @@ class GenerationScheduler:
         """ONE device-to-host transfer for every queued token array, then
         route values + make EOS/max_tokens/full decisions in order."""
         import jax.numpy as jnp
-        arrays = [item[1].reshape(-1) if item[0] == 'step'
+        arrays = [item[1].reshape(-1) if item[0] in ('step', 'firsts')
                   else item[1].reshape(1) for item in batch]
         flat = (jnp.concatenate(arrays) if len(arrays) > 1
                 else arrays[0]).tolist()
@@ -401,6 +474,14 @@ class GenerationScheduler:
                 if req.done:
                     continue
                 self._emit_token(req, tok, slot, now)
+            elif item[0] == 'firsts':
+                _, _, f_reqs, f_slots = item
+                toks = flat[off:off + len(f_reqs)]
+                off += len(f_reqs)
+                for req, slot, tok in zip(f_reqs, f_slots, toks):
+                    if req.done:
+                        continue
+                    self._emit_token(req, int(tok), slot, now)
             else:
                 _, sampled, snapshot = item
                 b = len(snapshot)

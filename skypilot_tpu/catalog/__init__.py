@@ -203,6 +203,8 @@ def validate_region_zone(
     regions.update(fs_regions)
     vast_regions = set(_vms('vast')['region'].unique())
     regions.update(vast_regions)
+    runpod_regions = set(_vms('runpod')['region'].unique())
+    regions.update(runpod_regions)
     zones = set(tpus['zone'])
     # AWS AZs: region + single-letter suffix; regions carry up to six
     # (us-east-1a..f), so accept any letter on a known region.

@@ -1,0 +1,136 @@
+"""Generate the RunPod catalog CSV (runpod_vms.csv).
+
+Plans are ``{n}x_{GPU_ID}_{SECURE|COMMUNITY}`` (the reference invents
+the same shape). Two sources, merged:
+
+1. **GPU types GraphQL query** (``refresh(online=True)``): pulls live
+   per-GPU secure/community prices + specs. A ``types_fetcher`` seam
+   lets tests fake the API without network.
+2. **Static table** below (public pricing; spot = typical interruptible
+   rate ~50%): the offline fallback.
+
+Run:  python -m skypilot_tpu.catalog.fetchers.fetch_runpod [--online]
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+DATA_DIR = os.path.join(_HERE, '..', 'data')
+
+_REGIONS = ('US', 'CA', 'NL', 'SE', 'IS')
+
+# gpu_id -> (vcpus/gpu, mem_gb/gpu, secure $/h/gpu, community $/h/gpu,
+#            counts)
+_GPUS: Dict[str, Tuple[int, float, float, float, Tuple[int, ...]]] = {
+    'NVIDIA_RTX_4090': (8, 48, 0.69, 0.44, (1, 2, 4, 8)),
+    'NVIDIA_RTX_A6000': (8, 50, 0.76, 0.49, (1, 2, 4)),
+    'NVIDIA_A100_80GB_PCIe': (12, 96, 1.64, 1.19, (1, 2, 4, 8)),
+    'NVIDIA_H100_80GB_HBM3': (16, 188, 2.99, 2.39, (1, 2, 4, 8)),
+}
+
+_SPOT_FRACTION = 0.5
+
+
+def fetch_gpu_types(
+        types_fetcher: Optional[Callable[[], List[Dict[str, Any]]]] = None
+) -> List[Dict[str, Any]]:
+    """Live gpuTypes payload: [{id, securePrice, communityPrice,
+    memoryInGb, maxGpuCount}]. ``types_fetcher`` is the test seam."""
+    if types_fetcher is not None:
+        return types_fetcher()
+    from skypilot_tpu.provision import runpod_api
+    client = runpod_api.get_client()
+    data = client._gql(  # pylint: disable=protected-access
+        'query { gpuTypes { id securePrice communityPrice memoryInGb '
+        'maxGpuCount } }')
+    return list(data.get('gpuTypes') or [])
+
+
+def generate_vm_rows(live: Optional[List[Dict[str, Any]]] = None
+                     ) -> List[Dict[str, object]]:
+    rows: List[Dict[str, object]] = []
+    if live:
+        for g in sorted(live, key=lambda g: g.get('id', '')):
+            gid = (g.get('id') or '').replace(' ', '_')
+            if not gid:
+                continue
+            mem = float(g.get('memoryInGb') or 48)
+            # vcpus/GPU isn't in the gpuTypes payload: keep the static
+            # table's per-GPU value for known ids so an online refresh
+            # never rewrites cpu filters (H100 is 16/gpu, not 8).
+            vcpus_per_gpu = _GPUS.get(gid, (8,))[0]
+            counts = tuple(range(1, int(g.get('maxGpuCount') or 1) + 1))
+            for cloud_type, price_key in (('SECURE', 'securePrice'),
+                                          ('COMMUNITY', 'communityPrice')):
+                price = float(g.get(price_key) or 0)
+                if price <= 0:
+                    continue
+                for n in counts:
+                    for region in _REGIONS:
+                        rows.append({
+                            'instance_type': f'{n}x_{gid}_{cloud_type}',
+                            'vcpus': vcpus_per_gpu * n,
+                            'memory_gb': mem * n,
+                            'region': region,
+                            'price': round(price * n, 4),
+                            'spot_price': round(
+                                price * n * _SPOT_FRACTION, 4),
+                        })
+        if rows:
+            return rows
+    for gid, (vcpus, mem, secure, community, counts) in _GPUS.items():
+        for cloud_type, price in (('SECURE', secure),
+                                  ('COMMUNITY', community)):
+            for n in counts:
+                for region in _REGIONS:
+                    rows.append({
+                        'instance_type': f'{n}x_{gid}_{cloud_type}',
+                        'vcpus': vcpus * n,
+                        'memory_gb': mem * n,
+                        'region': region,
+                        'price': round(price * n, 4),
+                        'spot_price': round(price * n * _SPOT_FRACTION,
+                                            4),
+                    })
+    return rows
+
+
+def refresh(online: bool = False,
+            types_fetcher: Optional[Callable[[], List[Dict[str, Any]]]] = None
+            ) -> str:
+    """Regenerate runpod_vms.csv; returns 'online'/'offline'/'stale'."""
+    live: List[Dict[str, Any]] = []
+    source = 'offline'
+    if online:
+        try:
+            live = fetch_gpu_types(types_fetcher)
+            if live:
+                source = 'online'
+        except Exception as e:  # noqa: BLE001 — any failure = fallback
+            print(f'gpuTypes query unavailable ({type(e).__name__}: {e}); '
+                  'using static price table')
+    from skypilot_tpu.catalog.fetchers.fetch_gcp import write_csv
+    rows = generate_vm_rows(live)
+    try:
+        write_csv(os.path.join(DATA_DIR, 'runpod_vms.csv'), rows)
+    except OSError as e:
+        print(f'catalog dir not writable ({e}); keeping existing CSV')
+        return 'stale'
+    print(f'Wrote {len(rows)} RunPod plan rows to '
+          f'{os.path.normpath(DATA_DIR)} ({source})')
+    return source
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    import argparse
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--online', action='store_true',
+                        help='fetch live prices via the gpuTypes query')
+    args = parser.parse_args(argv)
+    refresh(online=args.online)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,124 @@
+"""RunPod: container pods (spot bids, terminate-only, fixed port sets).
+
+Counterpart of reference ``sky/clouds/runpod.py`` (STOP unsupported at
+:27; spot pods via bidPerGpu). Eighth VM cloud: spot WITHOUT stop — a
+feature combination none of the previous clouds exercise — and ports
+fixed at rent time (declared from resources.ports at launch; open_ports
+verifies instead of mutating).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu.clouds import cloud as cloud_lib
+
+
+@cloud_lib.CLOUD_REGISTRY.register(name='runpod')
+class RunPod(cloud_lib.Cloud):
+    NAME = 'runpod'
+    _FEATURES = frozenset({
+        cloud_lib.CloudFeature.AUTOSTOP,   # autodown only (no STOP)
+        cloud_lib.CloudFeature.SPOT,       # interruptible pods w/ bids
+        cloud_lib.CloudFeature.MULTI_HOST,
+        cloud_lib.CloudFeature.STORAGE_MOUNTS,
+        cloud_lib.CloudFeature.OPEN_PORTS,  # declared at rent time
+        cloud_lib.CloudFeature.CUSTOM_IMAGES,  # any docker image
+    })
+
+    # ---- credentials ------------------------------------------------------
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        if os.environ.get('SKYTPU_FAKE_RUNPOD_CREDENTIALS'):
+            return True, None
+        from skypilot_tpu.provision import runpod_api
+        if runpod_api.read_api_key() is not None:
+            return True, None
+        return False, ('RunPod credentials not found. Set '
+                       '$RUNPOD_API_KEY or run `runpod config`.')
+
+    @classmethod
+    def get_active_user_identity(cls) -> Optional[List[str]]:
+        if os.environ.get('SKYTPU_FAKE_RUNPOD_CREDENTIALS'):
+            return ['fake-identity@runpod.test']
+        from skypilot_tpu.provision import runpod_api
+        key = runpod_api.read_api_key()
+        return [f'runpod-key-{key[:8]}'] if key else None
+
+    # ---- topology ---------------------------------------------------------
+    def regions_for(self, resources) -> List[str]:
+        if resources.tpu is not None:
+            return []  # no TPUs on RunPod
+        itype = resources.instance_type or '1x_NVIDIA_RTX_4090_SECURE'
+        regions = catalog.get_vm_regions(itype, cloud=self.NAME)
+        if resources.region is not None:
+            regions = [r for r in regions if r == resources.region]
+        return regions
+
+    def zones_for(self, resources, region: str) -> List[Optional[str]]:
+        if resources.zone is not None:
+            return []  # no zones
+        return [None]
+
+    # ---- pricing ----------------------------------------------------------
+    def hourly_cost(self, resources, region=None, zone=None) -> float:
+        region = region or resources.region
+        assert resources.instance_type is not None, resources
+        return catalog.get_instance_hourly_cost(
+            resources.instance_type, resources.use_spot, region=region,
+            cloud=self.NAME)
+
+    def egress_cost_per_gb(self, dst_cloud: str, dst_region: str,
+                           src_region: Optional[str]) -> float:
+        return 0.0  # RunPod does not bill egress
+
+    # ---- feasibility ------------------------------------------------------
+    def get_feasible_resources(self,
+                               resources) -> cloud_lib.FeasibleResources:
+        if resources.tpu is not None:
+            return cloud_lib.FeasibleResources(
+                [], hint='RunPod has no TPU accelerators; use cloud: gcp.')
+        if resources.instance_type is not None:
+            if not catalog.get_vm_regions(resources.instance_type,
+                                          cloud=self.NAME):
+                return cloud_lib.FeasibleResources(
+                    [], hint=(f'{resources.instance_type} is not a RunPod '
+                              'plan in the catalog (format: '
+                              '{n}x_{GPU_ID}_{SECURE|COMMUNITY}).'))
+            return cloud_lib.FeasibleResources(
+                [resources.copy(cloud=self.NAME)])
+        itype = catalog.get_default_instance_type(
+            cpus=resources._cpus, cpus_plus=resources._cpus_plus,  # pylint: disable=protected-access
+            memory=resources._memory, memory_plus=resources._memory_plus,  # pylint: disable=protected-access
+            region=resources.region, cloud=self.NAME)
+        if itype is None:
+            return cloud_lib.FeasibleResources(
+                [], hint=(f'No RunPod plan with cpus={resources.cpus}, '
+                          f'memory={resources.memory}'))
+        return cloud_lib.FeasibleResources(
+            [resources.copy(cloud=self.NAME, instance_type=itype)])
+
+    # ---- deployment -------------------------------------------------------
+    def make_deploy_variables(self, resources, cluster_name_on_cloud: str,
+                              region: str,
+                              zone: Optional[str]) -> Dict[str, Any]:
+        from skypilot_tpu.provision import docker_utils
+        image_id = resources.image_id
+        if docker_utils.is_docker_image(image_id):
+            # Pods ARE containers: the task image is the pod image.
+            image_id = docker_utils.image_name(image_id)
+        return {
+            'cloud': self.NAME,
+            'mode': 'runpod_pod',
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region,
+            'zone': None,
+            'use_spot': resources.use_spot,
+            'disk_size_gb': resources.disk_size,
+            'labels': dict(resources.labels or {}),
+            # Ports ride the pod spec (fixed at rent time).
+            'ports': list(resources.ports or ()),
+            'instance_type': resources.instance_type,
+            'image_id': image_id,
+        }

@@ -29,6 +29,7 @@ _CLOUD_MODULES = {
     'do': 'skypilot_tpu.provision.do_impl',
     'fluidstack': 'skypilot_tpu.provision.fluidstack_impl',
     'vast': 'skypilot_tpu.provision.vast_impl',
+    'runpod': 'skypilot_tpu.provision.runpod_impl',
 }
 
 

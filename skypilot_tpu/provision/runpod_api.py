@@ -1,0 +1,139 @@
+"""Thin RunPod GraphQL client with a test seam.
+
+Counterpart of the reference's ``sky/provision/runpod/utils.py`` (runpod
+SDK wrapper: create_pod / create_spot_pod / get_pods / terminate). The
+real transport POSTs GraphQL to ``https://api.runpod.io/graphql``
+(``api_key`` query param, the SDK's auth shape); tests install an
+in-process fake via ``set_runpod_factory`` implementing the same flat
+surface (``create_pod``, ``list_pods``, ``terminate_pod``), so pod
+lifecycle + bid/failover logic runs for real with no cloud.
+
+Error classification: "no longer any instances available" / "no gpu
+found" wording (the API's stockout phrasing) -> capacity failover;
+balance/spend-limit wording -> quota.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+import os
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import rest_cloud
+
+API_ENDPOINT = 'https://api.runpod.io/graphql'
+CREDENTIALS_PATH = '~/.runpod/config.toml'
+
+_CAPACITY_MARKERS = (
+    'no longer any instances available',
+    'no gpu found',
+    'not enough',
+    'unavailable',
+)
+_QUOTA_MARKERS = (
+    'spend limit',
+    'insufficient balance',
+    'zero balance',
+)
+
+
+class RunpodApiError(Exception):
+    """Fake/real client error carrying a GraphQL error message."""
+
+    def __init__(self, message: str):
+        super().__init__(message)
+        self.message = message
+
+
+classify_error = rest_cloud.marker_classifier(_CAPACITY_MARKERS,
+                                              _QUOTA_MARKERS)
+
+
+def read_api_key() -> Optional[str]:
+    env = os.environ.get('RUNPOD_API_KEY')
+    if env:
+        return env
+    path = os.path.expanduser(CREDENTIALS_PATH)
+    if os.path.exists(path):
+        # Minimal TOML: the SDK writes `api_key = "<key>"`.
+        with open(path, encoding='utf-8') as f:
+            for line in f:
+                key, _, value = line.partition('=')
+                if key.strip() == 'api_key':
+                    return value.strip().strip('"\'') or None
+    return None
+
+
+def _parse_error(status: int, raw: bytes) -> Exception:
+    try:
+        body = json.loads(raw.decode())
+        errs = body.get('errors') or []
+        if errs:
+            return RunpodApiError(errs[0].get('message', raw.decode()))
+        return RunpodApiError(raw.decode())
+    except (ValueError, AttributeError):
+        return RunpodApiError(raw.decode(errors='replace') or str(status))
+
+
+class _RestClient:
+    """Flat op surface over GraphQL mutations/queries."""
+
+    def __init__(self):
+        api_key = read_api_key()
+        if api_key is None:
+            raise exceptions.CloudError(
+                'RunPod credentials not found: set $RUNPOD_API_KEY or '
+                f'run `runpod config` ({CREDENTIALS_PATH}).')
+        self._url = f'{API_ENDPOINT}?api_key={api_key}'
+        self._headers = {'Content-Type': 'application/json'}
+
+    def _gql(self, query: str,
+             variables: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        body = rest_cloud.retrying_request(
+            'POST', self._url, self._headers,
+            {'query': query, 'variables': variables or {}}, _parse_error)
+        errs = body.get('errors') or []
+        if errs:  # GraphQL errors ride a 200 response
+            raise RunpodApiError(errs[0].get('message', str(errs[0])))
+        return body.get('data') or {}
+
+    # -- flat op surface (mirrored by test fakes) ---------------------------
+    def create_pod(self, name: str, image: str, gpu_type_id: str,
+                   gpu_count: int, cloud_type: str, country_code: str,
+                   disk_gb: int, ports: str, docker_args: str,
+                   bid_per_gpu: Optional[float] = None) -> Dict[str, Any]:
+        mutation = ('podRentInterruptable' if bid_per_gpu is not None
+                    else 'podFindAndDeployOnDemand')
+        inp: Dict[str, Any] = {
+            'name': name, 'imageName': image, 'gpuTypeId': gpu_type_id,
+            'gpuCount': gpu_count, 'cloudType': cloud_type,
+            'countryCode': country_code, 'containerDiskInGb': disk_gb,
+            'ports': ports, 'dockerArgs': docker_args,
+            'supportPublicIp': True,
+        }
+        if bid_per_gpu is not None:
+            inp['bidPerGpu'] = bid_per_gpu
+        data = self._gql(
+            f'mutation($input: PodRentInput!) {{ {mutation}(input: $input)'
+            ' { id desiredStatus } }', {'input': inp})
+        return dict(data.get(mutation) or {})
+
+    def list_pods(self) -> List[Dict[str, Any]]:
+        data = self._gql(
+            'query { myself { pods { id name desiredStatus costPerHr '
+            'runtime { ports { ip isIpPublic privatePort publicPort } } '
+            '} } }')
+        return list(((data.get('myself') or {}).get('pods')) or [])
+
+    def terminate_pod(self, pod_id: str) -> None:
+        self._gql('mutation($id: String!) { podTerminate(podId: $id) }',
+                  {'id': pod_id})
+
+
+# Test seam (``set_runpod_factory(lambda: fake)``), client construction
+# and error-normalizing ``call`` via the shared ClientSeam.
+_seam = rest_cloud.ClientSeam(_RestClient, RunpodApiError, classify_error)
+set_runpod_factory = _seam.set_factory
+get_client = _seam.get_client
+call = _seam.call

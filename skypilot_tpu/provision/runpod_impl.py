@@ -1,0 +1,261 @@
+"""RunPod provisioner: container pods with spot bids (terminate-only).
+
+Counterpart of reference ``sky/provision/runpod/instance.py`` +
+``utils.py`` (pod launch with ssh bootstrap via dockerArgs, spot pods
+with bidPerGpu, no stop). RunPod-isms:
+
+- pods are CONTAINERS: ssh is bootstrapped by the pod's docker command
+  (sshd install + the local public key, reference utils.py:258-283) and
+  lands on a host-mapped public port;
+- instance types are ``{n}x_{GPU_ID}_{SECURE|COMMUNITY}`` plans
+  (reference invents the same shape); regions are country codes;
+- ``use_spot`` rents an interruptible pod with a per-GPU bid
+  (catalog's spot price / gpu count); a preempted spot pod DISAPPEARS
+  from the pod list (terminate semantics), which the shared rank-hole
+  detection already classifies as capacity;
+- ports CANNOT be opened after creation — the pod's port set is fixed
+  at rent time, so ``open_ports`` only verifies the request against
+  what run_instances already declared from deploy_vars.
+"""
+from __future__ import annotations
+
+import shlex
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import provision as provision_lib
+from skypilot_tpu.provision import rest_cloud
+from skypilot_tpu.provision import runpod_api
+from skypilot_tpu.utils import command_runner as runner_lib
+
+SSH_USER = 'root'
+
+DEFAULT_IMAGE = 'runpod/base:0.6.2-cpu'
+
+_STATE_MAP = {
+    'CREATED': 'pending',
+    'RUNNING': 'running',
+    'RESTARTING': 'pending',
+    'EXITED': 'stopped',
+    'TERMINATED': 'terminated',
+}
+
+# Cluster bookkeeping + rank decoding via the shared REST-cloud
+# scaffolding (rest_cloud.py).
+_records = rest_cloud.ClusterRecords('runpod_cluster')
+
+
+def split_plan(instance_type: str) -> tuple:
+    """'2x_NVIDIA_RTX_4090_SECURE' -> (2, 'NVIDIA RTX 4090', 'SECURE')."""
+    parts = instance_type.split('_')
+    count = int(parts[0].rstrip('x'))
+    cloud_type = parts[-1]
+    if cloud_type not in ('SECURE', 'COMMUNITY'):
+        cloud_type = 'SECURE'
+        gpu = ' '.join(parts[1:])
+    else:
+        gpu = ' '.join(parts[1:-1])
+    return count, gpu, cloud_type
+
+
+def _live_pods(client, name: str) -> Dict[int, Dict[str, Any]]:
+    out: Dict[int, Dict[str, Any]] = {}
+    for pod in runpod_api.call(client, 'list_pods'):
+        rank = rest_cloud.rank_of(pod.get('name') or '', name)
+        if rank is None:
+            continue
+        if pod.get('desiredStatus') == 'TERMINATED':
+            continue
+        out[rank] = pod
+    return out
+
+
+def _bootstrap_docker_args() -> str:
+    """Pod entry command: install sshd + the local public key, then hold
+    the container open (reference utils.py setup_cmd)."""
+    from skypilot_tpu import authentication
+    _, pub_path = authentication.get_or_generate_keys()
+    with open(pub_path, encoding='utf-8') as f:
+        pub_key = f.read().strip()
+    script = (
+        'apt-get update && '
+        'DEBIAN_FRONTEND=noninteractive apt-get install -y '
+        'openssh-server rsync && '
+        'mkdir -p /var/run/sshd ~/.ssh && '
+        f'echo {shlex.quote(pub_key)} >> ~/.ssh/authorized_keys && '
+        'chmod 700 ~/.ssh && chmod 600 ~/.ssh/authorized_keys && '
+        'service ssh restart && sleep infinity')
+    return f'bash -c {shlex.quote(script)}'
+
+
+def _ports_spec(deploy_vars: Dict[str, Any]) -> str:
+    """The pod's FIXED port set: ssh + every task port, declared at rent
+    time (RunPod cannot open ports later)."""
+    ports = ['22/tcp']
+    for p in deploy_vars.get('ports') or []:
+        if '-' in str(p):
+            lo, hi = (int(x) for x in str(p).split('-', 1))
+            ports.extend(f'{q}/tcp' for q in range(lo, hi + 1))
+        else:
+            ports.append(f'{int(p)}/tcp')
+    return ','.join(ports)
+
+
+# ---- provision API ---------------------------------------------------------
+def run_instances(cluster_name: str, region: str, zone: Optional[str],
+                  num_hosts: int, deploy_vars: Dict[str, Any]) -> None:
+    del zone  # country codes only
+    name = deploy_vars['cluster_name_on_cloud']
+    use_spot = bool(deploy_vars.get('use_spot'))
+    record = {'region': region, 'zone': None, 'name_on_cloud': name,
+              'num_hosts': num_hosts, 'deploy_vars': deploy_vars}
+    _records.save(cluster_name, record)
+    client = runpod_api.get_client()
+    count, gpu, cloud_type = split_plan(
+        deploy_vars.get('instance_type', '1x_NVIDIA_RTX_4090_SECURE'))
+    bid = None
+    if use_spot:
+        from skypilot_tpu import catalog
+        total = catalog.get_instance_hourly_cost(
+            deploy_vars['instance_type'], use_spot=True, region=region,
+            cloud='runpod')
+        bid = round(total / count, 4)
+    try:
+        existing = _live_pods(client, name)
+        for rank in range(num_hosts):
+            if rank in existing:
+                continue  # idempotent relaunch
+            runpod_api.call(
+                client, 'create_pod',
+                name=f'{name}-r{rank}',
+                image=deploy_vars.get('image_id') or DEFAULT_IMAGE,
+                gpu_type_id=gpu,
+                gpu_count=count,
+                cloud_type=cloud_type,
+                country_code=region,
+                disk_gb=int(deploy_vars.get('disk_size_gb') or 50),
+                ports=_ports_spec(deploy_vars),
+                docker_args=_bootstrap_docker_args(),
+                bid_per_gpu=bid)
+    except exceptions.InsufficientCapacityError:
+        try:
+            _terminate_all(client, name)
+        except exceptions.CloudError:
+            pass
+        else:
+            _records.delete(cluster_name)
+        raise
+
+
+def wait_instances(cluster_name: str, region: str, state: str = 'running',
+                   timeout: float = 1800) -> None:
+    if state != 'running':
+        raise exceptions.NotSupportedError(
+            'RunPod cannot stop pods (terminate-only).')
+    rest_cloud.poll_for_state(
+        cluster_name, lambda: query_instances(cluster_name, region),
+        state, timeout)
+
+
+def query_instances(cluster_name: str, region: str) -> Dict[str, str]:
+    del region
+    record = _records.load(cluster_name)
+    if not record:
+        return {}
+    client = runpod_api.get_client()
+    live = _live_pods(client, record['name_on_cloud'])
+    if not live:
+        return {}
+    out: Dict[str, str] = {}
+    for rank, pod in live.items():
+        out[pod.get('name', f'r{rank}')] = _STATE_MAP.get(
+            pod.get('desiredStatus', ''), 'unknown')
+    for rank in range(int(record.get('num_hosts') or 0)):
+        if rank not in live:
+            # A preempted spot pod disappears from the list: the hole
+            # classifies as capacity via the shared poll loop.
+            out[f'rank{rank}-missing'] = 'terminated'
+    return out
+
+
+def stop_instances(cluster_name: str, region: str) -> None:
+    raise exceptions.NotSupportedError(
+        'RunPod cannot stop pods (terminate-only); '
+        'use `skytpu down` instead.')
+
+
+def _terminate_all(client, name: str) -> None:
+    for pod in _live_pods(client, name).values():
+        runpod_api.call(client, 'terminate_pod', pod_id=pod['id'])
+
+
+def terminate_instances(cluster_name: str, region: str) -> None:
+    del region
+    record = _records.load(cluster_name)
+    if not record:
+        return
+    client = runpod_api.get_client()
+    _terminate_all(client, record['name_on_cloud'])
+    _records.delete(cluster_name)
+
+
+def _ssh_endpoint(pod: Dict[str, Any]) -> tuple:
+    """(ip, public_port) of the pod's mapped ssh port."""
+    runtime = pod.get('runtime') or {}
+    for port in runtime.get('ports') or []:
+        if port.get('privatePort') == 22 and port.get('isIpPublic'):
+            return port.get('ip'), int(port.get('publicPort') or 22)
+    return None, 22
+
+
+def get_cluster_info(cluster_name: str,
+                     region: str) -> provision_lib.ClusterInfo:
+    del region
+    record = _records.require(cluster_name, 'RunPod')
+    client = runpod_api.get_client()
+    live = _live_pods(client, record['name_on_cloud'])
+    hosts: List[provision_lib.HostInfo] = []
+    for rank in sorted(live):
+        pod = live[rank]
+        ip, port = _ssh_endpoint(pod)
+        if ip is None:
+            raise exceptions.ProvisionError(
+                f'Pod {pod.get("name")!r} has no public ssh port mapping '
+                'yet.')
+        hosts.append(provision_lib.HostInfo(
+            host_id=str(pod['id']), rank=rank,
+            internal_ip=ip, external_ip=ip, ssh_port=port,
+            extra={}))
+    return provision_lib.ClusterInfo(
+        cluster_name=cluster_name, cloud='runpod',
+        region=record['region'], zone=None, hosts=hosts,
+        deploy_vars=record['deploy_vars'])
+
+
+def open_ports(cluster_name: str, region: str, ports: List[str]) -> None:
+    """RunPod port sets are FIXED at rent time: run_instances already
+    declared deploy_vars['ports']; this verifies the request is covered
+    and raises an actionable error otherwise (re-renting the pod is the
+    only way to change its ports)."""
+    record = _records.require(cluster_name, 'RunPod')
+    declared = _ports_spec(record.get('deploy_vars') or {})
+    have = set(declared.split(','))
+    missing = []
+    for p in ports:
+        if '-' in str(p):
+            lo, hi = (int(x) for x in str(p).split('-', 1))
+            missing.extend(f'{q}/tcp' for q in range(lo, hi + 1)
+                           if f'{q}/tcp' not in have)
+        elif f'{int(p)}/tcp' not in have:
+            missing.append(f'{int(p)}/tcp')
+    if missing:
+        raise exceptions.NotSupportedError(
+            f'RunPod pods cannot open ports after creation; {missing} '
+            'were not declared at launch. Add them to resources.ports '
+            'and relaunch.')
+
+
+def get_command_runners(cluster_info: provision_lib.ClusterInfo,
+                        ssh_credentials: Optional[Dict[str, str]] = None
+                        ) -> List[runner_lib.CommandRunner]:
+    return rest_cloud.ssh_runners(cluster_info, SSH_USER, ssh_credentials)

@@ -470,3 +470,53 @@ def test_vast_fetcher_live_medians(tmp_path, monkeypatch):
     ca = [r for r in rows if r['instance_type'] == '1x_RTX_4090'
           and r['region'] == 'CA'][0]
     assert float(ca['price']) == 0.42
+
+
+def test_committed_runpod_catalog_matches_regeneration(tmp_path,
+                                                       monkeypatch):
+    """Drift guard: runpod_vms.csv must equal the offline fetcher
+    output."""
+    import csv as csv_lib
+    import os
+    from skypilot_tpu.catalog.fetchers import fetch_runpod
+
+    monkeypatch.setattr(fetch_runpod, 'DATA_DIR', str(tmp_path))
+    assert fetch_runpod.refresh(online=False) == 'offline'
+    committed_path = os.path.join(
+        os.path.dirname(os.path.abspath(fetch_runpod.__file__)), '..',
+        'data', 'runpod_vms.csv')
+    committed = open(committed_path).read()
+    assert committed == (tmp_path / 'runpod_vms.csv').read_text(), (
+        'runpod_vms.csv drifted from the fetcher: run '
+        'python -m skypilot_tpu.catalog.fetchers.fetch_runpod')
+    rows = list(csv_lib.DictReader(open(tmp_path / 'runpod_vms.csv')))
+    secure = [r for r in rows
+              if r['instance_type'] == '1x_NVIDIA_RTX_4090_SECURE'
+              and r['region'] == 'US'][0]
+    community = [r for r in rows
+                 if r['instance_type'] == '1x_NVIDIA_RTX_4090_COMMUNITY'
+                 and r['region'] == 'US'][0]
+    assert float(community['price']) < float(secure['price'])
+    assert float(secure['spot_price']) < float(secure['price'])
+
+
+def test_runpod_fetcher_live_override(tmp_path, monkeypatch):
+    """Live gpuTypes payloads replace the static table; plan count
+    scales with maxGpuCount and both cloud tiers are emitted."""
+    from skypilot_tpu.catalog.fetchers import fetch_runpod
+
+    live = [{'id': 'NVIDIA B200', 'securePrice': 5.98,
+             'communityPrice': 4.49, 'memoryInGb': 180,
+             'maxGpuCount': 2}]
+    monkeypatch.setattr(fetch_runpod, 'DATA_DIR', str(tmp_path))
+    assert fetch_runpod.refresh(online=True,
+                                types_fetcher=lambda: live) == 'online'
+    import csv as csv_lib
+    rows = list(csv_lib.DictReader(open(tmp_path / 'runpod_vms.csv')))
+    types = {r['instance_type'] for r in rows}
+    assert types == {'1x_NVIDIA_B200_SECURE', '2x_NVIDIA_B200_SECURE',
+                     '1x_NVIDIA_B200_COMMUNITY',
+                     '2x_NVIDIA_B200_COMMUNITY'}
+    two = [r for r in rows if r['instance_type'] == '2x_NVIDIA_B200_SECURE'
+           and r['region'] == 'US'][0]
+    assert float(two['price']) == pytest.approx(2 * 5.98)

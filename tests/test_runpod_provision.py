@@ -1,0 +1,230 @@
+"""RunPod provisioner tests against an in-process fake client.
+
+The fake implements the flat pod surface (create_pod / list_pods /
+terminate_pod) — so the container lifecycle, spot bids, fixed-at-rent
+port sets, host-mapped ssh endpoints, and stockout failover run for
+real with no cloud and no GraphQL.
+"""
+import itertools
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import exceptions
+from skypilot_tpu.backends.slice_backend import RetryingProvisioner
+from skypilot_tpu.provision import runpod_api
+from skypilot_tpu.provision import runpod_impl
+
+
+class FakeRunPod:
+    """In-memory RunPod account."""
+
+    def __init__(self):
+        self.pods = {}
+        self.fail_regions = set()
+        self.quota_error = False
+        self.create_calls = []
+        self._ids = itertools.count(7000)
+
+    def create_pod(self, name, image, gpu_type_id, gpu_count, cloud_type,
+                   country_code, disk_gb, ports, docker_args,
+                   bid_per_gpu=None):
+        self.create_calls.append((country_code, name, bid_per_gpu))
+        if self.quota_error:
+            raise runpod_api.RunpodApiError(
+                'You have reached your spend limit')
+        if country_code in self.fail_regions:
+            raise runpod_api.RunpodApiError(
+                'There are no longer any instances available with the '
+                'requested specifications')
+        n = next(self._ids)
+        pid = f'pod-{n}'
+        self.pods[pid] = {
+            'id': pid, 'name': name, 'desiredStatus': 'RUNNING',
+            'costPerHr': 0.69 if bid_per_gpu is None else bid_per_gpu,
+            'ports_spec': ports, 'image': image,
+            'bid_per_gpu': bid_per_gpu, 'docker_args': docker_args,
+            'runtime': {'ports': [
+                {'ip': f'194.26.0.{n % 250}', 'isIpPublic': True,
+                 'privatePort': 22, 'publicPort': 20000 + n % 1000},
+            ]},
+        }
+        return {'id': pid, 'desiredStatus': 'RUNNING'}
+
+    def list_pods(self):
+        return [dict(p) for p in self.pods.values()
+                if p['desiredStatus'] != 'TERMINATED']
+
+    def terminate_pod(self, pod_id):
+        if pod_id in self.pods:
+            self.pods[pod_id]['desiredStatus'] = 'TERMINATED'
+
+
+@pytest.fixture
+def fake_runpod(monkeypatch, tmp_path):
+    account = FakeRunPod()
+    runpod_api.set_runpod_factory(lambda: account)
+    monkeypatch.setenv('SKYTPU_FAKE_RUNPOD_CREDENTIALS', '1')
+    priv = tmp_path / 'key'
+    pub = tmp_path / 'key.pub'
+    priv.write_text('fake-private')
+    pub.write_text('ssh-ed25519 AAAA test')
+    monkeypatch.setattr('skypilot_tpu.authentication.get_or_generate_keys',
+                        lambda: (str(priv), str(pub)))
+    yield account
+    runpod_api.set_runpod_factory(None)
+
+
+def _deploy_vars(**over):
+    base = {
+        'cloud': 'runpod', 'mode': 'runpod_pod',
+        'cluster_name_on_cloud': 'c-rp1',
+        'instance_type': '1x_NVIDIA_RTX_4090_SECURE', 'image_id': None,
+        'disk_size_gb': 50, 'use_spot': False, 'labels': {}, 'ports': [],
+    }
+    base.update(over)
+    return base
+
+
+class TestLifecycle:
+
+    def test_create_query_info_terminate(self, fake_runpod):
+        dv = _deploy_vars()
+        runpod_impl.run_instances('r1', 'US', None, 2, dv)
+        runpod_impl.wait_instances('r1', 'US', timeout=5)
+        states = runpod_impl.query_instances('r1', 'US')
+        assert set(states.values()) == {'running'} and len(states) == 2
+
+        info = runpod_impl.get_cluster_info('r1', 'US')
+        assert info.num_hosts == 2
+        assert info.head.ssh_port >= 20000  # host-mapped, not 22
+        runner = runpod_impl.get_command_runners(info)[0]
+        assert runner.port == info.head.ssh_port
+
+        runpod_impl.terminate_instances('r1', 'US')
+        assert runpod_impl.query_instances('r1', 'US') == {}
+
+    def test_stop_is_not_supported(self, fake_runpod):
+        runpod_impl.run_instances('r2', 'US', None, 1, _deploy_vars())
+        with pytest.raises(exceptions.NotSupportedError):
+            runpod_impl.stop_instances('r2', 'US')
+
+    def test_pod_bootstrap_installs_ssh_key(self, fake_runpod):
+        runpod_impl.run_instances('r3', 'US', None, 1, _deploy_vars())
+        pod = next(iter(fake_runpod.pods.values()))
+        assert 'authorized_keys' in pod['docker_args']
+        assert 'openssh-server' in pod['docker_args']
+
+    def test_plan_parsing(self):
+        assert runpod_impl.split_plan('2x_NVIDIA_RTX_4090_SECURE') == (
+            2, 'NVIDIA RTX 4090', 'SECURE')
+        assert runpod_impl.split_plan(
+            '8x_NVIDIA_H100_80GB_HBM3_COMMUNITY') == (
+            8, 'NVIDIA H100 80GB HBM3', 'COMMUNITY')
+
+
+class TestPortsFixedAtRent:
+
+    def test_declared_ports_ride_the_pod_spec(self, fake_runpod):
+        runpod_impl.run_instances('p1', 'US', None, 1,
+                                  _deploy_vars(ports=['8080']))
+        pod = next(iter(fake_runpod.pods.values()))
+        assert '22/tcp' in pod['ports_spec']
+        assert '8080/tcp' in pod['ports_spec']
+        # open_ports for a declared port: verification passes, no-op.
+        runpod_impl.open_ports('p1', 'US', ['8080'])
+
+    def test_undeclared_port_is_actionable_error(self, fake_runpod):
+        runpod_impl.run_instances('p2', 'US', None, 1, _deploy_vars())
+        with pytest.raises(exceptions.NotSupportedError,
+                           match='resources.ports'):
+            runpod_impl.open_ports('p2', 'US', ['9090'])
+
+
+class TestSpot:
+
+    def test_spot_pod_gets_per_gpu_bid(self, fake_runpod):
+        runpod_impl.run_instances(
+            's1', 'US', None, 1,
+            _deploy_vars(use_spot=True,
+                         instance_type='2x_NVIDIA_RTX_4090_SECURE'))
+        _, _, bid = fake_runpod.create_calls[0]
+        # Catalog spot total for 2x SECURE / 2 gpus.
+        from skypilot_tpu import catalog
+        total = catalog.get_instance_hourly_cost(
+            '2x_NVIDIA_RTX_4090_SECURE', use_spot=True, region='US',
+            cloud='runpod')
+        assert bid == pytest.approx(total / 2, abs=1e-4)
+
+    def test_preempted_spot_pod_is_a_rank_hole(self, fake_runpod):
+        runpod_impl.run_instances('s2', 'US', None, 2,
+                                  _deploy_vars(use_spot=True))
+        victim = next(p for p in fake_runpod.pods.values()
+                      if p['name'].endswith('-r1'))
+        # RunPod spot preemption removes the pod.
+        victim['desiredStatus'] = 'TERMINATED'
+        states = runpod_impl.query_instances('s2', 'US')
+        assert states.get('rank1-missing') == 'terminated'
+        with pytest.raises(exceptions.InsufficientCapacityError):
+            runpod_impl.wait_instances('s2', 'US', timeout=5)
+
+
+class TestFailover:
+
+    def _task(self, *regions):
+        task = sky.Task(run='echo x')
+        rs = [sky.Resources(cloud='runpod',
+                            instance_type='1x_NVIDIA_RTX_4090_SECURE',
+                            region=r) for r in regions]
+        task.set_resources([rs[0]])
+        task.best_resources = rs[0]
+        task.candidate_resources = rs
+        return task
+
+    def test_stockout_fails_over_to_next_region(self, fake_runpod):
+        fake_runpod.fail_regions.add('US')
+        launched, info = RetryingProvisioner().provision(
+            self._task('US', 'CA'), 'rp-fo')
+        assert launched.region == 'CA'
+        assert info.num_hosts == 1
+
+    def test_spend_limit_is_quota_not_capacity(self, fake_runpod):
+        fake_runpod.quota_error = True
+        err = None
+        try:
+            runpod_api.call(fake_runpod, 'create_pod', name='x-r0',
+                            image='i', gpu_type_id='NVIDIA RTX 4090',
+                            gpu_count=1, cloud_type='SECURE',
+                            country_code='US', disk_gb=50, ports='22/tcp',
+                            docker_args='')
+        except exceptions.CloudError as e:
+            err = e
+        assert err is not None
+        assert not isinstance(err, exceptions.InsufficientCapacityError)
+        assert err.reason == 'quota'
+
+
+class TestCloudClass:
+
+    def test_feasibility_and_catalog(self, fake_runpod):
+        cloud = sky.clouds.get_cloud('runpod')
+        feas = cloud.get_feasible_resources(
+            sky.Resources(cloud='runpod', cpus='8+'))
+        assert feas.resources, feas.hint
+        regions = cloud.regions_for(feas.resources[0])
+        assert 'US' in regions
+
+    def test_spot_supported_stop_not(self, fake_runpod):
+        from skypilot_tpu import clouds as clouds_lib
+        cloud = sky.clouds.get_cloud('runpod')
+        assert cloud.supports(clouds_lib.CloudFeature.SPOT)
+        assert not cloud.supports(clouds_lib.CloudFeature.STOP)
+
+    def test_optimizer_prefers_community_pricing(self, fake_runpod):
+        from skypilot_tpu import optimizer
+        task = sky.Task(run='echo x')
+        task.set_resources([sky.Resources(cloud='runpod', cpus='8+')])
+        optimizer.optimize(task, quiet=True)
+        res = task.best_resources
+        assert res.cloud == 'runpod'
+        assert res.instance_type.endswith('_COMMUNITY')  # cheaper tier

@@ -191,20 +191,15 @@ def validate_region_zone(
         return
     tpus, vms = _tpus(), _vms()
     regions = set(tpus['region']).union(vms['region'])
+    # Only AWS/Azure need their region sets later (zone-suffix rules);
+    # every other cloud just contributes its regions to the known set.
     aws_regions = set(_vms('aws')['region'].unique())
     regions.update(aws_regions)
     azure_regions = set(_vms('azure')['region'].unique())
     regions.update(azure_regions)
-    lambda_regions = set(_vms('lambda')['region'].unique())
-    regions.update(lambda_regions)
-    do_regions = set(_vms('do')['region'].unique())
-    regions.update(do_regions)
-    fs_regions = set(_vms('fluidstack')['region'].unique())
-    regions.update(fs_regions)
-    vast_regions = set(_vms('vast')['region'].unique())
-    regions.update(vast_regions)
-    runpod_regions = set(_vms('runpod')['region'].unique())
-    regions.update(runpod_regions)
+    for cloud_name in ('lambda', 'do', 'fluidstack', 'vast', 'runpod',
+                       'paperspace'):
+        regions.update(_vms(cloud_name)['region'].unique())
     zones = set(tpus['zone'])
     # AWS AZs: region + single-letter suffix; regions carry up to six
     # (us-east-1a..f), so accept any letter on a known region.

@@ -1,0 +1,121 @@
+"""Generate the Paperspace catalog CSV (paperspace_vms.csv).
+
+Static table of CORE machine types (public pricing; CPU 'C' tier +
+GPU tiers; no spot market, so ``spot_price`` mirrors ``price``) with a
+``types_fetcher`` seam for a live ``/machine-types`` override.
+
+Run:  python -m skypilot_tpu.catalog.fetchers.fetch_paperspace [--online]
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+DATA_DIR = os.path.join(_HERE, '..', 'data')
+
+_REGIONS = ('ny2', 'ca1', 'ams1')
+
+# machine_type -> (vcpus, memory_gb, $/h)
+_TYPES: Dict[str, Tuple[int, float, float]] = {
+    'C4': (2, 4, 0.04),
+    'C5': (4, 8, 0.08),
+    'C6': (8, 16, 0.16),
+    'C7': (12, 30, 0.30),
+    'P4000': (8, 30, 0.51),
+    'RTX4000': (8, 30, 0.56),
+    'A4000': (8, 45, 0.76),
+    'A100': (12, 90, 3.09),
+    'A100-80G': (12, 90, 3.18),
+}
+
+
+def fetch_machine_types(
+        types_fetcher: Optional[Callable[[], List[Dict[str, Any]]]] = None
+) -> List[Dict[str, Any]]:
+    """Live machine-types payload: [{label, cpus, ram (bytes or GB),
+    price, regions}]. ``types_fetcher`` is the test seam."""
+    if types_fetcher is not None:
+        return types_fetcher()
+    from skypilot_tpu.provision import paperspace_api
+    client = paperspace_api.get_client()
+    body = client._request('GET', '/machine-types')  # pylint: disable=protected-access
+    return list(body.get('items') or body.get('data') or [])
+
+
+def generate_vm_rows(live: Optional[List[Dict[str, Any]]] = None
+                     ) -> List[Dict[str, object]]:
+    rows: List[Dict[str, object]] = []
+    if live:
+        # Drop malformed entries BEFORE sorting (a None label would
+        # TypeError inside sorted()).
+        live = [t for t in live if t.get('label')]
+        for t in sorted(live, key=lambda t: t['label']):
+            label = t['label']
+            price = float(t.get('price') or 0)
+            if price <= 0:
+                continue
+            ram = float(t.get('ram') or 0)
+            if ram > 1e6:  # bytes -> GB
+                ram = ram / (1024 ** 3)
+            for region in t.get('regions') or _REGIONS:
+                rows.append({
+                    'instance_type': label,
+                    'vcpus': int(t.get('cpus') or 0),
+                    'memory_gb': round(ram, 1),
+                    'region': region,
+                    'price': round(price, 4),
+                    'spot_price': round(price, 4),
+                })
+        if rows:
+            return rows
+    for label, (vcpus, mem, price) in _TYPES.items():
+        for region in _REGIONS:
+            rows.append({
+                'instance_type': label,
+                'vcpus': vcpus,
+                'memory_gb': mem,
+                'region': region,
+                'price': price,
+                'spot_price': price,
+            })
+    return rows
+
+
+def refresh(online: bool = False,
+            types_fetcher: Optional[Callable[[], List[Dict[str, Any]]]] = None
+            ) -> str:
+    """Regenerate paperspace_vms.csv; returns 'online'/'offline'/'stale'."""
+    live: List[Dict[str, Any]] = []
+    source = 'offline'
+    if online:
+        try:
+            live = fetch_machine_types(types_fetcher)
+            if live:
+                source = 'online'
+        except Exception as e:  # noqa: BLE001 — any failure = fallback
+            print(f'machine-types API unavailable ({type(e).__name__}: '
+                  f'{e}); using static price table')
+    from skypilot_tpu.catalog.fetchers.fetch_gcp import write_csv
+    rows = generate_vm_rows(live)
+    try:
+        write_csv(os.path.join(DATA_DIR, 'paperspace_vms.csv'), rows)
+    except OSError as e:
+        print(f'catalog dir not writable ({e}); keeping existing CSV')
+        return 'stale'
+    print(f'Wrote {len(rows)} Paperspace machine rows to '
+          f'{os.path.normpath(DATA_DIR)} ({source})')
+    return source
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    import argparse
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--online', action='store_true',
+                        help='fetch live machine types from the API')
+    args = parser.parse_args(argv)
+    refresh(online=args.online)
+
+
+if __name__ == '__main__':
+    main()

@@ -15,6 +15,7 @@ from skypilot_tpu.clouds import gcp as _gcp  # registers
 from skypilot_tpu.clouds import kubernetes as _kubernetes  # registers
 from skypilot_tpu.clouds import lambda_cloud as _lambda  # registers
 from skypilot_tpu.clouds import local as _local  # registers
+from skypilot_tpu.clouds import paperspace as _paperspace  # registers
 from skypilot_tpu.clouds import runpod as _runpod  # registers
 from skypilot_tpu.clouds import vast as _vast  # registers
 

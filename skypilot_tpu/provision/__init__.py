@@ -30,6 +30,7 @@ _CLOUD_MODULES = {
     'fluidstack': 'skypilot_tpu.provision.fluidstack_impl',
     'vast': 'skypilot_tpu.provision.vast_impl',
     'runpod': 'skypilot_tpu.provision.runpod_impl',
+    'paperspace': 'skypilot_tpu.provision.paperspace_impl',
 }
 
 

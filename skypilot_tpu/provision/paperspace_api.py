@@ -1,0 +1,152 @@
+"""Thin Paperspace REST client with a test seam.
+
+Counterpart of the reference's ``sky/provision/paperspace/utils.py``
+(PaperspaceCloudClient over ``https://api.paperspace.com/v1``,
+bearer-token auth from ``~/.paperspace/config.json``). The real
+transport is a tiny urllib client; tests install an in-process fake via
+``set_paperspace_factory`` implementing the same flat surface
+(``create_machine``, ``list_machines``, ``start/stop/delete_machine``),
+so the full stop-capable lifecycle runs with no cloud.
+
+Error classification: capacity wording ("out of capacity",
+"no available machines") -> failover; team-limit wording -> quota.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import rest_cloud
+
+API_ENDPOINT = 'https://api.paperspace.com/v1'
+CREDENTIALS_PATH = '~/.paperspace/config.json'
+
+_CAPACITY_MARKERS = (
+    'out of capacity',
+    'no available machines',
+    'not currently available',
+)
+_QUOTA_MARKERS = (
+    'machine limit',
+    'team limit',
+    'quota',
+)
+
+
+class PaperspaceApiError(Exception):
+    """Fake/real client error carrying an HTTP status + message."""
+
+    def __init__(self, status: int, message: str = ''):
+        super().__init__(message or str(status))
+        self.status = status
+        self.message = message or str(status)
+
+
+classify_error = rest_cloud.marker_classifier(_CAPACITY_MARKERS,
+                                              _QUOTA_MARKERS)
+
+
+def read_api_key() -> Optional[str]:
+    env = os.environ.get('PAPERSPACE_API_KEY')
+    if env:
+        return env
+    path = os.path.expanduser(CREDENTIALS_PATH)
+    if os.path.exists(path):
+        try:
+            with open(path, encoding='utf-8') as f:
+                cfg = json.load(f)
+            return cfg.get('apiKey') or None
+        except (ValueError, OSError):
+            return None
+    return None
+
+
+def _parse_error(status: int, raw: bytes) -> Exception:
+    try:
+        err = json.loads(raw.decode())
+        msg = (err.get('message')
+               or (err.get('error') or {}).get('message')
+               or raw.decode())
+        return PaperspaceApiError(status, str(msg))
+    except (ValueError, AttributeError):
+        return PaperspaceApiError(
+            status, raw.decode(errors='replace') or str(status))
+
+
+class _RestClient:
+    """Flat op surface over the shared retrying urllib transport."""
+
+    def __init__(self):
+        api_key = read_api_key()
+        if api_key is None:
+            raise exceptions.CloudError(
+                'Paperspace credentials not found: set '
+                f'$PAPERSPACE_API_KEY or log in ({CREDENTIALS_PATH}).')
+        self._headers = {'Authorization': f'Bearer {api_key}',
+                         'Content-Type': 'application/json'}
+
+    def _request(self, method: str, path: str,
+                 payload: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        return rest_cloud.retrying_request(
+            method, f'{API_ENDPOINT}{path}', self._headers, payload,
+            _parse_error)
+
+    # -- flat op surface (mirrored by test fakes) ---------------------------
+    def list_startup_scripts(self) -> List[Dict[str, Any]]:
+        body = self._request('GET', '/startup-scripts?limit=200')
+        items = body.get('items')
+        if items is None:
+            items = body.get('data') or []
+        return list(items)
+
+    def create_startup_script(self, name: str,
+                              script: str) -> Dict[str, Any]:
+        body = self._request('POST', '/startup-scripts', {
+            'name': name, 'script': script, 'isRunOnce': False,
+            'isEnabled': True,
+        })
+        return dict(body.get('data') or body)
+
+    def create_machine(self, name: str, machine_type: str, region: str,
+                       disk_gb: int, startup_script_id: str,
+                       template_id: str = 'tkni3aa4'  # Ubuntu 22.04
+                       ) -> Dict[str, Any]:
+        # The v1 API only takes a PERSISTED startup script by id
+        # (startupScriptId) — an inline script field is silently ignored
+        # and the machine would boot keyless (reference
+        # sky/provision/paperspace/utils.py set_sky_key_script persists
+        # the object for the same reason).
+        body = self._request('POST', '/machines', {
+            'name': name, 'machineType': machine_type, 'region': region,
+            'diskSize': disk_gb, 'templateId': template_id,
+            'publicIpType': 'dynamic',
+            'startupScriptId': startup_script_id,
+        })
+        return dict(body.get('data') or body)
+
+    def list_machines(self) -> List[Dict[str, Any]]:
+        body = self._request('GET', '/machines?limit=200')
+        items = body.get('items')
+        if items is None:
+            items = body.get('data') or []
+        return list(items)
+
+    def start_machine(self, machine_id: str) -> None:
+        self._request('PATCH', f'/machines/{machine_id}/start')
+
+    def stop_machine(self, machine_id: str) -> None:
+        self._request('PATCH', f'/machines/{machine_id}/stop')
+
+    def delete_machine(self, machine_id: str) -> None:
+        self._request('DELETE', f'/machines/{machine_id}')
+
+
+# Test seam (``set_paperspace_factory(lambda: fake)``), client
+# construction and error-normalizing ``call`` via the shared ClientSeam.
+_seam = rest_cloud.ClientSeam(_RestClient, PaperspaceApiError,
+                              classify_error)
+set_paperspace_factory = _seam.set_factory
+get_client = _seam.get_client
+call = _seam.call

@@ -1,0 +1,200 @@
+"""Paperspace provisioner: CORE machines (full stop/start lifecycle).
+
+Counterpart of reference ``sky/provision/paperspace/instance.py`` +
+``utils.py``. Ninth VM cloud: a REST cloud with the FULL lifecycle —
+stop/start work and don't bill compute while off — making it the first
+REST cloud the optimizer can autostop without `--down`. No spot, no
+zones, no firewall API (machines get dynamic public IPs with open
+inbound on the account's default network; the cloud class omits
+OPEN_PORTS to stay conservative).
+
+Rank discovery is stateless via machine names ``{name}-r{rank}``; the
+machine list is account-global, so the shared region filter applies
+(same adoption hazard as Lambda/FluidStack).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import authentication
+from skypilot_tpu import exceptions
+from skypilot_tpu import provision as provision_lib
+from skypilot_tpu.provision import paperspace_api
+from skypilot_tpu.provision import rest_cloud
+from skypilot_tpu.utils import command_runner as runner_lib
+
+SSH_USER = 'paperspace'
+
+# Paperspace machine states -> provision API state words.
+_STATE_MAP = {
+    'provisioning': 'pending',
+    'starting': 'pending',
+    'restarting': 'pending',
+    'ready': 'running',
+    'stopping': 'stopping',
+    'off': 'stopped',
+    'upgrading': 'pending',
+    'serviceready': 'pending',
+}
+
+# Cluster bookkeeping + rank decoding via the shared REST-cloud
+# scaffolding (rest_cloud.py).
+_records = rest_cloud.ClusterRecords('paperspace_cluster')
+
+
+def _ensure_startup_script(client) -> str:
+    """Persist (or reuse) the key-install startup script; returns its id
+    (the v1 API only takes scripts by id — reference
+    sky/provision/paperspace/utils.py get/set_sky_key_script)."""
+    _, pub_path = authentication.get_or_generate_keys()
+    with open(pub_path, encoding='utf-8') as f:
+        pub_key = f.read().strip()
+    script = ('#!/bin/bash\nmkdir -p /home/paperspace/.ssh\n'
+              f'grep -qF "{pub_key}" /home/paperspace/.ssh/authorized_keys '
+              f'2>/dev/null || echo "{pub_key}" >> '
+              '/home/paperspace/.ssh/authorized_keys\n'
+              'chown -R paperspace:paperspace /home/paperspace/.ssh\n')
+    for s in paperspace_api.call(client, 'list_startup_scripts'):
+        if s.get('name') == 'skytpu-key' and pub_key in (
+                s.get('script') or ''):
+            return s['id']
+    created = paperspace_api.call(client, 'create_startup_script',
+                                  name='skytpu-key', script=script)
+    return created['id']
+
+
+def _live_machines(client, name: str,
+                   region: Optional[str] = None
+                   ) -> Dict[int, Dict[str, Any]]:
+    out: Dict[int, Dict[str, Any]] = {}
+    for m in paperspace_api.call(client, 'list_machines'):
+        rank = rest_cloud.rank_of(m.get('name') or '', name)
+        if rank is None:
+            continue
+        if m.get('state') in ('deleted', 'deleting'):
+            continue
+        if region is not None and (m.get('region') or region) != region:
+            continue
+        out[rank] = m
+    return out
+
+
+# ---- provision API ---------------------------------------------------------
+def run_instances(cluster_name: str, region: str, zone: Optional[str],
+                  num_hosts: int, deploy_vars: Dict[str, Any]) -> None:
+    del zone  # no zones
+    name = deploy_vars['cluster_name_on_cloud']
+    record = {'region': region, 'zone': None, 'name_on_cloud': name,
+              'num_hosts': num_hosts, 'deploy_vars': deploy_vars}
+    _records.save(cluster_name, record)
+    client = paperspace_api.get_client()
+    try:
+        script_id = _ensure_startup_script(client)
+        existing = _live_machines(client, name, region)
+        for rank, m in existing.items():
+            if m.get('state') == 'off':
+                paperspace_api.call(client, 'start_machine',
+                                    machine_id=m['id'])
+        for rank in range(num_hosts):
+            if rank in existing:
+                continue  # idempotent relaunch
+            paperspace_api.call(
+                client, 'create_machine',
+                name=f'{name}-r{rank}',
+                machine_type=deploy_vars.get('instance_type', 'C5'),
+                region=region,
+                disk_gb=int(deploy_vars.get('disk_size_gb') or 100),
+                startup_script_id=script_id)
+    except exceptions.InsufficientCapacityError:
+        try:
+            _terminate_all(client, name)
+        except exceptions.CloudError:
+            pass
+        else:
+            _records.delete(cluster_name)
+        raise
+
+
+def wait_instances(cluster_name: str, region: str, state: str = 'running',
+                   timeout: float = 1800) -> None:
+    rest_cloud.poll_for_state(
+        cluster_name, lambda: query_instances(cluster_name, region),
+        state, timeout)
+
+
+def query_instances(cluster_name: str, region: str) -> Dict[str, str]:
+    del region
+    record = _records.load(cluster_name)
+    if not record:
+        return {}
+    client = paperspace_api.get_client()
+    live = _live_machines(client, record['name_on_cloud'],
+                          record.get('region'))
+    if not live:
+        return {}
+    out: Dict[str, str] = {}
+    for rank, m in live.items():
+        out[m.get('name', f'r{rank}')] = _STATE_MAP.get(
+            m.get('state', ''), 'unknown')
+    for rank in range(int(record.get('num_hosts') or 0)):
+        if rank not in live:
+            out[f'rank{rank}-missing'] = 'terminated'
+    return out
+
+
+def stop_instances(cluster_name: str, region: str) -> None:
+    """Stop (machines off don't bill compute on Paperspace — unlike DO,
+    a clean stop story)."""
+    record = _records.require(cluster_name, 'Paperspace')
+    client = paperspace_api.get_client()
+    for m in _live_machines(client, record['name_on_cloud']).values():
+        if m.get('state') in ('provisioning', 'starting', 'restarting',
+                              'ready', 'serviceready', 'upgrading'):
+            paperspace_api.call(client, 'stop_machine',
+                                machine_id=m['id'])
+
+
+def _terminate_all(client, name: str) -> None:
+    for m in _live_machines(client, name).values():
+        paperspace_api.call(client, 'delete_machine', machine_id=m['id'])
+
+
+def terminate_instances(cluster_name: str, region: str) -> None:
+    del region
+    record = _records.load(cluster_name)
+    if not record:
+        return
+    client = paperspace_api.get_client()
+    _terminate_all(client, record['name_on_cloud'])
+    _records.delete(cluster_name)
+
+
+def get_cluster_info(cluster_name: str,
+                     region: str) -> provision_lib.ClusterInfo:
+    del region
+    record = _records.require(cluster_name, 'Paperspace')
+    client = paperspace_api.get_client()
+    live = _live_machines(client, record['name_on_cloud'],
+                          record.get('region'))
+    hosts: List[provision_lib.HostInfo] = []
+    for rank in sorted(live):
+        m = live[rank]
+        public = m.get('publicIp')
+        private = m.get('privateIp') or public
+        if private is None:
+            raise exceptions.ProvisionError(
+                f'No IP on machine {m.get("name")!r} yet.')
+        hosts.append(provision_lib.HostInfo(
+            host_id=str(m['id']), rank=rank,
+            internal_ip=private, external_ip=public,
+            extra={}))
+    return provision_lib.ClusterInfo(
+        cluster_name=cluster_name, cloud='paperspace',
+        region=record['region'], zone=None, hosts=hosts,
+        deploy_vars=record['deploy_vars'])
+
+
+def get_command_runners(cluster_info: provision_lib.ClusterInfo,
+                        ssh_credentials: Optional[Dict[str, str]] = None
+                        ) -> List[runner_lib.CommandRunner]:
+    return rest_cloud.ssh_runners(cluster_info, SSH_USER, ssh_credentials)

@@ -520,3 +520,47 @@ def test_runpod_fetcher_live_override(tmp_path, monkeypatch):
     two = [r for r in rows if r['instance_type'] == '2x_NVIDIA_B200_SECURE'
            and r['region'] == 'US'][0]
     assert float(two['price']) == pytest.approx(2 * 5.98)
+
+
+def test_committed_paperspace_catalog_matches_regeneration(tmp_path,
+                                                           monkeypatch):
+    """Drift guard: paperspace_vms.csv must equal the offline fetcher
+    output."""
+    import csv as csv_lib
+    import os
+    from skypilot_tpu.catalog.fetchers import fetch_paperspace
+
+    monkeypatch.setattr(fetch_paperspace, 'DATA_DIR', str(tmp_path))
+    assert fetch_paperspace.refresh(online=False) == 'offline'
+    committed_path = os.path.join(
+        os.path.dirname(os.path.abspath(fetch_paperspace.__file__)), '..',
+        'data', 'paperspace_vms.csv')
+    committed = open(committed_path).read()
+    assert committed == (tmp_path / 'paperspace_vms.csv').read_text(), (
+        'paperspace_vms.csv drifted from the fetcher: run '
+        'python -m skypilot_tpu.catalog.fetchers.fetch_paperspace')
+    rows = list(csv_lib.DictReader(
+        open(tmp_path / 'paperspace_vms.csv')))
+    c5 = [r for r in rows if r['instance_type'] == 'C5'
+          and r['region'] == 'ny2'][0]
+    assert float(c5['price']) == 0.08
+    assert c5['spot_price'] == c5['price']  # no spot market
+
+
+def test_paperspace_fetcher_live_override(tmp_path, monkeypatch):
+    """Live machine-types payloads replace the static table; byte RAM
+    values normalize to GB."""
+    from skypilot_tpu.catalog.fetchers import fetch_paperspace
+
+    live = [{'label': 'C10', 'cpus': 16,
+             'ram': 64 * 1024 ** 3,  # bytes
+             'price': 0.46, 'regions': ['ny2']}]
+    monkeypatch.setattr(fetch_paperspace, 'DATA_DIR', str(tmp_path))
+    assert fetch_paperspace.refresh(
+        online=True, types_fetcher=lambda: live) == 'online'
+    import csv as csv_lib
+    rows = list(csv_lib.DictReader(
+        open(tmp_path / 'paperspace_vms.csv')))
+    assert len(rows) == 1
+    assert rows[0]['instance_type'] == 'C10'
+    assert float(rows[0]['memory_gb']) == 64.0

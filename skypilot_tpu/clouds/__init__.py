@@ -12,6 +12,7 @@ from skypilot_tpu.clouds import azure as _azure  # registers
 from skypilot_tpu.clouds import do as _do  # registers
 from skypilot_tpu.clouds import fluidstack as _fluidstack  # registers
 from skypilot_tpu.clouds import gcp as _gcp  # registers
+from skypilot_tpu.clouds import hyperstack as _hyperstack  # registers
 from skypilot_tpu.clouds import kubernetes as _kubernetes  # registers
 from skypilot_tpu.clouds import lambda_cloud as _lambda  # registers
 from skypilot_tpu.clouds import local as _local  # registers

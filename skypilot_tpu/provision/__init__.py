@@ -31,6 +31,7 @@ _CLOUD_MODULES = {
     'vast': 'skypilot_tpu.provision.vast_impl',
     'runpod': 'skypilot_tpu.provision.runpod_impl',
     'paperspace': 'skypilot_tpu.provision.paperspace_impl',
+    'hyperstack': 'skypilot_tpu.provision.hyperstack_impl',
 }
 
 

@@ -1,0 +1,152 @@
+"""Thin Hyperstack REST client with a test seam.
+
+Counterpart of the reference's
+``sky/provision/hyperstack/hyperstack_utils.py`` (HyperstackClient over
+``https://infrahub-api.nexgencloud.com/v1`` with an ``api_key``
+header). The real transport is a tiny urllib client; tests install an
+in-process fake via ``set_hyperstack_factory`` implementing the same
+flat surface (``create_vm``, ``list_vms``, ``start/stop/delete_vm``,
+``add_security_rule``, environments, ssh keys), so the stop-capable
+lifecycle and the per-instance port rules run with no cloud.
+
+Error classification: stock wording ("not enough capacity",
+"insufficient resources") -> failover; credit/quota wording -> quota.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import rest_cloud
+
+API_ENDPOINT = 'https://infrahub-api.nexgencloud.com/v1'
+API_KEY_PATH = '~/.hyperstack/api_key'
+
+_CAPACITY_MARKERS = (
+    'not enough capacity',
+    'insufficient resources',
+    'no hosts available',
+    'out of stock',
+)
+_QUOTA_MARKERS = (
+    'quota',
+    'credit',
+    'exceeded your limit',
+)
+
+
+class HyperstackApiError(Exception):
+    """Fake/real client error carrying an HTTP status + message."""
+
+    def __init__(self, status: int, message: str = ''):
+        super().__init__(message or str(status))
+        self.status = status
+        self.message = message or str(status)
+
+
+classify_error = rest_cloud.marker_classifier(_CAPACITY_MARKERS,
+                                              _QUOTA_MARKERS)
+
+
+def read_api_key() -> Optional[str]:
+    env = os.environ.get('HYPERSTACK_API_KEY')
+    if env:
+        return env
+    path = os.path.expanduser(API_KEY_PATH)
+    if os.path.exists(path):
+        with open(path, encoding='utf-8') as f:
+            key = f.read().strip()
+        return key or None
+    return None
+
+
+def _parse_error(status: int, raw: bytes) -> Exception:
+    try:
+        err = json.loads(raw.decode())
+        msg = err.get('message') or err.get('error') or raw.decode()
+        return HyperstackApiError(status, str(msg))
+    except (ValueError, AttributeError):
+        return HyperstackApiError(
+            status, raw.decode(errors='replace') or str(status))
+
+
+class _RestClient:
+    """Flat op surface over the shared retrying urllib transport."""
+
+    def __init__(self):
+        api_key = read_api_key()
+        if api_key is None:
+            raise exceptions.CloudError(
+                'Hyperstack credentials not found: set '
+                f'$HYPERSTACK_API_KEY or write the key to {API_KEY_PATH}.')
+        self._headers = {'api_key': api_key,
+                         'Content-Type': 'application/json'}
+
+    def _request(self, method: str, path: str,
+                 payload: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        return rest_cloud.retrying_request(
+            method, f'{API_ENDPOINT}{path}', self._headers, payload,
+            _parse_error)
+
+    # -- flat op surface (mirrored by test fakes) ---------------------------
+    def list_environments(self) -> List[Dict[str, Any]]:
+        return list(self._request('GET', '/core/environments')
+                    .get('environments', []))
+
+    def create_environment(self, name: str, region: str) -> Dict[str, Any]:
+        return dict(self._request('POST', '/core/environments',
+                                  {'name': name, 'region': region})
+                    .get('environment', {}))
+
+    def list_ssh_keys(self) -> List[Dict[str, Any]]:
+        return list(self._request('GET', '/core/keypairs')
+                    .get('keypairs', []))
+
+    def register_ssh_key(self, name: str, environment: str,
+                         public_key: str) -> Dict[str, Any]:
+        return dict(self._request('POST', '/core/keypairs', {
+            'name': name, 'environment_name': environment,
+            'public_key': public_key,
+        }).get('keypair', {}))
+
+    def create_vm(self, name: str, environment: str, flavor: str,
+                  key_name: str, image: str,
+                  security_rules: List[Dict[str, Any]]) -> Dict[str, Any]:
+        body = self._request('POST', '/core/virtual-machines', {
+            'name': name, 'environment_name': environment,
+            'flavor_name': flavor, 'key_name': key_name,
+            'image_name': image, 'count': 1,
+            'assign_floating_ip': True,
+            'security_rules': security_rules,
+        })
+        instances = body.get('instances') or []
+        return dict(instances[0]) if instances else dict(body)
+
+    def list_vms(self) -> List[Dict[str, Any]]:
+        return list(self._request('GET', '/core/virtual-machines')
+                    .get('instances', []))
+
+    def start_vm(self, vm_id: int) -> None:
+        self._request('GET', f'/core/virtual-machines/{vm_id}/start')
+
+    def stop_vm(self, vm_id: int) -> None:
+        self._request('GET', f'/core/virtual-machines/{vm_id}/stop')
+
+    def delete_vm(self, vm_id: int) -> None:
+        self._request('DELETE', f'/core/virtual-machines/{vm_id}')
+
+    def add_security_rule(self, vm_id: int,
+                          rule: Dict[str, Any]) -> None:
+        self._request('POST',
+                      f'/core/virtual-machines/{vm_id}/sg-rules', rule)
+
+
+# Test seam (``set_hyperstack_factory(lambda: fake)``), client
+# construction and error-normalizing ``call`` via the shared ClientSeam.
+_seam = rest_cloud.ClientSeam(_RestClient, HyperstackApiError,
+                              classify_error)
+set_hyperstack_factory = _seam.set_factory
+get_client = _seam.get_client
+call = _seam.call

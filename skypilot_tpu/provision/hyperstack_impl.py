@@ -1,0 +1,253 @@
+"""Hyperstack provisioner: GPU VMs in per-region environments.
+
+Counterpart of reference ``sky/provision/hyperstack/instance.py`` +
+``hyperstack_utils.py``. Tenth VM cloud. Hyperstack-isms:
+
+- VMs live inside an ENVIRONMENT (a per-region project container);
+  the provisioner creates/reuses ``skytpu-{region}`` per region and
+  keypairs are registered per environment (reference
+  hyperstack_utils.py:139-170);
+- ports are PER-INSTANCE security rules: SSH is opened in the create
+  payload, task/serve ports are added to each VM post-creation
+  (reference _security_rule/open_ports) — a fourth ports flavor after
+  per-cluster SGs (AWS/DO), account-global rules (Lambda), and
+  fixed-at-rent sets (RunPod);
+- stop/start are supported ('SHUTOFF' doesn't bill compute);
+- no spot market, no zones.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import authentication
+from skypilot_tpu import exceptions
+from skypilot_tpu import provision as provision_lib
+from skypilot_tpu.provision import hyperstack_api
+from skypilot_tpu.provision import rest_cloud
+from skypilot_tpu.utils import command_runner as runner_lib
+
+SSH_USER = 'ubuntu'
+
+DEFAULT_IMAGE = 'Ubuntu Server 22.04 LTS R535 CUDA 12.2'
+
+# Hyperstack VM statuses -> provision API state words.
+_STATE_MAP = {
+    'CREATING': 'pending',
+    'BUILD': 'pending',
+    'STARTING': 'pending',
+    'ACTIVE': 'running',
+    'STOPPING': 'stopping',
+    'SHUTOFF': 'stopped',
+    'DELETING': 'terminating',
+    'ERROR': 'terminated',  # failed build: treat as a hole -> failover
+}
+
+# Cluster bookkeeping + rank decoding via the shared REST-cloud
+# scaffolding (rest_cloud.py).
+_records = rest_cloud.ClusterRecords('hyperstack_cluster')
+
+
+def _environment_name(region: str) -> str:
+    return f'skytpu-{region}'
+
+
+def _ensure_environment(client, region: str) -> str:
+    name = _environment_name(region)
+    for env in hyperstack_api.call(client, 'list_environments'):
+        if env.get('name') == name:
+            return name
+    hyperstack_api.call(client, 'create_environment', name=name,
+                        region=region)
+    return name
+
+
+def _ensure_ssh_key(client, environment: str) -> str:
+    """Keypairs are scoped to an environment (reference
+    hyperstack_utils.py:139-170); one 'skytpu' key per environment."""
+    _, pub_path = authentication.get_or_generate_keys()
+    with open(pub_path, encoding='utf-8') as f:
+        pub_key = f.read().strip()
+    key_name = f'skytpu-{environment}'
+    for key in hyperstack_api.call(client, 'list_ssh_keys'):
+        if (key.get('name') == key_name
+                and (key.get('environment') or {}).get(
+                    'name', key.get('environment_name')) == environment):
+            return key_name
+    hyperstack_api.call(client, 'register_ssh_key', name=key_name,
+                        environment=environment, public_key=pub_key)
+    return key_name
+
+
+def _ssh_rule(port: int) -> Dict[str, Any]:
+    return {'direction': 'ingress', 'protocol': 'tcp',
+            'ethertype': 'IPv4', 'remote_ip_prefix': '0.0.0.0/0',
+            'port_range_min': port, 'port_range_max': port}
+
+
+def _live_vms(client, name: str,
+              region: Optional[str] = None) -> Dict[int, Dict[str, Any]]:
+    """rank -> VM, scoped to our environment when region is known (the
+    VM list is account-global: same adoption hazard as Lambda)."""
+    env = _environment_name(region) if region else None
+    out: Dict[int, Dict[str, Any]] = {}
+    for vm in hyperstack_api.call(client, 'list_vms'):
+        rank = rest_cloud.rank_of(vm.get('name') or '', name)
+        if rank is None:
+            continue
+        if vm.get('status') in ('DELETING', 'DELETED'):
+            continue
+        vm_env = (vm.get('environment') or {}).get(
+            'name', vm.get('environment_name'))
+        if env is not None and (vm_env or env) != env:
+            continue
+        out[rank] = vm
+    return out
+
+
+# ---- provision API ---------------------------------------------------------
+def run_instances(cluster_name: str, region: str, zone: Optional[str],
+                  num_hosts: int, deploy_vars: Dict[str, Any]) -> None:
+    del zone  # no zones
+    name = deploy_vars['cluster_name_on_cloud']
+    record = {'region': region, 'zone': None, 'name_on_cloud': name,
+              'num_hosts': num_hosts, 'deploy_vars': deploy_vars}
+    _records.save(cluster_name, record)
+    client = hyperstack_api.get_client()
+    try:
+        environment = _ensure_environment(client, region)
+        key_name = _ensure_ssh_key(client, environment)
+        existing = _live_vms(client, name, region)
+        for rank, vm in existing.items():
+            if vm.get('status') == 'SHUTOFF':
+                hyperstack_api.call(client, 'start_vm', vm_id=vm['id'])
+        for rank in range(num_hosts):
+            if rank in existing:
+                continue  # idempotent relaunch
+            hyperstack_api.call(
+                client, 'create_vm',
+                name=f'{name}-r{rank}',
+                environment=environment,
+                flavor=deploy_vars.get('instance_type',
+                                       'n3-RTX-A6000x1'),
+                key_name=key_name,
+                image=deploy_vars.get('image_id') or DEFAULT_IMAGE,
+                security_rules=[_ssh_rule(22)])
+    except exceptions.InsufficientCapacityError:
+        try:
+            _terminate_all(client, name)
+        except exceptions.CloudError:
+            pass
+        else:
+            _records.delete(cluster_name)
+        raise
+
+
+def wait_instances(cluster_name: str, region: str, state: str = 'running',
+                   timeout: float = 1800) -> None:
+    rest_cloud.poll_for_state(
+        cluster_name, lambda: query_instances(cluster_name, region),
+        state, timeout)
+
+
+def query_instances(cluster_name: str, region: str) -> Dict[str, str]:
+    del region
+    record = _records.load(cluster_name)
+    if not record:
+        return {}
+    client = hyperstack_api.get_client()
+    live = _live_vms(client, record['name_on_cloud'],
+                     record.get('region'))
+    if not live:
+        return {}
+    out: Dict[str, str] = {}
+    for rank, vm in live.items():
+        out[vm.get('name', f'r{rank}')] = _STATE_MAP.get(
+            vm.get('status', ''), 'unknown')
+    for rank in range(int(record.get('num_hosts') or 0)):
+        if rank not in live:
+            out[f'rank{rank}-missing'] = 'terminated'
+    return out
+
+
+def stop_instances(cluster_name: str, region: str) -> None:
+    record = _records.require(cluster_name, 'Hyperstack')
+    client = hyperstack_api.get_client()
+    for vm in _live_vms(client, record['name_on_cloud']).values():
+        if vm.get('status') in ('CREATING', 'BUILD', 'STARTING',
+                                'ACTIVE'):
+            hyperstack_api.call(client, 'stop_vm', vm_id=vm['id'])
+
+
+def _terminate_all(client, name: str) -> None:
+    for vm in _live_vms(client, name).values():
+        hyperstack_api.call(client, 'delete_vm', vm_id=vm['id'])
+
+
+def terminate_instances(cluster_name: str, region: str) -> None:
+    del region
+    record = _records.load(cluster_name)
+    if not record:
+        return
+    client = hyperstack_api.get_client()
+    _terminate_all(client, record['name_on_cloud'])
+    # The per-region environment is shared by other skytpu clusters:
+    # left in place deliberately.
+    _records.delete(cluster_name)
+
+
+def get_cluster_info(cluster_name: str,
+                     region: str) -> provision_lib.ClusterInfo:
+    del region
+    record = _records.require(cluster_name, 'Hyperstack')
+    client = hyperstack_api.get_client()
+    live = _live_vms(client, record['name_on_cloud'],
+                     record.get('region'))
+    hosts: List[provision_lib.HostInfo] = []
+    for rank in sorted(live):
+        vm = live[rank]
+        public = vm.get('floating_ip')
+        private = vm.get('fixed_ip') or public
+        if private is None:
+            raise exceptions.ProvisionError(
+                f'No IP on VM {vm.get("name")!r} yet.')
+        hosts.append(provision_lib.HostInfo(
+            host_id=str(vm['id']), rank=rank,
+            internal_ip=private, external_ip=public,
+            extra={}))
+    return provision_lib.ClusterInfo(
+        cluster_name=cluster_name, cloud='hyperstack',
+        region=record['region'], zone=None, hosts=hosts,
+        deploy_vars=record['deploy_vars'])
+
+
+def open_ports(cluster_name: str, region: str, ports: List[str]) -> None:
+    """Per-INSTANCE security rules added post-creation (reference
+    hyperstack_utils.py open_ports): one tcp rule per port per VM.
+    Idempotent via the VM's existing rule list."""
+    if not ports:
+        return
+    record = _records.require(cluster_name, 'Hyperstack')
+    client = hyperstack_api.get_client()
+    for vm in _live_vms(client, record['name_on_cloud'],
+                        record.get('region')).values():
+        have = {(r.get('port_range_min'), r.get('port_range_max'))
+                for r in vm.get('security_rules') or []}
+        for port in ports:
+            if '-' in str(port):
+                lo, hi = (int(p) for p in str(port).split('-', 1))
+            else:
+                lo = hi = int(port)
+            if (lo, hi) in have:
+                continue
+            hyperstack_api.call(
+                client, 'add_security_rule', vm_id=vm['id'],
+                rule={'direction': 'ingress', 'protocol': 'tcp',
+                      'ethertype': 'IPv4',
+                      'remote_ip_prefix': '0.0.0.0/0',
+                      'port_range_min': lo, 'port_range_max': hi})
+
+
+def get_command_runners(cluster_info: provision_lib.ClusterInfo,
+                        ssh_credentials: Optional[Dict[str, str]] = None
+                        ) -> List[runner_lib.CommandRunner]:
+    return rest_cloud.ssh_runners(cluster_info, SSH_USER, ssh_credentials)

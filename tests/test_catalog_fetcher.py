@@ -564,3 +564,51 @@ def test_paperspace_fetcher_live_override(tmp_path, monkeypatch):
     assert len(rows) == 1
     assert rows[0]['instance_type'] == 'C10'
     assert float(rows[0]['memory_gb']) == 64.0
+
+
+def test_committed_hyperstack_catalog_matches_regeneration(tmp_path,
+                                                           monkeypatch):
+    """Drift guard: hyperstack_vms.csv must equal the offline fetcher
+    output."""
+    import csv as csv_lib
+    import os
+    from skypilot_tpu.catalog.fetchers import fetch_hyperstack
+
+    monkeypatch.setattr(fetch_hyperstack, 'DATA_DIR', str(tmp_path))
+    assert fetch_hyperstack.refresh(online=False) == 'offline'
+    committed_path = os.path.join(
+        os.path.dirname(os.path.abspath(fetch_hyperstack.__file__)), '..',
+        'data', 'hyperstack_vms.csv')
+    committed = open(committed_path).read()
+    assert committed == (tmp_path / 'hyperstack_vms.csv').read_text(), (
+        'hyperstack_vms.csv drifted from the fetcher: run '
+        'python -m skypilot_tpu.catalog.fetchers.fetch_hyperstack')
+    rows = list(csv_lib.DictReader(
+        open(tmp_path / 'hyperstack_vms.csv')))
+    a6000 = [r for r in rows if r['instance_type'] == 'n3-RTX-A6000x1'
+             and r['region'] == 'CANADA-1'][0]
+    assert float(a6000['price']) == 0.5
+    assert a6000['spot_price'] == a6000['price']  # no spot market
+
+
+def test_hyperstack_fetcher_live_override(tmp_path, monkeypatch):
+    """Live flavors replace the static table; payloads missing a price
+    keep the static one for known flavors."""
+    from skypilot_tpu.catalog.fetchers import fetch_hyperstack
+
+    live = [
+        {'name': 'n3-B200x8', 'cpu': 224, 'ram': 2048,
+         'price': 31.2, 'regions': ['US-1']},
+        {'name': 'n3-A100x1', 'cpu': 28, 'ram': 120},  # no price: static
+        {'name': None},                                 # malformed: drop
+    ]
+    monkeypatch.setattr(fetch_hyperstack, 'DATA_DIR', str(tmp_path))
+    assert fetch_hyperstack.refresh(
+        online=True, flavors_fetcher=lambda: live) == 'online'
+    import csv as csv_lib
+    rows = list(csv_lib.DictReader(
+        open(tmp_path / 'hyperstack_vms.csv')))
+    b200 = [r for r in rows if r['instance_type'] == 'n3-B200x8']
+    assert [r['region'] for r in b200] == ['US-1']
+    a100 = [r for r in rows if r['instance_type'] == 'n3-A100x1'][0]
+    assert float(a100['price']) == 1.35  # static price kept

@@ -50,9 +50,12 @@ def test_collectives_bench_runs_on_cpu_mesh(capsys):
     rec = records[0]
     assert rec['ranks'] == 8
     assert rec['busbw_gbps'] > 0
-    # busbw = algbw * 2*(n-1)/n
+    # busbw = algbw * 2*(n-1)/n. Both fields are rounded to 3 decimals,
+    # so allow the rounding granularity too: on a heavily loaded CI
+    # machine the measured bandwidth can be small enough that rounding
+    # alone exceeds a pure relative tolerance (observed flake).
     assert rec['busbw_gbps'] == pytest.approx(
-        rec['algbw_gbps'] * 2 * 7 / 8, rel=0.01)
+        rec['algbw_gbps'] * 2 * 7 / 8, rel=0.01, abs=2e-3)
 
 
 def test_train_run_entrypoint_tiny(capsys):

@@ -160,7 +160,9 @@ def test_server_rejects_outside_allow_prefix(binaries, tmp_path):
         [binaries['server'], '--socket', sock, '--fake', '--fake-log', log,
          '--allow-prefix', '/data/'])
     try:
-        deadline = time.time() + 10
+        # 30s: the server binary can start slowly on a heavily loaded CI
+        # machine (observed flake at 10s with concurrent suite runs).
+        deadline = time.time() + 30
         while not os.path.exists(sock):
             assert time.time() < deadline
             time.sleep(0.05)

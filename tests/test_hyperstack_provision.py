@@ -1,0 +1,229 @@
+"""Hyperstack provisioner tests against an in-process fake client.
+
+The fake implements the flat surface (environments / keypairs /
+create_vm / list / start / stop / delete / add_security_rule) — so the
+per-region environment bootstrap, the stop-capable lifecycle, and the
+per-instance port rules run for real with no cloud.
+"""
+import itertools
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import exceptions
+from skypilot_tpu.backends.slice_backend import RetryingProvisioner
+from skypilot_tpu.provision import hyperstack_api
+from skypilot_tpu.provision import hyperstack_impl
+
+
+class FakeHyperstack:
+    """In-memory Hyperstack account."""
+
+    def __init__(self):
+        self.environments = []
+        self.keypairs = []
+        self.vms = {}
+        self.fail_regions = set()
+        self.quota_error = False
+        self.create_calls = []
+        self._ids = itertools.count(5000)
+
+    def list_environments(self):
+        return [dict(e) for e in self.environments]
+
+    def create_environment(self, name, region):
+        env = {'name': name, 'region': region}
+        self.environments.append(env)
+        return dict(env)
+
+    def list_ssh_keys(self):
+        return [dict(k) for k in self.keypairs]
+
+    def register_ssh_key(self, name, environment, public_key):
+        key = {'name': name, 'environment_name': environment,
+               'public_key': public_key}
+        self.keypairs.append(key)
+        return dict(key)
+
+    def create_vm(self, name, environment, flavor, key_name, image,
+                  security_rules):
+        env = next(e for e in self.environments
+                   if e['name'] == environment)
+        self.create_calls.append((env['region'], name))
+        if self.quota_error:
+            raise hyperstack_api.HyperstackApiError(
+                402, 'You have exceeded your limit of credit')
+        if env['region'] in self.fail_regions:
+            raise hyperstack_api.HyperstackApiError(
+                409, f'Not enough capacity for {flavor} in '
+                f'{env["region"]}')
+        n = next(self._ids)
+        vm = {
+            'id': n, 'name': name, 'status': 'ACTIVE',
+            'environment': {'name': environment},
+            'flavor': {'name': flavor}, 'keypair': {'name': key_name},
+            'floating_ip': f'38.80.0.{n % 250}',
+            'fixed_ip': f'10.41.0.{n % 250}',
+            'security_rules': [dict(r) for r in security_rules],
+        }
+        self.vms[n] = vm
+        return dict(vm)
+
+    def list_vms(self):
+        return [dict(v) for v in self.vms.values()]
+
+    def start_vm(self, vm_id):
+        self.vms[vm_id]['status'] = 'ACTIVE'
+
+    def stop_vm(self, vm_id):
+        self.vms[vm_id]['status'] = 'SHUTOFF'
+
+    def delete_vm(self, vm_id):
+        self.vms.pop(vm_id, None)
+
+    def add_security_rule(self, vm_id, rule):
+        self.vms[vm_id]['security_rules'].append(dict(rule))
+
+
+@pytest.fixture
+def fake_hyperstack(monkeypatch, tmp_path):
+    account = FakeHyperstack()
+    hyperstack_api.set_hyperstack_factory(lambda: account)
+    monkeypatch.setenv('SKYTPU_FAKE_HYPERSTACK_CREDENTIALS', '1')
+    priv = tmp_path / 'key'
+    pub = tmp_path / 'key.pub'
+    priv.write_text('fake-private')
+    pub.write_text('ssh-ed25519 AAAA test')
+    monkeypatch.setattr('skypilot_tpu.authentication.get_or_generate_keys',
+                        lambda: (str(priv), str(pub)))
+    yield account
+    hyperstack_api.set_hyperstack_factory(None)
+
+
+def _deploy_vars(**over):
+    base = {
+        'cloud': 'hyperstack', 'mode': 'hyperstack_vm',
+        'cluster_name_on_cloud': 'c-hs1',
+        'instance_type': 'n3-RTX-A6000x1', 'image_id': None,
+        'disk_size_gb': 100, 'use_spot': False, 'labels': {}, 'ports': [],
+    }
+    base.update(over)
+    return base
+
+
+class TestLifecycle:
+
+    def test_create_query_info_stop_start_terminate(self,
+                                                    fake_hyperstack):
+        dv = _deploy_vars()
+        hyperstack_impl.run_instances('h1', 'CANADA-1', None, 2, dv)
+        hyperstack_impl.wait_instances('h1', 'CANADA-1', timeout=5)
+        states = hyperstack_impl.query_instances('h1', 'CANADA-1')
+        assert set(states.values()) == {'running'} and len(states) == 2
+
+        # Environment + per-environment keypair bootstrapped once.
+        assert [e['name'] for e in fake_hyperstack.environments] == [
+            'skytpu-CANADA-1']
+        assert len(fake_hyperstack.keypairs) == 1
+
+        info = hyperstack_impl.get_cluster_info('h1', 'CANADA-1')
+        assert info.num_hosts == 2
+        assert info.head.internal_ip.startswith('10.41.')
+        assert info.head.external_ip.startswith('38.80.')
+
+        hyperstack_impl.stop_instances('h1', 'CANADA-1')
+        assert set(hyperstack_impl.query_instances(
+            'h1', 'CANADA-1').values()) == {'stopped'}
+        hyperstack_impl.run_instances('h1', 'CANADA-1', None, 2, dv)
+        assert set(hyperstack_impl.query_instances(
+            'h1', 'CANADA-1').values()) == {'running'}
+        assert len(fake_hyperstack.create_calls) == 2  # restart, no new
+
+        hyperstack_impl.terminate_instances('h1', 'CANADA-1')
+        assert hyperstack_impl.query_instances('h1', 'CANADA-1') == {}
+        # Shared environment survives teardown by design.
+        assert fake_hyperstack.environments
+
+    def test_ssh_rule_present_at_creation(self, fake_hyperstack):
+        hyperstack_impl.run_instances('h2', 'CANADA-1', None, 1,
+                                      _deploy_vars())
+        vm = next(iter(fake_hyperstack.vms.values()))
+        assert any(r['port_range_min'] == 22
+                   for r in vm['security_rules'])
+
+    def test_error_build_is_a_rank_hole(self, fake_hyperstack):
+        hyperstack_impl.run_instances('h3', 'CANADA-1', None, 2,
+                                      _deploy_vars())
+        victim = next(v for v in fake_hyperstack.vms.values()
+                      if v['name'].endswith('-r1'))
+        victim['status'] = 'ERROR'  # failed build
+        with pytest.raises(exceptions.InsufficientCapacityError):
+            hyperstack_impl.wait_instances('h3', 'CANADA-1', timeout=5)
+
+
+class TestOpenPorts:
+
+    def test_per_instance_rules_added_idempotently(self,
+                                                   fake_hyperstack):
+        hyperstack_impl.run_instances('p1', 'CANADA-1', None, 2,
+                                      _deploy_vars())
+        hyperstack_impl.open_ports('p1', 'CANADA-1', ['8080'])
+        hyperstack_impl.open_ports('p1', 'CANADA-1', ['8080'])  # idem
+        hyperstack_impl.open_ports('p1', 'CANADA-1', ['9000-9010'])
+        for vm in fake_hyperstack.vms.values():
+            ranges = {(r['port_range_min'], r['port_range_max'])
+                      for r in vm['security_rules']}
+            assert (8080, 8080) in ranges
+            assert (9000, 9010) in ranges
+            # idempotent: exactly one 8080 rule per VM
+            assert len([r for r in vm['security_rules']
+                        if r['port_range_min'] == 8080]) == 1
+
+
+class TestFailover:
+
+    def _task(self, *regions):
+        task = sky.Task(run='echo x')
+        rs = [sky.Resources(cloud='hyperstack',
+                            instance_type='n3-RTX-A6000x1',
+                            region=r) for r in regions]
+        task.set_resources([rs[0]])
+        task.best_resources = rs[0]
+        task.candidate_resources = rs
+        return task
+
+    def test_capacity_fails_over_to_next_region(self, fake_hyperstack):
+        fake_hyperstack.fail_regions.add('CANADA-1')
+        launched, info = RetryingProvisioner().provision(
+            self._task('CANADA-1', 'NORWAY-1'), 'hs-fo')
+        assert launched.region == 'NORWAY-1'
+        assert info.num_hosts == 1
+
+    def test_credit_limit_is_quota_not_capacity(self, fake_hyperstack):
+        fake_hyperstack.quota_error = True
+        fake_hyperstack.create_environment('skytpu-CANADA-1', 'CANADA-1')
+        err = None
+        try:
+            hyperstack_api.call(fake_hyperstack, 'create_vm', name='x-r0',
+                                environment='skytpu-CANADA-1',
+                                flavor='n3-A100x1', key_name='k',
+                                image='i', security_rules=[])
+        except exceptions.CloudError as e:
+            err = e
+        assert err is not None
+        assert not isinstance(err, exceptions.InsufficientCapacityError)
+        assert err.reason == 'quota'
+
+
+class TestCloudClass:
+
+    def test_optimizer_places_pinned_hyperstack_task(self,
+                                                     fake_hyperstack):
+        from skypilot_tpu import optimizer
+        task = sky.Task(run='echo x')
+        task.set_resources([sky.Resources(cloud='hyperstack',
+                                          cpus='16+')])
+        optimizer.optimize(task, quiet=True)
+        res = task.best_resources
+        assert res.cloud == 'hyperstack'
+        assert res.instance_type == 'n3-RTX-A6000x1'

@@ -198,7 +198,7 @@ def validate_region_zone(
     azure_regions = set(_vms('azure')['region'].unique())
     regions.update(azure_regions)
     for cloud_name in ('lambda', 'do', 'fluidstack', 'vast', 'runpod',
-                       'paperspace', 'hyperstack'):
+                       'paperspace', 'hyperstack', 'oci'):
         regions.update(_vms(cloud_name)['region'].unique())
     zones = set(tpus['zone'])
     # AWS AZs: region + single-letter suffix; regions carry up to six
@@ -206,6 +206,9 @@ def validate_region_zone(
     zones.update(f'{r}{s}' for r in aws_regions for s in 'abcdef')
     # Azure AZs are bare digits within a region ('1'/'2'/'3').
     zones.update('123')
+    # OCI availability domains: '{region}-AD-{n}'.
+    oci_regions = set(_vms('oci')['region'].unique())
+    zones.update(f'{r}-AD-{i}' for r in oci_regions for i in (1, 2, 3))
     if zone is not None and zone not in zones:
         # GCE zones are region+suffix; accept unknown-but-wellformed.
         if zone.rsplit('-', 1)[0] not in regions:
@@ -222,8 +225,9 @@ def validate_region_zone(
                     'is not an Azure region')
         elif zone is not None and zone.rsplit('-', 1)[0] != region \
                 and not (zone.startswith(region)
-                         and len(zone) == len(region) + 1):
+                         and len(zone) == len(region) + 1) \
+                and not zone.startswith(f'{region}-AD-'):
             # GCP: region-suffix (us-central1-a); AWS: region+letter
-            # (us-east-1a).
+            # (us-east-1a); OCI: region-AD-n.
             raise exceptions.InvalidResourcesError(
                 f'Zone {zone!r} is not in region {region!r}')

@@ -1,0 +1,121 @@
+"""Generate the OCI catalog CSV (oci_vms.csv).
+
+Static table of common shapes (public pay-as-you-go pricing; OCI
+preemptible instances are billed at 50% of on-demand — a FIXED
+discount, unlike market spot) with a ``shapes_fetcher`` seam for a live
+override.
+
+Flex shapes are priced per-OCPU+GB; the catalog rows carry a concrete
+(vcpus, memory) point per shape so the optimizer compares like for
+like (the provisioner derives shapeConfig from the same row).
+
+Run:  python -m skypilot_tpu.catalog.fetchers.fetch_oci [--online]
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+DATA_DIR = os.path.join(_HERE, '..', 'data')
+
+_REGIONS = ('us-ashburn-1', 'us-phoenix-1', 'eu-frankfurt-1',
+            'uk-london-1', 'ap-tokyo-1')
+
+# shape -> (vcpus, memory_gb, $/h). E4.Flex points: OCPU $0.025/h +
+# $0.0015/GB/h (1 OCPU = 2 vcpus).
+_SHAPES: Dict[str, Tuple[int, float, float]] = {
+    'VM.Standard.E4.Flex': (4, 16, 0.074),       # 2 OCPU + 16 GB
+    'VM.Standard.E4.Flex.8': (8, 32, 0.148),     # 4 OCPU + 32 GB
+    'VM.Standard.E4.Flex.16': (16, 64, 0.296),   # 8 OCPU + 64 GB
+    'VM.Standard3.Flex': (4, 16, 0.084),
+    'VM.Standard.A1.Flex': (4, 24, 0.046),       # Ampere Arm
+    'BM.Standard.E4.128': (256, 2048, 6.40),
+}
+
+_PREEMPTIBLE_DISCOUNT = 0.5  # fixed 50% for preemptible capacity
+
+
+def fetch_shapes(
+        shapes_fetcher: Optional[Callable[[], List[Dict[str, Any]]]] = None
+) -> List[Dict[str, Any]]:
+    """Live shapes payload: [{shape, vcpus, memory_gb, price, regions}].
+    ``shapes_fetcher`` is the test seam (there is no public unauth
+    pricing API; the real path would walk the signed ListShapes +
+    published price list)."""
+    if shapes_fetcher is not None:
+        return shapes_fetcher()
+    return []
+
+
+def generate_vm_rows(live: Optional[List[Dict[str, Any]]] = None
+                     ) -> List[Dict[str, object]]:
+    rows: List[Dict[str, object]] = []
+    if live:
+        live = [s for s in live if s.get('shape')]
+        for s in sorted(live, key=lambda s: s['shape']):
+            price = float(s.get('price') or 0)
+            if price <= 0:
+                continue
+            for region in s.get('regions') or _REGIONS:
+                rows.append({
+                    'instance_type': s['shape'],
+                    'vcpus': int(s.get('vcpus') or 0),
+                    'memory_gb': float(s.get('memory_gb') or 0),
+                    'region': region,
+                    'price': round(price, 4),
+                    'spot_price': round(price * _PREEMPTIBLE_DISCOUNT,
+                                        4),
+                })
+        if rows:
+            return rows
+    for shape, (vcpus, mem, price) in _SHAPES.items():
+        for region in _REGIONS:
+            rows.append({
+                'instance_type': shape,
+                'vcpus': vcpus,
+                'memory_gb': mem,
+                'region': region,
+                'price': price,
+                'spot_price': round(price * _PREEMPTIBLE_DISCOUNT, 4),
+            })
+    return rows
+
+
+def refresh(online: bool = False,
+            shapes_fetcher: Optional[Callable[[], List[Dict[str, Any]]]] = None
+            ) -> str:
+    """Regenerate oci_vms.csv; returns 'online'/'offline'/'stale'."""
+    live: List[Dict[str, Any]] = []
+    source = 'offline'
+    if online:
+        try:
+            live = fetch_shapes(shapes_fetcher)
+            if live:
+                source = 'online'
+        except Exception as e:  # noqa: BLE001 — any failure = fallback
+            print(f'shapes source unavailable ({type(e).__name__}: {e}); '
+                  'using static price table')
+    from skypilot_tpu.catalog.fetchers.fetch_gcp import write_csv
+    rows = generate_vm_rows(live)
+    try:
+        write_csv(os.path.join(DATA_DIR, 'oci_vms.csv'), rows)
+    except OSError as e:
+        print(f'catalog dir not writable ({e}); keeping existing CSV')
+        return 'stale'
+    print(f'Wrote {len(rows)} OCI shape rows to '
+          f'{os.path.normpath(DATA_DIR)} ({source})')
+    return source
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    import argparse
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--online', action='store_true',
+                        help='use a live shapes source when provided')
+    args = parser.parse_args(argv)
+    refresh(online=args.online)
+
+
+if __name__ == '__main__':
+    main()

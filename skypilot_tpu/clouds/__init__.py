@@ -16,6 +16,7 @@ from skypilot_tpu.clouds import hyperstack as _hyperstack  # registers
 from skypilot_tpu.clouds import kubernetes as _kubernetes  # registers
 from skypilot_tpu.clouds import lambda_cloud as _lambda  # registers
 from skypilot_tpu.clouds import local as _local  # registers
+from skypilot_tpu.clouds import oci as _oci  # registers
 from skypilot_tpu.clouds import paperspace as _paperspace  # registers
 from skypilot_tpu.clouds import runpod as _runpod  # registers
 from skypilot_tpu.clouds import vast as _vast  # registers

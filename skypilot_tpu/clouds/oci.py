@@ -1,0 +1,147 @@
+"""OCI: Core compute instances (4th enterprise cloud; preemptible spot).
+
+Counterpart of reference ``sky/clouds/oci.py``. Availability domains
+play the zone role (``{region}-AD-{n}``); ``use_spot`` maps to
+preemptible instances (TERMINATE on reclaim). Requires an existing
+subnet (``oci.subnet_ocid`` config) — see docs/clouds.md.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu.clouds import cloud as cloud_lib
+
+
+@cloud_lib.CLOUD_REGISTRY.register(name='oci')
+class OCI(cloud_lib.Cloud):
+    NAME = 'oci'
+    _FEATURES = frozenset({
+        cloud_lib.CloudFeature.STOP,      # standard shapes don't bill
+        cloud_lib.CloudFeature.AUTOSTOP,  # compute while stopped
+        cloud_lib.CloudFeature.SPOT,      # preemptible instances
+        cloud_lib.CloudFeature.MULTI_HOST,
+        cloud_lib.CloudFeature.STORAGE_MOUNTS,
+        cloud_lib.CloudFeature.OPEN_PORTS,   # per-cluster NSG
+        cloud_lib.CloudFeature.CUSTOM_IMAGES,
+    })
+
+    # ---- credentials ------------------------------------------------------
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        if os.environ.get('SKYTPU_FAKE_OCI_CREDENTIALS'):
+            return True, None
+        from skypilot_tpu.provision import oci_api
+        if oci_api.read_config() is not None:
+            return True, None
+        return False, ('OCI credentials not found. Run '
+                       '`oci setup config` (needs user, fingerprint, '
+                       'key_file, tenancy, region in ~/.oci/config).')
+
+    @classmethod
+    def get_active_user_identity(cls) -> Optional[List[str]]:
+        if os.environ.get('SKYTPU_FAKE_OCI_CREDENTIALS'):
+            return ['fake-identity@oci.test']
+        from skypilot_tpu.provision import oci_api
+        cfg = oci_api.read_config()
+        return [cfg['user']] if cfg else None
+
+    # ---- topology ---------------------------------------------------------
+    def regions_for(self, resources) -> List[str]:
+        if resources.tpu is not None:
+            return []  # no TPUs on OCI
+        itype = resources.instance_type or 'VM.Standard.E4.Flex'
+        regions = catalog.get_vm_regions(itype, cloud=self.NAME)
+        if resources.region is not None:
+            regions = [r for r in regions if r == resources.region]
+        return regions
+
+    def zones_for(self, resources, region: str) -> List[Optional[str]]:
+        # Availability domains: AD-1..AD-3 (single-AD regions fail over
+        # to the next region when AD-2/3 don't exist — the capacity
+        # classification handles the NotFound).
+        if resources.zone is not None:
+            return ([resources.zone]
+                    if resources.zone.startswith(region) else [])
+        return [f'{region}-AD-{i}' for i in (1, 2, 3)]
+
+    # ---- pricing ----------------------------------------------------------
+    def hourly_cost(self, resources, region=None, zone=None) -> float:
+        region = region or resources.region
+        assert resources.instance_type is not None, resources
+        return catalog.get_instance_hourly_cost(
+            resources.instance_type, resources.use_spot, region=region,
+            cloud=self.NAME)
+
+    def egress_cost_per_gb(self, dst_cloud: str, dst_region: str,
+                           src_region: Optional[str]) -> float:
+        # First 10 TB/month free; the overage rate as the conservative
+        # planning number.
+        if src_region is not None and dst_cloud == self.NAME \
+                and src_region == dst_region:
+            return 0.0
+        return 0.0085
+
+    # ---- feasibility ------------------------------------------------------
+    def get_feasible_resources(self,
+                               resources) -> cloud_lib.FeasibleResources:
+        if resources.tpu is not None:
+            return cloud_lib.FeasibleResources(
+                [], hint='OCI has no TPU accelerators; use cloud: gcp.')
+        if resources.instance_type is not None:
+            if not catalog.get_vm_regions(resources.instance_type,
+                                          cloud=self.NAME):
+                return cloud_lib.FeasibleResources(
+                    [], hint=(f'{resources.instance_type} is not an OCI '
+                              'shape in the catalog.'))
+            return cloud_lib.FeasibleResources(
+                [resources.copy(cloud=self.NAME)])
+        itype = catalog.get_default_instance_type(
+            cpus=resources._cpus, cpus_plus=resources._cpus_plus,  # pylint: disable=protected-access
+            memory=resources._memory, memory_plus=resources._memory_plus,  # pylint: disable=protected-access
+            region=resources.region, cloud=self.NAME)
+        if itype is None:
+            return cloud_lib.FeasibleResources(
+                [], hint=(f'No OCI shape with cpus={resources.cpus}, '
+                          f'memory={resources.memory}'))
+        return cloud_lib.FeasibleResources(
+            [resources.copy(cloud=self.NAME, instance_type=itype)])
+
+    # ---- deployment -------------------------------------------------------
+    def make_deploy_variables(self, resources, cluster_name_on_cloud: str,
+                              region: str,
+                              zone: Optional[str]) -> Dict[str, Any]:
+        from skypilot_tpu.provision import docker_utils
+        image_id = resources.image_id
+        if docker_utils.is_docker_image(image_id):
+            image_id = None  # stock image; ranks run in the container
+        shape = resources.instance_type
+        shape_config = None
+        if shape and '.Flex' in shape:
+            # Catalog sizing variants ('VM.Standard.E4.Flex.8') are
+            # pricing points of the REAL Flex shape: strip the numeric
+            # suffix for the launch and derive shapeConfig from the
+            # variant's catalog row so the launch matches what was
+            # priced. Arm A1 shapes are 1 OCPU = 1 vCPU; x86 SMT shapes
+            # are 1 OCPU = 2 vCPUs.
+            import re
+            vcpus, mem = catalog.get_instance_info(shape, cloud=self.NAME)
+            per_ocpu = 1 if '.A1.' in shape else 2
+            shape = re.sub(r'(\.Flex)\.\d+$', r'\1', shape)
+            shape_config = {'ocpus': max(1, vcpus // per_ocpu),
+                            'memoryInGBs': mem}
+        return {
+            'cloud': self.NAME,
+            'mode': 'oci_instance',
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region,
+            'zone': zone,
+            'use_spot': resources.use_spot,
+            'disk_size_gb': resources.disk_size,
+            'labels': dict(resources.labels or {}),
+            'ports': list(resources.ports or ()),
+            'instance_type': shape,
+            'shape_config': shape_config,
+            'image_id': image_id,
+        }

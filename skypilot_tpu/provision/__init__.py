@@ -32,6 +32,7 @@ _CLOUD_MODULES = {
     'runpod': 'skypilot_tpu.provision.runpod_impl',
     'paperspace': 'skypilot_tpu.provision.paperspace_impl',
     'hyperstack': 'skypilot_tpu.provision.hyperstack_impl',
+    'oci': 'skypilot_tpu.provision.oci_impl',
 }
 
 

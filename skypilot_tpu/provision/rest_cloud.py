@@ -183,10 +183,13 @@ def retrying_request(method: str, url: str, headers: Dict[str, str],
                      payload: Optional[Dict[str, Any]],
                      parse_error: Callable[[int, bytes], Exception],
                      max_attempts: int = 6,
-                     timeout: float = 60.0) -> Any:
+                     timeout: float = 60.0,
+                     return_headers: bool = False) -> Any:
     """One urllib call with 429 backoff. ``parse_error(status, body)``
     builds the cloud's typed API error from a failure response (each
-    provider has its own error envelope)."""
+    provider has its own error envelope). ``return_headers=True``
+    returns ``(body, response_headers)`` — needed by providers that
+    paginate via response headers (OCI's ``opc-next-page``)."""
     data = json.dumps(payload).encode() if payload is not None else None
     backoff = 5.0
     for attempt in range(max_attempts):
@@ -195,7 +198,10 @@ def retrying_request(method: str, url: str, headers: Dict[str, str],
         try:
             with urllib.request.urlopen(req, timeout=timeout) as resp:
                 body = resp.read().decode()
-                return json.loads(body) if body else {}
+                parsed = json.loads(body) if body else {}
+                if return_headers:
+                    return parsed, dict(resp.headers)
+                return parsed
         except urllib.error.HTTPError as e:
             if e.code == 429 and attempt < max_attempts - 1:
                 time.sleep(backoff)  # rate limited: retry with backoff

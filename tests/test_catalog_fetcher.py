@@ -612,3 +612,27 @@ def test_hyperstack_fetcher_live_override(tmp_path, monkeypatch):
     assert [r['region'] for r in b200] == ['US-1']
     a100 = [r for r in rows if r['instance_type'] == 'n3-A100x1'][0]
     assert float(a100['price']) == 1.35  # static price kept
+
+
+def test_committed_oci_catalog_matches_regeneration(tmp_path,
+                                                    monkeypatch):
+    """Drift guard: oci_vms.csv must equal the offline fetcher output."""
+    import csv as csv_lib
+    import os
+    from skypilot_tpu.catalog.fetchers import fetch_oci
+
+    monkeypatch.setattr(fetch_oci, 'DATA_DIR', str(tmp_path))
+    assert fetch_oci.refresh(online=False) == 'offline'
+    committed_path = os.path.join(
+        os.path.dirname(os.path.abspath(fetch_oci.__file__)), '..',
+        'data', 'oci_vms.csv')
+    committed = open(committed_path).read()
+    assert committed == (tmp_path / 'oci_vms.csv').read_text(), (
+        'oci_vms.csv drifted from the fetcher: run '
+        'python -m skypilot_tpu.catalog.fetchers.fetch_oci')
+    rows = list(csv_lib.DictReader(open(tmp_path / 'oci_vms.csv')))
+    e4 = [r for r in rows if r['instance_type'] == 'VM.Standard.E4.Flex'
+          and r['region'] == 'us-ashburn-1'][0]
+    # Preemptible capacity is a FIXED 50% discount on OCI.
+    assert float(e4['spot_price']) == pytest.approx(
+        float(e4['price']) * 0.5)

@@ -198,7 +198,7 @@ def validate_region_zone(
     azure_regions = set(_vms('azure')['region'].unique())
     regions.update(azure_regions)
     for cloud_name in ('lambda', 'do', 'fluidstack', 'vast', 'runpod',
-                       'paperspace', 'hyperstack', 'oci'):
+                       'paperspace', 'hyperstack', 'oci', 'cudo'):
         regions.update(_vms(cloud_name)['region'].unique())
     zones = set(tpus['zone'])
     # AWS AZs: region + single-letter suffix; regions carry up to six

@@ -1,0 +1,110 @@
+"""Generate the Cudo Compute catalog CSV (cudo_vms.csv).
+
+Static table of machine families at concrete sizing points (Cudo
+prices per-vCPU/per-GB; each row is the priced point the provisioner
+launches with) with a ``types_fetcher`` seam for a live override.
+
+Run:  python -m skypilot_tpu.catalog.fetchers.fetch_cudo [--online]
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+DATA_DIR = os.path.join(_HERE, '..', 'data')
+
+_REGIONS = ('gb-bournemouth', 'se-smedjebacken-1', 'us-santaclara-1')
+
+# family -> (vcpus, memory_gb, $/h at that sizing point)
+_FAMILIES: Dict[str, Tuple[int, float, float]] = {
+    'epyc-milan': (4, 16, 0.042),
+    'epyc-milan-8': (8, 32, 0.084),
+    'epyc-milan-16': (16, 64, 0.168),
+    'intel-broadwell': (4, 16, 0.036),
+}
+
+
+def fetch_machine_types(
+        types_fetcher: Optional[Callable[[], List[Dict[str, Any]]]] = None
+) -> List[Dict[str, Any]]:
+    """Live machine-types payload; ``types_fetcher`` is the test seam."""
+    if types_fetcher is not None:
+        return types_fetcher()
+    return []
+
+
+def generate_vm_rows(live: Optional[List[Dict[str, Any]]] = None
+                     ) -> List[Dict[str, object]]:
+    rows: List[Dict[str, object]] = []
+    if live:
+        live = [t for t in live if t.get('machineType')]
+        for t in sorted(live, key=lambda t: t['machineType']):
+            price = float(t.get('price') or 0)
+            if price <= 0:
+                continue
+            for region in t.get('dataCenters') or _REGIONS:
+                rows.append({
+                    'instance_type': t['machineType'],
+                    'vcpus': int(t.get('vcpus') or 0),
+                    'memory_gb': float(t.get('memory_gb') or 0),
+                    'region': region,
+                    'price': round(price, 4),
+                    'spot_price': round(price, 4),
+                })
+        if rows:
+            return rows
+    for family, (vcpus, mem, price) in _FAMILIES.items():
+        for region in _REGIONS:
+            rows.append({
+                'instance_type': family,
+                'vcpus': vcpus,
+                'memory_gb': mem,
+                'region': region,
+                'price': price,
+                'spot_price': price,
+            })
+    return rows
+
+
+def refresh(online: bool = False,
+            types_fetcher: Optional[Callable[[], List[Dict[str, Any]]]] = None
+            ) -> str:
+    """Regenerate cudo_vms.csv; returns 'online'/'offline'/'stale'."""
+    live: List[Dict[str, Any]] = []
+    source = 'offline'
+    if online:
+        try:
+            live = fetch_machine_types(types_fetcher)
+            if live:
+                source = 'online'
+        except Exception as e:  # noqa: BLE001 — any failure = fallback
+            print(f'machine-types source unavailable ({type(e).__name__}:'
+                  f' {e}); using static price table')
+    from skypilot_tpu.catalog.fetchers.fetch_gcp import write_csv
+    rows = generate_vm_rows(live)
+    if source == 'online' and rows == generate_vm_rows(None):
+        # Every live row was discarded (no machineType / no price):
+        # the CSV is the static fallback - do not label it online.
+        source = 'offline'
+    try:
+        write_csv(os.path.join(DATA_DIR, 'cudo_vms.csv'), rows)
+    except OSError as e:
+        print(f'catalog dir not writable ({e}); keeping existing CSV')
+        return 'stale'
+    print(f'Wrote {len(rows)} Cudo machine rows to '
+          f'{os.path.normpath(DATA_DIR)} ({source})')
+    return source
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    import argparse
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--online', action='store_true',
+                        help='use a live machine-types source')
+    args = parser.parse_args(argv)
+    refresh(online=args.online)
+
+
+if __name__ == '__main__':
+    main()

@@ -9,6 +9,7 @@ from skypilot_tpu.clouds.cloud import (Cloud, CloudFeature, CLOUD_REGISTRY,
                                        FeasibleResources)
 from skypilot_tpu.clouds import aws as _aws  # registers
 from skypilot_tpu.clouds import azure as _azure  # registers
+from skypilot_tpu.clouds import cudo as _cudo  # registers
 from skypilot_tpu.clouds import do as _do  # registers
 from skypilot_tpu.clouds import fluidstack as _fluidstack  # registers
 from skypilot_tpu.clouds import gcp as _gcp  # registers

@@ -1,0 +1,123 @@
+"""Cudo Compute: project-scoped VMs in data centers (stop/start, no
+spot).
+
+Counterpart of reference ``sky/clouds/cudo.py``. Twelfth VM cloud;
+data centers play the region role, sizing rides the create call
+(catalog rows carry the priced vcpus/memory point per machine family).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu.clouds import cloud as cloud_lib
+
+
+@cloud_lib.CLOUD_REGISTRY.register(name='cudo')
+class Cudo(cloud_lib.Cloud):
+    NAME = 'cudo'
+    _FEATURES = frozenset({
+        cloud_lib.CloudFeature.STOP,
+        cloud_lib.CloudFeature.AUTOSTOP,
+        cloud_lib.CloudFeature.MULTI_HOST,
+        cloud_lib.CloudFeature.STORAGE_MOUNTS,
+    })
+
+    # ---- credentials ------------------------------------------------------
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        if os.environ.get('SKYTPU_FAKE_CUDO_CREDENTIALS'):
+            return True, None
+        from skypilot_tpu.provision import cudo_api
+        if cudo_api.read_credentials() is not None:
+            return True, None
+        return False, ('Cudo credentials not found. Set $CUDO_API_KEY + '
+                       '$CUDO_PROJECT_ID or run `cudo init`.')
+
+    @classmethod
+    def get_active_user_identity(cls) -> Optional[List[str]]:
+        if os.environ.get('SKYTPU_FAKE_CUDO_CREDENTIALS'):
+            return ['fake-identity@cudo.test']
+        from skypilot_tpu.provision import cudo_api
+        creds = cudo_api.read_credentials()
+        return [f'cudo-project-{creds["project"]}'] if creds else None
+
+    # ---- topology ---------------------------------------------------------
+    def regions_for(self, resources) -> List[str]:
+        if resources.tpu is not None:
+            return []  # no TPUs on Cudo
+        if resources.use_spot:
+            return []  # no spot market
+        itype = resources.instance_type or 'epyc-milan'
+        regions = catalog.get_vm_regions(itype, cloud=self.NAME)
+        if resources.region is not None:
+            regions = [r for r in regions if r == resources.region]
+        return regions
+
+    def zones_for(self, resources, region: str) -> List[Optional[str]]:
+        if resources.zone is not None:
+            return []  # data centers have no zones
+        return [None]
+
+    # ---- pricing ----------------------------------------------------------
+    def hourly_cost(self, resources, region=None, zone=None) -> float:
+        region = region or resources.region
+        assert resources.instance_type is not None, resources
+        return catalog.get_instance_hourly_cost(
+            resources.instance_type, resources.use_spot, region=region,
+            cloud=self.NAME)
+
+    def egress_cost_per_gb(self, dst_cloud: str, dst_region: str,
+                           src_region: Optional[str]) -> float:
+        return 0.0  # Cudo does not bill egress
+
+    # ---- feasibility ------------------------------------------------------
+    def get_feasible_resources(self,
+                               resources) -> cloud_lib.FeasibleResources:
+        if resources.tpu is not None:
+            return cloud_lib.FeasibleResources(
+                [], hint='Cudo has no TPU accelerators; use cloud: gcp.')
+        if resources.use_spot:
+            return cloud_lib.FeasibleResources(
+                [], hint='Cudo has no spot market.')
+        if resources.ports:
+            return cloud_lib.FeasibleResources(
+                [], hint='Cudo port management is not wired up; tasks '
+                         'needing open ports cannot run there.')
+        if resources.instance_type is not None:
+            if not catalog.get_vm_regions(resources.instance_type,
+                                          cloud=self.NAME):
+                return cloud_lib.FeasibleResources(
+                    [], hint=(f'{resources.instance_type} is not a Cudo '
+                              'machine family in the catalog.'))
+            return cloud_lib.FeasibleResources(
+                [resources.copy(cloud=self.NAME)])
+        itype = catalog.get_default_instance_type(
+            cpus=resources._cpus, cpus_plus=resources._cpus_plus,  # pylint: disable=protected-access
+            memory=resources._memory, memory_plus=resources._memory_plus,  # pylint: disable=protected-access
+            region=resources.region, cloud=self.NAME)
+        if itype is None:
+            return cloud_lib.FeasibleResources(
+                [], hint=(f'No Cudo machine with cpus={resources.cpus}, '
+                          f'memory={resources.memory}'))
+        return cloud_lib.FeasibleResources(
+            [resources.copy(cloud=self.NAME, instance_type=itype)])
+
+    # ---- deployment -------------------------------------------------------
+    def make_deploy_variables(self, resources, cluster_name_on_cloud: str,
+                              region: str,
+                              zone: Optional[str]) -> Dict[str, Any]:
+        return {
+            'cloud': self.NAME,
+            'mode': 'cudo_vm',
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region,
+            'zone': None,
+            'use_spot': False,
+            'disk_size_gb': resources.disk_size,
+            'labels': dict(resources.labels or {}),
+            'ports': [],
+            'instance_type': resources.instance_type,
+            'image_id': None,  # stock ubuntu-2204 image
+        }

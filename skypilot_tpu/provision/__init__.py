@@ -33,6 +33,7 @@ _CLOUD_MODULES = {
     'paperspace': 'skypilot_tpu.provision.paperspace_impl',
     'hyperstack': 'skypilot_tpu.provision.hyperstack_impl',
     'oci': 'skypilot_tpu.provision.oci_impl',
+    'cudo': 'skypilot_tpu.provision.cudo_impl',
 }
 
 

@@ -1,0 +1,144 @@
+"""Thin Cudo Compute REST client with a test seam.
+
+Counterpart of the reference's cudo SDK usage
+(``sky/provision/cudo/cudo_wrapper.py`` over the cudo python SDK). The
+real transport is a tiny urllib client over
+``https://rest.compute.cudo.org/v1`` (bearer key + project id from the
+cudo CLI's ``~/.config/cudo/cudo.yml``); tests install an in-process
+fake via ``set_cudo_factory`` implementing the flat surface
+(``create_vm``, ``list_vms``, ``start/stop/terminate_vm``), so the
+project-scoped lifecycle runs with no cloud.
+
+Error classification: stock wording ("no host available", "insufficient
+capacity") -> failover; billing/quota wording -> quota.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import rest_cloud
+
+API_ENDPOINT = 'https://rest.compute.cudo.org/v1'
+CONFIG_PATH = '~/.config/cudo/cudo.yml'
+
+_CAPACITY_MARKERS = (
+    'no host available',
+    'insufficient capacity',
+    'out of stock',
+    'no capacity',
+)
+_QUOTA_MARKERS = (
+    'quota',
+    'billing',
+    'insufficient funds',
+)
+
+
+class CudoApiError(Exception):
+    """Fake/real client error carrying an HTTP status + message."""
+
+    def __init__(self, status: int, message: str = ''):
+        super().__init__(message or str(status))
+        self.status = status
+        self.message = message or str(status)
+
+
+classify_error = rest_cloud.marker_classifier(_CAPACITY_MARKERS,
+                                              _QUOTA_MARKERS)
+
+
+def read_credentials() -> Optional[Dict[str, str]]:
+    """(api key, project id) from env or the cudo CLI config."""
+    key = os.environ.get('CUDO_API_KEY')
+    project = os.environ.get('CUDO_PROJECT_ID')
+    if key and project:
+        return {'key': key, 'project': project}
+    path = os.path.expanduser(CONFIG_PATH)
+    if os.path.exists(path):
+        try:
+            import yaml
+            with open(path, encoding='utf-8') as f:
+                cfg = yaml.safe_load(f) or {}
+        except Exception:  # noqa: BLE001 — malformed config = no creds
+            return None
+        contexts = cfg.get('contexts') or {}
+        ctx = contexts.get(cfg.get('current-context', 'default')) or {}
+        key = key or ctx.get('key')
+        project = project or ctx.get('project')
+        if key and project:
+            return {'key': str(key), 'project': str(project)}
+    return None
+
+
+def _parse_error(status: int, raw: bytes) -> Exception:
+    try:
+        err = json.loads(raw.decode())
+        return CudoApiError(status, err.get('message', raw.decode()))
+    except (ValueError, AttributeError):
+        return CudoApiError(status,
+                            raw.decode(errors='replace') or str(status))
+
+
+class _RestClient:
+    """Flat op surface over the shared retrying urllib transport."""
+
+    def __init__(self):
+        creds = read_credentials()
+        if creds is None:
+            raise exceptions.CloudError(
+                'Cudo credentials not found: set $CUDO_API_KEY + '
+                f'$CUDO_PROJECT_ID or run `cudo init` ({CONFIG_PATH}).')
+        self.project = creds['project']
+        self._headers = {'Authorization': f'Bearer {creds["key"]}',
+                         'Content-Type': 'application/json'}
+
+    def _request(self, method: str, path: str,
+                 payload: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        return rest_cloud.retrying_request(
+            method, f'{API_ENDPOINT}{path}', self._headers, payload,
+            _parse_error)
+
+    # -- flat op surface (mirrored by test fakes) ---------------------------
+    def create_vm(self, vm_id: str, data_center_id: str,
+                  machine_type: str, vcpus: int, memory_gib: int,
+                  boot_disk_gib: int, image_id: str, ssh_public_key: str,
+                  metadata: Dict[str, str]) -> Dict[str, Any]:
+        return dict(self._request(
+            'POST', f'/projects/{self.project}/vm', {
+                'vmId': vm_id, 'dataCenterId': data_center_id,
+                'machineType': machine_type,
+                'vcpus': vcpus, 'memoryGib': memory_gib,
+                'bootDiskSizeGib': boot_disk_gib,
+                'bootDiskImageId': image_id,
+                'sshKeySource': 'SSH_KEY_SOURCE_NONE',
+                'customSshKeys': [ssh_public_key],
+                'metadata': dict(metadata),
+            }))
+
+    def list_vms(self) -> List[Dict[str, Any]]:
+        return list(self._request(
+            'GET', f'/projects/{self.project}/vms').get('VMs', []))
+
+    def start_vm(self, vm_id: str) -> None:
+        self._request('POST',
+                      f'/projects/{self.project}/vms/{vm_id}/start', {})
+
+    def stop_vm(self, vm_id: str) -> None:
+        self._request('POST',
+                      f'/projects/{self.project}/vms/{vm_id}/stop', {})
+
+    def terminate_vm(self, vm_id: str) -> None:
+        self._request(
+            'POST',
+            f'/projects/{self.project}/vms/{vm_id}/terminate', {})
+
+
+# Test seam (``set_cudo_factory(lambda: fake)``), client construction
+# and error-normalizing ``call`` via the shared ClientSeam.
+_seam = rest_cloud.ClientSeam(_RestClient, CudoApiError, classify_error)
+set_cudo_factory = _seam.set_factory
+get_client = _seam.get_client
+call = _seam.call

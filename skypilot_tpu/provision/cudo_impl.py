@@ -1,0 +1,205 @@
+"""Cudo Compute provisioner: project-scoped VMs in data centers.
+
+Counterpart of reference ``sky/provision/cudo/instance.py`` +
+``cudo_wrapper.py``. Twelfth VM cloud. Cudo-isms:
+
+- VMs live in a PROJECT (the account's container, like an OCI
+  compartment) and a DATA CENTER (the region unit, e.g.
+  'gb-bournemouth'); no zones;
+- the vmId is caller-chosen and unique per project: rank lives directly
+  in the id (``{name}-r{rank}``) AND in metadata (belt and braces —
+  metadata is the reference's tag mechanism, cudo_wrapper.py:78);
+- stop/start supported; no spot market; no per-VM firewall API in
+  scope (VMs get public IPs; the cloud class omits OPEN_PORTS);
+- vcpus/memory ride the create call (Cudo machine types are
+  host-family templates, sized per request) — derived from the catalog
+  row like OCI's Flex shapeConfig.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import authentication
+from skypilot_tpu import catalog
+from skypilot_tpu import exceptions
+from skypilot_tpu import provision as provision_lib
+from skypilot_tpu.provision import cudo_api
+from skypilot_tpu.provision import rest_cloud
+from skypilot_tpu.utils import command_runner as runner_lib
+
+SSH_USER = 'root'
+
+DEFAULT_IMAGE = 'ubuntu-2204'
+
+_STATE_MAP = {
+    'PENDING': 'pending',
+    'PROVISIONING': 'pending',
+    'STARTING': 'pending',
+    'ACTIVE': 'running',
+    'RUNNING': 'running',
+    'STOPPING': 'stopping',
+    'STOPPED': 'stopped',
+    'SUSPENDED': 'stopped',
+    'DELETING': 'terminating',
+    'FAILED': 'terminated',  # failed build -> rank hole -> failover
+}
+
+# Cluster bookkeeping + rank decoding via the shared REST-cloud
+# scaffolding (rest_cloud.py).
+_records = rest_cloud.ClusterRecords('cudo_cluster')
+
+
+def _live_vms(client, name: str,
+              region: Optional[str] = None) -> Dict[int, Dict[str, Any]]:
+    """rank -> VM by vmId prefix, data-center filtered. The listing is
+    project-scoped but spans data centers, and the SAME cluster name
+    fails over across data centers — a cleanup survivor from the failed
+    region must not be adopted into the new gang (the rest_cloud
+    invariant; hyperstack guards identically)."""
+    out: Dict[int, Dict[str, Any]] = {}
+    for vm in cudo_api.call(client, 'list_vms'):
+        vm_id = vm.get('id') or vm.get('vmId') or ''
+        rank = rest_cloud.rank_of(vm_id, name)
+        if rank is None:
+            continue
+        if vm.get('state') in ('DELETING', 'DELETED'):
+            continue
+        if region is not None and (vm.get('dataCenterId')
+                                   or region) != region:
+            continue
+        out[rank] = vm
+    return out
+
+
+# ---- provision API ---------------------------------------------------------
+def run_instances(cluster_name: str, region: str, zone: Optional[str],
+                  num_hosts: int, deploy_vars: Dict[str, Any]) -> None:
+    del zone  # data centers have no zones
+    name = deploy_vars['cluster_name_on_cloud']
+    record = {'region': region, 'zone': None, 'name_on_cloud': name,
+              'num_hosts': num_hosts, 'deploy_vars': deploy_vars}
+    _records.save(cluster_name, record)
+    client = cudo_api.get_client()
+    machine_type = deploy_vars.get('instance_type', 'epyc-milan')
+    vcpus, mem = catalog.get_instance_info(machine_type, cloud='cudo')
+    try:
+        _, pub_path = authentication.get_or_generate_keys()
+        with open(pub_path, encoding='utf-8') as f:
+            pub_key = f.read().strip()
+        existing = _live_vms(client, name, region)
+        for rank, vm in existing.items():
+            if _STATE_MAP.get(vm.get('state', '')) == 'stopped':
+                cudo_api.call(client, 'start_vm',
+                              vm_id=vm.get('id') or vm.get('vmId'))
+        for rank in range(num_hosts):
+            if rank in existing:
+                continue  # idempotent relaunch
+            cudo_api.call(
+                client, 'create_vm',
+                vm_id=f'{name}-r{rank}',
+                data_center_id=region,
+                machine_type=machine_type,
+                vcpus=int(vcpus),
+                memory_gib=int(mem),
+                boot_disk_gib=int(deploy_vars.get('disk_size_gb')
+                                  or 100),
+                image_id=deploy_vars.get('image_id') or DEFAULT_IMAGE,
+                ssh_public_key=pub_key,
+                metadata={'skytpu-cluster': name,
+                          'skytpu-rank': str(rank),
+                          **{k: str(v) for k, v in
+                             (deploy_vars.get('labels') or {}).items()}})
+    except exceptions.InsufficientCapacityError:
+        try:
+            _terminate_all(client, name)
+        except exceptions.CloudError:
+            pass
+        else:
+            _records.delete(cluster_name)
+        raise
+
+
+def wait_instances(cluster_name: str, region: str, state: str = 'running',
+                   timeout: float = 1800) -> None:
+    rest_cloud.poll_for_state(
+        cluster_name, lambda: query_instances(cluster_name, region),
+        state, timeout)
+
+
+def query_instances(cluster_name: str, region: str) -> Dict[str, str]:
+    del region
+    record = _records.load(cluster_name)
+    if not record:
+        return {}
+    client = cudo_api.get_client()
+    live = _live_vms(client, record['name_on_cloud'],
+                     record.get('region'))
+    if not live:
+        return {}
+    out: Dict[str, str] = {}
+    for rank, vm in live.items():
+        out[vm.get('id') or vm.get('vmId') or f'r{rank}'] = \
+            _STATE_MAP.get(vm.get('state', ''), 'unknown')
+    for rank in range(int(record.get('num_hosts') or 0)):
+        if rank not in live:
+            out[f'rank{rank}-missing'] = 'terminated'
+    return out
+
+
+def stop_instances(cluster_name: str, region: str) -> None:
+    record = _records.require(cluster_name, 'Cudo')
+    client = cudo_api.get_client()
+    for vm in _live_vms(client, record['name_on_cloud']).values():
+        if _STATE_MAP.get(vm.get('state', '')) in ('pending', 'running'):
+            cudo_api.call(client, 'stop_vm',
+                          vm_id=vm.get('id') or vm.get('vmId'))
+
+
+def _terminate_all(client, name: str) -> None:
+    for vm in _live_vms(client, name).values():
+        cudo_api.call(client, 'terminate_vm',
+                      vm_id=vm.get('id') or vm.get('vmId'))
+
+
+def terminate_instances(cluster_name: str, region: str) -> None:
+    del region
+    record = _records.load(cluster_name)
+    if not record:
+        return
+    client = cudo_api.get_client()
+    _terminate_all(client, record['name_on_cloud'])
+    _records.delete(cluster_name)
+
+
+def get_cluster_info(cluster_name: str,
+                     region: str) -> provision_lib.ClusterInfo:
+    del region
+    record = _records.require(cluster_name, 'Cudo')
+    client = cudo_api.get_client()
+    live = _live_vms(client, record['name_on_cloud'],
+                     record.get('region'))
+    hosts: List[provision_lib.HostInfo] = []
+    for rank in sorted(live):
+        vm = live[rank]
+        nic = (vm.get('nics') or [{}])[0]
+        public = (vm.get('publicIpAddress')
+                  or nic.get('externalIpAddress'))
+        private = (vm.get('privateIpAddress')
+                   or nic.get('internalIpAddress') or public)
+        if private is None:
+            raise exceptions.ProvisionError(
+                f'No IP on VM {vm.get("id")!r} yet.')
+        hosts.append(provision_lib.HostInfo(
+            host_id=str(vm.get('id') or vm.get('vmId')), rank=rank,
+            internal_ip=private, external_ip=public,
+            extra={}))
+    return provision_lib.ClusterInfo(
+        cluster_name=cluster_name, cloud='cudo',
+        region=record['region'], zone=None, hosts=hosts,
+        deploy_vars=record['deploy_vars'])
+
+
+def get_command_runners(cluster_info: provision_lib.ClusterInfo,
+                        ssh_credentials: Optional[Dict[str, str]] = None
+                        ) -> List[runner_lib.CommandRunner]:
+    return rest_cloud.ssh_runners(cluster_info, SSH_USER, ssh_credentials)

@@ -636,3 +636,26 @@ def test_committed_oci_catalog_matches_regeneration(tmp_path,
     # Preemptible capacity is a FIXED 50% discount on OCI.
     assert float(e4['spot_price']) == pytest.approx(
         float(e4['price']) * 0.5)
+
+
+def test_committed_cudo_catalog_matches_regeneration(tmp_path,
+                                                     monkeypatch):
+    """Drift guard: cudo_vms.csv must equal the offline fetcher output."""
+    import csv as csv_lib
+    import os
+    from skypilot_tpu.catalog.fetchers import fetch_cudo
+
+    monkeypatch.setattr(fetch_cudo, 'DATA_DIR', str(tmp_path))
+    assert fetch_cudo.refresh(online=False) == 'offline'
+    committed_path = os.path.join(
+        os.path.dirname(os.path.abspath(fetch_cudo.__file__)), '..',
+        'data', 'cudo_vms.csv')
+    committed = open(committed_path).read()
+    assert committed == (tmp_path / 'cudo_vms.csv').read_text(), (
+        'cudo_vms.csv drifted from the fetcher: run '
+        'python -m skypilot_tpu.catalog.fetchers.fetch_cudo')
+    rows = list(csv_lib.DictReader(open(tmp_path / 'cudo_vms.csv')))
+    milan = [r for r in rows if r['instance_type'] == 'epyc-milan'
+             and r['region'] == 'gb-bournemouth'][0]
+    assert float(milan['price']) == 0.042
+    assert milan['spot_price'] == milan['price']  # no spot market

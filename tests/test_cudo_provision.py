@@ -1,0 +1,211 @@
+"""Cudo provisioner tests against an in-process fake client.
+
+The fake implements the flat project-scoped surface (create_vm /
+list_vms / start / stop / terminate) — so the data-center lifecycle,
+catalog-derived sizing, FAILED-build rank holes, and capacity failover
+run for real with no cloud.
+"""
+import itertools
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import exceptions
+from skypilot_tpu.backends.slice_backend import RetryingProvisioner
+from skypilot_tpu.provision import cudo_api
+from skypilot_tpu.provision import cudo_impl
+
+
+class FakeCudo:
+    """In-memory Cudo project."""
+
+    project = 'proj-test'
+
+    def __init__(self):
+        self.vms = {}
+        self.fail_regions = set()
+        self.quota_error = False
+        self.create_calls = []
+        self._ids = itertools.count(1)
+
+    def create_vm(self, vm_id, data_center_id, machine_type, vcpus,
+                  memory_gib, boot_disk_gib, image_id, ssh_public_key,
+                  metadata):
+        self.create_calls.append((data_center_id, vm_id))
+        if self.quota_error:
+            raise cudo_api.CudoApiError(
+                402, 'Project billing quota exceeded')
+        if data_center_id in self.fail_regions:
+            raise cudo_api.CudoApiError(
+                409, f'No host available for {machine_type} in '
+                f'{data_center_id}')
+        n = next(self._ids)
+        self.vms[vm_id] = {
+            'id': vm_id, 'state': 'ACTIVE',
+            'dataCenterId': data_center_id,
+            'machineType': machine_type, 'vcpus': vcpus,
+            'memoryGib': memory_gib, 'bootDiskGib': boot_disk_gib,
+            'metadata': dict(metadata),
+            'publicIpAddress': f'185.61.0.{n % 250}',
+            'privateIpAddress': f'10.53.0.{n % 250}',
+            'ssh_key': ssh_public_key,
+        }
+        return dict(self.vms[vm_id])
+
+    def list_vms(self):
+        return {'VMs': [dict(v) for v in self.vms.values()
+                        if v['state'] != 'DELETED']}.get('VMs')
+
+    def start_vm(self, vm_id):
+        self.vms[vm_id]['state'] = 'ACTIVE'
+
+    def stop_vm(self, vm_id):
+        self.vms[vm_id]['state'] = 'STOPPED'
+
+    def terminate_vm(self, vm_id):
+        self.vms[vm_id]['state'] = 'DELETED'
+
+
+@pytest.fixture
+def fake_cudo(monkeypatch, tmp_path):
+    account = FakeCudo()
+    cudo_api.set_cudo_factory(lambda: account)
+    monkeypatch.setenv('SKYTPU_FAKE_CUDO_CREDENTIALS', '1')
+    priv = tmp_path / 'key'
+    pub = tmp_path / 'key.pub'
+    priv.write_text('fake-private')
+    pub.write_text('ssh-ed25519 AAAA test')
+    monkeypatch.setattr('skypilot_tpu.authentication.get_or_generate_keys',
+                        lambda: (str(priv), str(pub)))
+    yield account
+    cudo_api.set_cudo_factory(None)
+
+
+def _deploy_vars(**over):
+    base = {
+        'cloud': 'cudo', 'mode': 'cudo_vm',
+        'cluster_name_on_cloud': 'c-cu1',
+        'instance_type': 'epyc-milan', 'image_id': None,
+        'disk_size_gb': 100, 'use_spot': False, 'labels': {}, 'ports': [],
+    }
+    base.update(over)
+    return base
+
+
+class TestLifecycle:
+
+    def test_create_query_info_stop_start_terminate(self, fake_cudo):
+        dv = _deploy_vars()
+        cudo_impl.run_instances('c1', 'gb-bournemouth', None, 2, dv)
+        cudo_impl.wait_instances('c1', 'gb-bournemouth', timeout=5)
+        states = cudo_impl.query_instances('c1', 'gb-bournemouth')
+        assert set(states.values()) == {'running'} and len(states) == 2
+
+        # Sizing derived from the catalog row for the priced point.
+        vm = next(iter(fake_cudo.vms.values()))
+        assert (vm['vcpus'], vm['memoryGib']) == (4, 16)
+
+        info = cudo_impl.get_cluster_info('c1', 'gb-bournemouth')
+        assert info.num_hosts == 2
+        assert info.head.internal_ip.startswith('10.53.')
+
+        cudo_impl.stop_instances('c1', 'gb-bournemouth')
+        assert set(cudo_impl.query_instances(
+            'c1', 'gb-bournemouth').values()) == {'stopped'}
+        cudo_impl.run_instances('c1', 'gb-bournemouth', None, 2, dv)
+        assert set(cudo_impl.query_instances(
+            'c1', 'gb-bournemouth').values()) == {'running'}
+        assert len(fake_cudo.create_calls) == 2  # restart, no new
+
+        cudo_impl.terminate_instances('c1', 'gb-bournemouth')
+        assert cudo_impl.query_instances('c1', 'gb-bournemouth') == {}
+
+    def test_failed_build_is_a_rank_hole(self, fake_cudo):
+        cudo_impl.run_instances('c2', 'gb-bournemouth', None, 2,
+                                _deploy_vars())
+        victim = fake_cudo.vms['c-cu1-r1']
+        victim['state'] = 'FAILED'
+        with pytest.raises(exceptions.InsufficientCapacityError):
+            cudo_impl.wait_instances('c2', 'gb-bournemouth', timeout=5)
+
+
+class TestFailover:
+
+    def _task(self, *regions):
+        task = sky.Task(run='echo x')
+        rs = [sky.Resources(cloud='cudo', instance_type='epyc-milan',
+                            region=r) for r in regions]
+        task.set_resources([rs[0]])
+        task.best_resources = rs[0]
+        task.candidate_resources = rs
+        return task
+
+    def test_no_host_fails_over_to_next_data_center(self, fake_cudo):
+        fake_cudo.fail_regions.add('gb-bournemouth')
+        launched, info = RetryingProvisioner().provision(
+            self._task('gb-bournemouth', 'se-smedjebacken-1'), 'cu-fo')
+        assert launched.region == 'se-smedjebacken-1'
+        assert info.num_hosts == 1
+
+    def test_billing_quota_is_not_capacity(self, fake_cudo):
+        fake_cudo.quota_error = True
+        err = None
+        try:
+            cudo_api.call(fake_cudo, 'create_vm', vm_id='x-r0',
+                          data_center_id='gb-bournemouth',
+                          machine_type='epyc-milan', vcpus=4,
+                          memory_gib=16, boot_disk_gib=100,
+                          image_id='i', ssh_public_key='k', metadata={})
+        except exceptions.CloudError as e:
+            err = e
+        assert err is not None
+        assert not isinstance(err, exceptions.InsufficientCapacityError)
+        assert err.reason == 'quota'
+
+
+class TestCloudClass:
+
+    def test_stop_supported_spot_and_ports_not(self, fake_cudo):
+        from skypilot_tpu import clouds as clouds_lib
+        cloud = sky.clouds.get_cloud('cudo')
+        assert cloud.supports(clouds_lib.CloudFeature.STOP)
+        assert not cloud.supports(clouds_lib.CloudFeature.SPOT)
+        assert not cloud.supports(clouds_lib.CloudFeature.OPEN_PORTS)
+        feas = cloud.get_feasible_resources(
+            sky.Resources(cloud='cudo', ports=['8080']))
+        assert feas.resources == [] and 'port' in feas.hint
+
+    def test_optimizer_places_pinned_cudo_task(self, fake_cudo):
+        from skypilot_tpu import optimizer
+        task = sky.Task(run='echo x')
+        task.set_resources([sky.Resources(cloud='cudo', cpus='4+')])
+        optimizer.optimize(task, quiet=True)
+        res = task.best_resources
+        assert res.cloud == 'cudo'
+        assert res.instance_type == 'intel-broadwell'  # cheapest >=4
+
+
+def test_failover_survivor_in_old_region_not_adopted(fake_cudo):
+    # Cleanup survivor from a failed-over data center must not be
+    # counted as a rank of the new region's gang (round-5 review).
+    fake_cudo.create_vm('c-cu1-r0', 'gb-bournemouth', 'epyc-milan', 4,
+                        16, 100, 'i', 'k', {})
+    cudo_impl.run_instances('g1', 'se-smedjebacken-1', None, 1,
+                            _deploy_vars())
+    se = [v for v in fake_cudo.vms.values()
+          if v['dataCenterId'] == 'se-smedjebacken-1'
+          and v['state'] == 'ACTIVE']
+    assert len(se) == 1  # freshly created, not adopted
+    info = cudo_impl.get_cluster_info('g1', 'se-smedjebacken-1')
+    assert info.num_hosts == 1
+    assert info.head.host_id == se[0]['id']
+
+
+def test_online_label_honest_when_live_rows_unusable(tmp_path,
+                                                     monkeypatch):
+    from skypilot_tpu.catalog.fetchers import fetch_cudo
+    monkeypatch.setattr(fetch_cudo, 'DATA_DIR', str(tmp_path))
+    live = [{'machineType': 'x', 'price': 0},  # no usable price
+            {'vcpus': 4}]                      # no machineType
+    assert fetch_cudo.refresh(online=True,
+                              types_fetcher=lambda: live) == 'offline'

@@ -13,7 +13,8 @@ EXAMPLES_DIR = os.path.join(os.path.dirname(__file__), '..', 'examples')
 
 
 # Examples that deliberately target CPU instances (no accelerators).
-_CPU_EXAMPLES = {'aws_cpu_task.yaml', 'docker_task.yaml'}
+_CPU_EXAMPLES = {'aws_cpu_task.yaml', 'docker_task.yaml',
+                 'oci_cpu_task.yaml'}
 
 
 @pytest.mark.parametrize('path', sorted(

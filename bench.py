@@ -453,23 +453,31 @@ def phase_serve(out_path: str, on_tpu: bool, chip_kind: str) -> None:
     def progress(partial: dict) -> None:
         _write_record(out_path, partial)  # survive a mid-sweep SIGKILL
 
-    # Inner deadlines (ready + warmup + sweep windows + teardown) sum to
-    # ~440s TPU / ~210s CPU — INSIDE the phase budget (480/300), so a
-    # slow-but-healthy run finishes rather than getting SIGKILLed.
+    # Inner deadlines (per service: ready + warmup + burn-in + sweep
+    # windows + teardown) sum to ~450s TPU / ~190s CPU per A/B arm, x2
+    # arms — INSIDE the phase budget (1000/450), so a slow-but-healthy
+    # run finishes rather than getting SIGKILLed.
     try:
         if on_tpu:
+            # 4-point sweep bracketing the r5 saturation knee (TTFT p99
+            # exploded between c24 and c48), A/B chunked-prefill +
+            # admission-control vs the monolithic control. SLO = the
+            # BASELINE anchor's P99 TTFT (4.5s).
             out = serve_bench.run(
                 preset='llama-1b', batch_slots=32, max_len=4096,
-                prompt_len=2500, output_len=150, concurrencies=(24, 48),
-                window_s=60.0, warmup_requests=2,
+                prompt_len=2500, output_len=150,
+                concurrencies=(12, 24, 36, 48),
+                window_s=45.0, warmup_requests=2,
                 ready_timeout_s=150 * _SCALE, warmup_deadline_s=90 * _SCALE,
+                prefill_chunk=256, ttft_slo_ms=4500.0, ab_monolithic=True,
                 progress=progress)
         else:
             out = serve_bench.run(
                 preset='test-tiny', batch_slots=2, max_len=128,
-                prompt_len=24, output_len=8, concurrencies=(2,),
-                window_s=6.0, warmup_requests=1,
+                prompt_len=24, output_len=8, concurrencies=(1, 2, 3, 4),
+                window_s=4.0, warmup_requests=1,
                 ready_timeout_s=120 * _SCALE, warmup_deadline_s=60 * _SCALE,
+                prefill_chunk=8, ttft_slo_ms=2000.0, ab_monolithic=True,
                 progress=progress)
     except Exception as e:  # noqa: BLE001 — a failed serve phase must
         # still contribute an explanatory record, not just rc!=0
@@ -640,10 +648,11 @@ def main() -> None:
             record['launched_tokens_per_sec_per_chip'] / record['value'], 3)
     _emit(record)
 
-    # Phase 3 — serve (controller + LB + replica).
+    # Phase 3 — serve (controller + LB + replica; the budget covers BOTH
+    # A/B arms — monolithic control + chunked headline).
     reprobe('before_serve')
     record.update(run_phase(
-        'serve', _phase_budget('serve', 480 if on_tpu else 300),
+        'serve', _phase_budget('serve', 1000 if on_tpu else 450),
         force_cpu=not on_tpu,
         extra_args=(['--on-tpu'] if on_tpu else [])
         + ['--chip-kind', chip_kind if on_tpu else 'cpu']))

@@ -54,6 +54,7 @@ def drive_load(endpoint: str, *, vocab_size: int, prompt_len: int,
     wall-clock measurements, not server-reported)."""
     results: List[Tuple[float, float, int]] = []  # (ttft_s, total_s, n_out)
     errors = [0]
+    rejected = [0]
     lock = threading.Lock()
     t_start = time.perf_counter()
     stop_at = t_start + window_s
@@ -83,6 +84,23 @@ def drive_load(endpoint: str, *, vocab_size: int, prompt_len: int,
                 if first is not None and n_out >= 2 and t1 <= stop_at:
                     with lock:
                         results.append((first - t0, t1 - t0, n_out))
+            except urllib.error.HTTPError as e:
+                if e.code == 429:
+                    # Admission early-reject: expected behavior past the
+                    # saturation knee, counted separately from errors.
+                    # Honor Retry-After (capped: a closed-loop client
+                    # that sleeps out the window measures nothing).
+                    with lock:
+                        rejected[0] += 1
+                    try:
+                        delay = float(e.headers.get('Retry-After', '1'))
+                    except (TypeError, ValueError):
+                        delay = 1.0
+                    time.sleep(min(max(delay, 0.1), 2.0))
+                else:
+                    with lock:
+                        errors[0] += 1
+                    time.sleep(0.5)
             except (urllib.error.URLError, OSError, ValueError):
                 with lock:
                     errors[0] += 1
@@ -97,7 +115,8 @@ def drive_load(endpoint: str, *, vocab_size: int, prompt_len: int,
 
     if not results:
         return {'concurrency': concurrency, 'completed': 0,
-                'errors': errors[0], 'req_per_s': 0.0}
+                'errors': errors[0], 'rejected': rejected[0],
+                'req_per_s': 0.0}
     ttfts = [r[0] * 1e3 for r in results]
     tpots = [(r[1] - r[0]) * 1e3 / (r[2] - 1) for r in results]
     total_out = sum(r[2] for r in results)
@@ -105,6 +124,7 @@ def drive_load(endpoint: str, *, vocab_size: int, prompt_len: int,
         'concurrency': concurrency,
         'completed': len(results),
         'errors': errors[0],
+        'rejected': rejected[0],
         'req_per_s': round(len(results) / window_s, 3),
         'output_tokens_per_s': round(total_out / window_s, 1),
         'ttft_p50_ms': round(_percentile(ttfts, 50), 1),
@@ -114,46 +134,22 @@ def drive_load(endpoint: str, *, vocab_size: int, prompt_len: int,
     }
 
 
-def run(*, preset: str = 'llama-1b', batch_slots: int = 16,
-        max_len: int = 4096, prompt_len: int = 2500, output_len: int = 150,
-        concurrencies: Sequence[int] = (8, 24), window_s: float = 75.0,
-        warmup_requests: int = 2, ready_timeout_s: float = 900.0,
-        warmup_deadline_s: Optional[float] = None,
-        service_name: str = 'bench-serve',
-        progress=None) -> Dict[str, Any]:
-    """Stand up the full serve stack on the local cloud, warm the replica
-    (big prefill bucket + steady step compile), sweep concurrency, tear
-    down. Returns the sweep plus the best-throughput point flattened into
-    ``serve_*`` fields (the BENCH record contract)."""
-    import skypilot_tpu as sky
-    from skypilot_tpu.models.llama import PRESETS
+def _bench_service(*, task, service_name: str, vocab_size: int,
+                   prompt_len: int, output_len: int,
+                   concurrencies: Sequence[int], window_s: float,
+                   warmup_requests: int, ready_timeout_s: float,
+                   warmup_deadline_s: float,
+                   progress=None) -> Dict[str, Any]:
+    """Stand up ONE serve stack for ``task`` on the local cloud, warm the
+    replica through the LB, sweep concurrency, fetch the replica's
+    /stats, tear down. Returns {'sweep', 'warmup_failed', 'stats'};
+    ``progress(sweep_so_far)`` persists partial results."""
     from skypilot_tpu.serve import core as serve_core
     from skypilot_tpu.serve import serve_state
-    from skypilot_tpu.serve import service_spec as spec_lib
     ReplicaStatus = serve_state.ReplicaStatus
 
-    config = PRESETS[preset]
-    # No --port: the replica reads $SKYTPU_SERVE_REPLICA_PORT assigned by
-    # the replica manager (local replicas each get their own free port).
-    task = sky.Task(
-        run=(f'{sys.executable} -m skypilot_tpu.serve.generation_server '
-             f'--preset {preset} '
-             f'--batch-slots {batch_slots} --max-len {max_len}'))
-    task.set_resources([sky.Resources(cloud='local')])
-    task.set_service(spec_lib.ServiceSpec.from_yaml_config({
-        'readiness_probe': {'path': '/health',
-                            'initial_delay_seconds': int(ready_timeout_s),
-                            'timeout_seconds': 5},
-        'replica_policy': {'min_replicas': 1, 'max_replicas': 1},
-    }))
-
-    out: Dict[str, Any] = {
-        'serve_model_params': int(config.num_params),
-        'serve_model_params_b': round(config.num_params / 1e9, 3),
-        'serve_prompt_len': prompt_len,
-        'serve_output_len': output_len,
-        'serve_batch_slots': batch_slots,
-    }
+    out: Dict[str, Any] = {'sweep': [], 'warmup_failed': False,
+                           'stats': {}}
     result = serve_core.up(task, service_name)
     endpoint = result['endpoint']
     try:
@@ -169,19 +165,18 @@ def run(*, preset: str = 'llama-1b', batch_slots: int = 16,
                 f'no READY replica within {ready_timeout_s}s')
 
         # Warmup THROUGH the LB: the first full-length request compiles the
-        # big prefill bucket + insert; repeats hit the LB sync + caches.
-        # Per-attempt timeout + overall deadline: a READY-but-wedged chip
-        # (degraded tunnel) must fail the phase in minutes, not hang the
-        # whole bench on 30 x 15-minute request timeouts.
+        # big prefill bucket + insert (or the chunk variants); repeats hit
+        # the LB sync + caches. Per-attempt timeout + overall deadline: a
+        # READY-but-wedged chip (degraded tunnel) must fail the phase in
+        # minutes, not hang the whole bench on 30 x 15-minute request
+        # timeouts.
         if progress is not None:
-            progress(dict(out))  # replica READY: persist the config fields
+            progress([])  # replica READY: persist the config fields
         rnd = random.Random(7)
-        if warmup_deadline_s is None:
-            warmup_deadline_s = max(300.0, ready_timeout_s / 2)
         warm_deadline = time.time() + warmup_deadline_s
         warmed = False
         for i in range(max(1, warmup_requests)):
-            tokens = [rnd.randrange(config.vocab_size)
+            tokens = [rnd.randrange(vocab_size)
                       for _ in range(prompt_len)]
             # Last warmup request goes through the STREAMING path — the
             # sweep measures streaming, so its first-hit costs (chunked
@@ -206,7 +201,7 @@ def run(*, preset: str = 'llama-1b', batch_slots: int = 16,
             # Every attempt failed but the deadline never fired (e.g. fast
             # connection-refused loops): the sweep below would fold compile
             # time into TTFT/TPOT. Record it so the numbers are legible.
-            out['serve_warmup_failed'] = True
+            out['warmup_failed'] = True
             print('serve bench WARNING: warmup exhausted all attempts '
                   'without a successful request; sweep numbers include '
                   'compile time', file=sys.stderr)
@@ -219,7 +214,7 @@ def run(*, preset: str = 'llama-1b', batch_slots: int = 16,
             # steady state) — c24-first read TTFT p50 3.0s + 2 errors
             # while c48-second read 2.2s + 0. ~15s of load washes that
             # out of the measured numbers.
-            burn = drive_load(endpoint, vocab_size=config.vocab_size,
+            burn = drive_load(endpoint, vocab_size=vocab_size,
                               prompt_len=prompt_len,
                               output_len=output_len,
                               concurrency=concurrencies[0],
@@ -227,18 +222,155 @@ def run(*, preset: str = 'llama-1b', batch_slots: int = 16,
             print(f'serve bench burn-in (discarded): {burn}',
                   file=sys.stderr)
 
-        sweep = []
+        sweep: List[Dict[str, Any]] = []
         for conc in concurrencies:
-            stats = drive_load(endpoint, vocab_size=config.vocab_size,
+            stats = drive_load(endpoint, vocab_size=vocab_size,
                                prompt_len=prompt_len,
                                output_len=output_len, concurrency=conc,
                                window_s=window_s, seed=conc)
-            print(f'serve bench @ concurrency {conc}: {stats}',
-                  file=sys.stderr)
+            print(f'serve bench [{service_name}] @ concurrency {conc}: '
+                  f'{stats}', file=sys.stderr)
             sweep.append(stats)
             if progress is not None:
-                progress({**out, 'serve_sweep': sweep})
-        out['serve_sweep'] = sweep
+                progress(sweep)
+        out['sweep'] = sweep
+        # Replica counters through the LB proxy: the rejected count is
+        # the admission-control acceptance signal.
+        try:
+            with urllib.request.urlopen(endpoint + '/stats',
+                                        timeout=30) as resp:
+                out['stats'] = json.loads(resp.read())
+        except (urllib.error.URLError, OSError, ValueError):
+            pass
+    finally:
+        try:
+            serve_core.down(service_name)
+        except Exception:  # noqa: BLE001 — bench must not die on teardown
+            pass
+    return out
+
+
+def run(*, preset: str = 'llama-1b', batch_slots: int = 16,
+        max_len: int = 4096, prompt_len: int = 2500, output_len: int = 150,
+        concurrencies: Sequence[int] = (8, 24), window_s: float = 75.0,
+        warmup_requests: int = 2, ready_timeout_s: float = 900.0,
+        warmup_deadline_s: Optional[float] = None,
+        service_name: str = 'bench-serve',
+        progress=None, prefill_chunk: int = 0, ttft_slo_ms: float = 0.0,
+        ab_monolithic: bool = False) -> Dict[str, Any]:
+    """Serve-path sweep, optionally A/B'd chunked-vs-monolithic.
+
+    The headline service runs with ``prefill_chunk``/``ttft_slo_ms``
+    (env-configured on the replica: $SKYTPU_PREFILL_CHUNK +
+    $SKYTPU_TTFT_SLO_MS). With ``ab_monolithic`` and a nonzero chunk, a
+    monolithic-prefill control service runs the SAME sweep first and its
+    points land in ``serve_sweep_monolithic`` + the per-concurrency
+    ``serve_ttft_p99_ab`` table — the record carries the A/B, not just
+    the winner. Returns the sweep plus the best-throughput point
+    flattened into ``serve_*`` fields (the BENCH record contract)."""
+    import skypilot_tpu as sky
+    from skypilot_tpu.models.llama import PRESETS
+    from skypilot_tpu.serve import service_spec as spec_lib
+
+    config = PRESETS[preset]
+    if warmup_deadline_s is None:
+        warmup_deadline_s = max(300.0, ready_timeout_s / 2)
+
+    def make_task(chunk: int, slo_ms: float):
+        # No --port: the replica reads $SKYTPU_SERVE_REPLICA_PORT
+        # assigned by the replica manager (local replicas each get their
+        # own free port).
+        envs = {}
+        if chunk:
+            envs['SKYTPU_PREFILL_CHUNK'] = str(int(chunk))
+        if slo_ms:
+            envs['SKYTPU_TTFT_SLO_MS'] = str(float(slo_ms))
+        task = sky.Task(
+            run=(f'{sys.executable} -m '
+                 'skypilot_tpu.serve.generation_server '
+                 f'--preset {preset} '
+                 f'--batch-slots {batch_slots} --max-len {max_len}'),
+            envs=envs or None)
+        task.set_resources([sky.Resources(cloud='local')])
+        task.set_service(spec_lib.ServiceSpec.from_yaml_config({
+            'readiness_probe': {'path': '/health',
+                                'initial_delay_seconds':
+                                    int(ready_timeout_s),
+                                'timeout_seconds': 5},
+            'replica_policy': {'min_replicas': 1, 'max_replicas': 1},
+        }))
+        return task
+
+    out: Dict[str, Any] = {
+        'serve_model_params': int(config.num_params),
+        'serve_model_params_b': round(config.num_params / 1e9, 3),
+        'serve_prompt_len': prompt_len,
+        'serve_output_len': output_len,
+        'serve_batch_slots': batch_slots,
+        'serve_prefill_chunk': prefill_chunk,
+        'serve_ttft_slo_ms': ttft_slo_ms,
+    }
+
+    def sub_progress(field: str):
+        if progress is None:
+            return None
+
+        def cb(sweep):
+            progress({**out, field: sweep} if sweep else dict(out))
+        return cb
+
+    common = dict(vocab_size=config.vocab_size, prompt_len=prompt_len,
+                  output_len=output_len, concurrencies=concurrencies,
+                  window_s=window_s, warmup_requests=warmup_requests,
+                  ready_timeout_s=ready_timeout_s,
+                  warmup_deadline_s=warmup_deadline_s)
+    if ab_monolithic and prefill_chunk:
+        # The control arm is context, not the measurement: an infra
+        # flake here (replica never READY, warmup timeout) must not
+        # abort the headline chunked arm below.
+        try:
+            mono = _bench_service(task=make_task(0, 0.0),
+                                  service_name=service_name + '-mono',
+                                  progress=sub_progress(
+                                      'serve_sweep_monolithic'),
+                                  **common)
+        except Exception as e:  # noqa: BLE001
+            out['serve_mono_error'] = f'{type(e).__name__}: {e}'
+            print(f'serve bench WARNING: monolithic control arm failed '
+                  f'({e}); continuing to the chunked arm',
+                  file=sys.stderr)
+        else:
+            out['serve_sweep_monolithic'] = mono['sweep']
+            if mono['warmup_failed']:
+                out['serve_mono_warmup_failed'] = True
+
+    main = _bench_service(task=make_task(prefill_chunk, ttft_slo_ms),
+                          service_name=service_name,
+                          progress=sub_progress('serve_sweep'), **common)
+    sweep = main['sweep']
+    out['serve_sweep'] = sweep
+    if main['warmup_failed']:
+        out['serve_warmup_failed'] = True
+    if main['stats']:
+        out['serve_rejected'] = main['stats'].get('rejected', 0)
+        out['serve_replica_stats'] = {
+            k: main['stats'][k]
+            for k in ('requests', 'rejected', 'queue_depth',
+                      'prefill_chunk', 'ttft_slo_ms',
+                      'prefill_tokens_per_s')
+            if k in main['stats']}
+    if out.get('serve_sweep_monolithic'):
+        # Per-concurrency TTFT p99 A/B: the acceptance signal that
+        # chunked+admission never regresses past the monolithic control.
+        mono_by_c = {s['concurrency']: s
+                     for s in out['serve_sweep_monolithic']}
+        out['serve_ttft_p99_ab'] = [
+            {'concurrency': s['concurrency'],
+             'monolithic_ms': mono_by_c.get(s['concurrency'],
+                                            {}).get('ttft_p99_ms'),
+             'chunked_ms': s.get('ttft_p99_ms')}
+            for s in sweep]
+    if sweep:
         best = max(sweep, key=lambda s: s.get('req_per_s', 0.0))
         if best.get('completed'):
             out.update({
@@ -250,11 +382,6 @@ def run(*, preset: str = 'llama-1b', batch_slots: int = 16,
                 'serve_tpot_p99_ms': best['tpot_p99_ms'],
                 'serve_concurrency': best['concurrency'],
             })
-    finally:
-        try:
-            serve_core.down(service_name)
-        except Exception:  # noqa: BLE001 — bench must not die on teardown
-            pass
     return out
 
 

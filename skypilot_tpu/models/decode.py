@@ -19,7 +19,7 @@ Design (vs the correctness-oracle ``LlamaModel.decode_step``):
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -65,6 +65,10 @@ class DecodeEngine:
         self.batch_slots = batch_slots
         self.max_len = max_len or config.max_seq_len
         self._prefill = jax.jit(self._prefill_impl)
+        self._prefill_chunk = jax.jit(self._prefill_chunk_impl,
+                                      donate_argnums=(0,))
+        self._prefill_chunk_final = jax.jit(self._prefill_chunk_final_impl,
+                                            donate_argnums=(0,))
         self._insert = jax.jit(self._insert_impl, donate_argnums=(0,))
         self._admit = jax.jit(self._admit_impl, donate_argnums=(0,))
         self._admit_many = jax.jit(self._admit_many_impl,
@@ -134,6 +138,118 @@ class DecodeEngine:
         last = x[0, true_len - 1].astype(jnp.float32)
         logits = last @ head.astype(jnp.float32)
         return ks, vs, logits
+
+    # -- chunked prefill ----------------------------------------------------
+    def prefill_chunk(self, params: Params, state: DecodeState,
+                      tokens: jax.Array, offset, slot) -> DecodeState:
+        """Run ONE prompt chunk [C] at cache ``offset`` of ``slot``,
+        writing its KV rows in place (donated state, one dispatch).
+
+        Unlike monolithic ``prefill`` — which stalls every occupied decode
+        slot for the full prompt length — a chunk dispatch is short, so
+        the scheduler can interleave ``step`` dispatches between chunks
+        (Sarathi-style piggybacked prefill). The chunk's queries attend
+        to the slot's already-written prefix rows [0, offset) plus the
+        chunk itself under a causal mask; rows past the chunk are masked,
+        so stale cache contents cannot leak in. The slot stays INACTIVE
+        (lengths 0) until the final chunk commits it, so concurrent
+        decode steps skip it."""
+        return self._prefill_chunk(state, params, tokens,
+                                   jnp.asarray(offset, jnp.int32),
+                                   jnp.asarray(slot, jnp.int32))
+
+    def prefill_chunk_final(self, params: Params, state: DecodeState,
+                            tokens: jax.Array, offset, slot, true_len,
+                            rng: jax.Array, temperature: float = 0.0,
+                            top_k: int = 0
+                            ) -> Tuple[DecodeState, jax.Array, jax.Array]:
+        """Final chunk: forward + first-token sample + slot activation in
+        ONE dispatch (the chunked counterpart of fused ``admit``).
+        Returns (state, first_token, next_rng). ``true_len`` is the FULL
+        prompt length; the chunk's padding past ``true_len - offset`` is
+        benign (garbage rows are masked by the slot length, exactly like
+        monolithic end-padding)."""
+        return self._prefill_chunk_final(
+            state, params, tokens, jnp.asarray(offset, jnp.int32),
+            jnp.asarray(slot, jnp.int32),
+            jnp.asarray(true_len, jnp.int32), rng,
+            jnp.float32(temperature), jnp.int32(top_k))
+
+    def _chunk_forward(self, state, params, tokens, offset, slot):
+        """Shared traced body: chunk forward over prefix KV + in-place
+        cache writes. Returns (x [1, C, e] final hidden, new_k, new_v)."""
+        c = self.config
+        t = tokens.shape[0]
+        grp = c.num_heads // c.num_kv_heads
+        positions = offset + jnp.arange(t)  # [C] absolute positions
+        cos, sin = precompute_rotary(c.head_dim, c.max_seq_len, c.rope_theta)
+        x = params['embed'][tokens][None].astype(c.dtype)  # [1, C, e]
+        kv_pos = jnp.arange(self.max_len)
+        # [C, M]: a chunk query at absolute position p sees kv rows <= p —
+        # the prompt's own prefix chunks plus the causal part of this one.
+        valid = kv_pos[None, :] <= positions[:, None]
+        model = self.model
+
+        def layer(carry, inputs):
+            x, cache_k, cache_v = carry
+            lp, i = inputs
+            q, k, v = model._qkv(lp, x, cos, sin, positions, constrain=False)
+            # [1, C, kvh, d] -> [1, 1, kvh, C, d]: one contiguous write at
+            # (layer i, slot, :, offset) in the head-major cache.
+            kf = k[0].transpose(1, 0, 2)[None, None]
+            vf = v[0].transpose(1, 0, 2)[None, None]
+            cache_k = lax.dynamic_update_slice(
+                cache_k, kf.astype(cache_k.dtype), (i, slot, 0, offset, 0))
+            cache_v = lax.dynamic_update_slice(
+                cache_v, vf.astype(cache_v.dtype), (i, slot, 0, offset, 0))
+            k_slot = cache_k[i, slot]  # [kvh, M, d]
+            v_slot = cache_v[i, slot]
+            # Grouped-query attention over the slot's cache rows, same
+            # contiguous-[M, d] streaming pattern as the decode step.
+            qg = q[0].reshape(t, c.num_kv_heads, grp, c.head_dim)
+            s = jnp.einsum('ckgd,kmd->ckgm', qg, k_slot,
+                           preferred_element_type=jnp.float32)
+            s = s * (c.head_dim**-0.5)
+            s = jnp.where(valid[:, None, None, :], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            attn = jnp.einsum('ckgm,kmd->ckgd', p.astype(c.dtype), v_slot,
+                              preferred_element_type=jnp.float32)
+            attn = attn.reshape(1, t, c.num_heads,
+                                c.head_dim).astype(c.dtype)
+            x = x + jnp.einsum('bshd,hde->bse', attn, lp['wo'])
+            x = x + model._mlp_delta(lp, x, constrain=False)[0]
+            return (x, cache_k, cache_v), None
+
+        (x, new_k, new_v), _ = lax.scan(
+            layer, (x, state.k, state.v),
+            (params['layers'], jnp.arange(c.num_layers)))
+        return x, new_k, new_v
+
+    def _prefill_chunk_impl(self, state, params, tokens, offset, slot):
+        _, new_k, new_v = self._chunk_forward(state, params, tokens,
+                                              offset, slot)
+        return DecodeState(k=new_k, v=new_v, lengths=state.lengths,
+                           last_tokens=state.last_tokens,
+                           active=state.active)
+
+    def _prefill_chunk_final_impl(self, state, params, tokens, offset,
+                                  slot, true_len, rng, temperature, top_k):
+        c = self.config
+        x, new_k, new_v = self._chunk_forward(state, params, tokens,
+                                              offset, slot)
+        x = rms_norm(x, params['final_norm'], c.norm_eps)
+        head = (params['embed'].T if c.tie_embeddings else params['lm_head'])
+        # Logits only for the prompt's last REAL token (chunk-relative).
+        last = x[0, true_len - 1 - offset].astype(jnp.float32)
+        logits = last @ head.astype(jnp.float32)
+        rng, sub = jax.random.split(rng)
+        first = _sample(logits[None], sub, temperature, top_k)[0]
+        return DecodeState(
+            k=new_k, v=new_v,
+            lengths=state.lengths.at[slot].set(true_len),
+            last_tokens=state.last_tokens.at[slot].set(first),
+            active=state.active.at[slot].set(True),
+        ), first, rng
 
     # -- insert -------------------------------------------------------------
     def insert(self, state: DecodeState, k: jax.Array, v: jax.Array,
@@ -343,6 +459,15 @@ class DecodeEngine:
         kv_pos = jnp.arange(self.max_len)
         # New key written at index ``lengths`` -> valid keys are <= lengths.
         valid = kv_pos[None] <= state.lengths[:, None]  # [B, M]
+        # INACTIVE slots park their (garbage) step-write at the LAST row
+        # instead of row ``lengths`` (= 0). A slot mid-chunked-prefill is
+        # inactive but already holds real KV rows from offset 0 up — the
+        # old unconditional write-at-lengths clobbered its row 0 on every
+        # interleaved decode step. The last row is never read before
+        # being rewritten: readers mask by kv_pos <= lengths, and a slot
+        # AT capacity rewrites that row itself before attending.
+        write_pos = jnp.where(state.active, state.lengths,
+                              self.max_len - 1)[:, None]  # [B, 1]
 
         model = self.model
 
@@ -356,10 +481,10 @@ class DecodeEngine:
             # (in-place on the donated carry). Cache is [L,B,kvh,M,d];
             # indices broadcast to [B, kvh] -> writes [B, kvh, d] rows.
             cache_k = cache_k.at[i, rows[:, None], kv_heads[None, :],
-                                 state.lengths[:, None]].set(
+                                 write_pos].set(
                 k[:, 0].astype(cache_k.dtype))
             cache_v = cache_v.at[i, rows[:, None], kv_heads[None, :],
-                                 state.lengths[:, None]].set(
+                                 write_pos].set(
                 v[:, 0].astype(cache_v.dtype))
             k_layer = cache_k[i]  # [B, kvh, M, d]
             v_layer = cache_v[i]
@@ -425,6 +550,30 @@ def _sample(logits: jax.Array, rng: jax.Array, temperature,
     filtered = jnp.where(scaled >= kth, scaled, -jnp.inf)
     sampled = jax.random.categorical(rng, filtered, axis=-1).astype(jnp.int32)
     return jnp.where(temperature <= 0.0, greedy, sampled)
+
+
+def chunk_spans(prompt_len: int, chunk: int,
+                max_len: int) -> List[Tuple[int, int, bool]]:
+    """Split a prompt into prefill-chunk spans ``(offset, bucket, final)``.
+
+    Every mid span is exactly ``chunk`` tokens (ONE compiled variant per
+    configured chunk size); the final span pads its remainder up to a
+    ``prefill_bucket`` capped at ``chunk``, so final-chunk variants stay a
+    small pow2 family instead of one compile per prompt length. The final
+    bucket is additionally capped at ``max_len - offset`` so the cache
+    write can never run past the slot.
+    """
+    if chunk <= 0:
+        raise ValueError(f'chunk must be positive, got {chunk}')
+    spans: List[Tuple[int, int, bool]] = []
+    off = 0
+    while prompt_len - off > chunk:
+        spans.append((off, chunk, False))
+        off += chunk
+    rem = prompt_len - off
+    bucket = min(prefill_bucket(rem, min(chunk, max_len)), max_len - off)
+    spans.append((off, bucket, True))
+    return spans
 
 
 def prefill_bucket(length: int, max_len: int, floor: int = 16) -> int:

@@ -279,7 +279,8 @@ def open_ports(cluster_name: str, region: str, ports: List[str]) -> None:
         wanted[f'tcp:{spec}'] = {
             'protocol': 'tcp', 'ports': spec,
             'sources': {'addresses': list(ranges)}}
-    rules = sorted(wanted.values(), key=lambda r: r['ports'])
+    # .get: preserved ICMP rules have no 'ports' (they sort first).
+    rules = sorted(wanted.values(), key=lambda r: r.get('ports', ''))
     if existing is None:
         do_api.call(client, 'create_firewall', name=fw_name,
                     inbound_rules=rules, tags=[tag])

@@ -170,10 +170,12 @@ class _RestClient:
         url = f'{self._base}{path}'
         body = (json.dumps(payload).encode()
                 if payload is not None else None)
-        headers = self._signer.sign(method, url, body)
+        # Header FACTORY, not a dict: each retry attempt re-signs, so a
+        # 429 backoff (up to ~135s of sleeps) can't drift the signed
+        # date header into OCI's clock-skew rejection window.
         return rest_cloud.retrying_request(
-            method, url, headers, payload, _parse_error,
-            return_headers=return_headers)
+            method, url, lambda: self._signer.sign(method, url, body),
+            payload, _parse_error, return_headers=return_headers)
 
     # -- flat op surface (mirrored by test fakes) ---------------------------
     def launch_instance(self, compartment_id: str, name: str, shape: str,

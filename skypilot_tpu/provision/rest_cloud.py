@@ -21,10 +21,11 @@ the marker strings and status shapes genuinely differ per provider.
 from __future__ import annotations
 
 import json
+import socket
 import time
 import urllib.error
 import urllib.request
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Union
 
 from skypilot_tpu import authentication
 from skypilot_tpu import exceptions
@@ -179,22 +180,29 @@ class ClientSeam:
             raise self._classify(e) from e
 
 
-def retrying_request(method: str, url: str, headers: Dict[str, str],
+def retrying_request(method: str, url: str,
+                     headers: Union[Dict[str, str],
+                                    Callable[[], Dict[str, str]]],
                      payload: Optional[Dict[str, Any]],
                      parse_error: Callable[[int, bytes], Exception],
                      max_attempts: int = 6,
                      timeout: float = 60.0,
                      return_headers: bool = False) -> Any:
-    """One urllib call with 429 backoff. ``parse_error(status, body)``
-    builds the cloud's typed API error from a failure response (each
-    provider has its own error envelope). ``return_headers=True``
-    returns ``(body, response_headers)`` — needed by providers that
-    paginate via response headers (OCI's ``opc-next-page``)."""
+    """One urllib call with 429/transport backoff. ``parse_error(status,
+    body)`` builds the cloud's typed API error from a failure response
+    (each provider has its own error envelope). ``headers`` may be a
+    CALLABLE rebuilt per attempt — required by providers whose headers
+    are time-sensitive (OCI signs the date header; with full backoff the
+    sleeps drift a once-signed date into the clock-skew rejection
+    window). ``return_headers=True`` returns ``(body,
+    response_headers)`` — needed by providers that paginate via response
+    headers (OCI's ``opc-next-page``)."""
     data = json.dumps(payload).encode() if payload is not None else None
     backoff = 5.0
     for attempt in range(max_attempts):
+        hdrs = headers() if callable(headers) else headers
         req = urllib.request.Request(url, data=data, method=method,
-                                     headers=headers)
+                                     headers=hdrs)
         try:
             with urllib.request.urlopen(req, timeout=timeout) as resp:
                 body = resp.read().decode()
@@ -212,4 +220,28 @@ def retrying_request(method: str, url: str, headers: Dict[str, str],
             except Exception:  # noqa: BLE001 — body read is best-effort
                 raw = b''
             raise parse_error(e.code, raw) from e
+        except (urllib.error.URLError, socket.timeout, OSError) as e:
+            # Transport-level failure: no HTTP status to classify, so
+            # parse_error can't apply — wrap as CloudError so the
+            # failover/retry machinery above understands it instead of
+            # seeing a raw socket exception. Resend ONLY when nothing
+            # can have reached the server (connect refused, DNS) or the
+            # method is idempotent (GET/HEAD, and PUT/DELETE by HTTP
+            # semantics — terminate/firewall-update resend safely): a
+            # read timeout on a POST may mean the cloud already accepted
+            # the mutation (an instance launch billed twice is worse
+            # than one failed-over error).
+            reason = getattr(e, 'reason', e)
+            resend_safe = (
+                method.upper() in ('GET', 'HEAD', 'PUT', 'DELETE')
+                or isinstance(reason, (ConnectionRefusedError,
+                                       socket.gaierror))
+                or isinstance(e, ConnectionRefusedError))
+            if resend_safe and attempt < max_attempts - 1:
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 60)
+                continue
+            raise exceptions.CloudError(
+                f'{method} {url} transport failure '
+                f'(attempt {attempt + 1}/{max_attempts}): {e}') from e
     raise parse_error(429, b'rate limited after retries')

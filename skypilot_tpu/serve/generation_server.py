@@ -30,7 +30,8 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
-from skypilot_tpu.models.decode import DecodeEngine, prefill_bucket
+from skypilot_tpu.models.decode import (DecodeEngine, chunk_spans,
+                                        prefill_bucket)
 from skypilot_tpu.models.llama import PRESETS, LlamaConfig, LlamaModel
 
 
@@ -53,7 +54,8 @@ class ByteTokenizer:
 class _Request:
     __slots__ = ('tokens', 'max_tokens', 'temperature', 'top_k', 'eos_id',
                  'out_queue', 'submitted_at', 'first_token_at', 'done',
-                 'error', 'prompt_len', 'emitted')
+                 'error', 'prompt_len', 'emitted', 'admit_started_at',
+                 'prefill_settled')
 
     def __init__(self, tokens, max_tokens, temperature, top_k, eos_id):
         self.tokens = tokens
@@ -68,6 +70,11 @@ class _Request:
         self.error: Optional[str] = None
         self.prompt_len = 0
         self.emitted = 0  # tokens delivered to the client (emitter-owned)
+        self.admit_started_at: Optional[float] = None  # first prefill
+        # dispatch for this request (scheduler-owned; feeds the
+        # effective-prefill-rate estimator behind admission control)
+        self.prefill_settled = False  # inflight-prefill accounting done
+        # (set once at first-token emission or terminal failure)
 
     def fail(self, msg: str) -> None:
         self.error = msg
@@ -118,9 +125,25 @@ class GenerationScheduler:
 
     def __init__(self, config: LlamaConfig, params: Any,
                  batch_slots: int = 8, max_len: Optional[int] = None,
-                 model: Any = None):
+                 model: Any = None,
+                 prefill_chunk: Optional[int] = None,
+                 prefill_budget: Optional[int] = None,
+                 ttft_slo_ms: Optional[float] = None):
         """``model`` serves a non-Llama family through the same engine
-        (e.g. a MixtralModel for MoE decode via its _mlp_delta)."""
+        (e.g. a MixtralModel for MoE decode via its _mlp_delta).
+
+        ``prefill_chunk`` ($SKYTPU_PREFILL_CHUNK, default 0 = monolithic):
+        split each prompt's prefill into fixed-size chunks so decode steps
+        interleave with prefill instead of stalling for the whole prompt
+        (the Sarathi-Serve insight on top of Orca-style continuous
+        batching). ``prefill_budget`` ($SKYTPU_PREFILL_BUDGET, default
+        2 x chunk) caps prefill tokens dispatched per scheduling round.
+        ``ttft_slo_ms`` ($SKYTPU_TTFT_SLO_MS, default 0 = never reject):
+        early-reject (HTTP 429 + Retry-After) requests whose estimated
+        queue wait would blow the TTFT SLO, so an overloaded replica
+        sheds load instead of queueing blind. Chunked mode supersedes
+        $SKYTPU_ADMIT_BATCH fusion (chunks already bound the stall).
+        """
         import jax
         self.config = config
         self.params = params
@@ -128,6 +151,42 @@ class GenerationScheduler:
                                    max_len=max_len, model=model)
         self.state = self.engine.init_state()
         self._rng = jax.random.key(0)
+        self.prefill_chunk = int(
+            prefill_chunk if prefill_chunk is not None
+            else os.environ.get('SKYTPU_PREFILL_CHUNK', '0') or 0)
+        self.prefill_budget = int(
+            prefill_budget if prefill_budget is not None
+            else os.environ.get('SKYTPU_PREFILL_BUDGET', '0') or 0)
+        self.ttft_slo_ms = float(
+            ttft_slo_ms if ttft_slo_ms is not None
+            else os.environ.get('SKYTPU_TTFT_SLO_MS', '0') or 0)
+        # Effective prefill throughput (tokens/s) EMA, measured by the
+        # emitter from admit-start -> first-token-emitted per request, so
+        # it reflects the real interleaved rate under load. None until
+        # the first measurement unless seeded ($SKYTPU_PREFILL_TOKENS_
+        # PER_S) — without evidence, admission control never rejects.
+        self._prefill_rate: Optional[float] = float(
+            os.environ.get('SKYTPU_PREFILL_TOKENS_PER_S', '0') or 0) or None
+        # Full-weight EMA reference length (~ the anchor prompt when
+        # chunked): shorter prompts update the rate proportionally less.
+        self._rate_ref_len = (8 * self.prefill_chunk
+                              if self.prefill_chunk > 0 else 256)
+        # Slot-turnover EMA (seconds between slot releases, scheduler-
+        # owned): at concurrency above the slot count TTFT is dominated
+        # by waiting for a slot, not by prefill, and a prefill-token-
+        # only estimate would admit everything through that overload.
+        self._last_release_t: Optional[float] = None
+        self._release_interval: Optional[float] = None
+        # Prompt tokens sitting in _pending (admission estimator input);
+        # submit() adds, the admit loop subtracts — both under the lock.
+        self._backlog_lock = threading.Lock()
+        self._backlog_tokens = 0
+        # Tokens still to dispatch for slots mid-chunked-prefill
+        # (scheduler-owned writes, estimator reads).
+        self._inflight_prefill_tokens = 0
+        # slot -> {'req', 'prompt', 'spans', 'next'} for prompts whose
+        # chunked prefill is in progress; dict order = FCFS start order.
+        self._chunking: Dict[int, Dict[str, Any]] = {}
         self._pending: 'queue.Queue[_Request]' = queue.Queue()
         self._slots: List[Optional[_Request]] = [None] * batch_slots
         # Decode steps dispatched since each slot's insert (scheduler-owned;
@@ -149,7 +208,7 @@ class GenerationScheduler:
         self._wake = threading.Event()
         self._stop = threading.Event()
         self.warm = threading.Event()
-        self.counters = {'requests': 0, 'tokens_out': 0}
+        self.counters = {'requests': 0, 'tokens_out': 0, 'rejected': 0}
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name='generation-scheduler')
         self._emit_thread = threading.Thread(target=self._emit_loop,
@@ -169,20 +228,106 @@ class GenerationScheduler:
         self._wake.set()
         self._emit_event.set()
 
-    def submit(self, req: _Request) -> None:
+    def _prefill_cost(self, n_tokens: int) -> int:
+        """Prefill work a prompt actually costs: prompts are truncated
+        to max_len - 1 at admission, so the admission estimator must
+        count the clamped length — otherwise one absurdly long prompt
+        inflates the backlog by tokens that will never be prefilled and
+        mass-429s the replica."""
+        return min(n_tokens, self.engine.max_len - 1)
+
+    def submit(self, req: _Request, reserved: bool = False) -> None:
+        """``reserved``: the caller already accounted this request's
+        prefill cost via a successful admission_check (which reserves
+        atomically with its estimate); direct submitters leave it False
+        and the cost is added here."""
         self.counters['requests'] += 1
+        if not reserved:
+            with self._backlog_lock:
+                self._backlog_tokens += self._prefill_cost(
+                    len(req.tokens))
         self._pending.put(req)
         self._wake.set()
 
+    def admission_check(self, prompt_len: int) -> Optional[Dict[str, Any]]:
+        """SLO-gated early reject: estimate this request's TTFT (queue
+        wait ahead of it + its own prefill) over the measured effective
+        prefill rate; past the SLO, refuse NOW (the caller answers HTTP
+        429 with Retry-After) instead of queueing into a blown deadline.
+        Returns None to admit — RESERVING the request's prefill cost in
+        the backlog atomically with the estimate, so the caller must
+        follow with ``submit(req, reserved=True)`` — or the rejection
+        detail (nothing reserved).
+
+        Two admit-always guards keep the estimator honest: no rejection
+        before the rate has evidence (or a $SKYTPU_PREFILL_TOKENS_PER_S
+        seed) — a cold replica must not shed its first wave — and no
+        rejection with an EMPTY queue. The rate EMA is sampled under
+        whatever congestion existed at admit time, so after a burst
+        drains it can sit depressed; rejecting on it while idle would
+        livelock (nothing admits, so the EMA never re-learns). An idle
+        replica admits, re-measures, recovers."""
+        cost = self._prefill_cost(prompt_len)
+        rate = self._prefill_rate
+        with self._backlog_lock:
+            if self.ttft_slo_ms > 0 and rate and rate > 0:
+                queued = (self._backlog_tokens
+                          + self._inflight_prefill_tokens)
+                if queued > 0:
+                    # Queue wait bounded two ways — prefill-token drain
+                    # (long-prompt regime) and slot-turnover drain
+                    # (short-prompt/long-output regime, invisible to a
+                    # token-only estimate). MAX, not sum: both measure
+                    # the same wait from different binding resources,
+                    # and the effective prefill rate already folds in
+                    # interleaved decode, so summing would double-count
+                    # and shed load the replica could serve within SLO.
+                    wait_s = queued / rate
+                    ri = self._release_interval
+                    pending_ahead = self._pending.qsize()
+                    if ri and pending_ahead > 0:
+                        wait_s = max(wait_s, pending_ahead * ri)
+                    est_ttft_ms = (wait_s + cost / rate) * 1e3
+                    if est_ttft_ms > self.ttft_slo_ms:
+                        # Counter mutated under the lock: it is consumed
+                        # as a measurement (serve_rejected in BENCH).
+                        self.counters['rejected'] += 1
+                        return {
+                            'retry_after_s': max(1, int(wait_s + 0.999)),
+                            'est_ttft_ms': round(est_ttft_ms, 1),
+                            'ttft_slo_ms': self.ttft_slo_ms,
+                        }
+            # ADMIT: reserve this request's prefill cost NOW, inside the
+            # same lock hold as the estimate. Check-then-act without the
+            # reservation lets a simultaneous burst of handler threads
+            # all read the pre-burst backlog and sail past the SLO
+            # together — the exact mass-overload the gate exists for.
+            # The caller passes submit(req, reserved=True) so the cost
+            # is not added twice.
+            self._backlog_tokens += cost
+        return None
+
     def stats(self) -> Dict[str, Any]:
+        pending = self._pending.qsize()
+        active = sum(r is not None and not r.done for r in self._slots)
+        with self._backlog_lock:
+            prefill_tokens = (self._backlog_tokens
+                              + self._inflight_prefill_tokens)
+        rate = self._prefill_rate
         return {
             'slots_total': self.engine.batch_slots,
             # A slot whose request finished but whose release hasn't been
             # applied yet is not "active" to callers.
-            'slots_active': sum(r is not None and not r.done
-                                for r in self._slots),
-            'pending': self._pending.qsize(),
+            'slots_active': active,
+            'pending': pending,
             'emit_backlog': len(self._emit_q),
+            # Queue-depth signal for the load balancer's least_load
+            # policy: requests holding or waiting for replica capacity.
+            'queue_depth': pending + active + len(self._chunking),
+            'pending_prefill_tokens': prefill_tokens,
+            'prefill_chunk': self.prefill_chunk,
+            'ttft_slo_ms': self.ttft_slo_ms,
+            'prefill_tokens_per_s': round(rate, 1) if rate else None,
             **self.counters,
         }
 
@@ -198,14 +343,178 @@ class GenerationScheduler:
         """
         import jax.numpy as jnp
         eng = self.engine
-        toks = jnp.zeros((prefill_bucket(1, eng.max_len),), jnp.int32)
-        eng.prefill(self.params, toks, 1)
+        if self.prefill_chunk > 0:
+            # Chunked mode never runs monolithic prefill; compile the mid
+            # chunk plus EVERY final-chunk bucket variant (the pow2
+            # family up to the chunk size) against the live state. A
+            # variant left uncompiled here lands its multi-second XLA
+            # compile inside the first unlucky request's TTFT — the
+            # exact metric admission control guards — and poisons the
+            # prefill-rate EMA's first sample. The final variants
+            # activate slot 0 — release it before serving.
+            chunk = min(self.prefill_chunk, eng.max_len)
+            toks = jnp.zeros((chunk,), jnp.int32)
+            self.state = eng.prefill_chunk(self.params, self.state, toks,
+                                           0, 0)
+            # Enumerate by asking chunk_spans itself (every admissible
+            # prompt length): matches runtime by construction, including
+            # the cache-edge cap that produces non-pow2 final buckets
+            # when max_len is not a multiple of the chunk size.
+            final_buckets = sorted({
+                chunk_spans(length, chunk, eng.max_len)[-1][1]
+                for length in range(1, eng.max_len)})
+            for bucket in final_buckets:
+                self.state, _, self._rng = eng.prefill_chunk_final(
+                    self.params, self.state,
+                    jnp.zeros((bucket,), jnp.int32), 0, 0, 1, self._rng)
+                self.state = eng.release(self.state, 0)
+        else:
+            toks = jnp.zeros((prefill_bucket(1, eng.max_len),), jnp.int32)
+            eng.prefill(self.params, toks, 1)
         self.state, sampled, self._rng = eng.step(self.params, self.state,
                                                   self._rng)
         int(sampled[0])  # scalar fetch: the one reliable sync everywhere
         self.warm.set()
 
+    def _take_pending(self) -> _Request:
+        """Pop one queued request, keeping the admission estimator's
+        backlog in sync and stamping the prefill-rate probe's start."""
+        req = self._pending.get()
+        cost = self._prefill_cost(len(req.tokens))
+        with self._backlog_lock:
+            self._backlog_tokens = max(0, self._backlog_tokens - cost)
+            # A popped request's prefill is OUTSTANDING (dispatched or
+            # about to be) until its first token is emitted or it fails
+            # terminally — in BOTH admit modes. Moving the tokens from
+            # the backlog bucket to the inflight bucket (instead of
+            # dropping them) keeps the admission estimator seeing the
+            # device-queued prefill work; monolithic admits would
+            # otherwise vanish from the estimate the moment they pop.
+            self._inflight_prefill_tokens += cost
+        req.admit_started_at = time.perf_counter()
+        return req
+
+    def _note_release(self) -> None:
+        """Sample the slot-turnover interval (scheduler thread only).
+
+        Samples are taken ONLY while demand is waiting: with no pending
+        request, the interval measures idleness, not turnover capacity —
+        one 10-minute lull folded into the EMA would make admission
+        control mass-429 the next burst on an idle-capacity replica.
+        The anchor timestamp also resets across idle periods so the
+        first busy-period release never spans the gap."""
+        now = time.perf_counter()
+        if self._pending.empty():
+            self._last_release_t = None
+            return
+        if self._last_release_t is not None:
+            dt = now - self._last_release_t
+            ri = self._release_interval
+            self._release_interval = (dt if ri is None
+                                      else 0.7 * ri + 0.3 * dt)
+        self._last_release_t = now
+
+    def _settle_prefill(self, req: _Request) -> None:
+        """Retire a request's prefill from the inflight accounting —
+        exactly once, at first-token emission or terminal failure. The
+        once-guard lives INSIDE the lock: the emitter (first token) and
+        the scheduler (failure paths) can race here, and a double
+        subtract would leave the admission estimator under-counting."""
+        cost = self._prefill_cost(len(req.tokens))
+        with self._backlog_lock:
+            if req.admit_started_at is None or req.prefill_settled:
+                return
+            req.prefill_settled = True
+            self._inflight_prefill_tokens = max(
+                0, self._inflight_prefill_tokens - cost)
+
     def _admit(self) -> None:
+        if self.prefill_chunk > 0:
+            self._admit_chunked()
+        else:
+            self._admit_monolithic()
+
+    def _admit_chunked(self) -> None:
+        """Dispatch up to a token budget of prefill CHUNKS, oldest prompt
+        first, then return so the tick's decode step runs. A monolithic
+        2500-token prefill stalls every occupied decode slot for the whole
+        prompt; chunking bounds each stall to one chunk and the budget
+        bounds the per-round total, which is what keeps TPOT (and through
+        slot turnover, TTFT) p99 flat past the saturation knee.
+
+        In-progress prompts advance before new ones start (FCFS): a
+        started prefill finishing late helps nobody, and interleaving
+        starts would multiply every prompt's TTFT. A slot mid-prefill
+        holds KV rows but stays device-inactive and OUT of ``_slots``
+        until its final chunk commits it, so step snapshots never route
+        its garbage tokens.
+        """
+        budget = self.prefill_budget or 2 * self.prefill_chunk
+        spent = 0
+        for slot in list(self._chunking):
+            if spent >= budget:
+                return
+            spent = self._advance_chunks(slot, spent, budget)
+        while spent < budget and not self._pending.empty():
+            free = [i for i, r in enumerate(self._slots)
+                    if r is None and i not in self._chunking]
+            if not free:
+                return
+            req = self._take_pending()
+            prompt = req.tokens[:self.engine.max_len - 1]
+            req.prompt_len = len(prompt)
+            slot = free[0]
+            spans = chunk_spans(len(prompt), self.prefill_chunk,
+                                self.engine.max_len)
+            self._chunking[slot] = {'req': req, 'prompt': prompt,
+                                    'spans': spans, 'next': 0}
+            spent = self._advance_chunks(slot, spent, budget)
+
+    def _advance_chunks(self, slot: int, spent: int, budget: int) -> int:
+        """Dispatch chunks for ``slot``'s prompt until its prefill
+        completes or the round budget is exhausted. The first chunk of a
+        round always dispatches (spent == 0) even if it alone exceeds the
+        budget, so every round makes progress."""
+        import jax.numpy as jnp
+        eng = self.engine
+        prog = self._chunking[slot]
+        req, prompt, spans = prog['req'], prog['prompt'], prog['spans']
+        while prog['next'] < len(spans):
+            off, bucket, final = spans[prog['next']]
+            if spent and spent + bucket > budget:
+                return spent
+            piece = prompt[off:off + bucket]
+            padded = jnp.asarray(piece + [0] * (bucket - len(piece)),
+                                 jnp.int32)
+            try:
+                if final:
+                    self.state, first, self._rng = eng.prefill_chunk_final(
+                        self.params, self.state, padded, off, slot,
+                        len(prompt), self._rng, req.temperature, req.top_k)
+                else:
+                    self.state = eng.prefill_chunk(
+                        self.params, self.state, padded, off, slot)
+            except Exception as e:  # noqa: BLE001 — fail THIS req
+                self._drop_chunking(slot)
+                req.fail(f'prefill failed: {e!r}')
+                return spent
+            spent += bucket
+            prog['next'] += 1
+            if final:
+                del self._chunking[slot]
+                self._slots[slot] = req
+                self._dispatched[slot] = 0
+                self._queue_emission(('first', first, req, slot))
+        return spent
+
+    def _drop_chunking(self, slot: int) -> None:
+        """Abandon a mid-prefill slot (its partial KV rows are dead: the
+        slot is still device-inactive and any reuse overwrites them)."""
+        prog = self._chunking.pop(slot, None)
+        if prog is not None:
+            self._settle_prefill(prog['req'])
+
+    def _admit_monolithic(self) -> None:
         """Prefill + insert pending requests into free slots.
 
         No host sync: the first generated token (sampled from the prefill
@@ -228,7 +537,7 @@ class GenerationScheduler:
             reqs: List[_Request] = []
             while (len(reqs) < min(len(free), max(self.ADMIT_BATCH_MAX, 1))
                    and not self._pending.empty()):
-                reqs.append(self._pending.get())
+                reqs.append(self._take_pending())
             group: List[tuple] = []  # (req, prompt) — same bucket
             solo: List[tuple] = []   # (req, prompt, bucket)
             group_bucket = None
@@ -249,6 +558,7 @@ class GenerationScheduler:
                         self._queue_emission(('first', first_tok, req,
                                               None))
                     except Exception as e:  # noqa: BLE001
+                        self._settle_prefill(req)
                         req.fail(f'prefill failed: {e!r}')
                     continue
                 if group_bucket is None or bucket == group_bucket:
@@ -284,6 +594,7 @@ class GenerationScheduler:
                          list(slots)))
                 except Exception as e:  # noqa: BLE001 — fail the group
                     for req, _ in group:
+                        self._settle_prefill(req)
                         req.fail(f'prefill failed: {e!r}')
             else:
                 solo = [(r, p, group_bucket) for r, p in group] + solo
@@ -295,6 +606,7 @@ class GenerationScheduler:
                         self.params, self.state, padded, len(prompt),
                         slot, self._rng, req.temperature, req.top_k)
                 except Exception as e:  # noqa: BLE001 — fail THIS req
+                    self._settle_prefill(req)
                     req.fail(f'prefill failed: {e!r}')
                     continue
                 self._slots[slot] = req
@@ -318,6 +630,7 @@ class GenerationScheduler:
             if self._slots[slot] is req and req is not None:
                 self.state = self.engine.release(self.state, slot)
                 self._slots[slot] = None
+                self._note_release()
 
     def _loop(self) -> None:
         if getattr(self, '_do_warmup', False):
@@ -348,12 +661,19 @@ class GenerationScheduler:
                             else [r for r in item[2] if r is not None])
                     for req in reqs:
                         if not req.done:
+                            self._settle_prefill(req)
                             req.fail(err)
                 for slot, req in enumerate(self._slots):
                     if req is not None:
                         if not req.done:
+                            self._settle_prefill(req)
                             req.fail(err)
                         self._slots[slot] = None
+                for slot in list(self._chunking):
+                    prog = self._chunking[slot]
+                    if not prog['req'].done:
+                        prog['req'].fail(err)
+                    self._drop_chunking(slot)
                 while not self._releases.empty():
                     try:
                         self._releases.get_nowait()
@@ -372,6 +692,8 @@ class GenerationScheduler:
             and 1 + self._dispatched[s] < r.max_tokens
             for s, r in enumerate(self._slots))
         if not needs_step:
+            if self._chunking:
+                return  # chunked prefills in flight: keep ticking
             self._wake.wait(timeout=0.05)
             self._wake.clear()
             return
@@ -418,6 +740,7 @@ class GenerationScheduler:
                     and 1 + self._dispatched[s] >= r.max_tokens):
                 self.state = self.engine.release(self.state, s)
                 self._slots[s] = None
+                self._note_release()
 
     # -- emitter ------------------------------------------------------------
     def _emit_loop(self) -> None:
@@ -451,6 +774,7 @@ class GenerationScheduler:
                             if req is not None)
                 for req, slot in failed:
                     if not req.done:
+                        self._settle_prefill(req)
                         req.fail('emission failed')
                         if slot is not None:
                             self._releases.put((slot, req))
@@ -496,6 +820,28 @@ class GenerationScheduler:
                     now: float) -> None:
         if req.first_token_at is None:
             req.first_token_at = now
+            self._settle_prefill(req)
+            if req.admit_started_at is not None and req.prompt_len:
+                # Effective prefill rate sample: prompt tokens over
+                # admit-start -> first-token wall time. Includes the
+                # decode steps interleaved into the prefill, so under
+                # load it converges on the rate that actually drains the
+                # queue — exactly what the admission estimator needs.
+                # LENGTH-WEIGHTED: a short prompt's duration is mostly
+                # fixed overhead (tick scheduling, emitter batch lag),
+                # not per-token throughput — at full weight a stream of
+                # tiny prompts would drag the rate far below reality and
+                # mass-429 the long prompts the gate actually protects.
+                dur = max(now - req.admit_started_at, 1e-6)
+                sample = req.prompt_len / dur
+                rate = self._prefill_rate
+                if rate is None:
+                    self._prefill_rate = sample
+                else:
+                    alpha = 0.3 * min(
+                        1.0, req.prompt_len / self._rate_ref_len)
+                    self._prefill_rate = ((1 - alpha) * rate
+                                          + alpha * sample)
         req.out_queue.put(tok)
         req.emitted += 1
         self.counters['tokens_out'] += 1
@@ -583,15 +929,38 @@ class GenerationServer:
         top_k = int(body.get('top_k', 0))
         if top_k < 0:
             raise ValueError('top_k must be >= 0')
+        # Parse EVERYTHING before admission_check: a successful check
+        # reserves backlog tokens, and a parse error after it would
+        # leak the reservation (phantom backlog -> spurious 429s).
+        max_tokens = max(1, int(body.get('max_tokens', 64)))
+        eos_id = body.get('eos_id', ByteTokenizer.EOS if is_text else None)
+        reject = self.scheduler.admission_check(len(tokens))
+        if reject is not None:
+            # Early reject: the queue-wait estimate already blows the
+            # TTFT SLO, so refuse before taking any engine work. 429 +
+            # Retry-After is the LB's signal to shed to another replica.
+            payload = json.dumps({
+                'error': 'replica overloaded: estimated TTFT '
+                         f"{reject['est_ttft_ms']:.0f}ms exceeds SLO "
+                         f"{reject['ttft_slo_ms']:.0f}ms",
+                **reject,
+            }).encode()
+            handler.send_response(429)
+            handler.send_header('Content-Type', 'application/json')
+            handler.send_header('Retry-After',
+                                str(reject['retry_after_s']))
+            handler.send_header('Content-Length', str(len(payload)))
+            handler.end_headers()
+            handler.wfile.write(payload)
+            return
         req = _Request(
             tokens=tokens,
-            max_tokens=max(1, int(body.get('max_tokens', 64))),
+            max_tokens=max_tokens,
             temperature=temperature,
             top_k=min(top_k, vocab),
-            eos_id=body.get('eos_id',
-                            ByteTokenizer.EOS if is_text else None),
+            eos_id=eos_id,
         )
-        self.scheduler.submit(req)
+        self.scheduler.submit(req, reserved=True)
 
         if body.get('stream'):
             handler.send_response(200)

@@ -73,8 +73,48 @@ class LoadBalancer:
                 self.policy.set_replicas(data.get('ready_urls', []))
             except (urllib.error.URLError, OSError, ValueError):
                 pass  # controller briefly unavailable; keep last list
+            # Autoscaler load report BEFORE stats polling: a wedged
+            # replica's poll timeout must not delay the request-rate
+            # signal the controller scales on.
             self._report_load()
+            self._poll_replica_stats()
             time.sleep(_sync_interval())
+
+    def _poll_replica_stats(self) -> None:
+        """Feed each replica's reported queue depth to the policy, so
+        least_load steers traffic away from replicas whose admission
+        queue is deep (the depth the generation server surfaces in
+        /stats as ``queue_depth``) before they start 429-ing. The
+        sub-second timeout bounds the sequential sweep: the depth is an
+        advisory routing signal, and one wedged replica must not stall
+        the sync loop for seconds per cycle. Policies that don't
+        override update_replica_load (e.g. round_robin) skip the sweep
+        entirely — N HTTP GETs feeding a no-op would only delay the
+        replica-list refresh."""
+        cls = type(self.policy)
+        if (cls.update_replica_load
+                is policies_lib.LoadBalancingPolicy.update_replica_load):
+            return
+        for url in self.policy.urls:
+            try:
+                with urllib.request.urlopen(url.rstrip('/') + '/stats',
+                                            timeout=0.8) as resp:
+                    stats = json.loads(resp.read())
+            except (urllib.error.URLError, OSError, ValueError):
+                continue  # replica busy/restarting: keep last signal
+            if not isinstance(stats, dict):
+                # Arbitrary user replicas may answer ANY GET with 200 +
+                # non-object JSON; an AttributeError here would kill the
+                # whole sync thread (replica list + autoscaler reports).
+                continue
+            depth = stats.get('queue_depth')
+            if depth is None:  # replicas that predate the signal
+                depth = (stats.get('pending', 0)
+                         + stats.get('slots_active', 0))
+            try:
+                self.policy.update_replica_load(url, float(depth))
+            except (TypeError, ValueError):
+                continue
 
     def _report_load(self) -> None:
         with self._ts_lock:
@@ -113,6 +153,8 @@ class LoadBalancer:
                 headers = {k: v for k, v in self.headers.items()
                            if k.lower() not in _HOP_HEADERS}
                 last_err = None
+                last_429 = None
+                maybe_delivered = False
                 refused: set = set()
                 for _ in range(3):
                     url = lb.policy.select(exclude=refused)
@@ -126,7 +168,21 @@ class LoadBalancer:
                     try:
                         resp = urllib.request.urlopen(req, timeout=600)
                     except urllib.error.HTTPError as e:
-                        # The replica answered: forward its error verbatim,
+                        lb.policy.on_request_end(url)
+                        if e.code == 429:
+                            # Admission early-reject: by contract nothing
+                            # was admitted, so shedding to another
+                            # replica is safe even for non-idempotent
+                            # requests. Keep the freshest rejection to
+                            # forward if EVERY replica is overloaded.
+                            try:
+                                last_429 = (e.read(),
+                                            e.headers.get('Retry-After'))
+                            except OSError:
+                                last_429 = (b'', None)
+                            refused.add(url)
+                            continue
+                        # Any other replica answer: forward it verbatim,
                         # no retry (it may be non-idempotent app logic).
                         try:
                             payload = e.read()
@@ -137,8 +193,6 @@ class LoadBalancer:
                             self.wfile.write(payload)
                         except OSError:
                             pass  # client went away mid-error-response
-                        finally:
-                            lb.policy.on_request_end(url)
                         return
                     except (urllib.error.URLError, OSError) as e:
                         lb.policy.on_request_end(url)
@@ -156,6 +210,7 @@ class LoadBalancer:
                             continue
                         # Anything else (read timeout, reset mid-response)
                         # may have reached the replica — do not resend.
+                        maybe_delivered = True
                         break
                     try:
                         with resp:
@@ -201,6 +256,23 @@ class LoadBalancer:
                         pass
                     finally:
                         lb.policy.on_request_end(url)
+                    return
+                if last_429 is not None and not maybe_delivered:
+                    # Every selectable replica early-rejected (and no
+                    # attempt may have been delivered): propagate the
+                    # backpressure (and its Retry-After hint) to the
+                    # client. A 429 says "safe to resend" — it must
+                    # never paper over an attempt that a replica may
+                    # already be processing; that case falls through to
+                    # the 502 below.
+                    payload, retry_after = last_429
+                    self.send_response(429)
+                    self.send_header('Content-Type', 'application/json')
+                    if retry_after:
+                        self.send_header('Retry-After', retry_after)
+                    self.send_header('Content-Length', str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
                     return
                 if last_err is not None:
                     payload = json.dumps(

@@ -57,6 +57,13 @@ class LoadBalancingPolicy:
     def on_request_end(self, url: str) -> None:
         pass
 
+    def update_replica_load(self, url: str, load: float) -> None:
+        """Replica-reported queue depth (from its /stats: pending +
+        active + mid-prefill requests). Fed by the LB's sync loop so a
+        policy can see load the LB didn't route itself — other LBs,
+        direct clients, or requests still draining a deep queue."""
+        pass
+
 
 @register('round_robin')
 class RoundRobinPolicy(LoadBalancingPolicy):
@@ -78,15 +85,27 @@ class RoundRobinPolicy(LoadBalancingPolicy):
 
 @register('least_load')
 class LeastLoadPolicy(LoadBalancingPolicy):
+    """Pick the replica with the least load: the max of the LB's own
+    in-flight count and the replica-reported queue depth (admission
+    queue + occupied slots, synced from /stats). The reported depth is
+    what routes traffic AWAY from a replica near its TTFT SLO —
+    in-flight alone is blind to the queue a replica built up from other
+    sources (direct clients, another LB). max, not sum: the replica's
+    report already includes this LB's own requests once they land, so
+    summing would double-count them and misroute toward replicas loaded
+    from elsewhere; in-flight still dominates in the window before the
+    next stats sync sees our freshly routed requests."""
 
     def __init__(self):
         super().__init__()
         self._inflight: Dict[str, int] = {}
+        self._reported: Dict[str, float] = {}
 
     def set_replicas(self, urls: List[str]) -> None:
         with self._lock:
             self._urls = list(urls)
             self._inflight = {u: self._inflight.get(u, 0) for u in urls}
+            self._reported = {u: self._reported.get(u, 0.0) for u in urls}
 
     def select(self, exclude: Optional[Set[str]] = None) -> Optional[str]:
         with self._lock:
@@ -94,7 +113,14 @@ class LeastLoadPolicy(LoadBalancingPolicy):
                           if not exclude or u not in exclude]
             if not candidates:
                 return None
-            return min(candidates, key=lambda u: self._inflight.get(u, 0))
+            return min(candidates,
+                       key=lambda u: max(self._inflight.get(u, 0),
+                                         self._reported.get(u, 0.0)))
+
+    def update_replica_load(self, url: str, load: float) -> None:
+        with self._lock:
+            if url in self._inflight:
+                self._reported[url] = load
 
     def on_request_start(self, url: str) -> None:
         with self._lock:

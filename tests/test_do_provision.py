@@ -213,6 +213,24 @@ class TestOpenPorts:
         do_impl.terminate_instances('p1', 'nyc3')
         assert fake_do.firewalls == {}
 
+    def test_existing_icmp_rule_preserved_without_ports(self, fake_do):
+        """ICMP rules legitimately omit 'ports' (DO requires it only for
+        tcp/udp): a manually added ICMP rule must survive a port update
+        instead of KeyError-crashing the sort (ADVICE r5)."""
+        do_impl.run_instances('p3', 'nyc3', None, 1, _deploy_vars())
+        do_impl.open_ports('p3', 'nyc3', ['8080'])
+        fw = next(iter(fake_do.firewalls.values()))
+        fw['inbound_rules'].append(
+            {'protocol': 'icmp',
+             'sources': {'addresses': ['0.0.0.0/0']}})
+        do_impl.open_ports('p3', 'nyc3', ['9090'])  # must not raise
+        fw = next(iter(fake_do.firewalls.values()))
+        protos = {r['protocol'] for r in fw['inbound_rules']}
+        assert 'icmp' in protos
+        ports = {r.get('ports') for r in fw['inbound_rules']
+                 if r['protocol'] == 'tcp'}
+        assert ports == {'22', '8080', '9090'}
+
     def test_tightened_source_ranges_reapply(self, fake_do):
         from skypilot_tpu import config as config_lib
         do_impl.run_instances('p2', 'nyc3', None, 1, _deploy_vars())
@@ -310,3 +328,166 @@ class TestCloudClass:
         res = task.best_resources
         assert res.cloud == 'do'
         assert res.instance_type == 's-2vcpu-4gb'  # cheapest >=2 vcpus
+
+
+class TestRetryingRequestTransport:
+    """Shared rest_cloud transport hardening (ADVICE r5): transport-level
+    failures (URLError/timeout) must retry with backoff and surface as a
+    classified CloudError, not a raw socket exception that bypasses the
+    failover machinery."""
+
+    @staticmethod
+    def _no_sleep(monkeypatch):
+        from skypilot_tpu.provision import rest_cloud
+        monkeypatch.setattr(rest_cloud.time, 'sleep', lambda s: None)
+
+    def test_transient_transport_error_retries_then_succeeds(
+            self, monkeypatch):
+        import urllib.error
+        from skypilot_tpu.provision import rest_cloud
+        self._no_sleep(monkeypatch)
+        calls = []
+
+        class FakeResp:
+            headers = {'X': '1'}
+
+            def read(self):
+                return b'{"ok": true}'
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+        def fake_urlopen(req, timeout=None):
+            calls.append(req.full_url)
+            if len(calls) < 3:
+                raise urllib.error.URLError(
+                    ConnectionRefusedError('refused'))
+            return FakeResp()
+
+        monkeypatch.setattr(rest_cloud.urllib.request, 'urlopen',
+                            fake_urlopen)
+        out = rest_cloud.retrying_request(
+            'GET', 'http://fake.invalid/x', {}, None,
+            lambda code, body: exceptions.CloudError(f'api {code}'))
+        assert out == {'ok': True}
+        assert len(calls) == 3
+
+    def test_terminal_transport_error_wraps_cloud_error(self,
+                                                        monkeypatch):
+        import urllib.error
+        from skypilot_tpu.provision import rest_cloud
+        self._no_sleep(monkeypatch)
+
+        def fake_urlopen(req, timeout=None):
+            raise urllib.error.URLError(TimeoutError('timed out'))
+
+        monkeypatch.setattr(rest_cloud.urllib.request, 'urlopen',
+                            fake_urlopen)
+        with pytest.raises(exceptions.CloudError,
+                           match='transport failure'):
+            rest_cloud.retrying_request(
+                'GET', 'http://fake.invalid/x', {}, None,
+                lambda code, body: exceptions.CloudError(f'api {code}'),
+                max_attempts=3)
+
+    def test_post_read_timeout_never_resends(self, monkeypatch):
+        """A read timeout on a POST may mean the cloud already accepted
+        the mutation — resending could double-launch instances. Only
+        connect-refused/DNS failures (nothing reached the server) or
+        idempotent methods retry."""
+        import urllib.error
+        from skypilot_tpu.provision import rest_cloud
+        self._no_sleep(monkeypatch)
+        calls = []
+
+        def fake_urlopen(req, timeout=None):
+            calls.append(1)
+            raise urllib.error.URLError(TimeoutError('read timed out'))
+
+        monkeypatch.setattr(rest_cloud.urllib.request, 'urlopen',
+                            fake_urlopen)
+        with pytest.raises(exceptions.CloudError,
+                           match='transport failure'):
+            rest_cloud.retrying_request(
+                'POST', 'http://fake.invalid/launch', {}, {'n': 1},
+                lambda code, body: exceptions.CloudError(f'api {code}'))
+        assert len(calls) == 1  # no resend of a possibly-applied POST
+
+    def test_post_connect_refused_resends(self, monkeypatch):
+        """Connect refused on a POST is safe to resend: the TCP connect
+        never completed, so the request cannot have been applied."""
+        import urllib.error
+        from skypilot_tpu.provision import rest_cloud
+        self._no_sleep(monkeypatch)
+        calls = []
+
+        class FakeResp:
+            headers = {}
+
+            def read(self):
+                return b'{"id": 7}'
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+        def fake_urlopen(req, timeout=None):
+            calls.append(1)
+            if len(calls) < 2:
+                raise urllib.error.URLError(
+                    ConnectionRefusedError('refused'))
+            return FakeResp()
+
+        monkeypatch.setattr(rest_cloud.urllib.request, 'urlopen',
+                            fake_urlopen)
+        out = rest_cloud.retrying_request(
+            'POST', 'http://fake.invalid/launch', {}, {'n': 1},
+            lambda code, body: exceptions.CloudError(f'api {code}'))
+        assert out == {'id': 7}
+        assert len(calls) == 2
+
+    def test_header_factory_invoked_per_attempt(self, monkeypatch):
+        """Callable headers are rebuilt on EVERY attempt (the OCI
+        re-sign contract), including across 429 backoff retries."""
+        import urllib.error
+        from skypilot_tpu.provision import rest_cloud
+        self._no_sleep(monkeypatch)
+        built = []
+        attempts = []
+
+        def header_factory():
+            built.append(1)
+            return {'date': f'attempt-{len(built)}'}
+
+        class FakeResp:
+            headers = {}
+
+            def read(self):
+                return b'{}'
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+        def fake_urlopen(req, timeout=None):
+            attempts.append(req.headers.get('Date'))
+            if len(attempts) < 3:
+                raise urllib.error.HTTPError(req.full_url, 429, 'slow',
+                                             {}, None)
+            return FakeResp()
+
+        monkeypatch.setattr(rest_cloud.urllib.request, 'urlopen',
+                            fake_urlopen)
+        out = rest_cloud.retrying_request(
+            'GET', 'http://fake.invalid/x', header_factory, None,
+            lambda code, body: exceptions.CloudError(f'api {code}'))
+        assert out == {}
+        assert len(built) == 3
+        assert attempts == ['attempt-1', 'attempt-2', 'attempt-3']

@@ -185,6 +185,45 @@ class TestSigner:
                            str(tmp_path / 'nope'))
         assert oci_api.read_config() is None
 
+    def test_request_resigns_headers_per_attempt(self, monkeypatch,
+                                                 tmp_path):
+        """_request hands the transport a header FACTORY, not a dict:
+        each retry attempt re-signs, so a 429 backoff (~135s of sleeps)
+        cannot drift the signed date header into OCI's clock-skew
+        rejection window (ADVICE r5)."""
+        pytest.importorskip('cryptography')
+        from cryptography.hazmat.primitives import serialization
+        from cryptography.hazmat.primitives.asymmetric import rsa
+        key = rsa.generate_private_key(public_exponent=65537,
+                                       key_size=2048)
+        key_path = tmp_path / 'k.pem'
+        key_path.write_bytes(key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption()))
+        cfg = {'user': 'ocid1.user.oc1..u', 'fingerprint': 'aa:bb',
+               'key_file': str(key_path),
+               'tenancy': 'ocid1.tenancy.oc1..t',
+               'region': 'us-ashburn-1'}
+        monkeypatch.setattr(oci_api, 'read_config', lambda: cfg)
+        captured = {}
+
+        def fake_retrying_request(method, url, headers, payload,
+                                  parse_error, **kwargs):
+            captured['headers'] = headers
+            return {}
+
+        monkeypatch.setattr(oci_api.rest_cloud, 'retrying_request',
+                            fake_retrying_request)
+        client = oci_api._RestClient()
+        client._request('GET', '/instances/?limit=1')
+        headers = captured['headers']
+        assert callable(headers)
+        # Every invocation yields a freshly signed header set.
+        h1, h2 = headers(), headers()
+        assert 'Authorization' in h1 and 'date' in h1
+        assert 'Authorization' in h2
+
 
 class TestLifecycle:
 

@@ -1,0 +1,75 @@
+#!/usr/bin/env python3
+"""Lint: every metric registered in the tree follows the naming
+convention ``skytpu_<subsystem>_<name>_<unit>``.
+
+Two enforcement layers share one rule (``utils.metrics.validate_name``):
+the registry raises at registration time (catches dynamic names), and
+this script statically scans every ``counter(``/``gauge(``/
+``histogram(`` call whose first argument is a string literal (catches
+names on code paths tests never execute). Run standalone::
+
+    python scripts/check_metric_names.py [root]
+
+or via the tier-1 test (tests/test_metrics.py). Exit 0 = clean,
+1 = violations (listed on stderr).
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+from skypilot_tpu.utils.metrics import validate_name  # noqa: E402
+
+# First string-literal argument of a metric constructor call. DOTALL so
+# calls wrapped onto the next line still match.
+_CALL_RE = re.compile(
+    r'\b(?:counter|gauge|histogram)\(\s*[\'"]([A-Za-z0-9_]+)[\'"]',
+    re.DOTALL)
+
+
+def scan_file(path: str) -> list:
+    """[(line_number, name, error)] for convention violations."""
+    with open(path, encoding='utf-8') as f:
+        src = f.read()
+    out = []
+    for m in _CALL_RE.finditer(src):
+        name = m.group(1)
+        err = validate_name(name)
+        if err:
+            line = src.count('\n', 0, m.start()) + 1
+            out.append((line, name, err))
+    return out
+
+
+def main(argv=None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    root = args[0] if args else os.path.join(_REPO_ROOT, 'skypilot_tpu')
+    violations = []
+    n_files = 0
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != '__pycache__']
+        for fn in filenames:
+            if not fn.endswith('.py'):
+                continue
+            path = os.path.join(dirpath, fn)
+            n_files += 1
+            for line, name, err in scan_file(path):
+                violations.append(
+                    f'{os.path.relpath(path, _REPO_ROOT)}:{line}: {err}')
+    if violations:
+        print('metric naming violations '
+              '(convention: skytpu_<subsystem>_<name>_<unit>):',
+              file=sys.stderr)
+        for v in violations:
+            print(f'  {v}', file=sys.stderr)
+        return 1
+    print(f'check_metric_names: {n_files} files clean')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

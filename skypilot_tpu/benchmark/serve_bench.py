@@ -27,6 +27,46 @@ import urllib.request
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 
+def summarize_metrics_text(text: str) -> Dict[str, Any]:
+    """Server-side histogram/counter summaries from a replica's /metrics
+    exposition — the production-signal counterpart of the client-side
+    sweep numbers (client TTFT includes LB + network; these are the
+    replica's own measurements, and the recompile counter is invisible
+    to clients entirely)."""
+    from skypilot_tpu.utils import metrics as metrics_lib
+    samples = metrics_lib.parse_text(text)
+    out: Dict[str, Any] = {}
+    for name in ('skytpu_serve_ttft_ms', 'skytpu_serve_tpot_ms',
+                 'skytpu_serve_queue_wait_ms',
+                 'skytpu_serve_ttft_estimate_error_ms',
+                 'skytpu_engine_step_ms'):
+        cum = metrics_lib.histogram_cumulative(samples, name)
+        count = metrics_lib.sample_value(samples, f'{name}_count')
+        total = metrics_lib.sample_value(samples, f'{name}_sum')
+        if not cum or not count:
+            continue
+        p50 = metrics_lib.histogram_quantile(cum, 0.5)
+        p99 = metrics_lib.histogram_quantile(cum, 0.99)
+        out[name] = {
+            'count': int(count),
+            'mean': round(total / count, 3) if total is not None else None,
+            'p50_est': round(p50, 2) if p50 is not None else None,
+            'p99_est': round(p99, 2) if p99 is not None else None,
+        }
+    for name in ('skytpu_serve_requests_total',
+                 'skytpu_serve_rejected_total',
+                 'skytpu_serve_slo_violations_total',
+                 'skytpu_engine_recompiles_total',
+                 'skytpu_engine_prefill_tokens_total',
+                 'skytpu_engine_decode_tokens_total',
+                 'skytpu_engine_occupancy_ratio',
+                 'skytpu_serve_slo_headroom_ms'):
+        v = metrics_lib.sample_value(samples, name)
+        if v is not None:
+            out[name] = round(v, 3)
+    return out
+
+
 def _percentile(values: Sequence[float], pct: float) -> float:
     """Nearest-rank percentile (no interpolation; robust for small N)."""
     ordered = sorted(values)
@@ -149,7 +189,7 @@ def _bench_service(*, task, service_name: str, vocab_size: int,
     ReplicaStatus = serve_state.ReplicaStatus
 
     out: Dict[str, Any] = {'sweep': [], 'warmup_failed': False,
-                           'stats': {}}
+                           'stats': {}, 'metrics': {}}
     result = serve_core.up(task, service_name)
     endpoint = result['endpoint']
     try:
@@ -240,6 +280,23 @@ def _bench_service(*, task, service_name: str, vocab_size: int,
             with urllib.request.urlopen(endpoint + '/stats',
                                         timeout=30) as resp:
                 out['stats'] = json.loads(resp.read())
+        except (urllib.error.URLError, OSError, ValueError):
+            pass
+        # Replica /metrics scraped DIRECTLY (the LB answers /metrics
+        # itself): server-side ttft/tpot/queue-wait histograms + the
+        # recompile counter land in the BENCH record next to the
+        # client-side sweep.
+        try:
+            replica_url = next(
+                (r['url'] for r in serve_state.list_replicas(service_name)
+                 if r['status'] == ReplicaStatus.READY and r['url']),
+                None)
+            if replica_url:
+                with urllib.request.urlopen(
+                        replica_url.rstrip('/') + '/metrics',
+                        timeout=30) as resp:
+                    out['metrics'] = summarize_metrics_text(
+                        resp.read().decode('utf-8', 'replace'))
         except (urllib.error.URLError, OSError, ValueError):
             pass
     finally:
@@ -351,6 +408,8 @@ def run(*, preset: str = 'llama-1b', batch_slots: int = 16,
     out['serve_sweep'] = sweep
     if main['warmup_failed']:
         out['serve_warmup_failed'] = True
+    if main.get('metrics'):
+        out['serve_replica_metrics_summary'] = main['metrics']
     if main['stats']:
         out['serve_rejected'] = main['stats'].get('rejected', 0)
         out['serve_replica_stats'] = {

@@ -19,6 +19,7 @@ Design (vs the correctness-oracle ``LlamaModel.decode_step``):
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -28,6 +29,60 @@ from jax import lax
 from skypilot_tpu.models.llama import LlamaConfig, LlamaModel, Params
 from skypilot_tpu.ops import attention as attention_ops
 from skypilot_tpu.ops.layers import precompute_rotary, rms_norm
+from skypilot_tpu.utils import metrics as metrics_lib
+
+
+class StepProfiler:
+    """Engine-side metrics: step timing, token mix, compile variants.
+
+    Registered on the process default registry so the generation
+    server's ``/metrics`` exposes engine series next to scheduler ones.
+    The engine holds ``profiler = None`` when metrics are disabled, so
+    every instrumentation site is a single ``is not None`` branch.
+
+    The recompile counter counts FIRST-SEEN jit variants host-side
+    (kind, shape) — prefill buckets, chunk_spans final-bucket variants,
+    the step itself. After warmup it equals the compiled-variant count;
+    any mid-traffic increase is a compile stall landing inside a
+    request's latency (the multi-second XLA pauses admission control
+    cannot see coming).
+    """
+
+    def __init__(self):
+        self.step_ms = metrics_lib.histogram(
+            'skytpu_engine_step_ms',
+            'decode step dispatch wall time',
+            buckets=(0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 250,
+                     1000, 10000, 60000))
+        self.steps = metrics_lib.counter(
+            'skytpu_engine_steps_total', 'decode steps dispatched')
+        self.recompiles = metrics_lib.counter(
+            'skytpu_engine_recompiles_total',
+            'first-seen jit variants (compile-cache misses)')
+        self.prefill_tokens = metrics_lib.counter(
+            'skytpu_engine_prefill_tokens_total',
+            'prefill tokens dispatched (padded buckets)')
+        self.decode_tokens = metrics_lib.counter(
+            'skytpu_engine_decode_tokens_total',
+            'decode tokens dispatched for active slots')
+        self.occupancy = metrics_lib.gauge(
+            'skytpu_engine_occupancy_ratio',
+            'active slots / batch slots at the last decode step')
+        self._seen_variants: set = set()
+
+    def note_variant(self, kind: str, *shape) -> None:
+        key = (kind, *shape)
+        if key not in self._seen_variants:
+            self._seen_variants.add(key)
+            self.recompiles.inc()
+
+    def note_step(self, wall_s: float) -> None:
+        self.steps.inc()
+        self.step_ms.observe(wall_s * 1e3)
+
+    def note_occupancy(self, active: int, total: int) -> None:
+        self.occupancy.set(active / total if total else 0.0)
+        self.decode_tokens.inc(active)
 
 
 @jax.tree_util.register_dataclass
@@ -85,6 +140,10 @@ class DecodeEngine:
         # the round-4 standalone decode bench. Callers passing scalars
         # must hit this cache; only genuinely per-slot arrays trace new.
         self._scalar_sampling_cache: dict = {}
+        # Step profiling (skytpu_engine_* series). None when metrics are
+        # disabled: every instrumentation site below is ONE branch.
+        self.profiler = (StepProfiler()
+                         if metrics_lib.enabled() else None)
 
     # -- state --------------------------------------------------------------
     def init_state(self) -> DecodeState:
@@ -112,6 +171,9 @@ class DecodeEngine:
         the FIRST generated token from ``last_logits`` (that token is the
         TTFT token) and feeds it to ``insert`` as ``last_token``.
         """
+        if self.profiler is not None:
+            self.profiler.note_variant('prefill', tokens.shape[0])
+            self.profiler.prefill_tokens.inc(tokens.shape[0])
         return self._prefill(params, tokens,
                              jnp.asarray(true_len, jnp.int32))
 
@@ -154,6 +216,9 @@ class DecodeEngine:
         so stale cache contents cannot leak in. The slot stays INACTIVE
         (lengths 0) until the final chunk commits it, so concurrent
         decode steps skip it."""
+        if self.profiler is not None:
+            self.profiler.note_variant('prefill_chunk', tokens.shape[0])
+            self.profiler.prefill_tokens.inc(tokens.shape[0])
         return self._prefill_chunk(state, params, tokens,
                                    jnp.asarray(offset, jnp.int32),
                                    jnp.asarray(slot, jnp.int32))
@@ -169,6 +234,10 @@ class DecodeEngine:
         prompt length; the chunk's padding past ``true_len - offset`` is
         benign (garbage rows are masked by the slot length, exactly like
         monolithic end-padding)."""
+        if self.profiler is not None:
+            self.profiler.note_variant('prefill_chunk_final',
+                                       tokens.shape[0])
+            self.profiler.prefill_tokens.inc(tokens.shape[0])
         return self._prefill_chunk_final(
             state, params, tokens, jnp.asarray(offset, jnp.int32),
             jnp.asarray(slot, jnp.int32),
@@ -293,6 +362,9 @@ class DecodeEngine:
         under serving load admission competes with decode steps for the
         chip, so admission overhead directly gates req/s.
         """
+        if self.profiler is not None:
+            self.profiler.note_variant('admit', tokens.shape[0])
+            self.profiler.prefill_tokens.inc(tokens.shape[0])
         return self._admit(state, params, tokens,
                            jnp.asarray(true_len, jnp.int32),
                            jnp.asarray(slot, jnp.int32), rng,
@@ -321,6 +393,10 @@ class DecodeEngine:
         N times. Compile variants are (N, bucket) pairs — the scheduler
         caps N (ADMIT_BATCH_MAX) and groups same-bucket prompts only.
         """
+        if self.profiler is not None:
+            self.profiler.note_variant('admit_many', tokens.shape)
+            self.profiler.prefill_tokens.inc(
+                tokens.shape[0] * tokens.shape[1])
         return self._admit_many(
             state, params, tokens,
             jnp.asarray(true_lens, jnp.int32),
@@ -430,7 +506,16 @@ class DecodeEngine:
             else:
                 top_k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32),
                                          (b,))
-        return self._step(params, state, rng, temperature, top_k)
+        if self.profiler is None:
+            return self._step(params, state, rng, temperature, top_k)
+        # Dispatch wall time, not device time: steps are pipelined (no
+        # host sync), so steady-state this tracks per-step cadence and a
+        # spike marks a compile or a backed-up dispatch queue.
+        self.profiler.note_variant('step', b)
+        t0 = time.perf_counter()
+        out = self._step(params, state, rng, temperature, top_k)
+        self.profiler.note_step(time.perf_counter() - t0)
+        return out
 
     def _scalar_sampling(self, value, dtype) -> jax.Array:
         """Device-resident [B] broadcast of a scalar sampling setting,

@@ -157,8 +157,13 @@ class _RestClient:
                 'tenancy/region).')
         self._cfg = cfg
         self._signer = _Signer(cfg)
-        self._base = (f'https://iaas.{region or cfg["region"]}'
+        self._region = region or cfg['region']
+        self._base = (f'https://iaas.{self._region}'
                       f'.oraclecloud.com/{API_VERSION}')
+        # Identity service (availability-domain listing) lives on its
+        # own per-region endpoint, same signing transport.
+        self._identity_base = (f'https://identity.{self._region}'
+                               f'.oraclecloud.com/{API_VERSION}')
 
     @property
     def tenancy(self) -> str:
@@ -166,8 +171,9 @@ class _RestClient:
 
     def _request(self, method: str, path: str,
                  payload: Optional[Dict[str, Any]] = None,
-                 return_headers: bool = False) -> Any:
-        url = f'{self._base}{path}'
+                 return_headers: bool = False,
+                 base: Optional[str] = None) -> Any:
+        url = f'{base or self._base}{path}'
         body = (json.dumps(payload).encode()
                 if payload is not None else None)
         # Header FACTORY, not a dict: each retry attempt re-signs, so a
@@ -277,6 +283,17 @@ class _RestClient:
 
     def get_subnet(self, subnet_id: str) -> Dict[str, Any]:
         return dict(self._request('GET', f'/subnets/{subnet_id}') or {})
+
+    def list_availability_domains(
+            self, compartment_id: str) -> List[Dict[str, Any]]:
+        """Identity API: the tenancy's REAL AD names for this region
+        (tenancy-prefixed, e.g. 'qIZq:US-ASHBURN-1-AD-2'). The catalog's
+        synthetic '{region}-AD-n' zones must be resolved through this
+        listing before launch — the Compute API rejects names that are
+        not exactly what identity returns."""
+        q = urllib.parse.urlencode({'compartmentId': compartment_id})
+        return list(self._request('GET', f'/availabilityDomains/?{q}',
+                                  base=self._identity_base) or [])
 
 
 # Test seam (``set_oci_factory(lambda: fake)``), client construction and

@@ -90,6 +90,48 @@ def _nsg_name(name_on_cloud: str) -> str:
     return f'skytpu-{name_on_cloud}-nsg'
 
 
+def _resolve_ad(client, compartment: str, region: str,
+                zone: Optional[str]) -> str:
+    """Map a catalog zone to a REAL availability-domain name.
+
+    Real OCI AD names are tenancy-prefixed ('qIZq:US-ASHBURN-1-AD-2');
+    the catalog's synthetic '{region}-AD-n' zones (and the old
+    '{region}-AD-1' fallback) are NOT valid launch arguments. Resolve
+    via the identity list-ADs call: a synthetic zone matches the AD
+    whose name ends with its 'AD-n' suffix; no zone picks the first AD.
+    A zone that is already tenancy-prefixed (contains ':') passes
+    through. A synthetic suffix with no matching AD (e.g. AD-3 in a
+    single-AD region) raises a capacity-class error so the provisioner
+    fails over to the next zone instead of sending a 404-bound launch.
+
+    Test fakes that don't implement the identity op keep the legacy
+    synthetic behavior (their launch_instance accepts any name).
+    """
+    if zone and ':' in zone:
+        return zone
+    if not hasattr(client, 'list_availability_domains'):
+        return zone or f'{region}-AD-1'
+    ads = oci_api.call(client, 'list_availability_domains',
+                       compartment_id=compartment)
+    names = [a.get('name') for a in ads if a.get('name')]
+    if not names:
+        raise exceptions.CloudError(
+            f'OCI identity returned no availability domains for '
+            f'region {region} (compartment {compartment})')
+    if zone is None:
+        return names[0]
+    # 'us-ashburn-1-AD-2' -> suffix 'AD-2'; exact-name zones also hit
+    # this path and match themselves case-insensitively.
+    z = zone.upper()
+    suffix = 'AD-' + z.rsplit('AD-', 1)[-1] if 'AD-' in z else z
+    for name in names:
+        if name.upper().endswith(suffix):
+            return name
+    raise exceptions.InsufficientCapacityError(
+        f'OCI availability domain for zone {zone!r} not found in '
+        f'region {region} (tenancy has: {names})')
+
+
 def _live_instances(client, compartment: str,
                     name: str) -> Dict[int, Dict[str, Any]]:
     """rank -> instance by freeform tags (compartment-scoped; tags are
@@ -147,8 +189,10 @@ def run_instances(cluster_name: str, region: str, zone: Optional[str],
         _, pub_path = authentication.get_or_generate_keys()
         with open(pub_path, encoding='utf-8') as f:
             pub_key = f.read().strip()
-        # zone is the availability domain (e.g. 'AD-1' suffix form).
-        ad = zone or f'{region}-AD-1'
+        # Resolve the catalog zone to the tenancy's real AD name via the
+        # identity listing; synthetic '{region}-AD-n' strings are not
+        # launchable on the real API.
+        ad = _resolve_ad(client, compartment, region, zone)
         existing = _live_instances(client, compartment, name)
         for rank, inst in existing.items():
             if inst.get('lifecycleState') == 'STOPPED':

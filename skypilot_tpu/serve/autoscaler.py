@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import math
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from skypilot_tpu.serve import service_spec as spec_lib
 
@@ -40,6 +40,17 @@ class RequestRateAutoscaler:
         self._upscale_counter = 0
         self._downscale_counter = 0
         self.target_num_replicas = self.policy.min_replicas
+        # Latest fleet-aggregated SLO signals (replica manager scrape:
+        # 429 counts, queue depth, pending prefill tokens). Stored here
+        # so the SLO-headroom scaling policy can consume them from
+        # evaluate() without new plumbing; the request-rate policy below
+        # does not read them yet.
+        self.fleet_signals: Dict[str, float] = {}
+
+    def observe_fleet(self, signals: Dict[str, float]) -> None:
+        """Adopt the controller's per-tick fleet metrics snapshot (keyed
+        by metric name, summed across replicas)."""
+        self.fleet_signals = dict(signals)
 
     def update_spec(self, spec: spec_lib.ServiceSpec) -> None:
         """Adopt a new replica policy (rolling update) without losing the

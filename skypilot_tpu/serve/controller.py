@@ -7,7 +7,9 @@ Counterpart of reference ``sky/serve/controller.py`` (:64 _run_autoscaler,
   reconcile the replica fleet, probe replicas, refresh the service status;
 - control HTTP endpoint (ThreadingHTTPServer on the recorded
   controller_port): GET /replicas for the LB's sync, POST /load for the
-  LB's request-rate reports, GET /status for CLI/SDK;
+  LB's request-rate reports, GET /status for CLI/SDK, GET /metrics for
+  the fleet-level Prometheus aggregate (controller gauges + replica
+  series scraped by the replica manager, summed across the fleet);
 - shutdown: ``serve down`` flips the service row to SHUTTING_DOWN in
   sqlite; the controller notices, terminates every replica cluster, removes
   the service, and exits.
@@ -29,6 +31,7 @@ from skypilot_tpu.serve import autoscaler as autoscaler_lib
 from skypilot_tpu.serve import replica_manager as rm_lib
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve import service_spec as spec_lib
+from skypilot_tpu.utils import metrics as metrics_lib
 
 ServiceStatus = serve_state.ServiceStatus
 ReplicaStatus = serve_state.ReplicaStatus
@@ -58,6 +61,13 @@ class _ControlHandler(BaseHTTPRequestHandler):
             self._json(200, {'ready_urls': c.manager.ready_urls()})
         elif self.path == '/status':
             self._json(200, c.status_payload())
+        elif self.path == '/metrics':
+            body = c.metrics_payload().encode()
+            self.send_response(200)
+            self.send_header('Content-Type', metrics_lib.CONTENT_TYPE)
+            self.send_header('Content-Length', str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         else:
             self._json(404, {'error': f'no route {self.path}'})
 
@@ -78,6 +88,24 @@ class _ControlHandler(BaseHTTPRequestHandler):
             self._json(404, {'error': f'no route {self.path}'})
 
 
+class _ControllerMetrics:
+    """Controller-plane gauges (fleet shape + observed load)."""
+
+    def __init__(self):
+        self.target_replicas = metrics_lib.gauge(
+            'skytpu_controller_target_replicas_count',
+            'autoscaler-adopted target replica count')
+        self.ready_replicas = metrics_lib.gauge(
+            'skytpu_controller_ready_replicas_count',
+            'replicas currently READY')
+        self.request_rate = metrics_lib.gauge(
+            'skytpu_controller_request_rate_rps',
+            'request rate observed over the autoscaler QPS window')
+        self.scraped_replicas = metrics_lib.gauge(
+            'skytpu_controller_scraped_replicas_count',
+            'replicas contributing to the fleet metrics aggregate')
+
+
 class ServeController:
 
     def __init__(self, service_name: str):
@@ -93,6 +121,8 @@ class ServeController:
             log=self._log, version=self.version)
         self.controller_port: int = 0  # assigned at bind time
         self._http: ThreadingHTTPServer = None
+        self._m = (_ControllerMetrics()
+                   if metrics_lib.enabled() else None)
 
     def _maybe_adopt_update(self, row) -> None:
         """`serve update` bumped the row's version: reload spec/task and
@@ -124,6 +154,23 @@ class ServeController:
                 for r in self.manager.replicas()
             ],
         }
+
+    def metrics_payload(self) -> str:
+        """Fleet /metrics: controller gauges (typed exposition) followed
+        by the summed replica aggregate (untyped lines — TYPE metadata
+        does not survive the scrape; Prometheus accepts untyped)."""
+        if self._m is not None:
+            replicas = self.manager.replicas()
+            self._m.target_replicas.set(
+                self.autoscaler.target_num_replicas)
+            self._m.ready_replicas.set(
+                sum(1 for r in replicas
+                    if r['status'] == ReplicaStatus.READY))
+            self._m.request_rate.set(self.autoscaler.observed_qps())
+            self._m.scraped_replicas.set(self.manager.num_scraped())
+        own = metrics_lib.REGISTRY.render()
+        fleet = metrics_lib.render_samples(self.manager.fleet_metrics())
+        return own + fleet
 
     def _serve_http(self) -> None:
         # Bind port 0 and record the kernel-assigned port: no TOCTOU window
@@ -173,6 +220,13 @@ class ServeController:
                 self.manager.reconcile(mixed.primary,
                                        mixed.ondemand_fallback)
                 self.manager.probe_all()
+                # Fleet observability: scrape replica /metrics and hand
+                # the SLO signal subset (429s, queue depth, pending
+                # prefill) to the autoscaler — evaluate() consumes them
+                # in the SLO-scaling follow-up.
+                self.manager.scrape_metrics()
+                self.autoscaler.observe_fleet(
+                    self.manager.fleet_signals())
                 self._refresh_service_status()
             except Exception as e:  # noqa: BLE001
                 # A transient failure (sqlite busy, cloud API hiccup) must

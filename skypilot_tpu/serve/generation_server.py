@@ -19,6 +19,15 @@ API (JSON over stdlib http.server, threaded):
     -> stream=true: newline-delimited JSON chunks {"token": id}
   GET /health     200 once the engine is warm (first compile done)
   GET /stats      slot occupancy / counters
+  GET /metrics    Prometheus text exposition (scheduler + engine series)
+
+Observability: requests carry an ``X-Skytpu-Request-Id`` (assigned by
+the LB, or minted here for direct callers); with ``SKYTPU_TIMELINE``
+set, correlated spans (queue wait, prefill chunks, TTFT, per-token
+emission) land in the trace ring buffer bound to that id, connecting to
+the LB's flow events in Perfetto. Metrics instrumentation is a single
+``self._m is not None`` branch per site and off entirely under
+``SKYTPU_METRICS=0``.
 """
 from __future__ import annotations
 
@@ -27,12 +36,17 @@ import os
 import queue
 import threading
 import time
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.models.decode import (DecodeEngine, chunk_spans,
                                         prefill_bucket)
 from skypilot_tpu.models.llama import PRESETS, LlamaConfig, LlamaModel
+from skypilot_tpu.utils import metrics as metrics_lib
+from skypilot_tpu.utils import timeline
+
+REQUEST_ID_HEADER = timeline.REQUEST_ID_HEADER
 
 
 class ByteTokenizer:
@@ -51,13 +65,62 @@ class ByteTokenizer:
         return bytes(t for t in tokens if t < 256).decode('utf-8', 'replace')
 
 
+class _SchedulerMetrics:
+    """Serve-plane series on the process default registry.
+
+    These are exactly the histograms the ROADMAP's SLO-driven
+    autoscaling item needs (TTFT estimate error, SLO headroom, 429
+    rate): the controller aggregates them fleet-wide and
+    ``autoscaler.observe_fleet`` stores them for ``evaluate()`` to
+    consume in the follow-up PR.
+    """
+
+    def __init__(self):
+        h = metrics_lib.histogram
+        self.requests = metrics_lib.counter(
+            'skytpu_serve_requests_total', 'requests submitted')
+        self.rejected = metrics_lib.counter(
+            'skytpu_serve_rejected_total',
+            'admission-control 429 early rejects')
+        self.tokens_out = metrics_lib.counter(
+            'skytpu_serve_tokens_out_total',
+            'tokens delivered to clients')
+        self.ttft_ms = h('skytpu_serve_ttft_ms',
+                         'submit to first-token wall time')
+        self.tpot_ms = h('skytpu_serve_tpot_ms',
+                         'mean inter-token time per finished request',
+                         buckets=(0.5, 1, 2.5, 5, 10, 25, 50, 100, 250,
+                                  1000, 10000))
+        self.queue_wait_ms = h('skytpu_serve_queue_wait_ms',
+                               'submit to admission-start wall time')
+        self.ttft_est_error_ms = h(
+            'skytpu_serve_ttft_estimate_error_ms',
+            'abs(admission TTFT estimate - measured TTFT)')
+        self.slo_headroom_ms = metrics_lib.gauge(
+            'skytpu_serve_slo_headroom_ms',
+            'ttft_slo_ms - last measured TTFT (negative = violation)')
+        self.slo_violations = metrics_lib.counter(
+            'skytpu_serve_slo_violations_total',
+            'admitted requests whose measured TTFT blew the SLO')
+        self.queue_depth = metrics_lib.gauge(
+            'skytpu_serve_queue_depth_requests',
+            'requests holding or waiting for replica capacity')
+        self.pending_prefill = metrics_lib.gauge(
+            'skytpu_serve_pending_prefill_tokens',
+            'prompt tokens queued or in-flight for prefill')
+        self.slots_active = metrics_lib.gauge(
+            'skytpu_serve_slots_active_count', 'occupied decode slots')
+
+
 class _Request:
     __slots__ = ('tokens', 'max_tokens', 'temperature', 'top_k', 'eos_id',
                  'out_queue', 'submitted_at', 'first_token_at', 'done',
                  'error', 'prompt_len', 'emitted', 'admit_started_at',
-                 'prefill_settled')
+                 'prefill_settled', 'request_id', 'est_ttft_ms',
+                 'last_token_at')
 
-    def __init__(self, tokens, max_tokens, temperature, top_k, eos_id):
+    def __init__(self, tokens, max_tokens, temperature, top_k, eos_id,
+                 request_id: Optional[str] = None):
         self.tokens = tokens
         self.max_tokens = max_tokens
         self.temperature = temperature
@@ -75,6 +138,9 @@ class _Request:
         # effective-prefill-rate estimator behind admission control)
         self.prefill_settled = False  # inflight-prefill accounting done
         # (set once at first-token emission or terminal failure)
+        self.request_id = request_id  # LB-assigned trace correlation id
+        self.est_ttft_ms: Optional[float] = None  # admission estimate
+        self.last_token_at: Optional[float] = None  # feeds TPOT metric
 
     def fail(self, msg: str) -> None:
         self.error = msg
@@ -209,6 +275,10 @@ class GenerationScheduler:
         self._stop = threading.Event()
         self.warm = threading.Event()
         self.counters = {'requests': 0, 'tokens_out': 0, 'rejected': 0}
+        # Prometheus-side mirrors of the ad-hoc counters plus the
+        # latency histograms. None when metrics are disabled: every
+        # instrumentation site below is a single branch.
+        self._m = _SchedulerMetrics() if metrics_lib.enabled() else None
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name='generation-scheduler')
         self._emit_thread = threading.Thread(target=self._emit_loop,
@@ -242,6 +312,8 @@ class GenerationScheduler:
         atomically with its estimate); direct submitters leave it False
         and the cost is added here."""
         self.counters['requests'] += 1
+        if self._m is not None:
+            self._m.requests.inc()
         if not reserved:
             with self._backlog_lock:
                 self._backlog_tokens += self._prefill_cost(
@@ -274,24 +346,14 @@ class GenerationScheduler:
                 queued = (self._backlog_tokens
                           + self._inflight_prefill_tokens)
                 if queued > 0:
-                    # Queue wait bounded two ways — prefill-token drain
-                    # (long-prompt regime) and slot-turnover drain
-                    # (short-prompt/long-output regime, invisible to a
-                    # token-only estimate). MAX, not sum: both measure
-                    # the same wait from different binding resources,
-                    # and the effective prefill rate already folds in
-                    # interleaved decode, so summing would double-count
-                    # and shed load the replica could serve within SLO.
-                    wait_s = queued / rate
-                    ri = self._release_interval
-                    pending_ahead = self._pending.qsize()
-                    if ri and pending_ahead > 0:
-                        wait_s = max(wait_s, pending_ahead * ri)
-                    est_ttft_ms = (wait_s + cost / rate) * 1e3
+                    wait_s, est_ttft_ms = self._ttft_estimate_locked(
+                        cost, rate, queued)
                     if est_ttft_ms > self.ttft_slo_ms:
                         # Counter mutated under the lock: it is consumed
                         # as a measurement (serve_rejected in BENCH).
                         self.counters['rejected'] += 1
+                        if self._m is not None:
+                            self._m.rejected.inc()
                         return {
                             'retry_after_s': max(1, int(wait_s + 0.999)),
                             'est_ttft_ms': round(est_ttft_ms, 1),
@@ -330,6 +392,57 @@ class GenerationScheduler:
             'prefill_tokens_per_s': round(rate, 1) if rate else None,
             **self.counters,
         }
+
+    def _ttft_estimate_locked(self, cost: int, rate: float,
+                              queued: int) -> tuple:
+        """(wait_s, est_ttft_ms) for a request whose own ``cost`` is NOT
+        in ``queued``. Caller holds _backlog_lock. THE estimator: the
+        admission gate and the estimate-error metric both use this, so
+        the error histogram grades exactly the model that rejects.
+
+        Queue wait bounded two ways — prefill-token drain (long-prompt
+        regime) and slot-turnover drain (short-prompt/long-output
+        regime, invisible to a token-only estimate). MAX, not sum: both
+        measure the same wait from different binding resources, and the
+        effective prefill rate already folds in interleaved decode, so
+        summing would double-count and shed load the replica could
+        serve within SLO."""
+        wait_s = queued / rate
+        ri = self._release_interval
+        pending_ahead = self._pending.qsize()
+        if ri and pending_ahead > 0:
+            wait_s = max(wait_s, pending_ahead * ri)
+        return wait_s, (wait_s + cost / rate) * 1e3
+
+    def estimate_ttft_ms(self, prompt_len: int) -> Optional[float]:
+        """TTFT estimate for a request whose prefill cost is ALREADY
+        reserved in the backlog (i.e. right after a successful
+        admission_check) — the gate's own model, re-evaluated with the
+        reservation backed out so the formula is identical. Attached to
+        the request and compared with the measured TTFT at first-token
+        time (skytpu_serve_ttft_estimate_error_ms, the estimator-quality
+        signal SLO autoscaling will consume). None without rate
+        evidence."""
+        rate = self._prefill_rate
+        if not rate or rate <= 0:
+            return None
+        cost = self._prefill_cost(prompt_len)
+        with self._backlog_lock:
+            queued = max(0, self._backlog_tokens
+                         + self._inflight_prefill_tokens - cost)
+            _, est_ms = self._ttft_estimate_locked(cost, rate, queued)
+        return est_ms
+
+    def observe_gauges(self) -> None:
+        """Refresh point-in-time gauges; called by the /metrics handler
+        so scrapes see current depth without a per-change update on the
+        hot path."""
+        if self._m is None:
+            return
+        s = self.stats()
+        self._m.queue_depth.set(s['queue_depth'])
+        self._m.pending_prefill.set(s['pending_prefill_tokens'])
+        self._m.slots_active.set(s['slots_active'])
 
     # -- internals ----------------------------------------------------------
     def _warmup(self) -> None:
@@ -392,6 +505,12 @@ class GenerationScheduler:
             # otherwise vanish from the estimate the moment they pop.
             self._inflight_prefill_tokens += cost
         req.admit_started_at = time.perf_counter()
+        wait_s = req.admit_started_at - req.submitted_at
+        if self._m is not None:
+            self._m.queue_wait_ms.observe(wait_s * 1e3)
+        if timeline.enabled():
+            timeline.complete('serve.queue_wait', wait_s,
+                              request_id=req.request_id)
         return req
 
     def _note_release(self) -> None:
@@ -486,6 +605,7 @@ class GenerationScheduler:
             piece = prompt[off:off + bucket]
             padded = jnp.asarray(piece + [0] * (bucket - len(piece)),
                                  jnp.int32)
+            chunk_t0 = time.perf_counter() if timeline.enabled() else None
             try:
                 if final:
                     self.state, first, self._rng = eng.prefill_chunk_final(
@@ -498,6 +618,14 @@ class GenerationScheduler:
                 self._drop_chunking(slot)
                 req.fail(f'prefill failed: {e!r}')
                 return spent
+            if chunk_t0 is not None:
+                # Dispatch time, not device time (chunks are async): the
+                # span still localizes which chunk a stall landed in.
+                timeline.complete(
+                    'serve.prefill_chunk',
+                    time.perf_counter() - chunk_t0,
+                    request_id=req.request_id, offset=off,
+                    bucket=bucket, final=final)
             spent += bucket
             prog['next'] += 1
             if final:
@@ -720,6 +848,11 @@ class GenerationScheduler:
         self.state, sampled, self._rng = self.engine.step(
             self.params, self.state, self._rng,
             temperature=self._temps_dev, top_k=self._topks_dev)
+        prof = self.engine.profiler
+        if prof is not None:
+            prof.note_occupancy(
+                sum(1 for r in self._slots if r is not None),
+                self.engine.batch_slots)
         for s, r in enumerate(self._slots):
             if r is not None:
                 self._dispatched[s] += 1
@@ -820,6 +953,29 @@ class GenerationScheduler:
                     now: float) -> None:
         if req.first_token_at is None:
             req.first_token_at = now
+            ttft_ms = (now - req.submitted_at) * 1e3
+            if self._m is not None:
+                self._m.ttft_ms.observe(ttft_ms)
+                if req.est_ttft_ms is not None:
+                    self._m.ttft_est_error_ms.observe(
+                        abs(req.est_ttft_ms - ttft_ms))
+                if self.ttft_slo_ms > 0:
+                    self._m.slo_headroom_ms.set(
+                        self.ttft_slo_ms - ttft_ms)
+                    if ttft_ms > self.ttft_slo_ms:
+                        self._m.slo_violations.inc()
+            if timeline.enabled():
+                # A thin slice to anchor the flow step: Perfetto only
+                # draws flow arrows for events inside duration slices.
+                wall = time.time()
+                timeline.complete('serve.first_token', 1e-4,
+                                  end_wall_s=wall,
+                                  request_id=req.request_id,
+                                  ttft_ms=round(ttft_ms, 2))
+                if req.request_id:
+                    timeline.flow_step('request', req.request_id,
+                                       ts_s=wall - 5e-5,
+                                       ttft_ms=round(ttft_ms, 2))
             self._settle_prefill(req)
             if req.admit_started_at is not None and req.prompt_len:
                 # Effective prefill rate sample: prompt tokens over
@@ -844,12 +1000,26 @@ class GenerationScheduler:
                                           + alpha * sample)
         req.out_queue.put(tok)
         req.emitted += 1
+        req.last_token_at = now
         self.counters['tokens_out'] += 1
+        if self._m is not None:
+            self._m.tokens_out.inc()
+        if timeline.enabled():
+            timeline.instant('serve.token', request_id=req.request_id,
+                             n=req.emitted)
         hit_eos = (req.eos_id is not None and tok == req.eos_id)
         # Cache rows used = prompt + decode steps taken (= emitted - 1).
         full = req.prompt_len + req.emitted - 1 >= self.engine.max_len - 1
         if hit_eos or req.emitted >= req.max_tokens or full:
             req.done = True
+            if (self._m is not None and req.emitted >= 2
+                    and req.first_token_at is not None):
+                # Emitter-side TPOT: decode wall time over decode
+                # tokens. Batch D2H fetches quantize per-token arrival,
+                # so the per-request MEAN is the honest grain.
+                self._m.tpot_ms.observe(
+                    (now - req.first_token_at) * 1e3
+                    / (req.emitted - 1))
             req.out_queue.put(None)  # sentinel: stream end
             if slot is not None:
                 self._releases.put((slot, req))
@@ -879,6 +1049,25 @@ class GenerationServer:
                         self._json(503, {'status': 'warming up'})
                 elif self.path == '/stats':
                     self._json(200, outer.scheduler.stats())
+                elif self.path == '/metrics':
+                    outer.scheduler.observe_gauges()
+                    data = metrics_lib.REGISTRY.render().encode()
+                    self.send_response(200)
+                    self.send_header('Content-Type',
+                                     metrics_lib.CONTENT_TYPE)
+                    self.send_header('Content-Length', str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                elif self.path == '/trace':
+                    # Flush the timeline ring buffer on demand: a serve
+                    # replica is terminated by the controller, so the
+                    # atexit dump never runs for it — this is how its
+                    # flow events actually reach Perfetto.
+                    if not timeline.enabled():
+                        self._json(404, {'error':
+                                         'SKYTPU_TIMELINE not set'})
+                    else:
+                        self._json(200, {'saved': timeline.save()})
                 else:
                     self._json(404, {'error': 'not found'})
 
@@ -934,8 +1123,17 @@ class GenerationServer:
         # leak the reservation (phantom backlog -> spurious 429s).
         max_tokens = max(1, int(body.get('max_tokens', 64)))
         eos_id = body.get('eos_id', ByteTokenizer.EOS if is_text else None)
+        # Trace correlation id: LB-assigned via header; minted here for
+        # direct callers so replica-side spans are always addressable.
+        request_id = (handler.headers.get(REQUEST_ID_HEADER)
+                      or uuid.uuid4().hex[:16])
         reject = self.scheduler.admission_check(len(tokens))
         if reject is not None:
+            if timeline.enabled():
+                timeline.instant('serve.admission_reject',
+                                 request_id=request_id,
+                                 est_ttft_ms=reject['est_ttft_ms'],
+                                 ttft_slo_ms=reject['ttft_slo_ms'])
             # Early reject: the queue-wait estimate already blows the
             # TTFT SLO, so refuse before taking any engine work. 429 +
             # Retry-After is the LB's signal to shed to another replica.
@@ -949,6 +1147,7 @@ class GenerationServer:
             handler.send_header('Content-Type', 'application/json')
             handler.send_header('Retry-After',
                                 str(reject['retry_after_s']))
+            handler.send_header(REQUEST_ID_HEADER, request_id)
             handler.send_header('Content-Length', str(len(payload)))
             handler.end_headers()
             handler.wfile.write(payload)
@@ -959,12 +1158,18 @@ class GenerationServer:
             temperature=temperature,
             top_k=min(top_k, vocab),
             eos_id=eos_id,
+            request_id=request_id,
         )
+        # Admission's own estimate of this request's TTFT (its prefill
+        # cost is already reserved): measured against reality at
+        # first-token time to grade the estimator.
+        req.est_ttft_ms = self.scheduler.estimate_ttft_ms(len(tokens))
         self.scheduler.submit(req, reserved=True)
 
         if body.get('stream'):
             handler.send_response(200)
             handler.send_header('Content-Type', 'application/x-ndjson')
+            handler.send_header(REQUEST_ID_HEADER, request_id)
             handler.send_header('Transfer-Encoding', 'chunked')
             handler.end_headers()
 
@@ -1005,6 +1210,7 @@ class GenerationServer:
         payload = json.dumps(result).encode()
         handler.send_response(500 if req.error else 200)
         handler.send_header('Content-Type', 'application/json')
+        handler.send_header(REQUEST_ID_HEADER, request_id)
         handler.send_header('Content-Length', str(len(payload)))
         handler.end_headers()
         handler.wfile.write(payload)

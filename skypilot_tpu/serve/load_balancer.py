@@ -9,7 +9,16 @@ Counterpart of reference ``sky/serve/load_balancer.py`` (SkyServeLoadBalancer
   the response back chunk-by-chunk (generation endpoints stream tokens —
   buffering would destroy TTFT);
 - reports request timestamps to the controller's POST /load for the
-  request-rate autoscaler.
+  request-rate autoscaler;
+- assigns every request an ``X-Skytpu-Request-Id`` (kept if the client
+  sent one) propagated to the replica and echoed in the response, so
+  LB-side and replica-side trace events correlate; with
+  ``SKYTPU_TIMELINE`` set the LB emits flow start/end events bound to
+  that id (the replica emits the intermediate steps);
+- ``GET /metrics`` answers the LB's OWN Prometheus series (requests,
+  responses by code, shed retries, proxy latency) — it is NOT proxied.
+  Replica engine metrics are scraped by the replica manager and
+  aggregated at the controller's /metrics.
 
 Entry: ``python -m skypilot_tpu.serve.load_balancer --service-name NAME``
 (spawned detached by serve.core.up).
@@ -23,11 +32,16 @@ import threading
 import time
 import urllib.error
 import urllib.request
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List
 
 from skypilot_tpu.serve import load_balancing_policies as policies_lib
 from skypilot_tpu.serve import serve_state
+from skypilot_tpu.utils import metrics as metrics_lib
+from skypilot_tpu.utils import timeline
+
+REQUEST_ID_HEADER = timeline.REQUEST_ID_HEADER
 
 _HOP_HEADERS = {'connection', 'keep-alive', 'proxy-authenticate',
                 'proxy-authorization', 'te', 'trailers',
@@ -36,6 +50,29 @@ _HOP_HEADERS = {'connection', 'keep-alive', 'proxy-authenticate',
 
 def _sync_interval() -> float:
     return float(os.environ.get('SKYTPU_SERVE_LB_SYNC', '5'))
+
+
+class _LbMetrics:
+    """LB-plane series (the LB is its own process, so the default
+    registry holds exactly these)."""
+
+    def __init__(self):
+        self.requests = metrics_lib.counter(
+            'skytpu_lb_requests_total', 'requests received')
+        self.sheds = metrics_lib.counter(
+            'skytpu_lb_sheds_total',
+            'requests re-routed after a replica 429 early-reject')
+        self.retries = metrics_lib.counter(
+            'skytpu_lb_retries_total',
+            'requests re-routed after a connection refusal')
+        self.proxy_ms = metrics_lib.histogram(
+            'skytpu_lb_proxy_ms',
+            'request receipt to response completion')
+
+    def response(self, code: int) -> None:
+        metrics_lib.counter('skytpu_lb_responses_total',
+                            'responses by status code',
+                            labels={'code': str(code)}).inc()
 
 
 class LoadBalancer:
@@ -61,6 +98,14 @@ class LoadBalancer:
         self.policy = policies_lib.make(policy_name)
         self._pending_timestamps: List[float] = []
         self._ts_lock = threading.Lock()
+        self._m = _LbMetrics() if metrics_lib.enabled() else None
+        # Path the LB answers with its OWN metrics instead of proxying.
+        # Services that expose their own /metrics (a user-deployed
+        # Prometheus-instrumented app) set $SKYTPU_LB_METRICS_PATH to
+        # relocate the LB's endpoint (or '' to disable interception
+        # entirely and proxy /metrics through to replicas).
+        self.metrics_path = os.environ.get('SKYTPU_LB_METRICS_PATH',
+                                           '/metrics')
 
     # -- controller sync ------------------------------------------------------
     def _sync_loop(self) -> None:
@@ -148,10 +193,39 @@ class LoadBalancer:
 
             def _proxy(self):
                 lb.record_request()
+                # Trace correlation id: minted here (kept if the client
+                # sent one), propagated to the replica via header and
+                # echoed back to the client on every response path.
+                rid = (self.headers.get(REQUEST_ID_HEADER)
+                       or uuid.uuid4().hex[:16])
+                t0 = time.perf_counter()
+                if lb._m is not None:
+                    lb._m.requests.inc()
+                if timeline.enabled():
+                    timeline.flow_start('request', rid, path=self.path)
+
+                def account(code: int) -> None:
+                    dur_s = time.perf_counter() - t0
+                    if lb._m is not None:
+                        lb._m.proxy_ms.observe(dur_s * 1e3)
+                        lb._m.response(code)
+                    if timeline.enabled():
+                        # The lb.proxy slice ENCLOSES this request's
+                        # flow events (the earlier flow_start and the
+                        # flow_end below): Perfetto only renders flow
+                        # arrows anchored inside duration slices.
+                        end = time.time()
+                        timeline.complete('lb.proxy', dur_s,
+                                          end_wall_s=end,
+                                          request_id=rid, status=code)
+                        timeline.flow_end('request', rid,
+                                          ts_s=end - 1e-6, status=code)
+
                 length = int(self.headers.get('Content-Length', 0))
                 body = self.rfile.read(length) if length else None
                 headers = {k: v for k, v in self.headers.items()
                            if k.lower() not in _HOP_HEADERS}
+                headers[REQUEST_ID_HEADER] = rid
                 last_err = None
                 last_429 = None
                 maybe_delivered = False
@@ -181,18 +255,22 @@ class LoadBalancer:
                             except OSError:
                                 last_429 = (b'', None)
                             refused.add(url)
+                            if lb._m is not None:
+                                lb._m.sheds.inc()
                             continue
                         # Any other replica answer: forward it verbatim,
                         # no retry (it may be non-idempotent app logic).
                         try:
                             payload = e.read()
                             self.send_response(e.code)
+                            self.send_header(REQUEST_ID_HEADER, rid)
                             self.send_header('Content-Length',
                                              str(len(payload)))
                             self.end_headers()
                             self.wfile.write(payload)
                         except OSError:
                             pass  # client went away mid-error-response
+                        account(e.code)
                         return
                     except (urllib.error.URLError, OSError) as e:
                         lb.policy.on_request_end(url)
@@ -207,18 +285,24 @@ class LoadBalancer:
                             # this URL on re-select so a single dead READY
                             # replica can't absorb all attempts.
                             refused.add(url)
+                            if lb._m is not None:
+                                lb._m.retries.inc()
                             continue
                         # Anything else (read timeout, reset mid-response)
                         # may have reached the replica — do not resend.
                         maybe_delivered = True
                         break
+                    upstream_status = resp.status
                     try:
                         with resp:
                             self.send_response(resp.status)
                             for k, v in resp.headers.items():
-                                if k.lower() not in _HOP_HEADERS:
+                                if (k.lower() not in _HOP_HEADERS
+                                        and k.lower()
+                                        != REQUEST_ID_HEADER.lower()):
                                     self.send_header(k, v)
                             self.send_header('X-Skytpu-Replica', url)
+                            self.send_header(REQUEST_ID_HEADER, rid)
                             chunked = (resp.headers.get('Content-Length')
                                        is None)
                             if chunked:
@@ -252,10 +336,12 @@ class LoadBalancer:
                     except (urllib.error.URLError, OSError):
                         # Mid-stream failure: headers already went out, so
                         # a retry or error response would corrupt the
-                        # stream — drop the connection.
-                        pass
+                        # stream — drop the connection. 499 in the
+                        # response-code metric marks the abort.
+                        upstream_status = 499
                     finally:
                         lb.policy.on_request_end(url)
+                    account(upstream_status)
                     return
                 if last_429 is not None and not maybe_delivered:
                     # Every selectable replica early-rejected (and no
@@ -270,9 +356,11 @@ class LoadBalancer:
                     self.send_header('Content-Type', 'application/json')
                     if retry_after:
                         self.send_header('Retry-After', retry_after)
+                    self.send_header(REQUEST_ID_HEADER, rid)
                     self.send_header('Content-Length', str(len(payload)))
                     self.end_headers()
                     self.wfile.write(payload)
+                    account(429)
                     return
                 if last_err is not None:
                     payload = json.dumps(
@@ -287,11 +375,28 @@ class LoadBalancer:
                     code = 503
                 self.send_response(code)
                 self.send_header('Content-Type', 'application/json')
+                self.send_header(REQUEST_ID_HEADER, rid)
                 self.send_header('Content-Length', str(len(payload)))
                 self.end_headers()
                 self.wfile.write(payload)
+                account(code)
 
-            do_GET = do_POST = do_PUT = do_DELETE = do_PATCH = _proxy
+            def do_GET(self):
+                # The LB's own metrics; NOT proxied (replica metrics are
+                # scraped by the replica manager and aggregated at the
+                # controller's /metrics).
+                if lb.metrics_path and self.path == lb.metrics_path:
+                    data = metrics_lib.REGISTRY.render().encode()
+                    self.send_response(200)
+                    self.send_header('Content-Type',
+                                     metrics_lib.CONTENT_TYPE)
+                    self.send_header('Content-Length', str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                self._proxy()
+
+            do_POST = do_PUT = do_DELETE = do_PATCH = _proxy
 
         threading.Thread(target=self._sync_loop, name='lb-sync',
                          daemon=True).start()

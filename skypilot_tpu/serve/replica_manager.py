@@ -33,6 +33,7 @@ from skypilot_tpu import exceptions
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve import service_spec as spec_lib
 from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import metrics as metrics_lib
 
 ReplicaStatus = serve_state.ReplicaStatus
 
@@ -59,6 +60,12 @@ class ReplicaManager:
         self._debug = bool(os.environ.get('SKYTPU_SERVE_DEBUG'))
         self._probe_pool = ThreadPoolExecutor(
             max_workers=_PROBE_POOL, thread_name_prefix='probe')
+        # Latest PARSED /metrics samples per replica id (scraped each
+        # controller tick; parsed once at scrape time — consumers run
+        # every tick and every controller-/metrics request). Feeds the
+        # controller's fleet aggregate and the autoscaler's SLO signals.
+        self._metrics_lock = threading.Lock()
+        self._replica_metrics: Dict[int, List[metrics_lib.Sample]] = {}
 
     def _set_task(self, spec: spec_lib.ServiceSpec, task_yaml: Dict) -> None:
         self.spec = spec
@@ -324,6 +331,66 @@ class ReplicaManager:
             time.sleep(0.2)
         self.log('terminate_all timed out; some replicas may need manual '
                  '`skytpu down`')
+
+    # -- metrics scraping -----------------------------------------------------
+    def scrape_metrics(self) -> None:
+        """Scrape each READY replica's /metrics (bounded timeout, probe
+        pool) and keep the latest exposition text per replica. Replicas
+        without the endpoint (arbitrary user services, pre-metrics
+        replicas) simply contribute nothing. Entries for replicas no
+        longer live are dropped so a terminated replica's counters stop
+        inflating the fleet aggregate."""
+        live = {r['replica_id']: r for r in self.replicas()
+                if r['status'] == ReplicaStatus.READY and r['url']}
+        with self._metrics_lock:
+            for rid in list(self._replica_metrics):
+                if rid not in live:
+                    del self._replica_metrics[rid]
+        list(self._probe_pool.map(self._scrape_one, live.values()))
+
+    def _scrape_one(self, replica: Dict) -> None:
+        rid = replica['replica_id']
+        try:
+            with urllib.request.urlopen(
+                    replica['url'].rstrip('/') + '/metrics',
+                    timeout=1.0) as resp:
+                if resp.status != 200:
+                    return
+                text = resp.read(4 << 20).decode('utf-8', 'replace')
+        except (urllib.error.URLError, OSError, ValueError):
+            return  # replica busy/restarting: keep the last scrape
+        samples = metrics_lib.parse_text(text)
+        if not samples:
+            return  # 200 + non-exposition body (arbitrary user replica)
+        with self._metrics_lock:
+            self._replica_metrics[rid] = samples
+
+    def num_scraped(self) -> int:
+        with self._metrics_lock:
+            return len(self._replica_metrics)
+
+    def fleet_metrics(self) -> List[metrics_lib.Sample]:
+        """Fleet-level aggregate: samples with identical (name, labels)
+        summed across the latest scrape of every replica."""
+        with self._metrics_lock:
+            scrapes = list(self._replica_metrics.values())
+        return metrics_lib.aggregate_samples(scrapes)
+
+    def fleet_signals(self) -> Dict[str, float]:
+        """The SLO-relevant subset of the fleet aggregate, keyed by
+        metric name — what the controller feeds
+        ``autoscaler.observe_fleet`` each tick."""
+        wanted = ('skytpu_serve_requests_total',
+                  'skytpu_serve_rejected_total',
+                  'skytpu_serve_slo_violations_total',
+                  'skytpu_serve_queue_depth_requests',
+                  'skytpu_serve_pending_prefill_tokens',
+                  'skytpu_serve_slots_active_count')
+        out: Dict[str, float] = {}
+        for name, labels, value in self.fleet_metrics():
+            if name in wanted and not labels:
+                out[name] = value
+        return out
 
     # -- probing & preemption -------------------------------------------------
     def probe_all(self) -> None:

@@ -31,6 +31,7 @@ _PAGE = """<!doctype html>
 <h2>Clusters</h2>{clusters}
 <h2>Managed jobs</h2>{jobs}
 <h2>Services</h2>{services}
+<h2>Serve metrics</h2>{serve_metrics}
 <h2>Recent API requests</h2>{requests}
 </body></html>"""
 
@@ -61,6 +62,42 @@ def _table(headers: List[str], rows: List[List[Any]]) -> str:
 
 def _esc(v: Any) -> str:
     return html.escape(str(v if v is not None else '-'))
+
+
+def _service_metrics_row(name: str, controller_port: int) -> List[Any]:
+    """One fleet-metrics row from the service controller's /metrics
+    aggregate (see docs/observability.md, 'reading the dashboard').
+    Sub-second timeout: the dashboard renders inside an API request and
+    a wedged controller must not stall the whole page."""
+    import urllib.request
+
+    from skypilot_tpu.utils import metrics as metrics_lib
+
+    with urllib.request.urlopen(
+            f'http://127.0.0.1:{controller_port}/metrics',
+            timeout=0.8) as resp:
+        samples = metrics_lib.parse_text(
+            resp.read().decode('utf-8', 'replace'))
+
+    def val(metric, default='-'):
+        v = metrics_lib.sample_value(samples, metric)
+        return default if v is None else int(v)
+
+    def quantile(metric, q):
+        cum = metrics_lib.histogram_cumulative(samples, metric)
+        v = metrics_lib.histogram_quantile(cum, q)
+        return '-' if v is None else f'{v:.0f}'
+
+    return [
+        _esc(name),
+        _esc(val('skytpu_serve_requests_total')),
+        _esc(val('skytpu_serve_rejected_total')),
+        _esc(val('skytpu_serve_queue_depth_requests')),
+        _esc(quantile('skytpu_serve_ttft_ms', 0.5)),
+        _esc(quantile('skytpu_serve_ttft_ms', 0.99)),
+        _esc(quantile('skytpu_serve_tpot_ms', 0.5)),
+        _esc(val('skytpu_engine_recompiles_total')),
+    ]
 
 
 def render() -> str:
@@ -94,8 +131,10 @@ def render() -> str:
         pass
 
     service_rows = []
+    serve_metric_rows = []
     try:
         from skypilot_tpu.serve import serve_state
+        metric_targets = []
         for s in serve_state.list_services():
             replicas = serve_state.list_replicas(s['name'])
             ready = sum(1 for rep in replicas
@@ -105,6 +144,23 @@ def render() -> str:
                 f'{ready}/{len(replicas)}',
                 _esc(s['lb_port'] or '-'),
             ])
+            if s.get('controller_port'):
+                metric_targets.append((s['name'], s['controller_port']))
+        if metric_targets:
+            # Concurrent scrapes: k services with wedged controllers
+            # must cost ONE sub-second timeout, not k in series.
+            from concurrent.futures import ThreadPoolExecutor
+
+            def fetch(target):
+                try:
+                    return _service_metrics_row(*target)
+                except Exception:  # controller briefly unreachable
+                    return None
+            with ThreadPoolExecutor(
+                    max_workers=min(8, len(metric_targets))) as pool:
+                serve_metric_rows = [
+                    row for row in pool.map(fetch, metric_targets)
+                    if row is not None]
     except Exception:
         pass
 
@@ -135,6 +191,11 @@ def render() -> str:
             job_rows),
         services=_table(['name', 'status', 'ready', 'lb port'],
                         service_rows),
+        serve_metrics=_table(
+            ['service', 'requests', '429s', 'queue depth',
+             'ttft p50 (ms)', 'ttft p99 (ms)', 'tpot p50 (ms)',
+             'recompiles'],
+            serve_metric_rows),
         requests=_table(['id', 'op', 'user', 'status', 'created'],
                         request_rows),
     )

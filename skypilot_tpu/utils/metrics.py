@@ -1,0 +1,437 @@
+"""Dependency-free metrics plane: counters, gauges, fixed-bucket
+histograms with Prometheus text exposition.
+
+The serve path computes TTFT estimates, queue depths, and per-step
+timings and (before this module) threw them away as ad-hoc dict
+counters; here they become scrapeable series so batching/autoscaling
+tuning happens against production signals, not only bench runs. No
+prometheus_client dependency: the container bakes a fixed toolchain, so
+the registry + text format live in-tree (~the same architecture as
+vLLM's metrics.py, minus the client library).
+
+Cost model: instruments are plain attribute updates under a per-metric
+lock (uncontended ~100ns); nothing is allocated per observation and
+nothing happens at all unless a scraper hits ``render()``. Call sites
+that want a strictly-zero disabled path hold ``None`` instead of a
+metric container when ``enabled()`` is false, so disabled
+instrumentation is one branch.
+
+Naming convention (enforced at registration AND by
+``scripts/check_metric_names.py``): ``skytpu_<subsystem>_<name>_<unit>``
+with the unit drawn from :data:`UNITS` (counters end ``_total`` per
+Prometheus convention).
+"""
+from __future__ import annotations
+
+import bisect
+import os
+import re
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+# Latency buckets in MILLISECONDS: spans sub-ms decode steps through
+# multi-second queue waits (TTFT p99 ~10s at the r05 knee).
+DEFAULT_BUCKETS_MS: Tuple[float, ...] = (
+    0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500,
+    1000, 2500, 5000, 10000, 30000)
+
+# Allowed trailing unit tokens for skytpu_* metric names. 'total' is the
+# Prometheus counter suffix; the rest are the units this codebase
+# actually measures in.
+UNITS = ('total', 'ms', 'seconds', 'tokens', 'requests', 'slots',
+         'bytes', 'ratio', 'count', 'rps')
+
+_NAME_RE = re.compile(r'^skytpu_[a-z0-9]+(_[a-z0-9]+)+$')
+
+
+def enabled() -> bool:
+    """Metrics default ON (the plane exists to be on in production);
+    $SKYTPU_METRICS=0 disables collection for overhead-sensitive runs."""
+    return os.environ.get('SKYTPU_METRICS', '1').lower() not in (
+        '0', 'false', 'off')
+
+
+def validate_name(name: str) -> Optional[str]:
+    """Return an error string when ``name`` violates the
+    ``skytpu_<subsystem>_<name>_<unit>`` convention, else None. Shared
+    with scripts/check_metric_names.py so the lint and the registry
+    enforce one rule."""
+    if not _NAME_RE.match(name):
+        return (f'{name!r}: must match skytpu_<subsystem>_<name>_<unit> '
+                '(lowercase, underscores)')
+    parts = name.split('_')
+    if len(parts) < 4:
+        return (f'{name!r}: needs at least skytpu_<subsystem>_<name>_'
+                f'<unit> (4 segments, got {len(parts)})')
+    if parts[-1] not in UNITS:
+        return (f'{name!r}: unit suffix {parts[-1]!r} not in '
+                f'{sorted(UNITS)}')
+    return None
+
+
+def _fmt(v: float) -> str:
+    """Prometheus float formatting: integers render without the '.0'."""
+    return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+def _fmt_labels(labels: Tuple[Tuple[str, str], ...]) -> str:
+    if not labels:
+        return ''
+    inner = ','.join(f'{k}="{v}"' for k, v in labels)
+    return '{' + inner + '}'
+
+
+class Counter:
+    """Monotonic counter."""
+
+    kind = 'counter'
+
+    def __init__(self, name: str, help_text: str,
+                 labels: Tuple[Tuple[str, str], ...] = ()):
+        self.name = name
+        self.help = help_text
+        self.labels = labels
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def samples(self) -> List[Tuple[str, Tuple[Tuple[str, str], ...],
+                                    float]]:
+        return [(self.name, self.labels, self._value)]
+
+
+class Gauge:
+    """Instantaneous value."""
+
+    kind = 'gauge'
+
+    def __init__(self, name: str, help_text: str,
+                 labels: Tuple[Tuple[str, str], ...] = ()):
+        self.name = name
+        self.help = help_text
+        self.labels = labels
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def samples(self) -> List[Tuple[str, Tuple[Tuple[str, str], ...],
+                                    float]]:
+        return [(self.name, self.labels, self._value)]
+
+
+class Histogram:
+    """Fixed-bucket histogram (cumulative buckets + sum + count).
+
+    Buckets are chosen at registration; ``observe`` is a bisect + two
+    adds under the lock — no per-observation allocation.
+    """
+
+    kind = 'histogram'
+
+    def __init__(self, name: str, help_text: str,
+                 buckets: Sequence[float] = DEFAULT_BUCKETS_MS,
+                 labels: Tuple[Tuple[str, str], ...] = ()):
+        self.name = name
+        self.help = help_text
+        self.labels = labels
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        if not self.buckets:
+            raise ValueError(f'{name}: histogram needs >= 1 bucket')
+        self._lock = threading.Lock()
+        # Non-cumulative per-bucket counts; +Inf is the final slot.
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float) -> None:
+        i = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += value
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def _snapshot(self) -> Tuple[List[int], float, int]:
+        """Consistent (counts, sum, count) under one lock hold, so a
+        scrape mid-observe can never show count != the +Inf bucket."""
+        with self._lock:
+            return list(self._counts), self._sum, self._count
+
+    def cumulative(self) -> List[Tuple[float, int]]:
+        """[(le, cumulative_count)] including the +Inf bucket."""
+        counts, _, _ = self._snapshot()
+        out: List[Tuple[float, int]] = []
+        running = 0
+        for le, c in zip(self.buckets, counts):
+            running += c
+            out.append((le, running))
+        out.append((float('inf'), running + counts[-1]))
+        return out
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Estimated q-quantile (0..1) by linear interpolation inside
+        the containing bucket — the scraper-side promql
+        histogram_quantile, usable locally (dashboard, bench summary).
+        None when empty; the top bucket clamps to its lower edge."""
+        return histogram_quantile(self.cumulative(), q)
+
+    def samples(self) -> List[Tuple[str, Tuple[Tuple[str, str], ...],
+                                    float]]:
+        counts, total, n = self._snapshot()
+        out = []
+        running = 0
+        for le, c in zip(self.buckets, counts):
+            running += c
+            out.append((f'{self.name}_bucket',
+                        self.labels + (('le', _fmt(le)),),
+                        float(running)))
+        out.append((f'{self.name}_bucket',
+                    self.labels + (('le', '+Inf'),), float(n)))
+        out.append((f'{self.name}_sum', self.labels, total))
+        out.append((f'{self.name}_count', self.labels, float(n)))
+        return out
+
+
+def histogram_quantile(cumulative: Sequence[Tuple[float, float]],
+                       q: float) -> Optional[float]:
+    """Quantile estimate from [(le, cumulative_count)] pairs (the last
+    pair being +Inf). Mirrors PromQL histogram_quantile: linear
+    interpolation within the bucket, top (+Inf) bucket clamped to the
+    highest finite edge."""
+    if not cumulative:
+        return None
+    total = cumulative[-1][1]
+    if total <= 0:
+        return None
+    rank = q * total
+    prev_le, prev_cum = 0.0, 0.0
+    for le, cum in cumulative:
+        if cum >= rank:
+            if le == float('inf'):
+                return prev_le  # clamp: no upper edge to interpolate to
+            if cum == prev_cum:
+                return le
+            frac = (rank - prev_cum) / (cum - prev_cum)
+            return prev_le + (le - prev_le) * frac
+        prev_le, prev_cum = le, cum
+    return prev_le
+
+
+# Rendering an EMPTY registry must not allocate: the no-metrics case is
+# every non-serving process that still mounts /metrics.
+_EMPTY = ''
+
+
+class Registry:
+    """Ordered collection of metrics with idempotent registration.
+
+    Re-registering a name returns the existing metric (multiple
+    scheduler instances in one process — tests — share series); a kind
+    mismatch is a programming error and raises.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
+                            Any] = {}
+
+    def _register(self, cls, name: str, help_text: str,
+                  labels: Optional[Dict[str, str]] = None,
+                  **kwargs) -> Any:
+        err = validate_name(name)
+        if err:
+            raise ValueError(f'bad metric name {err}')
+        lbl = tuple(sorted((labels or {}).items()))
+        key = (name, lbl)
+        with self._lock:
+            existing = self._metrics.get(key)
+            if existing is not None:
+                if not isinstance(existing, cls):
+                    raise ValueError(
+                        f'{name} already registered as '
+                        f'{existing.kind}, not {cls.kind}')
+                return existing
+            metric = cls(name, help_text, labels=lbl, **kwargs)
+            self._metrics[key] = metric
+            return metric
+
+    def counter(self, name: str, help_text: str = '',
+                labels: Optional[Dict[str, str]] = None) -> Counter:
+        return self._register(Counter, name, help_text, labels)
+
+    def gauge(self, name: str, help_text: str = '',
+              labels: Optional[Dict[str, str]] = None) -> Gauge:
+        return self._register(Gauge, name, help_text, labels)
+
+    def histogram(self, name: str, help_text: str = '',
+                  buckets: Sequence[float] = DEFAULT_BUCKETS_MS,
+                  labels: Optional[Dict[str, str]] = None) -> Histogram:
+        return self._register(Histogram, name, help_text, labels,
+                              buckets=buckets)
+
+    def get(self, name: str,
+            labels: Optional[Dict[str, str]] = None) -> Optional[Any]:
+        return self._metrics.get(
+            (name, tuple(sorted((labels or {}).items()))))
+
+    def __len__(self) -> int:
+        return len(self._metrics)
+
+    def render(self) -> str:
+        """Prometheus text exposition (format version 0.0.4)."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        if not metrics:
+            return _EMPTY
+        lines: List[str] = []
+        seen_headers = set()
+        for m in metrics:
+            if m.name not in seen_headers:
+                seen_headers.add(m.name)
+                if m.help:
+                    lines.append(f'# HELP {m.name} {m.help}')
+                lines.append(f'# TYPE {m.name} {m.kind}')
+            for sample_name, labels, value in m.samples():
+                lines.append(
+                    f'{sample_name}{_fmt_labels(labels)} {_fmt(value)}')
+        return '\n'.join(lines) + '\n'
+
+
+# Default process-wide registry; serving subsystems register here so one
+# /metrics endpoint exposes scheduler + engine series together.
+REGISTRY = Registry()
+
+CONTENT_TYPE = 'text/plain; version=0.0.4; charset=utf-8'
+
+
+def counter(name: str, help_text: str = '',
+            labels: Optional[Dict[str, str]] = None) -> Counter:
+    return REGISTRY.counter(name, help_text, labels)
+
+
+def gauge(name: str, help_text: str = '',
+          labels: Optional[Dict[str, str]] = None) -> Gauge:
+    return REGISTRY.gauge(name, help_text, labels)
+
+
+def histogram(name: str, help_text: str = '',
+              buckets: Sequence[float] = DEFAULT_BUCKETS_MS,
+              labels: Optional[Dict[str, str]] = None) -> Histogram:
+    return REGISTRY.histogram(name, help_text, buckets, labels)
+
+
+# ---- scrape-side helpers ----------------------------------------------------
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)\s*$')
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+Sample = Tuple[str, Tuple[Tuple[str, str], ...], float]
+
+
+def parse_text(text: str) -> List[Sample]:
+    """Parse Prometheus text exposition into (name, labels, value)
+    samples. Tolerant: comment/blank/malformed lines are skipped — a
+    scrape of an arbitrary replica must never crash the scraper."""
+    out: List[Sample] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith('#'):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        name, raw_labels, raw_value = m.groups()
+        try:
+            value = float(raw_value)
+        except ValueError:
+            continue
+        labels = tuple((k, v) for k, v in
+                       _LABEL_RE.findall(raw_labels or ''))
+        out.append((name, labels, value))
+    return out
+
+
+def aggregate_samples(
+        sample_lists: Iterable[Sequence[Sample]]) -> List[Sample]:
+    """Sum samples with identical (name, labels) across already-parsed
+    scrapes — the fleet-level rollup the controller exposes. Summing is
+    correct for counters and histogram series by construction; for
+    gauges it yields fleet totals (total queue depth, total pending
+    prefill tokens), which is the signal autoscaling consumes."""
+    acc: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+    order: List[Tuple[str, Tuple[Tuple[str, str], ...]]] = []
+    for samples in sample_lists:
+        for name, labels, value in samples:
+            key = (name, labels)
+            if key not in acc:
+                acc[key] = 0.0
+                order.append(key)
+            acc[key] += value
+    return [(name, labels, acc[(name, labels)])
+            for name, labels in order]
+
+
+def aggregate(texts: Iterable[str]) -> List[Sample]:
+    """aggregate_samples over raw exposition texts."""
+    return aggregate_samples(parse_text(t) for t in texts)
+
+
+def render_samples(samples: Iterable[Sample]) -> str:
+    """Render raw samples as (untyped) exposition lines — used for the
+    controller's fleet aggregate, which re-exports scraped series
+    without their original TYPE metadata."""
+    lines = [f'{name}{_fmt_labels(labels)} {_fmt(value)}'
+             for name, labels, value in samples]
+    return '\n'.join(lines) + ('\n' if lines else '')
+
+
+def sample_value(samples: Sequence[Sample], name: str) -> Optional[float]:
+    """First sample value for ``name`` ignoring labels (None if absent)."""
+    for n, _, v in samples:
+        if n == name:
+            return v
+    return None
+
+
+def histogram_cumulative(samples: Sequence[Sample],
+                         name: str) -> List[Tuple[float, float]]:
+    """Reconstruct [(le, cumulative)] for histogram ``name`` from parsed
+    samples (scrape-side counterpart of Histogram.cumulative)."""
+    out: List[Tuple[float, float]] = []
+    for n, labels, v in samples:
+        if n != f'{name}_bucket':
+            continue
+        le = dict(labels).get('le')
+        if le is None:
+            continue
+        out.append((float('inf') if le == '+Inf' else float(le), v))
+    out.sort(key=lambda p: p[0])
+    return out

@@ -5,21 +5,64 @@ manager + @event decorator, atexit JSON dump viewable in
 chrome://tracing / Perfetto). Recording is off unless ``SKYTPU_TIMELINE``
 is set (to a path, or ``1`` for the default under the state dir) — tracing
 must cost nothing on the hot path when disabled.
+
+Beyond the reference's begin/end pairs this recorder supports the serve
+request-tracing plane:
+
+- **flow events** (``ph`` s/t/f) bound by a request id, so one request's
+  spans connect across the load balancer and replica processes in the
+  Perfetto view;
+- **instant** (``ph`` i) and **complete** (``ph`` X, explicit duration)
+  events, for cross-thread spans whose begin and end are observed by
+  different threads (queue wait, prefill chunks);
+- a **bounded ring buffer**: ``_events`` is a deque capped at
+  ``$SKYTPU_TIMELINE_EVENTS`` (default 100k) events, so a long-running
+  replica with tracing on keeps the most recent window instead of
+  growing without bound. ``save()`` semantics are unchanged — it dumps
+  whatever the buffer currently holds.
 """
 from __future__ import annotations
 
 import atexit
+import collections
 import functools
 import json
 import os
 import threading
 import time
-from typing import Any, Callable, List, Optional
+from typing import Any, Callable, Deque, Optional
+
+DEFAULT_CAPACITY = 100_000
+
+# Cross-process trace-correlation header: assigned by the serve load
+# balancer, adopted by the generation replica, echoed to the client.
+# ONE definition — the name is a wire contract between processes.
+REQUEST_ID_HEADER = 'X-Skytpu-Request-Id'
 
 
-_events: List[dict] = []
+def _capacity_from_env() -> int:
+    raw = os.environ.get('SKYTPU_TIMELINE_EVENTS', '')
+    try:
+        cap = int(raw) if raw else DEFAULT_CAPACITY
+    except ValueError:
+        cap = DEFAULT_CAPACITY
+    return max(1, cap)
+
+
+_events: Deque[dict] = collections.deque(maxlen=_capacity_from_env())
 _lock = threading.Lock()
 _registered = False
+
+
+def configure(capacity: Optional[int] = None) -> None:
+    """Re-create the ring buffer (drops recorded events). Tests and
+    long-lived processes that change $SKYTPU_TIMELINE_EVENTS at runtime
+    call this; normal startup reads the env var at import."""
+    global _events
+    with _lock:
+        _events = collections.deque(
+            maxlen=max(1, capacity) if capacity is not None
+            else _capacity_from_env())
 
 
 def enabled() -> bool:
@@ -92,3 +135,68 @@ def event(name_or_fn: Any = None) -> Callable:
                     f'{name_or_fn.__module__}.{name_or_fn.__qualname__}')
     return lambda fn: wrap(fn, name_or_fn
                            or f'{fn.__module__}.{fn.__qualname__}')
+
+
+# ---- serve request-tracing events ------------------------------------------
+# All emitters check enabled() internally, but hot callers should still
+# guard with ``if timeline.enabled():`` so argument construction is also
+# skipped — the disabled path must stay one branch.
+
+def instant(name: str, **args: Any) -> None:
+    """Thread-scoped instant event (ph 'i') with optional args."""
+    if not enabled():
+        return
+    extra = {'s': 't'}
+    if args:
+        extra['args'] = args
+    _record(name, 'i', time.time() * 1e6, **extra)
+
+
+def complete(name: str, duration_s: float, end_wall_s: Optional[float]
+             = None, **args: Any) -> None:
+    """Complete event (ph 'X'): a span whose begin/end were observed by
+    different threads (or measured with perf_counter). ``duration_s`` is
+    the span length; the start timestamp is reconstructed from the end
+    wall clock (``end_wall_s`` or now) minus the duration."""
+    if not enabled():
+        return
+    end = end_wall_s if end_wall_s is not None else time.time()
+    extra: dict = {'dur': max(0.0, duration_s) * 1e6}
+    if args:
+        extra['args'] = args
+    _record(name, 'X', (end - max(0.0, duration_s)) * 1e6, **extra)
+
+
+def _flow(ph: str, name: str, flow_id: str,
+          ts_s: Optional[float] = None, **args: Any) -> None:
+    if not enabled():
+        return
+    extra: dict = {'cat': 'request', 'id': str(flow_id)}
+    if ph == 'f':
+        extra['bp'] = 'e'  # bind to the enclosing slice's end
+    if args:
+        extra['args'] = args
+    _record(name, ph, (time.time() if ts_s is None else ts_s) * 1e6,
+            **extra)
+
+
+def flow_start(name: str, flow_id: str, ts_s: Optional[float] = None,
+               **args: Any) -> None:
+    """Begin a flow (ph 's'): the LB emits this when it assigns a
+    request id; matching flow_step/flow_end events in other processes
+    draw connecting arrows in Perfetto. Flow events only render when
+    they fall INSIDE a duration slice on their thread — emitters pass
+    ``ts_s`` to pin the event within a ``complete`` span."""
+    _flow('s', name, flow_id, ts_s, **args)
+
+
+def flow_step(name: str, flow_id: str, ts_s: Optional[float] = None,
+              **args: Any) -> None:
+    """Intermediate flow point (ph 't') — e.g. replica-side TTFT."""
+    _flow('t', name, flow_id, ts_s, **args)
+
+
+def flow_end(name: str, flow_id: str, ts_s: Optional[float] = None,
+             **args: Any) -> None:
+    """Terminate a flow (ph 'f') — e.g. LB finished streaming."""
+    _flow('f', name, flow_id, ts_s, **args)

@@ -1,0 +1,381 @@
+"""Observability plane tests: metrics registry + exposition format,
+timeline ring buffer / flow events, the /stats + /metrics endpoint
+contracts on a live generation server (scraped mid-traffic), the
+single-branch disabled path, and the metric-name lint.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from skypilot_tpu.utils import metrics as metrics_lib
+from skypilot_tpu.utils import timeline
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---- registry + exposition --------------------------------------------------
+class TestRegistry:
+
+    def test_counter_gauge_histogram_render_and_parse(self):
+        r = metrics_lib.Registry()
+        c = r.counter('skytpu_test_requests_total', 'reqs')
+        c.inc()
+        c.inc(2)
+        g = r.gauge('skytpu_test_queue_depth_requests', 'depth')
+        g.set(4)
+        g.dec()
+        h = r.histogram('skytpu_test_latency_ms', 'lat',
+                        buckets=(1, 10, 100))
+        for v in (0.5, 5, 50, 500):
+            h.observe(v)
+        text = r.render()
+        samples = metrics_lib.parse_text(text)
+        assert metrics_lib.sample_value(
+            samples, 'skytpu_test_requests_total') == 3
+        assert metrics_lib.sample_value(
+            samples, 'skytpu_test_queue_depth_requests') == 3
+        assert metrics_lib.sample_value(
+            samples, 'skytpu_test_latency_ms_count') == 4
+        assert metrics_lib.sample_value(
+            samples, 'skytpu_test_latency_ms_sum') == pytest.approx(555.5)
+        # TYPE headers present (exposition format contract).
+        assert '# TYPE skytpu_test_requests_total counter' in text
+        assert '# TYPE skytpu_test_latency_ms histogram' in text
+
+    def test_histogram_buckets_cumulative_and_monotonic(self):
+        r = metrics_lib.Registry()
+        h = r.histogram('skytpu_test_wait_ms', buckets=(1, 10, 100))
+        for v in (0.5, 1.0, 9, 99, 10_000):  # edge value 1.0 -> le="1"
+            h.observe(v)
+        samples = metrics_lib.parse_text(r.render())
+        cum = metrics_lib.histogram_cumulative(samples,
+                                               'skytpu_test_wait_ms')
+        assert [le for le, _ in cum] == [1.0, 10.0, 100.0, float('inf')]
+        counts = [c for _, c in cum]
+        assert counts == sorted(counts), 'buckets must be cumulative'
+        assert counts[0] == 2  # le="1" is inclusive
+        assert counts[-1] == 5  # +Inf == _count
+        assert counts[-1] == metrics_lib.sample_value(
+            samples, 'skytpu_test_wait_ms_count')
+
+    def test_histogram_quantile_interpolates(self):
+        cum = [(10.0, 0.0), (100.0, 100.0), (float('inf'), 100.0)]
+        # All mass in (10, 100]: p50 interpolates inside the bucket.
+        q = metrics_lib.histogram_quantile(cum, 0.5)
+        assert 10.0 < q < 100.0
+        # Top-bucket mass clamps to the highest finite edge.
+        cum = [(10.0, 0.0), (float('inf'), 5.0)]
+        assert metrics_lib.histogram_quantile(cum, 0.99) == 10.0
+        assert metrics_lib.histogram_quantile([], 0.5) is None
+
+    def test_empty_registry_render_is_noop(self):
+        r = metrics_lib.Registry()
+        # Zero-allocation no-op: the empty exposition is one shared
+        # constant, not a fresh string per scrape.
+        assert r.render() == ''
+        assert r.render() is r.render()
+
+    def test_registration_idempotent_and_kind_checked(self):
+        r = metrics_lib.Registry()
+        a = r.counter('skytpu_test_events_total')
+        assert r.counter('skytpu_test_events_total') is a
+        with pytest.raises(ValueError, match='already registered'):
+            r.gauge('skytpu_test_events_total')
+        # Labeled children are distinct series under one name.
+        c200 = r.counter('skytpu_test_codes_total',
+                         labels={'code': '200'})
+        c429 = r.counter('skytpu_test_codes_total',
+                         labels={'code': '429'})
+        assert c200 is not c429
+        c200.inc()
+        samples = metrics_lib.parse_text(r.render())
+        by_labels = {lbl: v for n, lbl, v in samples
+                     if n == 'skytpu_test_codes_total'}
+        assert by_labels[(('code', '200'),)] == 1
+        assert by_labels[(('code', '429'),)] == 0
+
+    def test_name_convention_enforced_at_registration(self):
+        r = metrics_lib.Registry()
+        for bad in ('requests_total',           # no skytpu_ prefix
+                    'skytpu_requests_total',    # missing subsystem
+                    'skytpu_serve_ttft_usec',   # unknown unit
+                    'skytpu_serve_TTFT_ms'):    # uppercase
+            with pytest.raises(ValueError):
+                r.counter(bad)
+
+    def test_aggregate_sums_across_scrapes(self):
+        r = metrics_lib.Registry()
+        r.counter('skytpu_test_reqs_total').inc(3)
+        r.histogram('skytpu_test_lat_ms', buckets=(1, 10)).observe(5)
+        text = r.render()
+        agg = metrics_lib.aggregate([text, text, ''])
+        assert metrics_lib.sample_value(agg, 'skytpu_test_reqs_total') == 6
+        assert metrics_lib.sample_value(agg,
+                                        'skytpu_test_lat_ms_count') == 2
+        # Re-rendered aggregate stays parseable exposition.
+        rendered = metrics_lib.render_samples(agg)
+        again = metrics_lib.parse_text(rendered)
+        assert metrics_lib.sample_value(again,
+                                        'skytpu_test_reqs_total') == 6
+
+
+# ---- lint -------------------------------------------------------------------
+class TestMetricNameLint:
+
+    def test_tree_is_clean(self):
+        """Tier-1 enforcement of the skytpu_<subsystem>_<name>_<unit>
+        convention over every metric registered in skypilot_tpu/."""
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO_ROOT, 'scripts', 'check_metric_names.py')],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+
+    def test_detects_violation(self, tmp_path):
+        bad = tmp_path / 'bad.py'
+        bad.write_text("m = registry.counter('skytpu_bad_total')\n"
+                       "ok = registry.gauge('skytpu_serve_depth_count')\n")
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO_ROOT, 'scripts', 'check_metric_names.py'),
+             str(tmp_path)],
+            capture_output=True, text=True)
+        assert proc.returncode == 1
+        assert 'skytpu_bad_total' in proc.stderr
+        assert 'skytpu_serve_depth_count' not in proc.stderr
+
+
+# ---- timeline ring buffer + flow events -------------------------------------
+class TestTimelineExtensions:
+
+    def test_ring_buffer_caps_events(self, monkeypatch, tmp_path):
+        monkeypatch.setenv('SKYTPU_TIMELINE',
+                           str(tmp_path / 'trace.json'))
+        timeline.configure(capacity=8)
+        try:
+            for i in range(50):
+                timeline.instant('tick', n=i)
+            assert len(timeline._events) == 8
+            # save() keeps its semantics: dumps what the buffer holds
+            # (the most recent window).
+            path = timeline.save()
+            data = json.loads(open(path).read())
+            ns = [e['args']['n'] for e in data['traceEvents']]
+            assert ns == list(range(42, 50))
+        finally:
+            timeline.configure()  # restore env-sized buffer
+
+    def test_capacity_from_env(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_TIMELINE_EVENTS', '123')
+        timeline.configure()
+        try:
+            assert timeline._events.maxlen == 123
+        finally:
+            monkeypatch.delenv('SKYTPU_TIMELINE_EVENTS')
+            timeline.configure()
+
+    def test_flow_and_complete_events(self, monkeypatch, tmp_path):
+        monkeypatch.setenv('SKYTPU_TIMELINE',
+                           str(tmp_path / 'trace.json'))
+        timeline.configure(capacity=100)
+        try:
+            timeline.flow_start('request', 'rid1', path='/generate')
+            timeline.flow_step('request', 'rid1', ttft_ms=12.5)
+            timeline.complete('serve.queue_wait', 0.05,
+                              request_id='rid1')
+            timeline.flow_end('request', 'rid1', status=200)
+            events = list(timeline._events)
+            phases = [e['ph'] for e in events]
+            assert phases == ['s', 't', 'X', 'f']
+            flows = [e for e in events if e['ph'] in 'stf']
+            assert all(e['id'] == 'rid1' for e in flows)
+            assert all(e['cat'] == 'request' for e in flows)
+            x = events[2]
+            assert x['dur'] == pytest.approx(0.05 * 1e6)
+            assert x['args']['request_id'] == 'rid1'
+        finally:
+            timeline.configure()
+
+    def test_disabled_records_nothing(self, monkeypatch):
+        monkeypatch.delenv('SKYTPU_TIMELINE', raising=False)
+        before = len(timeline._events)
+        timeline.instant('x')
+        timeline.flow_start('request', 'rid')
+        timeline.complete('span', 0.1)
+        assert len(timeline._events) == before
+
+
+# ---- disabled path: a single branch per instrumentation site ----------------
+class TestDisabledPath:
+
+    def test_scheduler_and_engine_hold_none_when_disabled(
+            self, monkeypatch):
+        """SKYTPU_METRICS=0: instrumentation containers are None, so
+        every site reduces to one `is not None` branch and no metric
+        objects exist at all."""
+        monkeypatch.setenv('SKYTPU_METRICS', '0')
+        assert not metrics_lib.enabled()
+        from skypilot_tpu.models.llama import PRESETS
+        from skypilot_tpu.serve.generation_server import (
+            GenerationScheduler)
+        cfg = PRESETS['test-tiny']
+        sched = GenerationScheduler(cfg, params=None, batch_slots=1,
+                                    max_len=64)
+        assert sched._m is None
+        assert sched.engine.profiler is None
+        # Request path still works without metrics: counters dict only.
+        assert sched.stats()['rejected'] == 0
+
+    def test_enabled_by_default(self, monkeypatch):
+        monkeypatch.delenv('SKYTPU_METRICS', raising=False)
+        assert metrics_lib.enabled()
+
+
+# ---- live generation server: /stats contract + /metrics mid-traffic --------
+@pytest.mark.e2e
+class TestServerEndpoints:
+
+    @pytest.fixture()
+    def server(self):
+        import jax
+        from skypilot_tpu.models.llama import PRESETS, LlamaModel
+        from skypilot_tpu.serve.generation_server import (
+            GenerationScheduler, GenerationServer)
+        cfg = PRESETS['test-tiny']
+        params = jax.jit(LlamaModel(cfg).init)(jax.random.key(0))
+        sched = GenerationScheduler(cfg, params, batch_slots=2,
+                                    max_len=128, prefill_chunk=8)
+        sched.start(warmup=False)
+        srv = GenerationServer(sched, host='127.0.0.1', port=0)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        yield srv
+        srv.shutdown()
+
+    def test_stats_contract_and_metrics_scrape_mid_traffic(self, server):
+        """The /stats keys downstream consumers depend on (LB least_load,
+        BENCH record) plus a clean /metrics scrape while a request is
+        actively decoding."""
+        base = f'http://127.0.0.1:{server.port}'
+        body = json.dumps({'tokens': list(range(2, 22)),
+                           'max_tokens': 40, 'stream': True}).encode()
+        req = urllib.request.Request(
+            base + '/generate', data=body,
+            headers={'Content-Type': 'application/json',
+                     'X-Skytpu-Request-Id': 'ridtest42'})
+        resp = urllib.request.urlopen(req, timeout=120)
+        assert resp.headers['X-Skytpu-Request-Id'] == 'ridtest42'
+        lines = iter(resp)
+        # Wait for the first streamed token: traffic is now in flight.
+        first = json.loads(next(lines))
+        assert 'token' in first
+
+        # /stats contract.
+        with urllib.request.urlopen(base + '/stats', timeout=30) as r:
+            stats = json.loads(r.read())
+        for key in ('queue_depth', 'pending_prefill_tokens', 'rejected',
+                    'slots_total', 'slots_active', 'pending'):
+            assert key in stats, key
+        assert stats['queue_depth'] >= 1  # our request holds capacity
+
+        # /metrics mid-traffic: parseable exposition with the serve +
+        # engine series and monotone histogram buckets.
+        with urllib.request.urlopen(base + '/metrics', timeout=30) as r:
+            assert r.headers['Content-Type'].startswith('text/plain')
+            text = r.read().decode()
+        samples = metrics_lib.parse_text(text)
+        assert samples, 'exposition must parse'
+        names = {n for n, _, _ in samples}
+        for required in ('skytpu_serve_requests_total',
+                         'skytpu_serve_rejected_total',
+                         'skytpu_serve_ttft_ms_bucket',
+                         'skytpu_serve_tpot_ms_bucket',
+                         'skytpu_serve_queue_wait_ms_bucket',
+                         'skytpu_serve_queue_depth_requests',
+                         'skytpu_serve_slots_active_count',
+                         'skytpu_engine_step_ms_bucket',
+                         'skytpu_engine_steps_total',
+                         'skytpu_engine_recompiles_total',
+                         'skytpu_engine_occupancy_ratio'):
+            assert required in names, required
+        for hist in ('skytpu_serve_ttft_ms', 'skytpu_engine_step_ms'):
+            cum = metrics_lib.histogram_cumulative(samples, hist)
+            counts = [c for _, c in cum]
+            assert counts == sorted(counts), f'{hist} not monotonic'
+        # The in-flight request has emitted a token: TTFT observed,
+        # steps dispatched, compile variants counted.
+        assert metrics_lib.sample_value(
+            samples, 'skytpu_serve_ttft_ms_count') >= 1
+        assert metrics_lib.sample_value(
+            samples, 'skytpu_engine_recompiles_total') >= 1
+
+        # Drain the stream; the request finishes cleanly.
+        done = None
+        for line in lines:
+            obj = json.loads(line)
+            if obj.get('done') or obj.get('error'):
+                done = obj
+                break
+        assert done and not done.get('error')
+
+        # Post-traffic: tokens_out grew and TPOT was observed.
+        with urllib.request.urlopen(base + '/metrics', timeout=30) as r:
+            samples2 = metrics_lib.parse_text(r.read().decode())
+        assert metrics_lib.sample_value(
+            samples2, 'skytpu_serve_tokens_out_total') >= 40
+        assert metrics_lib.sample_value(
+            samples2, 'skytpu_serve_tpot_ms_count') >= 1
+
+    def test_request_tracing_spans_carry_request_id(
+            self, server, monkeypatch, tmp_path):
+        """With SKYTPU_TIMELINE on, a request's replica-side spans
+        (queue wait, prefill chunks, TTFT, per-token) all carry the
+        header-assigned request id, and the TTFT flow step binds to the
+        same flow id the LB starts."""
+        monkeypatch.setenv('SKYTPU_TIMELINE',
+                           str(tmp_path / 'trace.json'))
+        timeline.configure(capacity=10_000)
+        try:
+            base = f'http://127.0.0.1:{server.port}'
+            body = json.dumps({'tokens': list(range(2, 22)),
+                               'max_tokens': 4}).encode()
+            req = urllib.request.Request(
+                base + '/generate', data=body,
+                headers={'Content-Type': 'application/json',
+                         'X-Skytpu-Request-Id': 'flow77'})
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                out = json.loads(resp.read())
+            assert out['num_tokens'] == 4
+            events = list(timeline._events)
+            by_name = {}
+            for e in events:
+                by_name.setdefault(e['name'], []).append(e)
+            for span in ('serve.queue_wait', 'serve.prefill_chunk',
+                         'serve.first_token', 'serve.token'):
+                mine = [e for e in by_name.get(span, [])
+                        if e.get('args', {}).get('request_id') == 'flow77']
+                assert mine, f'missing {span} for request id'
+            # Chunked prefill of 20 tokens at chunk=8: two mid chunks
+            # plus a final-bucket chunk.
+            chunks = [e for e in by_name['serve.prefill_chunk']
+                      if e['args']['request_id'] == 'flow77']
+            assert len(chunks) == 3
+            assert chunks[-1]['args']['final'] is True
+            flows = [e for e in by_name.get('request', [])
+                     if e.get('id') == 'flow77']
+            assert any(e['ph'] == 't' for e in flows), 'TTFT flow step'
+            # GET /trace flushes the ring buffer on demand (replicas
+            # never exit cleanly, so atexit alone would lose traces).
+            with urllib.request.urlopen(base + '/trace',
+                                        timeout=30) as resp:
+                saved = json.loads(resp.read())['saved']
+            dumped = json.loads(open(saved).read())
+            assert any(e.get('args', {}).get('request_id') == 'flow77'
+                       for e in dumped['traceEvents'])
+        finally:
+            timeline.configure()

@@ -377,6 +377,73 @@ class TestFailover:
         assert err.reason == 'quota'
 
 
+class FakeOciWithIdentity(FakeOci):
+    """Fake exposing the identity list-ADs op with REAL (tenancy-
+    prefixed) AD names, the shape the Compute API actually accepts."""
+
+    AD_NAMES = ('qIZq:US-ASHBURN-1-AD-1', 'qIZq:US-ASHBURN-1-AD-2',
+                'qIZq:US-ASHBURN-1-AD-3')
+
+    def list_availability_domains(self, compartment_id):
+        return [{'name': n, 'compartmentId': compartment_id}
+                for n in self.AD_NAMES]
+
+
+class TestAdResolution:
+    """The `f'{region}-AD-1'` fallback never matched real tenancy-
+    prefixed AD names; launches must resolve zones through the identity
+    listing (advisor finding oci_impl.py:151)."""
+
+    DEPLOY_VARS = {'cluster_name_on_cloud': 'adres',
+                   'instance_type': 'VM.Standard.E4.Flex'}
+
+    @pytest.fixture
+    def fake_identity_oci(self, fake_oci):
+        account = FakeOciWithIdentity()
+        oci_api.set_oci_factory(lambda: account)
+        yield account
+        oci_api.set_oci_factory(lambda: fake_oci)
+
+    def test_no_zone_resolves_to_first_real_ad(self, fake_identity_oci):
+        oci_impl.run_instances('oci-ad0', 'us-ashburn-1', None, 1,
+                               dict(self.DEPLOY_VARS))
+        inst = next(iter(fake_identity_oci.instances.values()))
+        assert inst['availabilityDomain'] == 'qIZq:US-ASHBURN-1-AD-1'
+
+    def test_synthetic_zone_maps_to_suffix_matching_ad(
+            self, fake_identity_oci):
+        oci_impl.run_instances('oci-ad2', 'us-ashburn-1',
+                               'us-ashburn-1-AD-2', 1,
+                               dict(self.DEPLOY_VARS))
+        inst = next(iter(fake_identity_oci.instances.values()))
+        assert inst['availabilityDomain'] == 'qIZq:US-ASHBURN-1-AD-2'
+
+    def test_real_ad_name_passes_through(self, fake_identity_oci):
+        oci_impl.run_instances('oci-adr', 'us-ashburn-1',
+                               'Other:US-ASHBURN-1-AD-3', 1,
+                               dict(self.DEPLOY_VARS))
+        inst = next(iter(fake_identity_oci.instances.values()))
+        # ':' marks an already-real name: used verbatim, no listing.
+        assert inst['availabilityDomain'] == 'Other:US-ASHBURN-1-AD-3'
+
+    def test_missing_ad_classifies_as_capacity_for_failover(
+            self, fake_identity_oci):
+        with pytest.raises(exceptions.InsufficientCapacityError):
+            oci_impl.run_instances('oci-ad9', 'us-ashburn-1',
+                                   'us-ashburn-1-AD-9', 1,
+                                   dict(self.DEPLOY_VARS))
+        assert not fake_identity_oci.instances
+
+    def test_legacy_fake_without_identity_keeps_synthetic_zone(
+            self, fake_oci):
+        # Fakes (and hypothetical clients) without the identity op fall
+        # back to the old synthetic behavior instead of crashing.
+        oci_impl.run_instances('oci-leg', 'us-ashburn-1', None, 1,
+                               dict(self.DEPLOY_VARS))
+        inst = next(iter(fake_oci.instances.values()))
+        assert inst['availabilityDomain'] == 'us-ashburn-1-AD-1'
+
+
 class TestCloudClass:
 
     def test_spot_is_half_price(self, fake_oci):

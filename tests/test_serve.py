@@ -354,12 +354,32 @@ class TestServeE2E:
             svc = serve_state.get_service('svc-e2e')
             assert svc['status'] == ServiceStatus.READY
 
-            # LB proxies to the replica.
+            # LB proxies to the replica and assigns a request id.
             status_code, body, headers = _get_retry(endpoint + '/whoami')
             assert status_code == 200
             payload = json.loads(body)
             assert payload['path'] == '/whoami'
             assert 'X-Skytpu-Replica' in headers
+            assert headers.get('X-Skytpu-Request-Id')
+
+            # Observability smoke mid-traffic: the LB's own /metrics is
+            # served (not proxied) as parseable exposition, and the
+            # controller's fleet /metrics answers with its gauges.
+            from skypilot_tpu.utils import metrics as metrics_lib
+            code, lb_metrics, _ = _get_retry(endpoint + '/metrics')
+            assert code == 200
+            lb_samples = metrics_lib.parse_text(lb_metrics.decode())
+            assert metrics_lib.sample_value(
+                lb_samples, 'skytpu_lb_requests_total') >= 1
+            ctrl_port = serve_state.get_service(
+                'svc-e2e')['controller_port']
+            code, ctrl_metrics, _ = _get_retry(
+                f'http://127.0.0.1:{ctrl_port}/metrics')
+            assert code == 200
+            ctrl_samples = metrics_lib.parse_text(ctrl_metrics.decode())
+            assert metrics_lib.sample_value(
+                ctrl_samples,
+                'skytpu_controller_ready_replicas_count') >= 1
 
             # Push sustained traffic through the LB -> scale to 2.
             def push_and_check():

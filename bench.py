@@ -463,13 +463,22 @@ def phase_serve(out_path: str, on_tpu: bool, chip_kind: str) -> None:
             # exploded between c24 and c48), A/B chunked-prefill +
             # admission-control vs the monolithic control. SLO = the
             # BASELINE anchor's P99 TTFT (4.5s).
+            # batch_slots doubled vs the contiguous r5 run (32 -> 64)
+            # while kv_blocks pins the pool to the SAME HBM budget the
+            # 32-slot contiguous cache used (32 x 4096 / 64 rows): the
+            # paged engine's acceptance claim — admitted concurrency
+            # bounded by actual sequence lengths, not slot regions —
+            # measured under an unchanged memory budget. The shared-
+            # prefix arm (2048-token common prefix, the r05 system-
+            # prompt shape) records serve_prefix_hit_rate.
             out = serve_bench.run(
-                preset='llama-1b', batch_slots=32, max_len=4096,
+                preset='llama-1b', batch_slots=64, max_len=4096,
                 prompt_len=2500, output_len=150,
                 concurrencies=(12, 24, 36, 48),
                 window_s=45.0, warmup_requests=2,
                 ready_timeout_s=150 * _SCALE, warmup_deadline_s=90 * _SCALE,
                 prefill_chunk=256, ttft_slo_ms=4500.0, ab_monolithic=True,
+                prefix_share_len=2048, kv_block=64, kv_blocks=2049,
                 progress=progress)
         else:
             out = serve_bench.run(
@@ -478,6 +487,7 @@ def phase_serve(out_path: str, on_tpu: bool, chip_kind: str) -> None:
                 window_s=4.0, warmup_requests=1,
                 ready_timeout_s=120 * _SCALE, warmup_deadline_s=60 * _SCALE,
                 prefill_chunk=8, ttft_slo_ms=2000.0, ab_monolithic=True,
+                prefix_share_len=16, kv_block=8,
                 progress=progress)
     except Exception as e:  # noqa: BLE001 — a failed serve phase must
         # still contribute an explanatory record, not just rc!=0

@@ -30,26 +30,45 @@ _CALL_RE = re.compile(
     r'\b(?:counter|gauge|histogram)\(\s*[\'"]([A-Za-z0-9_]+)[\'"]',
     re.DOTALL)
 
+# Registration coverage: these metric FAMILIES are load-bearing (bench
+# records, dashboards, docs tables reference them by prefix) — a
+# refactor that renames them away silently breaks every consumer. The
+# scan must find at least one registration per family or the lint
+# fails, so "the family exists in the tree" is a tier-1 guarantee.
+EXPECTED_FAMILIES = (
+    'skytpu_serve_',      # scheduler/admission plane
+    'skytpu_engine_',     # decode engine step profiling
+    'skytpu_engine_kv_',  # paged-KV pool + prefix cache
+    'skytpu_lb_',         # load balancer proxy series
+)
 
-def scan_file(path: str) -> list:
-    """[(line_number, name, error)] for convention violations."""
+
+def scan_file(path: str) -> tuple:
+    """([(line_number, name, error)], [names]) for one file."""
     with open(path, encoding='utf-8') as f:
         src = f.read()
     out = []
+    names = []
     for m in _CALL_RE.finditer(src):
         name = m.group(1)
+        names.append(name)
         err = validate_name(name)
         if err:
             line = src.count('\n', 0, m.start()) + 1
             out.append((line, name, err))
-    return out
+    return out, names
 
 
 def main(argv=None) -> int:
     args = argv if argv is not None else sys.argv[1:]
+    # Family coverage is only meaningful over the full tree: a narrower
+    # root (e.g. `... skypilot_tpu/utils`) legitimately lacks most
+    # families and must not fail on their absence.
+    check_families = not args
     root = args[0] if args else os.path.join(_REPO_ROOT, 'skypilot_tpu')
     violations = []
     n_files = 0
+    all_names = []
     for dirpath, dirnames, filenames in os.walk(root):
         dirnames[:] = [d for d in dirnames if d != '__pycache__']
         for fn in filenames:
@@ -57,9 +76,18 @@ def main(argv=None) -> int:
                 continue
             path = os.path.join(dirpath, fn)
             n_files += 1
-            for line, name, err in scan_file(path):
+            file_violations, names = scan_file(path)
+            all_names.extend(names)
+            for line, name, err in file_violations:
                 violations.append(
                     f'{os.path.relpath(path, _REPO_ROOT)}:{line}: {err}')
+    if check_families:
+        for family in EXPECTED_FAMILIES:
+            if not any(n.startswith(family) for n in all_names):
+                violations.append(
+                    f'expected metric family {family}* has no '
+                    f'registration under {root} (renamed away? update '
+                    'EXPECTED_FAMILIES and every consumer)')
     if violations:
         print('metric naming violations '
               '(convention: skytpu_<subsystem>_<name>_<unit>):',

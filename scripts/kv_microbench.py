@@ -1,0 +1,122 @@
+#!/usr/bin/env python3
+"""Paged-vs-contiguous decode microbench: step time + KV HBM footprint.
+
+A full serve sweep takes minutes of wall clock and a whole serve stack;
+this is the 30-second regression probe for the paged-KV engine. It runs
+the SAME fixed-batch decode loop twice — contiguous per-slot KV
+(``kv_block=0``) and paged (``--kv-block``) — on one process's device
+and reports per-step wall time plus the exact KV state bytes, so a
+paged-path regression (gather/scatter overhead creeping up, pool
+mis-sizing) shows up in CI-adjacent tooling without a serve run::
+
+    python scripts/kv_microbench.py                      # CPU tiny
+    python scripts/kv_microbench.py --preset llama-1b \
+        --slots 16 --max-len 1024 --kv-block 64          # on-chip
+
+Output is one JSON line (machine-diffable in BENCH-style tooling).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+
+def _state_kv_bytes(state) -> int:
+    return int(state.k.nbytes) + int(state.v.nbytes)
+
+
+def bench_engine(config, params, *, slots: int, max_len: int,
+                 prompt_len: int, steps: int, kv_block: int,
+                 kv_blocks=None) -> dict:
+    """Decode-step timing at full occupancy for one engine mode."""
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.models.decode import DecodeEngine, prefill_bucket
+
+    engine = DecodeEngine(config, batch_slots=slots, max_len=max_len,
+                          kv_block=kv_block, kv_blocks=kv_blocks)
+    state = engine.init_state()
+    prompt = jax.random.randint(jax.random.key(7), (prompt_len,), 0,
+                                config.vocab_size)
+    bucket = prefill_bucket(prompt_len, engine.max_len)
+    padded = jnp.pad(prompt, (0, bucket - prompt_len))
+    rng = jax.random.key(11)
+    for s in range(slots):
+        state, _, rng = engine.admit(params, state, padded, prompt_len,
+                                     s, rng)
+    for _ in range(4):  # compile + warm
+        state, sampled, rng = engine.step(params, state, rng)
+    int(sampled[0])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, sampled, rng = engine.step(params, state, rng)
+    int(sampled[0])  # sync
+    dt = time.perf_counter() - t0
+    return {
+        'mode': 'paged' if kv_block > 0 else 'contiguous',
+        'kv_block': kv_block,
+        'kv_blocks': engine.kv_blocks,
+        'step_ms': round(dt / steps * 1e3, 3),
+        'decode_tokens_per_s': round(slots * steps / dt, 1),
+        'kv_state_bytes': _state_kv_bytes(state),
+        'kv_state_mib': round(_state_kv_bytes(state) / 2**20, 2),
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split('\n')[0])
+    parser.add_argument('--preset', default='test-tiny')
+    parser.add_argument('--slots', type=int, default=4)
+    parser.add_argument('--max-len', type=int, default=128)
+    parser.add_argument('--prompt-len', type=int, default=24)
+    parser.add_argument('--steps', type=int, default=32)
+    parser.add_argument('--kv-block', type=int, default=64,
+                        help='block rows for the paged arm')
+    parser.add_argument('--kv-blocks', type=int, default=None,
+                        help='paged pool size (default: contiguous HBM '
+                             'budget at --slots)')
+    args = parser.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.models.llama import PRESETS, LlamaModel
+
+    config = PRESETS[args.preset]
+    model = LlamaModel(config)
+    params = jax.jit(model.init)(jax.random.key(0))
+    params = jax.tree.map(
+        lambda a: a.astype(config.dtype)
+        if hasattr(a, 'dtype') and a.dtype == jnp.float32 else a, params)
+
+    common = dict(slots=args.slots, max_len=args.max_len,
+                  prompt_len=min(args.prompt_len, args.max_len - 1),
+                  steps=args.steps)
+    contiguous = bench_engine(config, params, kv_block=0, **common)
+    paged = bench_engine(config, params, kv_block=args.kv_block,
+                         kv_blocks=args.kv_blocks, **common)
+    record = {
+        'preset': args.preset,
+        'batch_slots': args.slots,
+        'max_len': args.max_len,
+        'prompt_len': common['prompt_len'],
+        'backend': jax.default_backend(),
+        'contiguous': contiguous,
+        'paged': paged,
+        'paged_step_overhead_pct': round(
+            (paged['step_ms'] / contiguous['step_ms'] - 1) * 100, 1)
+        if contiguous['step_ms'] else None,
+    }
+    print(json.dumps(record))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -60,6 +60,11 @@ def summarize_metrics_text(text: str) -> Dict[str, Any]:
                  'skytpu_engine_prefill_tokens_total',
                  'skytpu_engine_decode_tokens_total',
                  'skytpu_engine_occupancy_ratio',
+                 'skytpu_engine_kv_block_utilization_ratio',
+                 'skytpu_engine_kv_prefix_hits_total',
+                 'skytpu_engine_kv_prefix_hit_tokens_total',
+                 'skytpu_engine_kv_prefix_lookup_tokens_total',
+                 'skytpu_engine_kv_evictions_total',
                  'skytpu_serve_slo_headroom_ms'):
         v = metrics_lib.sample_value(samples, name)
         if v is not None:
@@ -75,6 +80,20 @@ def _percentile(values: Sequence[float], pct: float) -> float:
     return ordered[idx]
 
 
+def make_prompt(rnd: random.Random, vocab_size: int, prompt_len: int,
+                shared_prefix: Optional[Sequence[int]] = None
+                ) -> List[int]:
+    """One workload prompt: random ids, optionally behind a common
+    prefix (the millions-of-users-one-system-prompt shape the paged-KV
+    prefix cache serves). The prefix is truncated to leave >= 1 random
+    suffix token so every request is a distinct sequence."""
+    if not shared_prefix:
+        return [rnd.randrange(vocab_size) for _ in range(prompt_len)]
+    prefix = list(shared_prefix)[:max(0, prompt_len - 1)]
+    return prefix + [rnd.randrange(vocab_size)
+                     for _ in range(prompt_len - len(prefix))]
+
+
 def _post_generate(endpoint: str, tokens: List[int], max_tokens: int,
                    stream: bool, timeout: float = 900.0):
     body = json.dumps({'tokens': tokens, 'max_tokens': max_tokens,
@@ -87,11 +106,15 @@ def _post_generate(endpoint: str, tokens: List[int], max_tokens: int,
 
 def drive_load(endpoint: str, *, vocab_size: int, prompt_len: int,
                output_len: int, concurrency: int, window_s: float,
-               seed: int = 0) -> Dict[str, Any]:
+               seed: int = 0,
+               shared_prefix: Optional[Sequence[int]] = None
+               ) -> Dict[str, Any]:
     """Closed-loop load: ``concurrency`` clients, each streaming one
     request at a time, for ``window_s`` seconds. Only requests that
     complete inside the window count (their TTFT/TPOT are client-side
-    wall-clock measurements, not server-reported)."""
+    wall-clock measurements, not server-reported). With
+    ``shared_prefix``, every prompt starts with that common token run —
+    the shared-system-prompt workload arm."""
     results: List[Tuple[float, float, int]] = []  # (ttft_s, total_s, n_out)
     errors = [0]
     rejected = [0]
@@ -102,7 +125,8 @@ def drive_load(endpoint: str, *, vocab_size: int, prompt_len: int,
     def client(tid: int) -> None:
         rnd = random.Random(seed * 1000 + tid)
         while time.perf_counter() < stop_at:
-            tokens = [rnd.randrange(vocab_size) for _ in range(prompt_len)]
+            tokens = make_prompt(rnd, vocab_size, prompt_len,
+                                 shared_prefix)
             t0 = time.perf_counter()
             try:
                 with _post_generate(endpoint, tokens, output_len,
@@ -174,11 +198,75 @@ def drive_load(endpoint: str, *, vocab_size: int, prompt_len: int,
     }
 
 
+def _fetch_stats(endpoint: str) -> Dict[str, Any]:
+    try:
+        with urllib.request.urlopen(endpoint + '/stats',
+                                    timeout=30) as resp:
+            return json.loads(resp.read())
+    except (urllib.error.URLError, OSError, ValueError):
+        return {}
+
+
+def _prefix_arm(endpoint: str, *, vocab_size: int, prompt_len: int,
+                prefix_len: int, output_len: int, concurrency: int,
+                window_s: float) -> Dict[str, Any]:
+    """Shared-system-prompt arm: N closed-loop clients whose prompts
+    share a ``prefix_len`` common prefix. Records the replica's
+    prefix-cache hit rate over the arm (delta of the cumulative /stats
+    counters, so earlier random-prompt traffic doesn't dilute it) and
+    the KV block utilization sampled mid-load (after the window every
+    release has freed its blocks and the gauge reads 0)."""
+    rnd = random.Random(4242)
+    prefix = [rnd.randrange(vocab_size)
+              for _ in range(min(prefix_len, prompt_len - 1))]
+    before = _fetch_stats(endpoint)
+    sweep_box: Dict[str, Any] = {}
+    mid: Dict[str, Any] = {}
+
+    def _drive() -> None:
+        sweep_box.update(drive_load(
+            endpoint, vocab_size=vocab_size, prompt_len=prompt_len,
+            output_len=output_len, concurrency=concurrency,
+            window_s=window_s, seed=77, shared_prefix=prefix))
+
+    t = threading.Thread(target=_drive, daemon=True)
+    t.start()
+    time.sleep(window_s * 0.6)
+    mid = _fetch_stats(endpoint)
+    t.join(timeout=window_s + 900)
+    after = _fetch_stats(endpoint)
+    out: Dict[str, Any] = {'prefix_len': len(prefix),
+                           'sweep': sweep_box}
+    d_hit = (after.get('prefix_hit_tokens', 0)
+             - before.get('prefix_hit_tokens', 0))
+    d_lookup = (after.get('prefix_lookup_tokens', 0)
+                - before.get('prefix_lookup_tokens', 0))
+    d_admits = (after.get('prefix_lookups', 0)
+                - before.get('prefix_lookups', 0))
+    # Headline hit rate is over the SHAREABLE tokens (the block-aligned
+    # common prefix) per admission: random suffixes can never hit, so a
+    # whole-prompt denominator would cap the metric at
+    # prefix/prompt_len (~0.82 at the 2048/2500 anchor shape) no matter
+    # how well the cache works. Steady-state perfect sharing reads
+    # ~1.0 here; the raw all-tokens ratio rides along for context.
+    kv_block = int(after.get('kv_block', 0) or 0)
+    shareable = (len(prefix) // kv_block) * kv_block if kv_block else 0
+    if d_admits > 0 and shareable > 0:
+        out['prefix_hit_rate'] = round(
+            d_hit / (d_admits * shareable), 4)
+    if d_lookup > 0:
+        out['prefix_hit_rate_all_tokens'] = round(d_hit / d_lookup, 4)
+    if 'kv_block_utilization' in mid:
+        out['kv_block_utilization'] = mid['kv_block_utilization']
+        out['kv_blocks_total'] = mid.get('kv_blocks_total')
+    return out
+
+
 def _bench_service(*, task, service_name: str, vocab_size: int,
                    prompt_len: int, output_len: int,
                    concurrencies: Sequence[int], window_s: float,
                    warmup_requests: int, ready_timeout_s: float,
-                   warmup_deadline_s: float,
+                   warmup_deadline_s: float, prefix_share_len: int = 0,
                    progress=None) -> Dict[str, Any]:
     """Stand up ONE serve stack for ``task`` on the local cloud, warm the
     replica through the LB, sweep concurrency, fetch the replica's
@@ -189,7 +277,7 @@ def _bench_service(*, task, service_name: str, vocab_size: int,
     ReplicaStatus = serve_state.ReplicaStatus
 
     out: Dict[str, Any] = {'sweep': [], 'warmup_failed': False,
-                           'stats': {}, 'metrics': {}}
+                           'stats': {}, 'metrics': {}, 'prefix': {}}
     result = serve_core.up(task, service_name)
     endpoint = result['endpoint']
     try:
@@ -274,6 +362,16 @@ def _bench_service(*, task, service_name: str, vocab_size: int,
             if progress is not None:
                 progress(sweep)
         out['sweep'] = sweep
+        if warmed and prefix_share_len > 0 and concurrencies:
+            # Shared-prefix workload arm at the sweep's top concurrency:
+            # the prefix-cache acceptance measurement (hit rate > 0.9 at
+            # the r05 shape) rides the same service instance.
+            out['prefix'] = _prefix_arm(
+                endpoint, vocab_size=vocab_size, prompt_len=prompt_len,
+                prefix_len=prefix_share_len, output_len=output_len,
+                concurrency=max(concurrencies), window_s=window_s)
+            print(f'serve bench [{service_name}] shared-prefix arm: '
+                  f"{out['prefix']}", file=sys.stderr)
         # Replica counters through the LB proxy: the rejected count is
         # the admission-control acceptance signal.
         try:
@@ -314,7 +412,9 @@ def run(*, preset: str = 'llama-1b', batch_slots: int = 16,
         warmup_deadline_s: Optional[float] = None,
         service_name: str = 'bench-serve',
         progress=None, prefill_chunk: int = 0, ttft_slo_ms: float = 0.0,
-        ab_monolithic: bool = False) -> Dict[str, Any]:
+        ab_monolithic: bool = False, prefix_share_len: int = 0,
+        kv_block: Optional[int] = None,
+        kv_blocks: Optional[int] = None) -> Dict[str, Any]:
     """Serve-path sweep, optionally A/B'd chunked-vs-monolithic.
 
     The headline service runs with ``prefill_chunk``/``ttft_slo_ms``
@@ -324,7 +424,15 @@ def run(*, preset: str = 'llama-1b', batch_slots: int = 16,
     points land in ``serve_sweep_monolithic`` + the per-concurrency
     ``serve_ttft_p99_ab`` table — the record carries the A/B, not just
     the winner. Returns the sweep plus the best-throughput point
-    flattened into ``serve_*`` fields (the BENCH record contract)."""
+    flattened into ``serve_*`` fields (the BENCH record contract).
+
+    ``prefix_share_len`` > 0 appends a shared-system-prompt arm (all
+    prompts behind one ``prefix_share_len``-token prefix) to the
+    headline service and records ``serve_prefix_hit_rate`` +
+    ``serve_kv_block_utilization``. ``kv_block``/``kv_blocks``
+    (replica $SKYTPU_KV_BLOCK/$SKYTPU_KV_BLOCKS) pin the paged-KV pool
+    geometry — size ``kv_blocks`` below slots x max_len to measure
+    block-budget admission under a fixed HBM budget."""
     import skypilot_tpu as sky
     from skypilot_tpu.models.llama import PRESETS
     from skypilot_tpu.serve import service_spec as spec_lib
@@ -342,6 +450,10 @@ def run(*, preset: str = 'llama-1b', batch_slots: int = 16,
             envs['SKYTPU_PREFILL_CHUNK'] = str(int(chunk))
         if slo_ms:
             envs['SKYTPU_TTFT_SLO_MS'] = str(float(slo_ms))
+        if kv_block is not None:
+            envs['SKYTPU_KV_BLOCK'] = str(int(kv_block))
+        if kv_blocks is not None:
+            envs['SKYTPU_KV_BLOCKS'] = str(int(kv_blocks))
         task = sky.Task(
             run=(f'{sys.executable} -m '
                  'skypilot_tpu.serve.generation_server '
@@ -366,7 +478,12 @@ def run(*, preset: str = 'llama-1b', batch_slots: int = 16,
         'serve_batch_slots': batch_slots,
         'serve_prefill_chunk': prefill_chunk,
         'serve_ttft_slo_ms': ttft_slo_ms,
+        'serve_prefix_share_len': prefix_share_len,
     }
+    if kv_block is not None:
+        out['serve_kv_block'] = kv_block
+    if kv_blocks is not None:
+        out['serve_kv_blocks'] = kv_blocks
 
     def sub_progress(field: str):
         if progress is None:
@@ -403,20 +520,31 @@ def run(*, preset: str = 'llama-1b', batch_slots: int = 16,
 
     main = _bench_service(task=make_task(prefill_chunk, ttft_slo_ms),
                           service_name=service_name,
-                          progress=sub_progress('serve_sweep'), **common)
+                          progress=sub_progress('serve_sweep'),
+                          prefix_share_len=prefix_share_len, **common)
     sweep = main['sweep']
     out['serve_sweep'] = sweep
     if main['warmup_failed']:
         out['serve_warmup_failed'] = True
     if main.get('metrics'):
         out['serve_replica_metrics_summary'] = main['metrics']
+    if main.get('prefix'):
+        prefix = main['prefix']
+        out['serve_prefix_sweep'] = prefix.get('sweep', {})
+        if 'prefix_hit_rate' in prefix:
+            out['serve_prefix_hit_rate'] = prefix['prefix_hit_rate']
+        if 'kv_block_utilization' in prefix:
+            out['serve_kv_block_utilization'] = (
+                prefix['kv_block_utilization'])
     if main['stats']:
         out['serve_rejected'] = main['stats'].get('rejected', 0)
         out['serve_replica_stats'] = {
             k: main['stats'][k]
             for k in ('requests', 'rejected', 'queue_depth',
                       'prefill_chunk', 'ttft_slo_ms',
-                      'prefill_tokens_per_s')
+                      'prefill_tokens_per_s', 'kv_block',
+                      'kv_blocks_total', 'prefix_hits',
+                      'prefix_hit_rate', 'prefix_evictions')
             if k in main['stats']}
     if out.get('serve_sweep_monolithic'):
         # Per-concurrency TTFT p99 A/B: the acceptance signal that

@@ -15,17 +15,29 @@ Design (vs the correctness-oracle ``LlamaModel.decode_step``):
     continuous batching, the TPU-friendly JetStream architecture.
   - sampling (greedy / temperature / top-k) runs inside the step jit, so
     the only per-step host traffic is B sampled token ids.
+  - **paged KV** (default, ``SKYTPU_KV_BLOCK=64``; 0 = contiguous): KV
+    lives in a global pool of fixed-size blocks addressed through
+    per-slot block tables (PagedAttention, Kwon et al. SOSP '23), so a
+    slot consumes only the blocks its sequence fills and full prefix
+    blocks are shared across slots via the host-side refcounting +
+    hash-chain prefix cache in ``models/paged_kv.py`` (RadixAttention-
+    style reuse). All three admission paths and the decode-step scatter
+    are block-indexed; the contiguous layout remains as the
+    equivalence oracle and for A/B microbenches.
 """
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from skypilot_tpu.models import paged_kv
 from skypilot_tpu.models.llama import LlamaConfig, LlamaModel, Params
 from skypilot_tpu.ops import attention as attention_ops
 from skypilot_tpu.ops.layers import precompute_rotary, rms_norm
@@ -90,16 +102,29 @@ class StepProfiler:
 class DecodeState:
     """Batched decode state: stacked KV cache + per-slot bookkeeping.
 
-    Layout [L, B, kvh, M, d] (head-major, sequence next-to-minor): decode
-    attention for each (slot, kv-head) pair then streams a contiguous
-    [M, d] block from HBM. The naive [L, B, M, kvh, d] layout strides
-    those reads and measured ~3.4x slower per step at M=4096 on v5e.
+    Contiguous mode (``kv_block=0``): k/v are [L, B, kvh, M, d]
+    (head-major, sequence next-to-minor — decode attention for each
+    (slot, kv-head) pair streams a contiguous [M, d] block from HBM;
+    the naive [L, B, M, kvh, d] layout strides those reads and measured
+    ~3.4x slower per step at M=4096 on v5e) and ``block_tables`` is an
+    empty [B, 0] placeholder.
+
+    Paged mode (``kv_block>0``, the default): k/v are ONE global pool of
+    fixed-size blocks [L, num_blocks, kvh, block, d] and
+    ``block_tables[b]`` lists the physical block ids holding slot b's
+    rows in order (vLLM-style PagedAttention). Row ``p`` of slot ``b``
+    lives at pool row ``(block_tables[b, p // block], p % block)``;
+    unassigned table entries point at the reserved null block 0, whose
+    rows are never read unmasked. Slots sharing a prompt prefix map the
+    SAME physical blocks (refcounted on the host), which is what makes
+    the shared-system-prompt workload prefill its prefix once.
     """
-    k: jax.Array            # [L, B, kvh, M, d]
-    v: jax.Array            # [L, B, kvh, M, d]
+    k: jax.Array            # [L, B, kvh, M, d] or [L, NB, kvh, BS, d]
+    v: jax.Array            # same layout as k
     lengths: jax.Array      # [B] int32: tokens currently in each slot's cache
     last_tokens: jax.Array  # [B] int32: next token to feed per slot
     active: jax.Array       # [B] bool: slot occupied
+    block_tables: jax.Array  # [B, max_blocks] int32 (paged), [B, 0] else
 
 
 class DecodeEngine:
@@ -112,13 +137,53 @@ class DecodeEngine:
 
     def __init__(self, config: LlamaConfig, batch_slots: int = 8,
                  max_len: Optional[int] = None,
-                 model: Optional[LlamaModel] = None):
+                 model: Optional[LlamaModel] = None,
+                 kv_block: Optional[int] = None,
+                 kv_blocks: Optional[int] = None):
+        """``kv_block`` ($SKYTPU_KV_BLOCK, default 64; 0 = contiguous):
+        rows per KV block. Paged mode replaces the per-slot contiguous
+        [max_len] KV region with a global pool of ``kv_blocks`` blocks
+        ($SKYTPU_KV_BLOCKS, default batch_slots * ceil(max_len/block)
+        + null block — the contiguous layout's HBM budget) addressed
+        through per-slot block tables, so a slot only consumes the
+        blocks its sequence actually fills and full prefix blocks can
+        be shared across slots. The contiguous path stays selectable as
+        the equivalence oracle and for microbench A/Bs.
+        """
         self.config = config
         # Engine reuses the model's block methods (_qkv/_mlp_delta) so the
         # transformer math lives once; pass a MixtralModel to serve MoE.
         self.model = model or LlamaModel(config)
         self.batch_slots = batch_slots
         self.max_len = max_len or config.max_seq_len
+        if kv_block is None:
+            kv_block = int(os.environ.get('SKYTPU_KV_BLOCK', '64') or 0)
+        self.kv_block = max(0, int(kv_block))
+        self.paged = self.kv_block > 0
+        if self.paged:
+            self.max_blocks = -(-self.max_len // self.kv_block)
+            # Gathered per-slot view length; >= max_len when max_len is
+            # not a block multiple (the overhang is always masked).
+            self.m_pad = self.max_blocks * self.kv_block
+            if kv_blocks is None:
+                kv_blocks = int(os.environ.get('SKYTPU_KV_BLOCKS', '0')
+                                or 0) or None
+            if kv_blocks is None:
+                kv_blocks = batch_slots * self.max_blocks + 1
+            self.kv_blocks = max(int(kv_blocks), 2)
+            self.allocator = paged_kv.BlockAllocator(
+                self.kv_blocks, self.kv_block, reserved=1)
+            # Legacy-API convenience: slots driven without an explicit
+            # table (tests, bench microloops) get a full-capacity
+            # assignment on first touch, cached so the same slot always
+            # maps the same ids (deterministic across engines).
+            self._auto_tables: Dict[int, jax.Array] = {}
+        else:
+            self.max_blocks = 0
+            self.m_pad = self.max_len
+            self.kv_blocks = 0
+            self.allocator = None
+            self._auto_tables = {}
         self._prefill = jax.jit(self._prefill_impl)
         self._prefill_chunk = jax.jit(self._prefill_chunk_impl,
                                       donate_argnums=(0,))
@@ -139,7 +204,9 @@ class DecodeEngine:
         # per decoded token, which quietly multiplied step latency ~4x in
         # the round-4 standalone decode bench. Callers passing scalars
         # must hit this cache; only genuinely per-slot arrays trace new.
-        self._scalar_sampling_cache: dict = {}
+        # LRU-bounded: the settings are CLIENT-supplied, so an unbounded
+        # dict is a slow device-memory leak under adversarial traffic.
+        self._scalar_sampling_cache: 'OrderedDict' = OrderedDict()
         # Step profiling (skytpu_engine_* series). None when metrics are
         # disabled: every instrumentation site below is ONE branch.
         self.profiler = (StepProfiler()
@@ -148,16 +215,89 @@ class DecodeEngine:
     # -- state --------------------------------------------------------------
     def init_state(self) -> DecodeState:
         c = self.config
-        shape = (c.num_layers, self.batch_slots, c.num_kv_heads,
-                 self.max_len, c.head_dim)
         b = self.batch_slots
+        if self.paged:
+            shape = (c.num_layers, self.kv_blocks, c.num_kv_heads,
+                     self.kv_block, c.head_dim)
+            tables = jnp.zeros((b, self.max_blocks), jnp.int32)
+        else:
+            shape = (c.num_layers, b, c.num_kv_heads, self.max_len,
+                     c.head_dim)
+            tables = jnp.zeros((b, 0), jnp.int32)
         return DecodeState(
             k=jnp.zeros(shape, c.dtype),
             v=jnp.zeros(shape, c.dtype),
             lengths=jnp.zeros((b,), jnp.int32),
             last_tokens=jnp.zeros((b,), jnp.int32),
             active=jnp.zeros((b,), bool),
+            block_tables=tables,
         )
+
+    # -- paged-KV host-side helpers -----------------------------------------
+    def _table_arg(self, slot: Optional[int],
+                   table_row) -> jax.Array:
+        """Device table row for an admission-path dispatch: the caller's
+        explicit assignment (scheduler), the slot's cached auto
+        assignment (legacy API), or an empty placeholder (contiguous)."""
+        if not self.paged:
+            return jnp.zeros((0,), jnp.int32)
+        if table_row is not None:
+            row = jnp.asarray(table_row, jnp.int32)
+            if row.shape != (self.max_blocks,):
+                raise ValueError(f'table row must be [{self.max_blocks}]'
+                                 f', got {row.shape}')
+            return row
+        return self._auto_table(slot)
+
+    def _auto_table(self, slot: int) -> jax.Array:
+        """Full-capacity block assignment for ``slot``, allocated once
+        and reused (so repeated admissions into one slot — and the same
+        admission order on two engines — map identical physical ids)."""
+        row = self._auto_tables.get(slot)
+        if row is None:
+            ids = self.allocator.alloc(self.max_blocks)
+            if ids is None:
+                raise RuntimeError(
+                    f'KV pool exhausted auto-assigning slot {slot}: '
+                    f'{self.allocator.available()} of '
+                    f'{self.allocator.capacity} blocks free')
+            row = jnp.asarray(ids, jnp.int32)
+            self._auto_tables[slot] = row
+        return row
+
+    def free_auto_tables(self) -> None:
+        """Release every auto-assigned slot's blocks back to the pool
+        (a scheduler that takes over explicit block management calls
+        this after warmup so auto assignments don't pin the pool)."""
+        if not self.paged:
+            return
+        for row in self._auto_tables.values():
+            self.allocator.deref([int(b) for b in row])
+        self._auto_tables.clear()
+
+    def reset_kv(self) -> None:
+        """Forget all host-side block state (crash recovery, paired with
+        a fresh ``init_state``)."""
+        if self.paged:
+            self.allocator.reset()
+            self._auto_tables.clear()
+
+    def _gather_slot(self, pool_layer: jax.Array,
+                     table_row: jax.Array) -> jax.Array:
+        """[NB, kvh, BS, d] pool gathered through [nb] -> [kvh, M, d]."""
+        g = pool_layer[table_row]           # [nb, kvh, BS, d]
+        g = g.transpose(1, 0, 2, 3)         # [kvh, nb, BS, d]
+        return g.reshape(g.shape[0], -1, g.shape[3])
+
+    def _gather_batch(self, pool_layer: jax.Array,
+                      tables: jax.Array) -> jax.Array:
+        """[NB, kvh, BS, d] pool gathered through [B, nb] ->
+        [B, kvh, M, d] — the paged decode read: per (slot, kv-head) the
+        rows land in table order, so downstream attention is identical
+        to the contiguous layout's."""
+        g = pool_layer[tables]              # [B, nb, kvh, BS, d]
+        g = g.transpose(0, 2, 1, 3, 4)      # [B, kvh, nb, BS, d]
+        return g.reshape(g.shape[0], g.shape[1], -1, g.shape[4])
 
     # -- prefill ------------------------------------------------------------
     def prefill(self, params: Params, tokens: jax.Array,
@@ -203,7 +343,8 @@ class DecodeEngine:
 
     # -- chunked prefill ----------------------------------------------------
     def prefill_chunk(self, params: Params, state: DecodeState,
-                      tokens: jax.Array, offset, slot) -> DecodeState:
+                      tokens: jax.Array, offset, slot,
+                      table_row=None) -> DecodeState:
         """Run ONE prompt chunk [C] at cache ``offset`` of ``slot``,
         writing its KV rows in place (donated state, one dispatch).
 
@@ -215,18 +356,25 @@ class DecodeEngine:
         chunk itself under a causal mask; rows past the chunk are masked,
         so stale cache contents cannot leak in. The slot stays INACTIVE
         (lengths 0) until the final chunk commits it, so concurrent
-        decode steps skip it."""
+        decode steps skip it.
+
+        Paged mode: rows are written through ``table_row`` (explicit
+        scheduler assignment, or the slot's auto assignment). A nonzero
+        ``offset`` whose leading blocks came from the prefix cache skips
+        their prefill entirely — the chunk's queries attend to the
+        SHARED blocks through the table."""
         if self.profiler is not None:
             self.profiler.note_variant('prefill_chunk', tokens.shape[0])
             self.profiler.prefill_tokens.inc(tokens.shape[0])
         return self._prefill_chunk(state, params, tokens,
                                    jnp.asarray(offset, jnp.int32),
-                                   jnp.asarray(slot, jnp.int32))
+                                   jnp.asarray(slot, jnp.int32),
+                                   self._table_arg(slot, table_row))
 
     def prefill_chunk_final(self, params: Params, state: DecodeState,
                             tokens: jax.Array, offset, slot, true_len,
                             rng: jax.Array, temperature: float = 0.0,
-                            top_k: int = 0
+                            top_k: int = 0, table_row=None
                             ) -> Tuple[DecodeState, jax.Array, jax.Array]:
         """Final chunk: forward + first-token sample + slot activation in
         ONE dispatch (the chunked counterpart of fused ``admit``).
@@ -242,9 +390,10 @@ class DecodeEngine:
             state, params, tokens, jnp.asarray(offset, jnp.int32),
             jnp.asarray(slot, jnp.int32),
             jnp.asarray(true_len, jnp.int32), rng,
-            jnp.float32(temperature), jnp.int32(top_k))
+            jnp.float32(temperature), jnp.int32(top_k),
+            self._table_arg(slot, table_row))
 
-    def _chunk_forward(self, state, params, tokens, offset, slot):
+    def _chunk_forward(self, state, params, tokens, offset, slot, table):
         """Shared traced body: chunk forward over prefix KV + in-place
         cache writes. Returns (x [1, C, e] final hidden, new_k, new_v)."""
         c = self.config
@@ -253,26 +402,45 @@ class DecodeEngine:
         positions = offset + jnp.arange(t)  # [C] absolute positions
         cos, sin = precompute_rotary(c.head_dim, c.max_seq_len, c.rope_theta)
         x = params['embed'][tokens][None].astype(c.dtype)  # [1, C, e]
-        kv_pos = jnp.arange(self.max_len)
+        kv_pos = jnp.arange(self.m_pad)
         # [C, M]: a chunk query at absolute position p sees kv rows <= p —
         # the prompt's own prefix chunks plus the causal part of this one.
         valid = kv_pos[None, :] <= positions[:, None]
         model = self.model
+        if self.paged:
+            # Per-row physical addresses for the chunk's writes.
+            blk = table[positions // self.kv_block]   # [C]
+            row = positions % self.kv_block           # [C]
+            kv_heads = jnp.arange(c.num_kv_heads)
 
         def layer(carry, inputs):
             x, cache_k, cache_v = carry
             lp, i = inputs
             q, k, v = model._qkv(lp, x, cos, sin, positions, constrain=False)
-            # [1, C, kvh, d] -> [1, 1, kvh, C, d]: one contiguous write at
-            # (layer i, slot, :, offset) in the head-major cache.
-            kf = k[0].transpose(1, 0, 2)[None, None]
-            vf = v[0].transpose(1, 0, 2)[None, None]
-            cache_k = lax.dynamic_update_slice(
-                cache_k, kf.astype(cache_k.dtype), (i, slot, 0, offset, 0))
-            cache_v = lax.dynamic_update_slice(
-                cache_v, vf.astype(cache_v.dtype), (i, slot, 0, offset, 0))
-            k_slot = cache_k[i, slot]  # [kvh, M, d]
-            v_slot = cache_v[i, slot]
+            if self.paged:
+                # Scatter the chunk's [C, kvh, d] rows through the block
+                # table (in-place on the donated carry).
+                cache_k = cache_k.at[i, blk[:, None], kv_heads[None, :],
+                                     row[:, None]].set(
+                    k[0].astype(cache_k.dtype))
+                cache_v = cache_v.at[i, blk[:, None], kv_heads[None, :],
+                                     row[:, None]].set(
+                    v[0].astype(cache_v.dtype))
+                k_slot = self._gather_slot(cache_k[i], table)  # [kvh,M,d]
+                v_slot = self._gather_slot(cache_v[i], table)
+            else:
+                # [1, C, kvh, d] -> [1, 1, kvh, C, d]: one contiguous
+                # write at (layer i, slot, :, offset) head-major.
+                kf = k[0].transpose(1, 0, 2)[None, None]
+                vf = v[0].transpose(1, 0, 2)[None, None]
+                cache_k = lax.dynamic_update_slice(
+                    cache_k, kf.astype(cache_k.dtype),
+                    (i, slot, 0, offset, 0))
+                cache_v = lax.dynamic_update_slice(
+                    cache_v, vf.astype(cache_v.dtype),
+                    (i, slot, 0, offset, 0))
+                k_slot = cache_k[i, slot]  # [kvh, M, d]
+                v_slot = cache_v[i, slot]
             # Grouped-query attention over the slot's cache rows, same
             # contiguous-[M, d] streaming pattern as the decode step.
             qg = q[0].reshape(t, c.num_kv_heads, grp, c.head_dim)
@@ -294,18 +462,28 @@ class DecodeEngine:
             (params['layers'], jnp.arange(c.num_layers)))
         return x, new_k, new_v
 
-    def _prefill_chunk_impl(self, state, params, tokens, offset, slot):
+    def _tables_with(self, state, slot, table) -> jax.Array:
+        """state.block_tables with ``slot``'s row set (paged only)."""
+        if not self.paged:
+            return state.block_tables
+        return state.block_tables.at[slot].set(table)
+
+    def _prefill_chunk_impl(self, state, params, tokens, offset, slot,
+                            table):
         _, new_k, new_v = self._chunk_forward(state, params, tokens,
-                                              offset, slot)
+                                              offset, slot, table)
         return DecodeState(k=new_k, v=new_v, lengths=state.lengths,
                            last_tokens=state.last_tokens,
-                           active=state.active)
+                           active=state.active,
+                           block_tables=self._tables_with(state, slot,
+                                                          table))
 
     def _prefill_chunk_final_impl(self, state, params, tokens, offset,
-                                  slot, true_len, rng, temperature, top_k):
+                                  slot, true_len, rng, temperature, top_k,
+                                  table):
         c = self.config
         x, new_k, new_v = self._chunk_forward(state, params, tokens,
-                                              offset, slot)
+                                              offset, slot, table)
         x = rms_norm(x, params['final_norm'], c.norm_eps)
         head = (params['embed'].T if c.tie_embeddings else params['lm_head'])
         # Logits only for the prompt's last REAL token (chunk-relative).
@@ -318,41 +496,63 @@ class DecodeEngine:
             lengths=state.lengths.at[slot].set(true_len),
             last_tokens=state.last_tokens.at[slot].set(first),
             active=state.active.at[slot].set(True),
+            block_tables=self._tables_with(state, slot, table),
         ), first, rng
 
     # -- insert -------------------------------------------------------------
     def insert(self, state: DecodeState, k: jax.Array, v: jax.Array,
                true_len: jax.Array, last_token: jax.Array,
-               slot: jax.Array) -> DecodeState:
+               slot: jax.Array, table_row=None) -> DecodeState:
         """Write a prefilled prompt's KV into ``slot`` and mark it active."""
         return self._insert(state, k, v, jnp.asarray(true_len, jnp.int32),
                             jnp.asarray(last_token, jnp.int32),
-                            jnp.asarray(slot, jnp.int32))
+                            jnp.asarray(slot, jnp.int32),
+                            self._table_arg(slot, table_row))
 
-    def _insert_impl(self, state, k, v, true_len, last_token, slot):
+    def _insert_impl(self, state, k, v, true_len, last_token, slot,
+                     table):
         t = k.shape[2]
         pad_m = self.max_len - t
         if pad_m < 0:
             raise ValueError(f'prefill length {t} exceeds max_len '
                              f'{self.max_len}')
-        # [L, kvh, T, d] -> [L, 1, kvh, M, d] zero-extended, then one
-        # dynamic_update_slice into the stacked cache (in-place: donated).
-        kf = jnp.pad(k, ((0, 0), (0, 0), (0, pad_m), (0, 0)))[:, None]
-        vf = jnp.pad(v, ((0, 0), (0, 0), (0, pad_m), (0, 0)))[:, None]
-        new_k = lax.dynamic_update_slice(state.k, kf.astype(state.k.dtype),
-                                         (0, slot, 0, 0, 0))
-        new_v = lax.dynamic_update_slice(state.v, vf.astype(state.v.dtype),
-                                         (0, slot, 0, 0, 0))
+        if self.paged:
+            # Scatter the T rows through the block table. Rows past the
+            # table's assignment hit the null block (index 0) — garbage
+            # there is never read unmasked.
+            pos = jnp.arange(t)
+            blk = table[pos // self.kv_block]
+            row = pos % self.kv_block
+            kv_heads = jnp.arange(self.config.num_kv_heads)
+            vals_k = k.transpose(0, 2, 1, 3)  # [L, T, kvh, d]
+            vals_v = v.transpose(0, 2, 1, 3)
+            new_k = state.k.at[:, blk[:, None], kv_heads[None, :],
+                               row[:, None]].set(
+                vals_k.astype(state.k.dtype))
+            new_v = state.v.at[:, blk[:, None], kv_heads[None, :],
+                               row[:, None]].set(
+                vals_v.astype(state.v.dtype))
+        else:
+            # [L, kvh, T, d] -> [L, 1, kvh, M, d] zero-extended, then one
+            # dynamic_update_slice into the stacked cache (in-place:
+            # donated).
+            kf = jnp.pad(k, ((0, 0), (0, 0), (0, pad_m), (0, 0)))[:, None]
+            vf = jnp.pad(v, ((0, 0), (0, 0), (0, pad_m), (0, 0)))[:, None]
+            new_k = lax.dynamic_update_slice(
+                state.k, kf.astype(state.k.dtype), (0, slot, 0, 0, 0))
+            new_v = lax.dynamic_update_slice(
+                state.v, vf.astype(state.v.dtype), (0, slot, 0, 0, 0))
         return DecodeState(
             k=new_k, v=new_v,
             lengths=state.lengths.at[slot].set(true_len),
             last_tokens=state.last_tokens.at[slot].set(last_token),
             active=state.active.at[slot].set(True),
+            block_tables=self._tables_with(state, slot, table),
         )
 
     def admit(self, params: Params, state: DecodeState, tokens: jax.Array,
               true_len: int, slot: int, rng: jax.Array,
-              temperature: float = 0.0, top_k: int = 0
+              temperature: float = 0.0, top_k: int = 0, table_row=None
               ) -> Tuple[DecodeState, jax.Array, jax.Array]:
         """Fused prefill + first-token sample + insert: ONE device
         dispatch per admission. Returns (state, first_token, next_rng).
@@ -368,19 +568,21 @@ class DecodeEngine:
         return self._admit(state, params, tokens,
                            jnp.asarray(true_len, jnp.int32),
                            jnp.asarray(slot, jnp.int32), rng,
-                           jnp.float32(temperature), jnp.int32(top_k))
+                           jnp.float32(temperature), jnp.int32(top_k),
+                           self._table_arg(slot, table_row))
 
     def _admit_impl(self, state, params, tokens, true_len, slot, rng,
-                    temperature, top_k):
+                    temperature, top_k, table):
         ks, vs, logits = self._prefill_impl(params, tokens, true_len)
         rng, sub = jax.random.split(rng)
         first = _sample(logits[None], sub, temperature, top_k)[0]
-        new_state = self._insert_impl(state, ks, vs, true_len, first, slot)
+        new_state = self._insert_impl(state, ks, vs, true_len, first, slot,
+                                      table)
         return new_state, first, rng
 
     def admit_many(self, params: Params, state: DecodeState,
                    tokens: jax.Array, true_lens, slots, rng: jax.Array,
-                   temperatures, top_ks
+                   temperatures, top_ks, table_rows=None
                    ) -> Tuple[DecodeState, jax.Array, jax.Array]:
         """Fused BATCHED prefill + first-token sample + insert for N
         same-bucket prompts: ONE device dispatch admits all of them.
@@ -397,15 +599,22 @@ class DecodeEngine:
             self.profiler.note_variant('admit_many', tokens.shape)
             self.profiler.prefill_tokens.inc(
                 tokens.shape[0] * tokens.shape[1])
+        if not self.paged:
+            tables = jnp.zeros((tokens.shape[0], 0), jnp.int32)
+        elif table_rows is not None:
+            tables = jnp.asarray(table_rows, jnp.int32)
+        else:
+            tables = jnp.stack([self._table_arg(int(s), None)
+                                for s in slots])
         return self._admit_many(
             state, params, tokens,
             jnp.asarray(true_lens, jnp.int32),
             jnp.asarray(slots, jnp.int32), rng,
             jnp.asarray(temperatures, jnp.float32),
-            jnp.asarray(top_ks, jnp.int32))
+            jnp.asarray(top_ks, jnp.int32), tables)
 
     def _admit_many_impl(self, state, params, tokens, true_lens, slots,
-                         rng, temperatures, top_ks):
+                         rng, temperatures, top_ks, tables):
         c = self.config
         n, t = tokens.shape
         positions = jnp.arange(t)
@@ -431,18 +640,39 @@ class DecodeEngine:
         logits = last @ head.astype(jnp.float32)            # [N, V]
         rng, sub = jax.random.split(rng)
         firsts = _sample(logits, sub, temperatures, top_ks)  # [N]
-        pad_m = self.max_len - t
-        kf = jnp.pad(ks, ((0, 0), (0, 0), (0, 0), (0, pad_m), (0, 0)))
-        vf = jnp.pad(vs, ((0, 0), (0, 0), (0, 0), (0, pad_m), (0, 0)))
-        # One scatter per cache half writes all N slots' [L, kvh, M, d]
-        # blocks (in-place: donated state).
-        new_k = state.k.at[:, slots].set(kf.astype(state.k.dtype))
-        new_v = state.v.at[:, slots].set(vf.astype(state.v.dtype))
+        if self.paged:
+            # Scatter all N prompts' [T] rows through their tables in
+            # one update per cache half (in-place: donated state).
+            pos = jnp.arange(t)
+            blk = jnp.take(tables, pos // self.kv_block, axis=1)  # [N,T]
+            row = pos % self.kv_block                             # [T]
+            kv_heads = jnp.arange(c.num_kv_heads)
+            vals_k = ks.transpose(0, 1, 3, 2, 4)  # [L, N, T, kvh, d]
+            vals_v = vs.transpose(0, 1, 3, 2, 4)
+            new_k = state.k.at[:, blk[:, :, None],
+                               kv_heads[None, None, :],
+                               row[None, :, None]].set(
+                vals_k.astype(state.k.dtype))
+            new_v = state.v.at[:, blk[:, :, None],
+                               kv_heads[None, None, :],
+                               row[None, :, None]].set(
+                vals_v.astype(state.v.dtype))
+            new_tables = state.block_tables.at[slots].set(tables)
+        else:
+            pad_m = self.max_len - t
+            kf = jnp.pad(ks, ((0, 0), (0, 0), (0, 0), (0, pad_m), (0, 0)))
+            vf = jnp.pad(vs, ((0, 0), (0, 0), (0, 0), (0, pad_m), (0, 0)))
+            # One scatter per cache half writes all N slots'
+            # [L, kvh, M, d] blocks (in-place: donated state).
+            new_k = state.k.at[:, slots].set(kf.astype(state.k.dtype))
+            new_v = state.v.at[:, slots].set(vf.astype(state.v.dtype))
+            new_tables = state.block_tables
         return DecodeState(
             k=new_k, v=new_v,
             lengths=state.lengths.at[slots].set(true_lens),
             last_tokens=state.last_tokens.at[slots].set(firsts),
             active=state.active.at[slots].set(True),
+            block_tables=new_tables,
         ), firsts, rng
 
     def release(self, state: DecodeState, slot: int) -> DecodeState:
@@ -454,10 +684,17 @@ class DecodeEngine:
         return self._release(state, jnp.asarray(slot, jnp.int32))
 
     def _release_impl(self, state, slot):
+        # Paged: clear the slot's table to the null block. Its old
+        # blocks may be freed and reassigned to another slot's NEXT
+        # admission; a stale table would let this (now inactive) slot's
+        # parked decode write land inside the new owner's live block.
+        tables = (state.block_tables.at[slot].set(0) if self.paged
+                  else state.block_tables)
         return DecodeState(k=state.k, v=state.v,
                            lengths=state.lengths.at[slot].set(0),
                            last_tokens=state.last_tokens,
-                           active=state.active.at[slot].set(False))
+                           active=state.active.at[slot].set(False),
+                           block_tables=tables)
 
     def sample_first(self, logits: jax.Array, rng: jax.Array,
                      temperature: float, top_k: int
@@ -517,9 +754,16 @@ class DecodeEngine:
         self.profiler.note_step(time.perf_counter() - t0)
         return out
 
+    # Distinct scalar (temperature, top_k) settings are CLIENT-supplied;
+    # bound the cache so adversarial traffic (every request a new float)
+    # cannot grow device arrays without limit. 32 entries covers any
+    # realistic settings mix; past it, least-recently-used settings
+    # rebuild their [B] array on next use (one extra dispatch).
+    SCALAR_SAMPLING_CACHE_MAX = 32
+
     def _scalar_sampling(self, value, dtype) -> jax.Array:
         """Device-resident [B] broadcast of a scalar sampling setting,
-        cached so repeated step() calls with scalar defaults dispatch
+        LRU-cached so repeated step() calls with scalar defaults dispatch
         exactly ONE device computation (the step itself)."""
         key = (value, dtype.__name__)
         cached = self._scalar_sampling_cache.get(key)
@@ -530,6 +774,11 @@ class DecodeEngine:
             # view; block so later steps pay zero transfer.
             cached.block_until_ready()
             self._scalar_sampling_cache[key] = cached
+            while (len(self._scalar_sampling_cache)
+                   > self.SCALAR_SAMPLING_CACHE_MAX):
+                self._scalar_sampling_cache.popitem(last=False)
+        else:
+            self._scalar_sampling_cache.move_to_end(key)
         return cached
 
     def _step_impl(self, params, state, rng, temperature, top_k):
@@ -541,7 +790,7 @@ class DecodeEngine:
         positions = state.lengths[:, None]  # [B, 1]: new token's position
         x = params['embed'][state.last_tokens][:, None].astype(c.dtype)
         rows = jnp.arange(b)
-        kv_pos = jnp.arange(self.max_len)
+        kv_pos = jnp.arange(self.m_pad)
         # New key written at index ``lengths`` -> valid keys are <= lengths.
         valid = kv_pos[None] <= state.lengths[:, None]  # [B, M]
         # INACTIVE slots park their (garbage) step-write at the LAST row
@@ -550,9 +799,20 @@ class DecodeEngine:
         # old unconditional write-at-lengths clobbered its row 0 on every
         # interleaved decode step. The last row is never read before
         # being rewritten: readers mask by kv_pos <= lengths, and a slot
-        # AT capacity rewrites that row itself before attending.
+        # AT capacity rewrites that row itself before attending. (Paged:
+        # a released slot's table is cleared to the null block, so a
+        # vacated slot's parked write can never land in a reassigned
+        # block; mid-prefill slots park inside their own assignment or
+        # the null block.)
         write_pos = jnp.where(state.active, state.lengths,
-                              self.max_len - 1)[:, None]  # [B, 1]
+                              self.max_len - 1)  # [B]
+        if self.paged:
+            # Physical address of each slot's write through its table.
+            phys_blk = jnp.take_along_axis(
+                state.block_tables,
+                (write_pos // self.kv_block)[:, None], axis=1)[:, 0]
+            phys_row = (write_pos % self.kv_block)[:, None]  # [B, 1]
+        write_pos = write_pos[:, None]  # [B, 1]
 
         model = self.model
 
@@ -562,17 +822,32 @@ class DecodeEngine:
             x, cache_k, cache_v = carry
             lp, i = inputs
             q, k, v = model._qkv(lp, x, cos, sin, positions, constrain=False)
-            # Scatter the new K/V row into layer i at each slot's length
-            # (in-place on the donated carry). Cache is [L,B,kvh,M,d];
-            # indices broadcast to [B, kvh] -> writes [B, kvh, d] rows.
-            cache_k = cache_k.at[i, rows[:, None], kv_heads[None, :],
-                                 write_pos].set(
-                k[:, 0].astype(cache_k.dtype))
-            cache_v = cache_v.at[i, rows[:, None], kv_heads[None, :],
-                                 write_pos].set(
-                v[:, 0].astype(cache_v.dtype))
-            k_layer = cache_k[i]  # [B, kvh, M, d]
-            v_layer = cache_v[i]
+            if self.paged:
+                # Block-indexed row scatter + gather of each slot's view
+                # through its table (indices broadcast to [B, kvh]).
+                cache_k = cache_k.at[i, phys_blk[:, None],
+                                     kv_heads[None, :], phys_row].set(
+                    k[:, 0].astype(cache_k.dtype))
+                cache_v = cache_v.at[i, phys_blk[:, None],
+                                     kv_heads[None, :], phys_row].set(
+                    v[:, 0].astype(cache_v.dtype))
+                k_layer = self._gather_batch(cache_k[i],
+                                             state.block_tables)
+                v_layer = self._gather_batch(cache_v[i],
+                                             state.block_tables)
+            else:
+                # Scatter the new K/V row into layer i at each slot's
+                # length (in-place on the donated carry). Cache is
+                # [L,B,kvh,M,d]; indices broadcast to [B, kvh] -> writes
+                # [B, kvh, d] rows.
+                cache_k = cache_k.at[i, rows[:, None], kv_heads[None, :],
+                                     write_pos].set(
+                    k[:, 0].astype(cache_k.dtype))
+                cache_v = cache_v.at[i, rows[:, None], kv_heads[None, :],
+                                     write_pos].set(
+                    v[:, 0].astype(cache_v.dtype))
+                k_layer = cache_k[i]  # [B, kvh, M, d]
+                v_layer = cache_v[i]
             # Grouped-query attention without repeating KV ([B,kvh,grp,d]);
             # per (b, kvh) the [M, d] operand is contiguous in HBM, and the
             # MXU accumulates bf16 x bf16 in f32 (preferred_element_type)
@@ -611,6 +886,7 @@ class DecodeEngine:
                                 self.max_len - 1),
             last_tokens=jnp.where(state.active, sampled, state.last_tokens),
             active=state.active,
+            block_tables=state.block_tables,
         ), sampled, rng
 
 

@@ -1,0 +1,338 @@
+"""Host-side bookkeeping for the paged KV cache: block allocator +
+hash-chain prefix cache.
+
+The device side (``models/decode.py``) holds KV as a global pool of
+fixed-size blocks ``[L, num_blocks, kvh, block, d]`` addressed through a
+per-slot block table; THIS module decides which physical block ids a
+sequence maps, entirely on the host — no device traffic. Two layers:
+
+- **BlockAllocator**: refcounted alloc/free over block ids. Ids are
+  handed out lowest-first (deterministic: two engines fed the same
+  admission order build identical tables, which the paged-vs-contiguous
+  oracle tests rely on). Block 0 is reserved as the *null block*: every
+  unassigned table entry points at it, inactive slots park their decode
+  write in its last row, and nothing ever reads it unmasked.
+- **Prefix cache** (inside the allocator, vLLM/RadixAttention style): a
+  map from a *hash chain* over full token blocks to the block id that
+  holds that prefix's KV. A new request whose leading blocks hit the
+  chain maps them into its table (refcount++, no copy — blocks are only
+  shared FULL, and writes land strictly past a sequence's shared
+  prefix, so copy-on-write never actually copies) and prefills only its
+  suffix. Released blocks that are cached stay resident with ref 0 on
+  an LRU; allocation evicts them only when the free list is dry, and
+  never evicts a referenced block.
+
+The chain hash of block i commits to every token of blocks 0..i (one
+running sha256 over the token stream), so a hash hit implies the whole
+prefix matches — no per-block token comparison on lookup.
+"""
+from __future__ import annotations
+
+import bisect
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from skypilot_tpu.utils import metrics as metrics_lib
+
+
+def hash_token_blocks(tokens: Sequence[int], block_size: int,
+                      n_blocks: Optional[int] = None) -> List[bytes]:
+    """Chain hashes for the first ``n_blocks`` FULL blocks of ``tokens``
+    (default: every full block). ``hash[i]`` commits to tokens
+    ``[0, (i+1)*block_size)`` — a running digest, so matching hash[i]
+    implies hashes 0..i-1 matched too."""
+    if n_blocks is None:
+        n_blocks = len(tokens) // block_size
+    h = hashlib.sha256()
+    out: List[bytes] = []
+    for i in range(n_blocks):
+        block = tokens[i * block_size:(i + 1) * block_size]
+        h.update(b''.join(int(t).to_bytes(8, 'little', signed=True)
+                          for t in block))
+        out.append(h.digest())
+    return out
+
+
+def blocks_for(rows: int, block_size: int) -> int:
+    """Blocks needed to hold ``rows`` KV rows."""
+    return -(-max(0, rows) // block_size)
+
+
+class _KvMetrics:
+    """skytpu_engine_kv_* family on the process default registry."""
+
+    def __init__(self):
+        self.pool_blocks = metrics_lib.gauge(
+            'skytpu_engine_kv_pool_blocks_count',
+            'allocatable KV blocks in the pool')
+        self.used_blocks = metrics_lib.gauge(
+            'skytpu_engine_kv_used_blocks_count',
+            'KV blocks currently referenced by a slot')
+        self.utilization = metrics_lib.gauge(
+            'skytpu_engine_kv_block_utilization_ratio',
+            'referenced blocks / pool blocks')
+        self.prefix_lookups = metrics_lib.counter(
+            'skytpu_engine_kv_prefix_lookups_total',
+            'prefix-cache lookups at admission')
+        self.prefix_hits = metrics_lib.counter(
+            'skytpu_engine_kv_prefix_hits_total',
+            'admissions that reused >= 1 cached block')
+        self.lookup_tokens = metrics_lib.counter(
+            'skytpu_engine_kv_prefix_lookup_tokens_total',
+            'prompt tokens submitted to prefix lookup')
+        self.hit_tokens = metrics_lib.counter(
+            'skytpu_engine_kv_prefix_hit_tokens_total',
+            'prompt tokens served from cached blocks '
+            '(prefill work skipped)')
+        self.evictions = metrics_lib.counter(
+            'skytpu_engine_kv_evictions_total',
+            'cached unreferenced blocks evicted to satisfy an '
+            'allocation')
+
+
+class BlockAllocator:
+    """Refcounted block ids + prefix cache with LRU eviction.
+
+    Thread-safe: the serving scheduler mutates from its own thread while
+    HTTP handler threads peek (``match``) for admission estimates.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int,
+                 reserved: int = 1):
+        if num_blocks <= reserved:
+            raise ValueError(f'pool needs > {reserved} blocks, got '
+                             f'{num_blocks}')
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.reserved = reserved
+        self._lock = threading.Lock()
+        self._m = _KvMetrics() if metrics_lib.enabled() else None
+        self._init_tables()
+        if self._m is not None:
+            self._m.pool_blocks.set(self.capacity)
+
+    def _init_tables(self) -> None:
+        self._free: List[int] = list(range(self.reserved,
+                                           self.num_blocks))
+        self._ref: Dict[int, int] = {}
+        self._hash_to_block: Dict[bytes, int] = {}
+        self._block_hash: Dict[int, bytes] = {}
+        # ref-0 blocks still registered in the prefix cache, oldest
+        # (least recently touched) first — the eviction order.
+        self._lru: 'OrderedDict[int, None]' = OrderedDict()
+        self.counters = {'lookups': 0, 'hits': 0, 'lookup_tokens': 0,
+                         'hit_tokens': 0, 'evictions': 0}
+
+    # -- capacity -----------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return self.num_blocks - self.reserved
+
+    def available(self) -> int:
+        """Blocks allocatable right now (free + evictable)."""
+        with self._lock:
+            return len(self._free) + len(self._lru)
+
+    def used(self) -> int:
+        with self._lock:
+            return len(self._ref)
+
+    # -- alloc / free -------------------------------------------------------
+    def _alloc_locked(self, n: int) -> List[int]:
+        """``n`` blocks with ref 1 each; caller holds the lock and has
+        already checked availability."""
+        out: List[int] = []
+        for _ in range(n):
+            if self._free:
+                blk = self._free.pop(0)
+            else:
+                blk, _ = self._lru.popitem(last=False)  # LRU evict
+                h = self._block_hash.pop(blk)
+                del self._hash_to_block[h]
+                self.counters['evictions'] += 1
+                if self._m is not None:
+                    self._m.evictions.inc()
+            self._ref[blk] = 1
+            out.append(blk)
+        return out
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` fresh blocks with ref 1 each, or None if the pool (free
+        + evictable) cannot satisfy the request — nothing is taken on
+        failure, so callers can retry after a release."""
+        if n <= 0:
+            return []
+        with self._lock:
+            if len(self._free) + len(self._lru) < n:
+                return None
+            out = self._alloc_locked(n)
+            self._update_gauges_locked()
+            return out
+
+    def reserve(self, hashes: Sequence[bytes], total_blocks: int
+                ) -> Optional[Tuple[List[int], List[int]]]:
+        """One admission's whole reservation, atomically: longest cached
+        chain prefix ref'd + fresh blocks for the rest, or None with
+        NOTHING taken (and nothing recorded) when the pool can't satisfy
+        it. Metrics/counters record only on success, so a pool-dry
+        request retried every scheduler tick counts ONE lookup when it
+        finally admits — not one per retry — and its failed attempts
+        don't churn cached blocks to the LRU tail. Returns
+        (cached_ids, new_ids)."""
+        with self._lock:
+            cached = self._match_locked(hashes)
+            need = total_blocks - len(cached)
+            # Ref'ing a cached ref-0 block removes it from the LRU, so
+            # it cannot also back a fresh allocation.
+            evictable = len(self._lru) - sum(1 for b in cached
+                                             if b in self._lru)
+            if len(self._free) + evictable < need:
+                return None
+            for blk in cached:
+                cur = self._ref.get(blk, 0)
+                if cur == 0:
+                    self._lru.pop(blk, None)
+                self._ref[blk] = cur + 1
+            new = self._alloc_locked(need)
+            self._record_lookup_locked(len(hashes), len(cached))
+            self._update_gauges_locked()
+            return cached, new
+
+    def ref_blocks(self, blocks: Sequence[int]) -> None:
+        """Take an additional reference on already-live or cached
+        blocks (prefix sharing)."""
+        with self._lock:
+            for blk in blocks:
+                cur = self._ref.get(blk, 0)
+                if cur == 0:
+                    # Leaving the LRU: referenced again.
+                    self._lru.pop(blk, None)
+                self._ref[blk] = cur + 1
+            self._update_gauges_locked()
+
+    def deref(self, blocks: Sequence[int]) -> None:
+        """Drop one reference per block; ref-0 cached blocks become
+        evictable (LRU tail = most recently released), uncached ones
+        return to the free list."""
+        with self._lock:
+            for blk in blocks:
+                cur = self._ref.get(blk)
+                if cur is None:
+                    raise ValueError(f'deref of unreferenced block {blk}')
+                if cur > 1:
+                    self._ref[blk] = cur - 1
+                    continue
+                del self._ref[blk]
+                if blk in self._block_hash:
+                    self._lru[blk] = None
+                else:
+                    bisect.insort(self._free, blk)
+            self._update_gauges_locked()
+
+    # -- prefix cache -------------------------------------------------------
+    def _match_locked(self, hashes: Sequence[bytes]) -> List[int]:
+        out: List[int] = []
+        for h in hashes:
+            blk = self._hash_to_block.get(h)
+            if blk is None:
+                break
+            out.append(blk)
+        return out
+
+    def _record_lookup_locked(self, n_hashes: int, n_matched: int
+                              ) -> None:
+        """One admission's lookup in the hit-rate series — counted at
+        reservation time only (estimator peeks via ``match`` stay
+        silent, and a pool-dry retry loop records nothing until it
+        finally admits)."""
+        self.counters['lookups'] += 1
+        self.counters['lookup_tokens'] += n_hashes * self.block_size
+        if self._m is not None:
+            self._m.prefix_lookups.inc()
+            self._m.lookup_tokens.inc(n_hashes * self.block_size)
+        if n_matched:
+            self.counters['hits'] += 1
+            self.counters['hit_tokens'] += n_matched * self.block_size
+            if self._m is not None:
+                self._m.prefix_hits.inc()
+                self._m.hit_tokens.inc(n_matched * self.block_size)
+
+    def match(self, hashes: Sequence[bytes]) -> List[int]:
+        """Longest cached chain prefix -> block ids. Read-only: no refs
+        taken, nothing recorded — the estimator's peek."""
+        with self._lock:
+            return self._match_locked(hashes)
+
+    def match_and_ref(self, hashes: Sequence[bytes]) -> List[int]:
+        """match() + take a reference on every matched block, atomically
+        (a concurrent eviction between match and ref would hand the
+        caller a block about to be reused). Records the lookup."""
+        with self._lock:
+            out = self._match_locked(hashes)
+            for blk in out:
+                cur = self._ref.get(blk, 0)
+                if cur == 0:
+                    self._lru.pop(blk, None)
+                self._ref[blk] = cur + 1
+            self._record_lookup_locked(len(hashes), len(out))
+            self._update_gauges_locked()
+            return out
+
+    def commit(self, hashes: Sequence[bytes],
+               blocks: Sequence[int]) -> None:
+        """Register (hash, block) pairs after their KV has been
+        dispatched. First writer wins: a hash already cached keeps its
+        existing block (the duplicate's copy stays private and frees
+        normally). Only referenced blocks may be committed — the caller
+        still holds the admitting sequence's ref."""
+        with self._lock:
+            for h, blk in zip(hashes, blocks):
+                if h in self._hash_to_block:
+                    continue
+                if self._ref.get(blk, 0) <= 0:
+                    raise ValueError(
+                        f'commit of unreferenced block {blk}')
+                if blk in self._block_hash:
+                    continue  # already caches a different chain position
+                self._hash_to_block[h] = blk
+                self._block_hash[blk] = h
+
+    # -- maintenance --------------------------------------------------------
+    def reset(self) -> None:
+        """Forget everything (crash recovery alongside a fresh
+        ``init_state``)."""
+        with self._lock:
+            self._init_tables()
+            self._update_gauges_locked()
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            used = len(self._ref)
+            cap = self.capacity
+            lk = self.counters['lookup_tokens']
+            return {
+                'kv_block': self.block_size,
+                'kv_blocks_total': cap,
+                'kv_blocks_free': len(self._free) + len(self._lru),
+                'kv_blocks_used': used,
+                'kv_block_utilization': round(used / cap, 4) if cap
+                else 0.0,
+                'prefix_cache_blocks': len(self._hash_to_block),
+                'prefix_lookups': self.counters['lookups'],
+                'prefix_hits': self.counters['hits'],
+                'prefix_hit_tokens': self.counters['hit_tokens'],
+                'prefix_lookup_tokens': lk,
+                'prefix_hit_rate': (round(
+                    self.counters['hit_tokens'] / lk, 4) if lk else 0.0),
+                'prefix_evictions': self.counters['evictions'],
+            }
+
+    def _update_gauges_locked(self) -> None:
+        if self._m is None:
+            return
+        used = len(self._ref)
+        self._m.used_blocks.set(used)
+        if self.capacity:
+            self._m.utilization.set(used / self.capacity)

@@ -40,6 +40,7 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu.models import paged_kv
 from skypilot_tpu.models.decode import (DecodeEngine, chunk_spans,
                                         prefill_bucket)
 from skypilot_tpu.models.llama import PRESETS, LlamaConfig, LlamaModel
@@ -117,7 +118,7 @@ class _Request:
                  'out_queue', 'submitted_at', 'first_token_at', 'done',
                  'error', 'prompt_len', 'emitted', 'admit_started_at',
                  'prefill_settled', 'request_id', 'est_ttft_ms',
-                 'last_token_at')
+                 'last_token_at', 'prefill_cost', 'block_hashes')
 
     def __init__(self, tokens, max_tokens, temperature, top_k, eos_id,
                  request_id: Optional[str] = None):
@@ -141,6 +142,18 @@ class _Request:
         self.request_id = request_id  # LB-assigned trace correlation id
         self.est_ttft_ms: Optional[float] = None  # admission estimate
         self.last_token_at: Optional[float] = None  # feeds TPOT metric
+        # Prefill tokens this request actually costs (prompt clamped to
+        # the cache, minus its prefix-cache hit). Computed ONCE at
+        # reservation/submit and reused by every accounting site, so
+        # cache churn between check and settle can't unbalance the
+        # admission estimator's backlog.
+        self.prefill_cost: Optional[int] = None
+        # Full sha256 chain over the clamped prompt's full blocks,
+        # computed once (admission_check or first block prep) and
+        # reused by the estimator peek, the reservation match, and the
+        # prefix-cache commit — hashing a 2500-token prompt three times
+        # per admission was measurable scheduler-thread work.
+        self.block_hashes: Optional[List[bytes]] = None
 
     def fail(self, msg: str) -> None:
         self.error = msg
@@ -194,7 +207,9 @@ class GenerationScheduler:
                  model: Any = None,
                  prefill_chunk: Optional[int] = None,
                  prefill_budget: Optional[int] = None,
-                 ttft_slo_ms: Optional[float] = None):
+                 ttft_slo_ms: Optional[float] = None,
+                 kv_block: Optional[int] = None,
+                 kv_blocks: Optional[int] = None):
         """``model`` serves a non-Llama family through the same engine
         (e.g. a MixtralModel for MoE decode via its _mlp_delta).
 
@@ -209,13 +224,33 @@ class GenerationScheduler:
         queue wait would blow the TTFT SLO, so an overloaded replica
         sheds load instead of queueing blind. Chunked mode supersedes
         $SKYTPU_ADMIT_BATCH fusion (chunks already bound the stall).
+
+        ``kv_block`` ($SKYTPU_KV_BLOCK, default 64; 0 = contiguous
+        per-slot KV) / ``kv_blocks`` ($SKYTPU_KV_BLOCKS, default = the
+        contiguous HBM budget): paged-KV pool geometry. With paging on,
+        admission is **block-budget** admission: each request reserves
+        ceil(min(prompt+max_tokens, max_len)/block) physical blocks
+        minus its prefix-cache hit, and a request the pool cannot serve
+        right now waits head-of-line (FCFS) until a release frees
+        blocks — so ``batch_slots`` can exceed what contiguous slots
+        would fit in the same HBM, and admitted concurrency follows the
+        ACTUAL sequence lengths. Requests whose leading full blocks hit
+        the prefix cache map those blocks shared and prefill only their
+        suffix.
         """
         import jax
         self.config = config
         self.params = params
         self.engine = DecodeEngine(config, batch_slots=batch_slots,
-                                   max_len=max_len, model=model)
+                                   max_len=max_len, model=model,
+                                   kv_block=kv_block, kv_blocks=kv_blocks)
         self.state = self.engine.init_state()
+        # Paged-KV scheduler state: explicit per-slot block assignments
+        # (slot -> block ids to deref when the slot vacates) and the
+        # head-of-line request waiting for pool blocks. Both are
+        # scheduler-thread-owned.
+        self._slot_kv: Dict[int, List[int]] = {}
+        self._blocked: Optional[_Request] = None
         self._rng = jax.random.key(0)
         self.prefill_chunk = int(
             prefill_chunk if prefill_chunk is not None
@@ -298,13 +333,63 @@ class GenerationScheduler:
         self._wake.set()
         self._emit_event.set()
 
-    def _prefill_cost(self, n_tokens: int) -> int:
-        """Prefill work a prompt actually costs: prompts are truncated
-        to max_len - 1 at admission, so the admission estimator must
-        count the clamped length — otherwise one absurdly long prompt
-        inflates the backlog by tokens that will never be prefilled and
-        mass-429s the replica."""
-        return min(n_tokens, self.engine.max_len - 1)
+    def _prefill_cost(self, tokens) -> int:
+        """Prefill work a prompt actually costs. Two discounts keep the
+        admission estimator honest: prompts are truncated to max_len - 1
+        at admission, so the clamped length is counted (one absurdly
+        long prompt must not inflate the backlog by tokens that will
+        never be prefilled), and with the prefix cache on, leading full
+        blocks already cached are work this prompt will SKIP — counting
+        them would 429 exactly the cheap requests prefix reuse exists
+        to make cheap. Accepts the _Request (hash chain pinned +
+        reused), the token list, or a bare length (legacy callers: no
+        prefix discount)."""
+        if isinstance(tokens, int):
+            n = min(tokens, self.engine.max_len - 1)
+            return max(1, n)
+        req = tokens if isinstance(tokens, _Request) else None
+        toks = req.tokens if req is not None else tokens
+        n = min(len(toks), self.engine.max_len - 1)
+        cached = self._peek_cached_tokens(toks, req)
+        return max(1, n - min(cached, n - 1))
+
+    def _block_hashes(self, req: _Request) -> List[bytes]:
+        """The request's full-block sha256 chain over its clamped
+        prompt, computed once and pinned — the estimator peek, the
+        reservation match, and the prefix-cache commit all reuse it
+        (hashing a 2500-token prompt three times per admission was
+        avoidable scheduler-thread work)."""
+        if req.block_hashes is None:
+            eng = self.engine
+            prompt = req.tokens[:eng.max_len - 1]
+            req.block_hashes = paged_kv.hash_token_blocks(prompt,
+                                                          eng.kv_block)
+        return req.block_hashes
+
+    def _match_cap(self, plen: int) -> int:
+        """Blocks eligible for prefix matching: never the whole prompt
+        — at least one token always prefills (its logits sample the
+        first generated token)."""
+        return (plen - 1) // self.engine.kv_block
+
+    def _peek_cached_tokens(self, tokens,
+                            req: Optional[_Request] = None) -> int:
+        """Longest cached prefix (tokens) for a prompt, read-only — the
+        admission estimator's view; no refs taken, no hit-rate metrics
+        recorded (the admit-time reservation records)."""
+        eng = self.engine
+        if not eng.paged:
+            return 0
+        plen = min(len(tokens), eng.max_len - 1)
+        n_hash = self._match_cap(plen)
+        if n_hash <= 0:
+            return 0
+        if req is not None:
+            hashes = self._block_hashes(req)[:n_hash]
+        else:
+            hashes = paged_kv.hash_token_blocks(tokens, eng.kv_block,
+                                                n_hash)
+        return len(eng.allocator.match(hashes)) * eng.kv_block
 
     def submit(self, req: _Request, reserved: bool = False) -> None:
         """``reserved``: the caller already accounted this request's
@@ -314,14 +399,15 @@ class GenerationScheduler:
         self.counters['requests'] += 1
         if self._m is not None:
             self._m.requests.inc()
+        if req.prefill_cost is None:
+            req.prefill_cost = self._prefill_cost(req)
         if not reserved:
             with self._backlog_lock:
-                self._backlog_tokens += self._prefill_cost(
-                    len(req.tokens))
+                self._backlog_tokens += req.prefill_cost
         self._pending.put(req)
         self._wake.set()
 
-    def admission_check(self, prompt_len: int) -> Optional[Dict[str, Any]]:
+    def admission_check(self, request) -> Optional[Dict[str, Any]]:
         """SLO-gated early reject: estimate this request's TTFT (queue
         wait ahead of it + its own prefill) over the measured effective
         prefill rate; past the SLO, refuse NOW (the caller answers HTTP
@@ -338,8 +424,17 @@ class GenerationScheduler:
         whatever congestion existed at admit time, so after a burst
         drains it can sit depressed; rejecting on it while idle would
         livelock (nothing admits, so the EMA never re-learns). An idle
-        replica admits, re-measures, recovers."""
-        cost = self._prefill_cost(prompt_len)
+        replica admits, re-measures, recovers.
+
+        ``request``: the parsed _Request (its discounted prefill cost is
+        computed here once and pinned on the request so every later
+        accounting site uses the same number) or a bare prompt length
+        (legacy callers, no prefix discount)."""
+        if isinstance(request, _Request):
+            cost = self._prefill_cost(request)
+            request.prefill_cost = cost
+        else:
+            cost = self._prefill_cost(request)
         rate = self._prefill_rate
         with self._backlog_lock:
             if self.ttft_slo_ms > 0 and rate and rate > 0:
@@ -372,11 +467,12 @@ class GenerationScheduler:
     def stats(self) -> Dict[str, Any]:
         pending = self._pending.qsize()
         active = sum(r is not None and not r.done for r in self._slots)
+        blocked = 1 if self._blocked is not None else 0
         with self._backlog_lock:
             prefill_tokens = (self._backlog_tokens
                               + self._inflight_prefill_tokens)
         rate = self._prefill_rate
-        return {
+        out = {
             'slots_total': self.engine.batch_slots,
             # A slot whose request finished but whose release hasn't been
             # applied yet is not "active" to callers.
@@ -384,14 +480,23 @@ class GenerationScheduler:
             'pending': pending,
             'emit_backlog': len(self._emit_q),
             # Queue-depth signal for the load balancer's least_load
-            # policy: requests holding or waiting for replica capacity.
-            'queue_depth': pending + active + len(self._chunking),
+            # policy: requests holding or waiting for replica capacity
+            # (incl. the head-of-line request waiting for KV blocks).
+            'queue_depth': pending + active + len(self._chunking)
+                           + blocked,
             'pending_prefill_tokens': prefill_tokens,
             'prefill_chunk': self.prefill_chunk,
             'ttft_slo_ms': self.ttft_slo_ms,
             'prefill_tokens_per_s': round(rate, 1) if rate else None,
             **self.counters,
         }
+        if self.engine.paged:
+            # Block-pool + prefix-cache series: kv_block_utilization and
+            # prefix_hit_rate are the serve_bench prefix-arm record
+            # fields and the capacity signal block-budget admission
+            # exposes to the LB/autoscaler.
+            out.update(self.engine.allocator.stats())
+        return out
 
     def _ttft_estimate_locked(self, cost: int, rate: float,
                               queued: int) -> tuple:
@@ -414,7 +519,7 @@ class GenerationScheduler:
             wait_s = max(wait_s, pending_ahead * ri)
         return wait_s, (wait_s + cost / rate) * 1e3
 
-    def estimate_ttft_ms(self, prompt_len: int) -> Optional[float]:
+    def estimate_ttft_ms(self, request) -> Optional[float]:
         """TTFT estimate for a request whose prefill cost is ALREADY
         reserved in the backlog (i.e. right after a successful
         admission_check) — the gate's own model, re-evaluated with the
@@ -422,11 +527,17 @@ class GenerationScheduler:
         the request and compared with the measured TTFT at first-token
         time (skytpu_serve_ttft_estimate_error_ms, the estimator-quality
         signal SLO autoscaling will consume). None without rate
-        evidence."""
+        evidence. Accepts the _Request (reuses its pinned discounted
+        cost) or a bare prompt length."""
         rate = self._prefill_rate
         if not rate or rate <= 0:
             return None
-        cost = self._prefill_cost(prompt_len)
+        if isinstance(request, _Request):
+            cost = (request.prefill_cost
+                    if request.prefill_cost is not None
+                    else self._prefill_cost(request))
+        else:
+            cost = self._prefill_cost(request)
         with self._backlog_lock:
             queued = max(0, self._backlog_tokens
                          + self._inflight_prefill_tokens - cost)
@@ -487,13 +598,17 @@ class GenerationScheduler:
         self.state, sampled, self._rng = eng.step(self.params, self.state,
                                                   self._rng)
         int(sampled[0])  # scalar fetch: the one reliable sync everywhere
+        # Warmup drove the engine through its legacy auto-assignment;
+        # hand the blocks back — admissions below reserve explicitly.
+        eng.free_auto_tables()
         self.warm.set()
 
     def _take_pending(self) -> _Request:
         """Pop one queued request, keeping the admission estimator's
         backlog in sync and stamping the prefill-rate probe's start."""
         req = self._pending.get()
-        cost = self._prefill_cost(len(req.tokens))
+        cost = (req.prefill_cost if req.prefill_cost is not None
+                else self._prefill_cost(len(req.tokens)))
         with self._backlog_lock:
             self._backlog_tokens = max(0, self._backlog_tokens - cost)
             # A popped request's prefill is OUTSTANDING (dispatched or
@@ -539,13 +654,82 @@ class GenerationScheduler:
         once-guard lives INSIDE the lock: the emitter (first token) and
         the scheduler (failure paths) can race here, and a double
         subtract would leave the admission estimator under-counting."""
-        cost = self._prefill_cost(len(req.tokens))
+        cost = (req.prefill_cost if req.prefill_cost is not None
+                else self._prefill_cost(len(req.tokens)))
         with self._backlog_lock:
             if req.admit_started_at is None or req.prefill_settled:
                 return
             req.prefill_settled = True
             self._inflight_prefill_tokens = max(
                 0, self._inflight_prefill_tokens - cost)
+
+    # -- paged-KV block assignment ------------------------------------------
+    def _prepare_blocks(self, req: _Request, prompt: List[int]):
+        """Reserve this request's KV blocks (paged mode): prefix-cache
+        hit blocks mapped shared (refcounted, no prefill) + fresh blocks
+        for the suffix and decode rows. Returns the prep dict; ``None``
+        when the pool cannot satisfy it right now (the caller stashes
+        the request head-of-line and retries after a release); ``False``
+        when the request can NEVER fit (failed here). Contiguous mode
+        returns an empty prep (slot = region, nothing to reserve)."""
+        eng = self.engine
+        if not eng.paged:
+            return {'table': None, 'blocks': [], 'cached': 0,
+                    'commit': ((), ())}
+        plen = len(prompt)
+        rows = min(plen + max(req.max_tokens, 1), eng.max_len)
+        total_blocks = paged_kv.blocks_for(rows, eng.kv_block)
+        if total_blocks > eng.allocator.capacity:
+            self._settle_prefill(req)
+            req.fail(f'request needs {total_blocks} KV blocks; pool '
+                     f'holds {eng.allocator.capacity}')
+            return False
+        full_chain = self._block_hashes(req)
+        reservation = eng.allocator.reserve(
+            full_chain[:self._match_cap(plen)], total_blocks)
+        if reservation is None:
+            return None
+        cached_ids, new_ids = reservation
+        ids = cached_ids + new_ids
+        table = ids + [0] * (eng.max_blocks - len(ids))
+        # Commit candidates: every FULL prompt block (decode rows are
+        # not cached). Registered only after the prefill that fills
+        # them has been dispatched.
+        n_full = plen // eng.kv_block
+        return {'table': table, 'blocks': ids,
+                'cached': len(cached_ids) * eng.kv_block,
+                'commit': (full_chain[:n_full], ids[:n_full])}
+
+    def _commit_prefix(self, prep) -> None:
+        hashes, ids = prep['commit']
+        if hashes:
+            self.engine.allocator.commit(hashes, ids)
+
+    def _free_prep(self, prep) -> None:
+        """Back out a reservation whose admission dispatch failed."""
+        if prep and prep['blocks']:
+            self.engine.allocator.deref(prep['blocks'])
+
+    def _free_slot_kv(self, slot: int) -> None:
+        """Drop the vacating slot's block references. Called exactly
+        where the slot is released on device: dispatch order guarantees
+        any reuse's writes land after the released sequence's reads."""
+        ids = self._slot_kv.pop(slot, None)
+        if ids:
+            self.engine.allocator.deref(ids)
+
+    def _next_admittable(self) -> Optional[_Request]:
+        """Head-of-line pop: the request stalled on KV blocks retries
+        before anything newer (FCFS)."""
+        if self._blocked is not None:
+            req, self._blocked = self._blocked, None
+            return req
+        if not self._pending.empty():
+            return self._take_pending()
+        return None
+
+    def _has_admittable(self) -> bool:
+        return self._blocked is not None or not self._pending.empty()
 
     def _admit(self) -> None:
         if self.prefill_chunk > 0:
@@ -574,19 +758,35 @@ class GenerationScheduler:
             if spent >= budget:
                 return
             spent = self._advance_chunks(slot, spent, budget)
-        while spent < budget and not self._pending.empty():
+        while spent < budget and self._has_admittable():
             free = [i for i, r in enumerate(self._slots)
                     if r is None and i not in self._chunking]
             if not free:
                 return
-            req = self._take_pending()
+            req = self._next_admittable()
+            if req is None:
+                return
             prompt = req.tokens[:self.engine.max_len - 1]
             req.prompt_len = len(prompt)
+            prep = self._prepare_blocks(req, prompt)
+            if prep is False:
+                continue  # can never fit: failed, try the next request
+            if prep is None:
+                # Pool dry: wait head-of-line for a release to free
+                # blocks — block-budget admission's backpressure point.
+                self._blocked = req
+                return
             slot = free[0]
-            spans = chunk_spans(len(prompt), self.prefill_chunk,
-                                self.engine.max_len)
+            cached = prep['cached']
+            # Prefix-cache hit: the cached blocks are mapped shared, so
+            # prefill spans cover only the suffix [cached, plen).
+            spans = [(cached + off, bucket, final)
+                     for off, bucket, final in
+                     chunk_spans(len(prompt) - cached, self.prefill_chunk,
+                                 self.engine.max_len - cached)]
             self._chunking[slot] = {'req': req, 'prompt': prompt,
-                                    'spans': spans, 'next': 0}
+                                    'spans': spans, 'next': 0,
+                                    'prep': prep}
             spent = self._advance_chunks(slot, spent, budget)
 
     def _advance_chunks(self, slot: int, spent: int, budget: int) -> int:
@@ -598,6 +798,8 @@ class GenerationScheduler:
         eng = self.engine
         prog = self._chunking[slot]
         req, prompt, spans = prog['req'], prog['prompt'], prog['spans']
+        prep = prog.get('prep')
+        table = prep['table'] if prep else None
         while prog['next'] < len(spans):
             off, bucket, final = spans[prog['next']]
             if spent and spent + bucket > budget:
@@ -610,10 +812,12 @@ class GenerationScheduler:
                 if final:
                     self.state, first, self._rng = eng.prefill_chunk_final(
                         self.params, self.state, padded, off, slot,
-                        len(prompt), self._rng, req.temperature, req.top_k)
+                        len(prompt), self._rng, req.temperature, req.top_k,
+                        table_row=table)
                 else:
                     self.state = eng.prefill_chunk(
-                        self.params, self.state, padded, off, slot)
+                        self.params, self.state, padded, off, slot,
+                        table_row=table)
             except Exception as e:  # noqa: BLE001 — fail THIS req
                 self._drop_chunking(slot)
                 req.fail(f'prefill failed: {e!r}')
@@ -630,6 +834,12 @@ class GenerationScheduler:
             prog['next'] += 1
             if final:
                 del self._chunking[slot]
+                if prep and prep['blocks']:
+                    self._slot_kv[slot] = prep['blocks']
+                    # Register the prompt's full blocks in the prefix
+                    # cache now that their writes are dispatched (any
+                    # later reader's gather is ordered after them).
+                    self._commit_prefix(prep)
                 self._slots[slot] = req
                 self._dispatched[slot] = 0
                 self._queue_emission(('first', first, req, slot))
@@ -637,9 +847,26 @@ class GenerationScheduler:
 
     def _drop_chunking(self, slot: int) -> None:
         """Abandon a mid-prefill slot (its partial KV rows are dead: the
-        slot is still device-inactive and any reuse overwrites them)."""
+        slot is still device-inactive and any reuse overwrites them;
+        its block reservation goes straight back to the pool).
+
+        The slot's device table row must be CLEARED before the blocks
+        free: chunk dispatches already wrote it, and an inactive slot
+        parks its per-step garbage write at row max_len-1 *through its
+        table* — a stale full-length table would scatter that write
+        into whoever gets the freed blocks next. (Release does the same
+        clear for finished requests.) A failing release dispatch is
+        survivable here: the crash-recovery caller replaces the whole
+        state anyway."""
         prog = self._chunking.pop(slot, None)
         if prog is not None:
+            prep = prog.get('prep')
+            if prep and prep['blocks']:
+                try:
+                    self.state = self.engine.release(self.state, slot)
+                except Exception:  # noqa: BLE001 — crash path resets
+                    pass
+            self._free_prep(prep)
             self._settle_prefill(prog['req'])
 
     def _admit_monolithic(self) -> None:
@@ -650,31 +877,37 @@ class GenerationScheduler:
         pipeline. Same-bucket requests are FUSED into one admit_many
         dispatch (up to ADMIT_BATCH_MAX): under a wave of arrivals this
         divides admission round-trips by the group size.
+
+        Paged mode: each drained request first reserves its KV blocks
+        (waiting head-of-line if the pool is dry). A request whose
+        leading blocks hit the prefix cache skips their prefill — its
+        suffix runs as ONE ``prefill_chunk_final`` dispatch at the
+        cache offset (monolithic-with-offset), never through ``admit``.
         """
         import jax.numpy as jnp
 
         eng = self.engine
         while True:
             free = [i for i, r in enumerate(self._slots) if r is None]
-            if not free or self._pending.empty():
+            if not free or not self._has_admittable():
                 return
             # Drain up to the batchable window; group by prefill bucket.
             # Bucket minorities admit SOLO in this same round (no
             # requeue: a put-to-back would reset a minority request's
             # queue position every bounce and can starve it).
-            reqs: List[_Request] = []
-            while (len(reqs) < min(len(free), max(self.ADMIT_BATCH_MAX, 1))
-                   and not self._pending.empty()):
-                reqs.append(self._take_pending())
-            group: List[tuple] = []  # (req, prompt) — same bucket
-            solo: List[tuple] = []   # (req, prompt, bucket)
-            group_bucket = None
-            for req in reqs:
+            drained: List[tuple] = []  # (req, prompt, prep)
+            while (len(drained) < min(len(free),
+                                      max(self.ADMIT_BATCH_MAX, 1))
+                   and self._has_admittable()):
+                req = self._next_admittable()
+                if req is None:
+                    break
                 prompt = req.tokens[:eng.max_len - 1]
-                bucket = prefill_bucket(len(prompt), eng.max_len)
                 req.prompt_len = len(prompt)
                 if req.max_tokens <= 1:
-                    # Never joins the batch; emitter finishes it.
+                    # Never joins the batch (no slot, no block
+                    # reservation); emitter finishes it.
+                    bucket = prefill_bucket(len(prompt), eng.max_len)
                     try:
                         padded = jnp.asarray(
                             prompt + [0] * (bucket - len(prompt)),
@@ -689,11 +922,30 @@ class GenerationScheduler:
                         self._settle_prefill(req)
                         req.fail(f'prefill failed: {e!r}')
                     continue
+                prep = self._prepare_blocks(req, prompt)
+                if prep is False:
+                    continue  # can never fit: failed, keep draining
+                if prep is None:
+                    self._blocked = req  # pool dry: retry after release
+                    break
+                drained.append((req, prompt, prep))
+            if not drained:
+                if self._blocked is not None:
+                    return
+                continue
+            hits = [d for d in drained if d[2]['cached'] > 0]
+            group: List[tuple] = []  # (req, prompt, prep) — same bucket
+            solo: List[tuple] = []   # (req, prompt, prep, bucket)
+            group_bucket = None
+            for req, prompt, prep in drained:
+                if prep['cached'] > 0:
+                    continue  # admitted via the suffix path below
+                bucket = prefill_bucket(len(prompt), eng.max_len)
                 if group_bucket is None or bucket == group_bucket:
                     group_bucket = bucket
-                    group.append((req, prompt))
+                    group.append((req, prompt, prep))
                 else:
-                    solo.append((req, prompt, bucket))
+                    solo.append((req, prompt, prep, bucket))
             # Fusion fires ONLY at exactly ADMIT_BATCH_MAX (> 1): each
             # traffic bucket compiles exactly one extra variant, and the
             # default N=1 keeps the measured solo admit path.
@@ -704,41 +956,86 @@ class GenerationScheduler:
                 try:
                     toks = jnp.asarray(
                         [p + [0] * (group_bucket - len(p))
-                         for _, p in group], jnp.int32)
+                         for _, p, _ in group], jnp.int32)
+                    tables = ([p['table'] for _, _, p in group]
+                              if eng.paged else None)
                     self.state, firsts, self._rng = eng.admit_many(
                         self.params, self.state, toks,
-                        [len(p) for _, p in group], slots, self._rng,
-                        [r.temperature for r, _ in group],
-                        [r.top_k for r, _ in group])
+                        [len(p) for _, p, _ in group], slots, self._rng,
+                        [r.temperature for r, _, _ in group],
+                        [r.top_k for r, _, _ in group],
+                        table_rows=tables)
                     # ONE emission item carries the whole [N] device
                     # array: slicing it per request here would issue N
                     # gather dispatches on the path that exists to
                     # minimize dispatches.
-                    for (req, _), slot in zip(group, slots):
+                    for (req, _, prep), slot in zip(group, slots):
                         self._slots[slot] = req
                         self._dispatched[slot] = 0
+                        if prep['blocks']:
+                            self._slot_kv[slot] = prep['blocks']
+                            self._commit_prefix(prep)
                     self._queue_emission(
-                        ('firsts', firsts, [r for r, _ in group],
+                        ('firsts', firsts, [r for r, _, _ in group],
                          list(slots)))
                 except Exception as e:  # noqa: BLE001 — fail the group
-                    for req, _ in group:
+                    for req, _, prep in group:
+                        self._free_prep(prep)
                         self._settle_prefill(req)
                         req.fail(f'prefill failed: {e!r}')
             else:
-                solo = [(r, p, group_bucket) for r, p in group] + solo
-            for (req, prompt, bucket), slot in zip(solo, free):
+                solo = ([(r, p, pr, group_bucket) for r, p, pr in group]
+                        + solo)
+            for req, prompt, prep, bucket in solo:
+                slot = free.pop(0)
                 try:
                     padded = jnp.asarray(
                         prompt + [0] * (bucket - len(prompt)), jnp.int32)
                     self.state, first_tok, self._rng = eng.admit(
                         self.params, self.state, padded, len(prompt),
-                        slot, self._rng, req.temperature, req.top_k)
+                        slot, self._rng, req.temperature, req.top_k,
+                        table_row=prep['table'])
                 except Exception as e:  # noqa: BLE001 — fail THIS req
+                    free.insert(0, slot)
+                    self._free_prep(prep)
                     self._settle_prefill(req)
                     req.fail(f'prefill failed: {e!r}')
                     continue
                 self._slots[slot] = req
                 self._dispatched[slot] = 0
+                if prep['blocks']:
+                    self._slot_kv[slot] = prep['blocks']
+                    self._commit_prefix(prep)
+                self._queue_emission(('first', first_tok, req, slot))
+            # Prefix hits: ONE dispatch prefills only the suffix at the
+            # cache offset and activates the slot (same fused shape as
+            # the final chunk of chunked prefill) — the cached blocks'
+            # prefill is the work this path exists to skip.
+            for req, prompt, prep in hits:
+                slot = free.pop(0)
+                cached = prep['cached']
+                suffix = prompt[cached:]
+                bucket = min(prefill_bucket(len(suffix), eng.max_len),
+                             eng.max_len - cached)
+                try:
+                    padded = jnp.asarray(
+                        suffix + [0] * (bucket - len(suffix)), jnp.int32)
+                    self.state, first_tok, self._rng = (
+                        eng.prefill_chunk_final(
+                            self.params, self.state, padded, cached,
+                            slot, len(prompt), self._rng,
+                            req.temperature, req.top_k,
+                            table_row=prep['table']))
+                except Exception as e:  # noqa: BLE001 — fail THIS req
+                    free.insert(0, slot)
+                    self._free_prep(prep)
+                    self._settle_prefill(req)
+                    req.fail(f'prefill failed: {e!r}')
+                    continue
+                self._slots[slot] = req
+                self._dispatched[slot] = 0
+                self._slot_kv[slot] = prep['blocks']
+                self._commit_prefix(prep)
                 self._queue_emission(('first', first_tok, req, slot))
 
     def _queue_emission(self, item: tuple) -> None:
@@ -758,6 +1055,7 @@ class GenerationScheduler:
             if self._slots[slot] is req and req is not None:
                 self.state = self.engine.release(self.state, slot)
                 self._slots[slot] = None
+                self._free_slot_kv(slot)
                 self._note_release()
 
     def _loop(self) -> None:
@@ -802,11 +1100,20 @@ class GenerationScheduler:
                     if not prog['req'].done:
                         prog['req'].fail(err)
                     self._drop_chunking(slot)
+                if self._blocked is not None:
+                    self._settle_prefill(self._blocked)
+                    if not self._blocked.done:
+                        self._blocked.fail(err)
+                    self._blocked = None
                 while not self._releases.empty():
                     try:
                         self._releases.get_nowait()
                     except queue.Empty:
                         break
+                # Fresh device state AND fresh host block bookkeeping:
+                # the old state's block assignments died with it.
+                self._slot_kv.clear()
+                self.engine.reset_kv()
                 self.state = self.engine.init_state()
 
     def _tick(self) -> None:
@@ -873,6 +1180,7 @@ class GenerationScheduler:
                     and 1 + self._dispatched[s] >= r.max_tokens):
                 self.state = self.engine.release(self.state, s)
                 self._slots[s] = None
+                self._free_slot_kv(s)
                 self._note_release()
 
     # -- emitter ------------------------------------------------------------
@@ -1127,7 +1435,17 @@ class GenerationServer:
         # direct callers so replica-side spans are always addressable.
         request_id = (handler.headers.get(REQUEST_ID_HEADER)
                       or uuid.uuid4().hex[:16])
-        reject = self.scheduler.admission_check(len(tokens))
+        req = _Request(
+            tokens=tokens,
+            max_tokens=max_tokens,
+            temperature=temperature,
+            top_k=min(top_k, vocab),
+            eos_id=eos_id,
+            request_id=request_id,
+        )
+        # The check pins the request's prefix-discounted prefill cost
+        # and (on admit) reserves it atomically with the estimate.
+        reject = self.scheduler.admission_check(req)
         if reject is not None:
             if timeline.enabled():
                 timeline.instant('serve.admission_reject',
@@ -1152,18 +1470,10 @@ class GenerationServer:
             handler.end_headers()
             handler.wfile.write(payload)
             return
-        req = _Request(
-            tokens=tokens,
-            max_tokens=max_tokens,
-            temperature=temperature,
-            top_k=min(top_k, vocab),
-            eos_id=eos_id,
-            request_id=request_id,
-        )
         # Admission's own estimate of this request's TTFT (its prefill
         # cost is already reserved): measured against reality at
         # first-token time to grade the estimator.
-        req.est_ttft_ms = self.scheduler.estimate_ttft_ms(len(tokens))
+        req.est_ttft_ms = self.scheduler.estimate_ttft_ms(req)
         self.scheduler.submit(req, reserved=True)
 
         if body.get('stream'):
@@ -1251,6 +1561,12 @@ def main() -> None:
         default=int(os.environ.get('SKYTPU_SERVE_REPLICA_PORT', '8001')))
     parser.add_argument('--batch-slots', type=int, default=8)
     parser.add_argument('--max-len', type=int, default=None)
+    parser.add_argument('--kv-block', type=int, default=None,
+                        help='KV block rows ($SKYTPU_KV_BLOCK, default '
+                             '64; 0 = contiguous per-slot KV)')
+    parser.add_argument('--kv-blocks', type=int, default=None,
+                        help='KV pool size in blocks ($SKYTPU_KV_BLOCKS'
+                             ', default = contiguous HBM budget)')
     parser.add_argument('--ckpt-dir', default=None,
                         help='orbax checkpoint dir (train/checkpoint '
                              'layout) to serve trained weights from; '
@@ -1300,7 +1616,9 @@ def main() -> None:
     scheduler = GenerationScheduler(config, params,
                                     batch_slots=args.batch_slots,
                                     max_len=args.max_len,
-                                    model=model)
+                                    model=model,
+                                    kv_block=args.kv_block,
+                                    kv_blocks=args.kv_blocks)
     scheduler.start()
     server = GenerationServer(scheduler, port=args.port)
     print(f'generation server on :{server.port} '

@@ -1,0 +1,520 @@
+"""Paged KV cache: block allocator properties, prefix reuse, and the
+paged-vs-contiguous equivalence oracle.
+
+The acceptance bar for the paged engine is the same one chunked prefill
+cleared: greedy decoding through the paged path must be BIT-IDENTICAL
+to the contiguous path (and both to the naive recompute-everything
+oracle) on mixed prefill/decode batches. The allocator tests are pure
+host-side (no device work): refcount conservation, no double-free, and
+eviction never touching a referenced block are the invariants that keep
+two requests' KV from aliasing.
+"""
+import random
+
+import pytest
+
+from skypilot_tpu.models.paged_kv import (BlockAllocator, blocks_for,
+                                          hash_token_blocks)
+
+
+# ---- host-side allocator (no jax) ------------------------------------------
+class TestBlockAllocator:
+
+    def test_alloc_deref_conservation(self):
+        a = BlockAllocator(9, 4)  # 8 usable (block 0 reserved)
+        assert a.capacity == 8
+        ids = a.alloc(3)
+        assert len(ids) == 3 and 0 not in ids
+        assert a.available() == 5
+        assert a.used() == 3
+        a.deref(ids)
+        assert a.available() == 8
+        assert a.used() == 0
+
+    def test_alloc_deterministic_lowest_first(self):
+        a = BlockAllocator(9, 4)
+        assert a.alloc(3) == [1, 2, 3]
+        b = BlockAllocator(9, 4)
+        assert b.alloc(2) + b.alloc(1) == [1, 2, 3]
+
+    def test_alloc_fails_whole_not_partial(self):
+        a = BlockAllocator(5, 4)  # 4 usable
+        ids = a.alloc(3)
+        assert a.alloc(2) is None      # only 1 free: nothing taken
+        assert a.available() == 1
+        assert a.alloc(1) is not None
+        a.deref(ids)
+
+    def test_double_deref_raises(self):
+        a = BlockAllocator(5, 4)
+        ids = a.alloc(1)
+        a.deref(ids)
+        with pytest.raises(ValueError):
+            a.deref(ids)
+
+    def test_shared_block_refcounts(self):
+        a = BlockAllocator(5, 4)
+        ids = a.alloc(2)
+        a.ref_blocks(ids)          # second sequence maps them
+        a.deref(ids)
+        assert a.used() == 2       # still referenced by the other holder
+        a.deref(ids)
+        assert a.used() == 0
+
+    def test_cached_blocks_evict_lru_and_never_referenced(self):
+        a = BlockAllocator(5, 2)   # 4 usable
+        h = hash_token_blocks(list(range(8)), 2)  # 4 chain hashes
+        ids = a.alloc(4)
+        a.commit(h, ids)
+        a.deref(ids[2:])           # ids[2], ids[3] cached at ref 0
+        # Pool "full" of cached blocks: allocation must evict — oldest
+        # released first — and never touch the still-referenced ids[:2].
+        got = a.alloc(1)
+        assert got == [ids[2]]     # LRU order: first released
+        assert a.stats()['prefix_evictions'] == 1
+        # The evicted block's hash is gone; the chain now dead-ends
+        # there even though later links were committed.
+        assert a.match(h) == ids[:2]
+        a.deref(got)
+        a.deref(ids[:2])
+        assert a.available() == a.capacity
+
+    def test_match_and_ref_takes_refs_atomically(self):
+        a = BlockAllocator(9, 2)
+        tokens = list(range(6))
+        h = hash_token_blocks(tokens, 2)
+        ids = a.alloc(3)
+        a.commit(h, ids)
+        a.deref(ids)               # all cached, evictable
+        got = a.match_and_ref(h)
+        assert got == ids
+        assert a.used() == 3       # refs taken: eviction can't free them
+        assert a.alloc(6) is None
+        a.deref(got)
+
+    def test_commit_first_writer_wins(self):
+        a = BlockAllocator(9, 2)
+        h = hash_token_blocks([1, 2], 2)
+        first = a.alloc(1)
+        a.commit(h, first)
+        dup = a.alloc(1)
+        a.commit(h, dup)           # duplicate content: keeps the first
+        assert a.match(h) == first
+        a.deref(dup)
+        assert a.available() == 8 - a.used()
+        a.deref(first)
+
+    def test_partial_chain_match(self):
+        a = BlockAllocator(9, 2)
+        h = hash_token_blocks([1, 2, 3, 4, 5, 6], 2)
+        ids = a.alloc(3)
+        a.commit(h[:2], ids[:2])   # only 2 of 3 blocks cached
+        assert a.match(h) == ids[:2]
+        # A diverging prompt shares only the common blocks.
+        h2 = hash_token_blocks([1, 2, 3, 4, 9, 9], 2)
+        assert a.match(h2) == ids[:2]
+        h3 = hash_token_blocks([9, 2, 3, 4, 5, 6], 2)
+        assert a.match(h3) == []
+        a.deref(ids)
+
+    def test_property_random_ops_conserve_blocks(self):
+        """Randomized alloc/share/release/commit churn: block
+        conservation (free + evictable + referenced == capacity), no
+        negative refs, and eviction only ever reclaiming unreferenced
+        blocks."""
+        rnd = random.Random(7)
+        a = BlockAllocator(17, 4)  # 16 usable
+        live = []                  # [(ids, committed_hashes)]
+        next_tok = [0]
+        for _ in range(400):
+            op = rnd.random()
+            if op < 0.45:
+                n = rnd.randint(1, 5)
+                ids = a.alloc(n)
+                if ids is not None:
+                    assert len(set(ids)) == n and 0 not in ids
+                    for other, _ in live:
+                        assert not set(ids) & set(other), \
+                            'alloc handed out a referenced block'
+                    live.append((ids, []))
+            elif op < 0.65 and live:
+                ids, hashes = live[rnd.randrange(len(live))]
+                a.ref_blocks(ids)
+                live.append((ids, []))
+            elif op < 0.85 and live:
+                ids, _ = live.pop(rnd.randrange(len(live)))
+                a.deref(ids)
+            elif live:
+                ids, _ = live[rnd.randrange(len(live))]
+                toks = list(range(next_tok[0],
+                                  next_tok[0] + 4 * len(ids)))
+                next_tok[0] += 4 * len(ids)
+                a.commit(hash_token_blocks(toks, 4), ids)
+            referenced = {b for ids, _ in live for b in ids}
+            assert a.used() == len(referenced)
+            assert a.available() == a.capacity - len(referenced)
+        for ids, _ in live:
+            a.deref(ids)
+        assert a.available() == a.capacity
+
+    def test_hash_chain_prefix_property(self):
+        """hash[i] commits to ALL tokens before it: equal prefixes give
+        equal chains, any earlier difference changes every later hash."""
+        base = [5, 1, 4, 1, 5, 9, 2, 6]
+        h = hash_token_blocks(base, 2)
+        assert len(h) == 4
+        same = hash_token_blocks(base + [99], 2)
+        assert same == h           # trailing partial block ignored
+        diverged = hash_token_blocks([5, 1, 4, 1, 5, 9, 2, 7], 2)
+        assert diverged[:3] == h[:3] and diverged[3] != h[3]
+        early = hash_token_blocks([0, 1, 4, 1, 5, 9, 2, 6], 2)
+        assert all(x != y for x, y in zip(early, h))
+        assert hash_token_blocks(base, 2, n_blocks=2) == h[:2]
+
+    def test_blocks_for(self):
+        assert blocks_for(0, 8) == 0
+        assert blocks_for(1, 8) == 1
+        assert blocks_for(8, 8) == 1
+        assert blocks_for(9, 8) == 2
+
+
+# ---- device-side equivalence + reuse (tiny config, CPU) ---------------------
+compute = pytest.mark.compute
+
+
+@pytest.fixture(scope='module')
+def tiny():
+    import jax
+    from skypilot_tpu.models.llama import PRESETS, LlamaModel
+    cfg = PRESETS['test-tiny']
+    model = LlamaModel(cfg)
+    params = jax.jit(model.init)(jax.random.key(0))
+    return cfg, model, params
+
+
+def _naive_greedy(model, params, prompt, n_steps):
+    import jax.numpy as jnp
+    tokens = list(prompt)
+    out = []
+    for _ in range(n_steps):
+        logits = model.apply(params, jnp.asarray([tokens], jnp.int32))
+        nxt = int(jnp.argmax(logits[0, -1]))
+        out.append(nxt)
+        tokens.append(nxt)
+    return out
+
+
+@compute
+def test_paged_bit_identical_to_contiguous_mixed_batches(tiny):
+    """THE tentpole oracle: a mixed chunked-prefill/decode schedule —
+    admit p0 via chunks, decode, fused-admit p1 mid-decode, decode both
+    — produces BIT-IDENTICAL sampled tokens from the paged and
+    contiguous engines at every step, and both match the naive
+    recompute-everything oracle."""
+    import jax
+    import jax.numpy as jnp
+    from skypilot_tpu.models.decode import (DecodeEngine, chunk_spans,
+                                            prefill_bucket)
+    cfg, model, params = tiny
+    p0 = [(i * 7 + 3) % cfg.vocab_size for i in range(21)]
+    p1 = [9, 1, 200]
+
+    def drive(kv_block):
+        eng = DecodeEngine(cfg, batch_slots=2, max_len=64,
+                           kv_block=kv_block)
+        state = eng.init_state()
+        rng = jax.random.key(0)
+        # Chunked prefill of p0 into slot 0.
+        for off, cb, final in chunk_spans(len(p0), 8, eng.max_len):
+            piece = p0[off:off + cb]
+            pc = jnp.asarray(piece + [0] * (cb - len(piece)), jnp.int32)
+            if final:
+                state, first0, rng = eng.prefill_chunk_final(
+                    params, state, pc, off, 0, len(p0), rng)
+            else:
+                state = eng.prefill_chunk(params, state, pc, off, 0)
+        toks = [[int(first0)], []]
+        # Two solo decode steps for slot 0.
+        for _ in range(2):
+            state, s, rng = eng.step(params, state, rng)
+            toks[0].append(int(s[0]))
+        # Fused admit of p1 into slot 1 mid-decode.
+        b1 = prefill_bucket(len(p1), eng.max_len)
+        pad1 = jnp.asarray(p1 + [0] * (b1 - len(p1)), jnp.int32)
+        state, first1, rng = eng.admit(params, state, pad1, len(p1), 1,
+                                       rng)
+        toks[1].append(int(first1))
+        # Joint decode.
+        for _ in range(3):
+            state, s, rng = eng.step(params, state, rng)
+            toks[0].append(int(s[0]))
+            toks[1].append(int(s[1]))
+        return toks
+
+    contiguous = drive(kv_block=0)
+    paged = drive(kv_block=8)
+    assert paged == contiguous  # bit-identical, step for step
+    assert paged[0] == _naive_greedy(model, params, p0, 6)
+    assert paged[1] == _naive_greedy(model, params, p1, 4)
+
+
+@compute
+def test_engine_prefix_sharing_skips_prefill_and_matches_oracle(tiny):
+    """Two sequences sharing a full-block prefix: the second maps the
+    first's committed blocks (refcounted, zero copies), prefills ONLY
+    its suffix at the cache offset, and still greedy-decodes exactly
+    the oracle's tokens — while the first keeps decoding correctly
+    through the shared blocks."""
+    import jax
+    import jax.numpy as jnp
+    from skypilot_tpu.models.decode import DecodeEngine
+    from skypilot_tpu.models.paged_kv import hash_token_blocks
+    cfg, model, params = tiny
+    eng = DecodeEngine(cfg, batch_slots=2, max_len=64, kv_block=8)
+    alloc = eng.allocator
+    state = eng.init_state()
+    rng = jax.random.key(0)
+
+    prefix = [(i * 3 + 1) % cfg.vocab_size for i in range(16)]  # 2 blocks
+    pa = prefix + [7, 8, 9]
+    pb = prefix + [11, 12]
+
+    # Sequence A: explicit table, full prefill, commit its full blocks.
+    ids_a = alloc.alloc(3)
+    table_a = ids_a + [0] * (eng.max_blocks - 3)
+    pad_a = jnp.asarray(pa + [0] * (32 - len(pa)), jnp.int32)
+    state, first_a, rng = eng.prefill_chunk_final(
+        params, state, pad_a, 0, 0, len(pa), rng, table_row=table_a)
+    alloc.commit(hash_token_blocks(pa, 8), ids_a[:2])
+
+    # Sequence B: cache hit on the 2 prefix blocks; suffix-only prefill.
+    hit = alloc.match_and_ref(hash_token_blocks(pb, 8))
+    assert hit == ids_a[:2]
+    cached = len(hit) * 8
+    assert cached == 16
+    new_b = alloc.alloc(1)
+    table_b = hit + new_b + [0] * (eng.max_blocks - 3)
+    suffix = pb[cached:]
+    pad_b = jnp.asarray(suffix + [0] * (8 - len(suffix)), jnp.int32)
+    state, first_b, rng = eng.prefill_chunk_final(
+        params, state, pad_b, cached, 1, len(pb), rng,
+        table_row=table_b)
+
+    out_a, out_b = [int(first_a)], [int(first_b)]
+    for _ in range(3):
+        state, s, rng = eng.step(params, state, rng)
+        out_a.append(int(s[0]))
+        out_b.append(int(s[1]))
+    assert out_a == _naive_greedy(model, params, pa, 4)
+    assert out_b == _naive_greedy(model, params, pb, 4)
+
+
+@compute
+def test_scheduler_prefix_reuse_monolithic(tiny):
+    """Scheduler-level reuse in the default (monolithic-admit) mode:
+    the second request's admission dispatches only its suffix (one
+    prefill_chunk_final at the cache offset), /stats records the hit,
+    and both requests produce the oracle's tokens."""
+    from skypilot_tpu.serve.generation_server import (GenerationScheduler,
+                                                      _Request)
+    cfg, model, params = tiny
+    sched = GenerationScheduler(cfg, params, batch_slots=2, max_len=64,
+                                kv_block=8)
+    finals = []
+    real_final = sched.engine.prefill_chunk_final
+
+    def spy(params_, state, tokens, offset, *a, **k):
+        finals.append((tokens.shape[0], int(offset)))
+        return real_final(params_, state, tokens, offset, *a, **k)
+
+    sched.engine.prefill_chunk_final = spy
+    sched.start(warmup=False)
+    try:
+        prefix = [(i * 3 + 1) % cfg.vocab_size for i in range(16)]
+        p1, p2 = prefix + [7, 8, 9], prefix + [11, 12]
+        for prompt in (p1, p2):
+            req = _Request(prompt, max_tokens=4, temperature=0.0,
+                           top_k=0, eos_id=None)
+            sched.submit(req)
+            out = []
+            while True:
+                tok = req.out_queue.get(timeout=60)
+                if tok is None:
+                    break
+                out.append(tok)
+            assert req.error is None, req.error
+            assert out == _naive_greedy(model, params, prompt, 4)
+        st = sched.stats()
+        assert st['prefix_hits'] == 1
+        assert st['prefix_hit_tokens'] == 16
+        assert st['kv_blocks_used'] == 0  # everything released
+        # Exactly one suffix-only dispatch, at offset 16 (2 blocks).
+        assert finals == [(16, 16)], finals
+    finally:
+        sched.stop()
+
+
+@compute
+def test_scheduler_block_budget_serializes_and_completes(tiny):
+    """Pool smaller than two concurrent requests: the second waits
+    head-of-line (no failure, no slot starvation) and admits after the
+    first releases its blocks; both match the oracle. The acceptance
+    property behind 'admitted concurrency follows actual lengths under
+    a fixed HBM budget'."""
+    from skypilot_tpu.serve.generation_server import (GenerationScheduler,
+                                                      _Request)
+    cfg, model, params = tiny
+    sched = GenerationScheduler(cfg, params, batch_slots=2, max_len=64,
+                                kv_block=8, kv_blocks=5)  # 4 usable
+    sched.start(warmup=False)
+    try:
+        pa = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10]  # 10+20 rows -> 4 blocks
+        pb = [9, 8, 7, 6, 5, 4, 3, 2, 1]      # 9+20 rows -> 4 blocks
+        ra = _Request(pa, max_tokens=20, temperature=0.0, top_k=0,
+                      eos_id=None)
+        rb = _Request(pb, max_tokens=20, temperature=0.0, top_k=0,
+                      eos_id=None)
+        sched.submit(ra)
+        sched.submit(rb)
+
+        def drain(req):
+            toks = []
+            while True:
+                t = req.out_queue.get(timeout=120)
+                if t is None:
+                    return toks
+                toks.append(t)
+
+        assert drain(ra) == _naive_greedy(model, params, pa, 20)
+        assert drain(rb) == _naive_greedy(model, params, pb, 20)
+        assert sched.stats()['kv_blocks_used'] == 0
+    finally:
+        sched.stop()
+
+
+@compute
+def test_scheduler_rejects_request_that_can_never_fit(tiny):
+    """A request needing more blocks than the whole pool fails cleanly
+    (it would otherwise wedge head-of-line forever)."""
+    from skypilot_tpu.serve.generation_server import (GenerationScheduler,
+                                                      _Request)
+    cfg, model, params = tiny
+    sched = GenerationScheduler(cfg, params, batch_slots=1, max_len=64,
+                                kv_block=8, kv_blocks=3)  # 2 usable
+    sched.start(warmup=False)
+    try:
+        req = _Request(list(range(2, 40)), max_tokens=30,
+                       temperature=0.0, top_k=0, eos_id=None)
+        sched.submit(req)
+        while req.out_queue.get(timeout=60) is not None:
+            pass
+        assert req.error and 'KV blocks' in req.error
+        # The scheduler is not wedged: a fitting request still serves.
+        ok = _Request([1, 2, 3], max_tokens=2, temperature=0.0, top_k=0,
+                      eos_id=None)
+        sched.submit(ok)
+        out = []
+        while True:
+            t = ok.out_queue.get(timeout=60)
+            if t is None:
+                break
+            out.append(t)
+        assert ok.error is None
+        assert out == _naive_greedy(model, params, [1, 2, 3], 2)
+    finally:
+        sched.stop()
+
+
+@compute
+def test_dropped_midprefill_slot_clears_table_and_frees_blocks(tiny):
+    """A chunked prefill that fails mid-prompt must clear the slot's
+    DEVICE table row before its blocks return to the pool: an inactive
+    slot parks its per-step garbage write through its table, so a stale
+    full-length table would corrupt whoever gets the freed blocks
+    next. Also: the freed blocks are reusable and a follow-up request
+    decodes cleanly through them."""
+    import numpy as np
+    from skypilot_tpu.serve.generation_server import (GenerationScheduler,
+                                                      _Request)
+    cfg, model, params = tiny
+    sched = GenerationScheduler(cfg, params, batch_slots=1, max_len=64,
+                                kv_block=8, kv_blocks=9,  # 8 usable
+                                prefill_chunk=8, prefill_budget=8)
+    # Long prompt so rows == max_len -> a FULL table (the stale-table
+    # hazard needs table[max_blocks-1] to be a real block).
+    bad = _Request([(i * 5 + 2) % cfg.vocab_size for i in range(40)],
+                   max_tokens=30, temperature=0.0, top_k=0, eos_id=None)
+    boom = {'armed': False}
+    real_chunk = sched.engine.prefill_chunk
+
+    def failing_chunk(*a, **k):
+        if boom['armed']:
+            raise RuntimeError('injected chunk failure')
+        return real_chunk(*a, **k)
+
+    sched.engine.prefill_chunk = failing_chunk
+    sched.submit(bad)
+    sched._tick()           # first chunk dispatches, slot 0 mid-prefill
+    assert 0 in sched._chunking
+    boom['armed'] = True
+    sched._tick()           # next chunk raises -> request dropped
+    boom['armed'] = False
+    assert bad.error is not None
+    assert not sched._chunking
+    # Table row cleared on device; all blocks back in the pool.
+    assert int(np.asarray(sched.state.block_tables[0]).sum()) == 0
+    assert sched.engine.allocator.used() == 0
+    # Freed blocks are clean for the next request.
+    ok = _Request([5, 17, 200], max_tokens=3, temperature=0.0, top_k=0,
+                  eos_id=None)
+    sched.submit(ok)
+    for _ in range(20):
+        sched._tick()
+        if sched._slots[0] is None and not sched._chunking:
+            break
+    with sched._emit_lock:
+        batch, sched._emit_q = sched._emit_q, []
+    sched._emit_batch(batch)
+    toks = []
+    while True:
+        t = ok.out_queue.get(timeout=5)
+        if t is None:
+            break
+        toks.append(t)
+    assert toks == _naive_greedy(model, params, [5, 17, 200], 3)
+
+
+@compute
+def test_scalar_sampling_cache_is_lru_bounded(tiny):
+    """Satellite: client-supplied sampling settings must not grow the
+    device-array cache without bound; repeats still hit (same object)."""
+    import jax.numpy as jnp
+    from skypilot_tpu.models.decode import DecodeEngine
+    cfg, _, _ = tiny
+    eng = DecodeEngine(cfg, batch_slots=2, max_len=64)
+    first = eng._scalar_sampling(0.0, jnp.float32)
+    assert eng._scalar_sampling(0.0, jnp.float32) is first
+    for i in range(3 * eng.SCALAR_SAMPLING_CACHE_MAX):
+        eng._scalar_sampling(0.001 * (i + 1), jnp.float32)
+        assert (len(eng._scalar_sampling_cache)
+                <= eng.SCALAR_SAMPLING_CACHE_MAX)
+    # The LRU keeps the most recent entry hot.
+    last_key = (0.001 * 3 * eng.SCALAR_SAMPLING_CACHE_MAX, 'float32')
+    assert last_key in eng._scalar_sampling_cache
+
+
+def test_serve_bench_shared_prefix_prompts():
+    """Bench workload helper: shared-prefix prompts keep the requested
+    length, share exactly the prefix, and stay distinct sequences."""
+    from skypilot_tpu.benchmark.serve_bench import make_prompt
+    rnd = random.Random(3)
+    prefix = [7] * 16
+    p1 = make_prompt(rnd, 256, 24, prefix)
+    p2 = make_prompt(rnd, 256, 24, prefix)
+    assert len(p1) == len(p2) == 24
+    assert p1[:16] == p2[:16] == prefix
+    plain = make_prompt(rnd, 256, 24)
+    assert len(plain) == 24
+    # Prefix longer than the prompt: truncated to leave >= 1 random tail.
+    short = make_prompt(rnd, 256, 8, prefix)
+    assert len(short) == 8 and short[:7] == prefix[:7]

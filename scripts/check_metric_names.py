@@ -2,100 +2,43 @@
 """Lint: every metric registered in the tree follows the naming
 convention ``skytpu_<subsystem>_<name>_<unit>``.
 
-Two enforcement layers share one rule (``utils.metrics.validate_name``):
-the registry raises at registration time (catches dynamic names), and
-this script statically scans every ``counter(``/``gauge(``/
-``histogram(`` call whose first argument is a string literal (catches
-names on code paths tests never execute). Run standalone::
+Thin shim over the skylint framework's ``metric-name`` checker
+(skypilot_tpu/lint/checkers/metric_names.py) — the check moved there
+when the repo grew a full static-analysis suite; this entry point keeps
+the historical CLI contract (root argument, exit 0 clean / 1 with
+violations listed on stderr). Run standalone::
 
     python scripts/check_metric_names.py [root]
 
-or via the tier-1 test (tests/test_metrics.py). Exit 0 = clean,
-1 = violations (listed on stderr).
+or via the tier-1 tests (tests/test_metrics.py, tests/test_skylint.py).
+Family coverage (EXPECTED_FAMILIES in the checker module) is only
+enforced over the full default tree: a narrower root legitimately lacks
+most families and must not fail on their absence.
 """
 from __future__ import annotations
 
 import os
-import re
 import sys
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO_ROOT)
 
-from skypilot_tpu.utils.metrics import validate_name  # noqa: E402
-
-# First string-literal argument of a metric constructor call. DOTALL so
-# calls wrapped onto the next line still match.
-_CALL_RE = re.compile(
-    r'\b(?:counter|gauge|histogram)\(\s*[\'"]([A-Za-z0-9_]+)[\'"]',
-    re.DOTALL)
-
-# Registration coverage: these metric FAMILIES are load-bearing (bench
-# records, dashboards, docs tables reference them by prefix) — a
-# refactor that renames them away silently breaks every consumer. The
-# scan must find at least one registration per family or the lint
-# fails, so "the family exists in the tree" is a tier-1 guarantee.
-EXPECTED_FAMILIES = (
-    'skytpu_serve_',      # scheduler/admission plane
-    'skytpu_engine_',     # decode engine step profiling
-    'skytpu_engine_kv_',  # paged-KV pool + prefix cache
-    'skytpu_lb_',         # load balancer proxy series
-)
-
-
-def scan_file(path: str) -> tuple:
-    """([(line_number, name, error)], [names]) for one file."""
-    with open(path, encoding='utf-8') as f:
-        src = f.read()
-    out = []
-    names = []
-    for m in _CALL_RE.finditer(src):
-        name = m.group(1)
-        names.append(name)
-        err = validate_name(name)
-        if err:
-            line = src.count('\n', 0, m.start()) + 1
-            out.append((line, name, err))
-    return out, names
+from skypilot_tpu.lint import core  # noqa: E402
+from skypilot_tpu.lint.checkers.metric_names import (  # noqa: E402,F401
+    EXPECTED_FAMILIES)
 
 
 def main(argv=None) -> int:
     args = argv if argv is not None else sys.argv[1:]
-    # Family coverage is only meaningful over the full tree: a narrower
-    # root (e.g. `... skypilot_tpu/utils`) legitimately lacks most
-    # families and must not fail on their absence.
-    check_families = not args
-    root = args[0] if args else os.path.join(_REPO_ROOT, 'skypilot_tpu')
-    violations = []
-    n_files = 0
-    all_names = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if d != '__pycache__']
-        for fn in filenames:
-            if not fn.endswith('.py'):
-                continue
-            path = os.path.join(dirpath, fn)
-            n_files += 1
-            file_violations, names = scan_file(path)
-            all_names.extend(names)
-            for line, name, err in file_violations:
-                violations.append(
-                    f'{os.path.relpath(path, _REPO_ROOT)}:{line}: {err}')
-    if check_families:
-        for family in EXPECTED_FAMILIES:
-            if not any(n.startswith(family) for n in all_names):
-                violations.append(
-                    f'expected metric family {family}* has no '
-                    f'registration under {root} (renamed away? update '
-                    'EXPECTED_FAMILIES and every consumer)')
-    if violations:
+    run = core.run_skylint(roots=args or None, checks=['metric-name'])
+    if run.findings:
         print('metric naming violations '
               '(convention: skytpu_<subsystem>_<name>_<unit>):',
               file=sys.stderr)
-        for v in violations:
-            print(f'  {v}', file=sys.stderr)
+        for f in run.findings:
+            print(f'  {f.path}:{f.line}: {f.message}', file=sys.stderr)
         return 1
-    print(f'check_metric_names: {n_files} files clean')
+    print(f'check_metric_names: {len(run.contexts)} files clean')
     return 0
 
 

@@ -1,0 +1,60 @@
+#!/usr/bin/env python3
+"""skylint driver: AST static analysis over the skypilot_tpu tree.
+
+Usage::
+
+    python scripts/skylint.py                  # whole package, all checks
+    python scripts/skylint.py path [path ...]  # narrower roots
+    python scripts/skylint.py --check lock-discipline --json
+    python scripts/skylint.py --list-checks
+
+Exit 0 = no un-suppressed findings; 1 = findings (listed on stderr in
+human mode, on stdout as JSON with --json — bench.py archives the JSON
+per round). Aggregate contracts (dead env-var entries, docs table,
+metric-family coverage) only run over the full default tree; explicit
+roots get per-file checks only. See docs/static_analysis.md.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+from skypilot_tpu.lint import core  # noqa: E402
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('roots', nargs='*',
+                        help='files/dirs to lint (default: skypilot_tpu/)')
+    parser.add_argument('--check', action='append', dest='checks',
+                        help='run only this check (repeatable)')
+    parser.add_argument('--json', action='store_true',
+                        help='machine-readable output on stdout')
+    parser.add_argument('--list-checks', action='store_true')
+    args = parser.parse_args(argv)
+
+    if args.list_checks:
+        for cls in core.all_checkers():
+            print(f'{cls.name}: {cls.description}')
+        return 0
+
+    try:
+        run = core.run_skylint(roots=args.roots or None,
+                               checks=args.checks)
+    except ValueError as e:  # unknown --check name
+        print(f'skylint: {e}', file=sys.stderr)
+        return 2
+    if args.json:
+        print(run.to_json())
+    else:
+        stream = sys.stderr if run.findings else sys.stdout
+        print(run.render_human(), file=stream)
+    return 1 if run.findings else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,220 @@
+"""Central registry of every ``SKYTPU_*`` environment variable.
+
+Every environment variable the package READS must be registered here —
+``skylint``'s ``env-contract`` checker enforces it (an unregistered read
+fails tier-1, and a registered entry nothing reads is flagged as dead).
+The registry is also the source of the env-var table in
+``docs/serving.md`` (``render_markdown_table``), so name, default, and
+doc live in exactly one place.
+
+Entries marked ``exported=True`` are part of the env contract this
+framework EXPORTS to user job processes (the SKYTPU_* rank contract,
+``runtime/constants.py``) or to replica tasks; the package sets them but
+may never read them back, so the dead-entry check skips them.
+
+Readers either go through the accessors here (``get`` applies the
+registered default; hot subsystems — models/decode.py, serve/, jobs/ —
+do) or read ``os.environ`` directly with the same literal name (leaf
+utilities); both count as reads for the contract checker.
+
+Import-light on purpose (os + dataclasses only): this module is imported
+by utils/, serve/, models/ alike and must never create an import cycle.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, Iterable, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvVar:
+    name: str
+    default: Optional[str]      # None = unset means "feature off"/derived
+    subsystem: str
+    doc: str
+    exported: bool = False      # set for subprocesses/user tasks, not read
+
+
+REGISTRY: Dict[str, EnvVar] = {}
+
+
+def _v(name: str, default: Optional[str], subsystem: str, doc: str,
+       exported: bool = False) -> None:
+    if name in REGISTRY:
+        raise ValueError(f'duplicate env var registration: {name}')
+    REGISTRY[name] = EnvVar(name, default, subsystem, doc, exported)
+
+
+# -- runtime rank contract (exported to every job process) --------------------
+_v('SKYTPU_NUM_HOSTS', None, 'runtime',
+   'hosts in the gang (rank contract exported to job processes)',
+   exported=True)
+_v('SKYTPU_HOST_RANK', None, 'runtime',
+   'this host\'s rank within the gang (slice-major)', exported=True)
+_v('SKYTPU_HOST_IPS', None, 'runtime',
+   'newline-separated host IPs in rank order', exported=True)
+_v('SKYTPU_COORDINATOR_ADDR', None, 'runtime',
+   'host0_ip:port for jax.distributed rendezvous')
+_v('SKYTPU_NUM_PROCESSES', None, 'runtime',
+   'process count for jax.distributed.initialize')
+_v('SKYTPU_PROCESS_ID', None, 'runtime',
+   'process id for jax.distributed.initialize')
+_v('SKYTPU_JOB_ID', None, 'runtime',
+   'job id the agent exports to every job process', exported=True)
+_v('SKYTPU_CLUSTER_NAME', None, 'runtime',
+   'cluster name the agent exports to every job process', exported=True)
+_v('SKYTPU_NUM_SLICES', None, 'runtime',
+   'ganged slice count (multi-slice DCN jobs)')
+_v('SKYTPU_SLICE_ID', None, 'runtime',
+   'this host\'s slice id (multi-slice DCN jobs)')
+_v('SKYTPU_HOSTS_PER_SLICE', None, 'runtime',
+   'hosts per slice (rank = slice_id * hosts_per_slice + worker)',
+   exported=True)
+_v('SKYTPU_AXON_STASH', None, 'runtime',
+   'stashed PALLAS_AXON_POOL_IPS for control-plane spawns (restored '
+   'for user job processes)')
+_v('SKYTPU_MAX_CONCURRENT_JOBS', None, 'runtime',
+   'override for per-host concurrent job slots (default: derived from '
+   'host shape)')
+
+# -- state / identity / config ------------------------------------------------
+_v('SKYTPU_STATE_DIR', '~/.skytpu', 'state',
+   'root of the sqlite state + logs tree')
+_v('SKYTPU_CONFIG', None, 'state',
+   'path of the user config YAML (default ~/.skytpu/config.yaml)')
+_v('SKYTPU_USER_HASH', None, 'state',
+   'override for the stable per-user hash')
+_v('SKYTPU_USER', None, 'state',
+   'override for the user name recorded on clusters/requests')
+_v('SKYTPU_SSH_CONFIG', '~/.ssh/config', 'state',
+   'ssh config file that cluster host aliases are written into')
+_v('SKYTPU_CLUSTER_LOCK_TIMEOUT', '600', 'state',
+   'seconds to wait for a per-cluster operation lock')
+
+# -- API server / client ------------------------------------------------------
+_v('SKYTPU_API_SERVER_URL', None, 'api',
+   'remote API server endpoint (default: the local in-process server)')
+_v('SKYTPU_API_TOKEN', None, 'api',
+   'bearer token for the API server (client sends, server verifies)')
+_v('SKYTPU_UPLOAD_MAX_BYTES', None, 'api',
+   'server-side cap on workdir upload size (default in server/server.py)')
+
+# -- serve plane --------------------------------------------------------------
+_v('SKYTPU_SERVE_TICK', '20', 'serve',
+   'controller loop tick in seconds (autoscale + reconcile + probe)')
+_v('SKYTPU_SERVE_LB_SYNC', '5', 'serve',
+   'LB replica-list sync interval in seconds (also sizes the rolling-'
+   'update drain grace: 2x)')
+_v('SKYTPU_SERVE_LB_PORT', '0', 'serve',
+   'pinned LB listen port (0 = kernel-assigned)')
+_v('SKYTPU_LB_METRICS_PATH', '/metrics', 'serve',
+   "path the LB answers with its own metrics ('' = proxy through)")
+_v('SKYTPU_SERVE_DEBUG', None, 'serve',
+   'log per-replica liveness verdicts (preemption classification)')
+_v('SKYTPU_SERVE_REPLICA_PORT', '8001', 'serve',
+   'port the generation replica binds (assigned by the replica manager)')
+_v('SKYTPU_SERVE_REPLICA_ID', None, 'serve',
+   'replica id exported into each replica task', exported=True)
+_v('SKYTPU_ADMIT_BATCH', '1', 'serve',
+   'same-bucket admissions fused into one admit_many dispatch '
+   '(1 = solo)')
+_v('SKYTPU_PREFILL_CHUNK', '0', 'serve',
+   'prefill chunk size in tokens (0 = monolithic prefill)')
+_v('SKYTPU_PREFILL_BUDGET', '0', 'serve',
+   'prefill tokens dispatched per scheduling round (0 = 2x chunk)')
+_v('SKYTPU_TTFT_SLO_MS', '0', 'serve',
+   'TTFT SLO for admission control; estimated-over-SLO requests get '
+   '429 (0 = never reject)')
+_v('SKYTPU_PREFILL_TOKENS_PER_S', '0', 'serve',
+   'seed for the effective-prefill-rate EMA (0 = learn from traffic)')
+
+# -- decode engine ------------------------------------------------------------
+_v('SKYTPU_KV_BLOCK', '64', 'engine',
+   'KV cache block rows (0 = contiguous per-slot KV, the equivalence '
+   'oracle)')
+_v('SKYTPU_KV_BLOCKS', '0', 'engine',
+   'KV pool size in blocks (0 = the contiguous layout\'s HBM budget)')
+
+# -- observability ------------------------------------------------------------
+_v('SKYTPU_METRICS', '1', 'observability',
+   'metrics collection on/off (0/false/off disables)')
+_v('SKYTPU_TIMELINE', None, 'observability',
+   'trace output path; enables the Perfetto timeline when set')
+_v('SKYTPU_TIMELINE_EVENTS', None, 'observability',
+   'timeline ring-buffer capacity (default 100000)')
+
+# -- managed jobs -------------------------------------------------------------
+_v('SKYTPU_JOBS_POLL_INTERVAL', '15', 'jobs',
+   'jobs controller cluster-poll interval in seconds')
+_v('SKYTPU_JOBS_MAX_PARALLEL_JOBS', None, 'jobs',
+   'override for alive controller processes (default: derived from '
+   'controller memory)')
+_v('SKYTPU_JOBS_MAX_PARALLEL_LAUNCHES', None, 'jobs',
+   'override for in-flight provisions (default: derived from CPUs)')
+
+# -- train / bench ------------------------------------------------------------
+_v('SKYTPU_WARM_INIT_CACHE', None, 'train',
+   'persistent XLA compile-cache dir for warm-start A/B runs')
+_v('SKYTPU_BENCHMARK_LOG_DIR', None, 'train',
+   'arms the benchmark callbacks; summary JSON lands here')
+
+# -- provisioning -------------------------------------------------------------
+_v('SKYTPU_OCI_COMPARTMENT', None, 'provision',
+   'OCI compartment OCID override')
+_v('SKYTPU_OCI_SUBNET', None, 'provision',
+   'OCI subnet OCID override')
+for _cloud in ('AWS', 'GCP', 'AZURE', 'OCI', 'DO', 'LAMBDA', 'VAST',
+               'RUNPOD', 'CUDO', 'HYPERSTACK', 'PAPERSPACE',
+               'FLUIDSTACK'):
+    _v(f'SKYTPU_FAKE_{_cloud}_CREDENTIALS', None, 'clouds-testing',
+       f'fake-credential mode for {_cloud.title()} (tests/local runs '
+       'without real cloud credentials)')
+
+
+# -- accessors ----------------------------------------------------------------
+def get(name: str) -> Optional[str]:
+    """The variable's current value with its registered default applied
+    when UNSET (an explicitly empty value passes through as '' — several
+    knobs give '' a meaning distinct from their default, e.g.
+    SKYTPU_KV_BLOCK). Unregistered names raise: the registry is the
+    contract."""
+    entry = REGISTRY.get(name)
+    if entry is None:
+        raise KeyError(f'{name} is not registered in '
+                       'skypilot_tpu/env_vars.py — add it there (and to '
+                       'the docs table) before reading it')
+    return os.environ.get(name, entry.default)
+
+
+def get_int(name: str) -> int:
+    """``int(get(name))`` with empty-string treated as 0 (several knobs
+    use '' to mean "feature off", e.g. SKYTPU_KV_BLOCK= selects the
+    contiguous KV layout). Call sites whose empty-string semantics
+    differ (e.g. "raise on empty") coerce ``get()`` themselves."""
+    return int(get(name) or 0)
+
+
+def render_markdown_table(
+        subsystems: Optional[Iterable[str]] = None) -> str:
+    """The docs env-var table (docs/serving.md embeds the full one).
+
+    Regenerate with::
+
+        python -c "from skypilot_tpu import env_vars; \
+print(env_vars.render_markdown_table())"
+    """
+    wanted = set(subsystems) if subsystems is not None else None
+    rows = ['| Variable | Default | Subsystem | Description |',
+            '| --- | --- | --- | --- |']
+    for entry in sorted(REGISTRY.values(), key=lambda e: (e.subsystem,
+                                                          e.name)):
+        if wanted is not None and entry.subsystem not in wanted:
+            continue
+        default = '(unset)' if entry.default is None else \
+            f'`{entry.default}`'
+        doc = entry.doc + (' *(exported, not read back)*'
+                           if entry.exported else '')
+        rows.append(f'| `{entry.name}` | {default} | {entry.subsystem} '
+                    f'| {doc} |')
+    return '\n'.join(rows)

@@ -29,6 +29,7 @@ import traceback
 from typing import Optional
 
 from skypilot_tpu import core
+from skypilot_tpu import env_vars
 from skypilot_tpu import exceptions
 from skypilot_tpu import task as task_lib
 from skypilot_tpu.jobs import recovery_strategy
@@ -40,7 +41,7 @@ ManagedJobStatus = state.ManagedJobStatus
 
 
 def _poll_interval() -> float:
-    return float(os.environ.get('SKYTPU_JOBS_POLL_INTERVAL', '15'))
+    return float(env_vars.get('SKYTPU_JOBS_POLL_INTERVAL'))
 
 
 class JobsController:

@@ -29,6 +29,7 @@ from typing import Iterator, Optional
 
 import filelock
 
+from skypilot_tpu import env_vars
 from skypilot_tpu import global_user_state
 from skypilot_tpu.jobs import state
 
@@ -39,7 +40,7 @@ _JOB_MEMORY_MB = 400  # sizing heuristic per alive controller process
 
 
 def max_parallel_launches() -> int:
-    override = os.environ.get('SKYTPU_JOBS_MAX_PARALLEL_LAUNCHES')
+    override = env_vars.get('SKYTPU_JOBS_MAX_PARALLEL_LAUNCHES')
     if override:
         return max(1, int(override))
     return max(4, (os.cpu_count() or 1) * _LAUNCHES_PER_CPU)
@@ -57,7 +58,7 @@ def _total_memory_mb() -> int:
 
 
 def max_parallel_jobs() -> int:
-    override = os.environ.get('SKYTPU_JOBS_MAX_PARALLEL_JOBS')
+    override = env_vars.get('SKYTPU_JOBS_MAX_PARALLEL_JOBS')
     if override:
         return max(1, int(override))
     return max(4, int(_total_memory_mb() * 0.6 / _JOB_MEMORY_MB))

@@ -1,0 +1,10 @@
+"""skylint: AST-based static analysis for skypilot_tpu.
+
+Framework in ``core.py`` (checker registry, per-file AST walk with
+parent/scope tracking, ``# skylint: disable=<check>`` suppressions, JSON
+and human output); the checks themselves live in ``checkers/``. Driver:
+``python scripts/skylint.py``; tier-1 enforcement:
+``tests/test_skylint.py``. See docs/static_analysis.md.
+"""
+from skypilot_tpu.lint.core import (Checker, Finding, LintRun,  # noqa: F401
+                                    all_checkers, register)

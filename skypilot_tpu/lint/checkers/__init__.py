@@ -1,0 +1,6 @@
+"""skylint checkers: importing this package registers every checker."""
+from skypilot_tpu.lint.checkers import blocking_calls  # noqa: F401
+from skypilot_tpu.lint.checkers import env_contract  # noqa: F401
+from skypilot_tpu.lint.checkers import jax_hazards  # noqa: F401
+from skypilot_tpu.lint.checkers import lock_discipline  # noqa: F401
+from skypilot_tpu.lint.checkers import metric_names  # noqa: F401

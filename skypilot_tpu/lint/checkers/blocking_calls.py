@@ -1,0 +1,139 @@
+"""Blocking calls in latency-critical paths.
+
+Hot paths are declared IN the code with a marker comment on (or directly
+above) the ``def`` line::
+
+    def _tick(self) -> None:  # skylint: hot-path
+    # skylint: hot-path allow=network
+    def _proxy(self):
+
+The marked function plus every same-file function it transitively calls
+is hot scope. Inside it, flag:
+
+- ``sleep``      — ``time.sleep(...)``
+- ``network``    — synchronous urllib (``urlopen``), ``socket`` /
+  ``requests`` / ``http.client`` connection calls
+- ``file-io``    — builtin ``open(...)``
+- ``subprocess`` — ``subprocess.*`` / ``os.system`` / ``os.popen``
+
+``allow=<cat>[,<cat>]`` on the marker exempts categories that ARE the
+path's purpose (the LB proxy's upstream request is ``network`` by
+design; a sleep or disk write there would still be a bug).
+
+The motivating sites are the engine step loop (generation scheduler
+``_tick`` + emitter) and the LB proxy path: one stray ``time.sleep`` or
+synchronous metadata fetch there stalls every occupied decode slot (or
+every in-flight client) at once.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set
+
+from skypilot_tpu.lint.core import Checker, FileContext, Finding, register
+
+_MARKER_RE = re.compile(
+    r'#\s*skylint:\s*hot-path(?:\s+allow=(?P<allow>[a-z\-, ]+))?')
+
+_CATEGORIES = ('sleep', 'network', 'file-io', 'subprocess')
+
+
+def _call_category(node: ast.Call) -> str:
+    func = node.func
+    if isinstance(func, ast.Name):
+        if func.id == 'open':
+            return 'file-io'
+        if func.id == 'urlopen':
+            return 'network'
+        return ''
+    if not isinstance(func, ast.Attribute):
+        return ''
+    attr = func.attr
+    base = func.value
+    base_name = base.id if isinstance(base, ast.Name) else \
+        getattr(base, 'attr', '')
+    if attr == 'sleep' and base_name == 'time':
+        return 'sleep'
+    if attr == 'urlopen':  # urllib.request.urlopen / request.urlopen
+        return 'network'
+    if base_name == 'socket' and attr in ('socket', 'create_connection'):
+        return 'network'
+    if base_name == 'requests' and attr in ('get', 'post', 'put',
+                                            'delete', 'request', 'head'):
+        return 'network'
+    if base_name == 'subprocess':
+        return 'subprocess'
+    if base_name == 'os' and attr in ('system', 'popen'):
+        return 'subprocess'
+    return ''
+
+
+@register
+class BlockingCallChecker(Checker):
+    name = 'blocking-hot-path'
+    description = ('time.sleep / sync network / file IO inside '
+                   'skylint hot-path-marked functions')
+
+    def _markers(self, ctx: FileContext) -> Dict[int, Set[str]]:
+        """def-line -> allowed categories, for every marked function."""
+        marked: Dict[int, Set[str]] = {}
+        for i, text in enumerate(ctx.lines, start=1):
+            m = _MARKER_RE.search(text)
+            if not m:
+                continue
+            allow = {c.strip() for c in (m.group('allow') or '').split(',')
+                     if c.strip()}
+            # Marker on a signature line itself, or a standalone comment
+            # whose next line starts the function (its decorators count:
+            # the matcher spans decorator lines through the signature).
+            if text.lstrip().startswith('#'):
+                marked[i + 1] = allow
+            else:
+                marked[i] = allow
+        return marked
+
+    @staticmethod
+    def _marker_span(node) -> range:
+        """Lines where a marker attaches to this function: first
+        decorator (a standalone marker above a decorated def points at
+        the decorator line) through the signature. ``max(..., lineno+1)``
+        keeps the span non-empty for one-line defs, whose body starts on
+        the ``def`` line itself."""
+        start = min([d.lineno for d in node.decorator_list]
+                    + [node.lineno])
+        end = max(node.body[0].lineno, node.lineno + 1)
+        return range(start, end)
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        marked = self._markers(ctx)
+        if not marked:
+            return []
+        index = ctx.functions
+        findings: List[Finding] = []
+        for entry in index.entries:
+            allow = None
+            for line in self._marker_span(entry.node):
+                if line in marked:
+                    allow = marked[line]
+                    break
+            if allow is None:
+                continue
+            root_name = entry.qualname
+            for reached in index.reachable_from([entry]):
+                for node in ast.walk(reached.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    cat = _call_category(node)
+                    if not cat or cat in allow:
+                        continue
+                    via = ('' if reached is entry
+                           else f' (reached via {reached.qualname})')
+                    findings.append(ctx.finding(
+                        node, self.name,
+                        f'{cat} call inside hot path {root_name}'
+                        f'{via}: this blocks the latency-critical loop '
+                        f'— move it off-path, or suppress with a '
+                        f'justifying comment / allow={cat} on the '
+                        f'marker'))
+        return findings

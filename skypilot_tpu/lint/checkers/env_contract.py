@@ -1,0 +1,172 @@
+"""SKYTPU_* environment-variable contract.
+
+Three guarantees, all anchored on ``skypilot_tpu/env_vars.py``:
+
+1. every ``SKYTPU_*`` variable the package reads — via ``os.environ`` /
+   ``os.getenv`` directly, via the ``env_vars`` accessors, or through a
+   module-level name constant (the ``runtime/constants.py`` pattern
+   ``ENV_X = 'SKYTPU_X'`` ... ``os.environ.get(constants.ENV_X)``) —
+   must be registered;
+2. (full tree only) a registered entry that nothing reads is dead and
+   flagged — unless marked ``exported=True`` (set for subprocesses/user
+   tasks, legitimately never read back);
+3. (full tree only) every registered entry must appear in the docs
+   env-var table (docs/serving.md).
+
+Reads are collected per file; resolution against the registry happens in
+``finalize`` so constant names defined in one module and read in another
+still count.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu.lint.core import (Checker, FileContext, Finding,
+                                    register)
+
+_ACCESSOR_ATTRS = {'get', 'pop', 'setdefault'}
+_ENVVARS_ATTRS = {'get', 'get_int'}
+
+
+def _is_environ(node: ast.AST) -> bool:
+    """os.environ / environ / env (the `env = os.environ` alias)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr == 'environ'
+    if isinstance(node, ast.Name):
+        return node.id in ('environ', 'env')
+    return False
+
+
+def _env_read_arg(call: ast.Call) -> Optional[ast.AST]:
+    """The name argument when ``call`` reads the environment."""
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        if func.attr in _ACCESSOR_ATTRS and _is_environ(func.value):
+            return call.args[0] if call.args else None
+        if func.attr == 'getenv' and isinstance(func.value, ast.Name) \
+                and func.value.id == 'os':
+            return call.args[0] if call.args else None
+        if (func.attr in _ENVVARS_ATTRS
+                and isinstance(func.value, ast.Name)
+                and func.value.id == 'env_vars'):
+            return call.args[0] if call.args else None
+    elif isinstance(func, ast.Name) and func.id == 'getenv':
+        return call.args[0] if call.args else None
+    return None
+
+
+@register
+class EnvContractChecker(Checker):
+    name = 'env-contract'
+    description = ('SKYTPU_* reads must be registered in env_vars.py; '
+                   'registered entries must be read and documented')
+
+    def __init__(self):
+        # (var_name, relpath, line) for every literal read.
+        self._reads: List[Tuple[str, str, int]] = []
+        # const name -> SKYTPU_* literal, collected across all files.
+        self._consts: Dict[str, str] = {}
+        # (const_name, relpath, line) reads deferred to finalize.
+        self._const_reads: List[Tuple[str, str, int]] = []
+        # registry entry name -> (relpath, line) in env_vars.py.
+        self._entry_lines: Dict[str, Tuple[str, int]] = {}
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        is_registry = ctx.relpath.replace(os.sep, '/').endswith(
+            'skypilot_tpu/env_vars.py')
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign):
+                # Module/class-level NAME = 'SKYTPU_X' constants.
+                if (isinstance(node.value, ast.Constant)
+                        and isinstance(node.value.value, str)
+                        and node.value.value.startswith('SKYTPU_')):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self._consts[t.id] = node.value.value
+            elif isinstance(node, ast.Call):
+                if is_registry:
+                    # _v('SKYTPU_X', ...) registration sites.
+                    if (isinstance(node.func, ast.Name)
+                            and node.func.id == '_v' and node.args
+                            and isinstance(node.args[0], ast.Constant)):
+                        self._entry_lines[node.args[0].value] = (
+                            ctx.relpath, node.lineno)
+                    continue  # the registry itself reads os.environ
+                arg = _env_read_arg(node)
+                if arg is None:
+                    continue
+                if isinstance(arg, ast.Constant) \
+                        and isinstance(arg.value, str):
+                    if arg.value.startswith('SKYTPU_'):
+                        self._reads.append((arg.value, ctx.relpath,
+                                            arg.lineno))
+                elif isinstance(arg, ast.Name):
+                    self._const_reads.append((arg.id, ctx.relpath,
+                                              arg.lineno))
+                elif isinstance(arg, ast.Attribute):
+                    # constants.ENV_X — resolve by the attribute name.
+                    self._const_reads.append((arg.attr, ctx.relpath,
+                                              arg.lineno))
+            elif isinstance(node, ast.Subscript):
+                # os.environ['SKYTPU_X'] loads.
+                if (_is_environ(node.value)
+                        and isinstance(node.ctx, ast.Load)
+                        and isinstance(node.slice, ast.Constant)
+                        and isinstance(node.slice.value, str)
+                        and node.slice.value.startswith('SKYTPU_')
+                        and not is_registry):
+                    self._reads.append((node.slice.value, ctx.relpath,
+                                        node.lineno))
+        return []
+
+    def finalize(self, run) -> List[Finding]:
+        from skypilot_tpu import env_vars
+        reads = list(self._reads)
+        for const_name, relpath, line in self._const_reads:
+            literal = self._consts.get(const_name)
+            if literal is not None:
+                reads.append((literal, relpath, line))
+        findings: List[Finding] = []
+        for var, relpath, line in reads:
+            if var not in env_vars.REGISTRY:
+                findings.append(Finding(
+                    relpath, line, 0, self.name,
+                    f'{var} is read here but not registered in '
+                    'skypilot_tpu/env_vars.py — register it (name, '
+                    'default, subsystem, doc) and add it to the docs '
+                    'table'))
+        if not run.full_tree:
+            return findings
+        read_names = {var for var, _, _ in reads}
+        for var, entry in sorted(env_vars.REGISTRY.items()):
+            relpath, line = self._entry_lines.get(
+                var, ('skypilot_tpu/env_vars.py', 1))
+            if not entry.exported and var not in read_names:
+                findings.append(Finding(
+                    relpath, line, 0, self.name,
+                    f'registry entry {var} is read nowhere in the '
+                    'package — dead contract; delete it or mark it '
+                    'exported=True if it is only set for subprocesses'))
+        docs_path = os.path.join(run.repo_root, 'docs', 'serving.md')
+        try:
+            with open(docs_path, encoding='utf-8') as f:
+                docs = f.read()
+        except OSError:
+            docs = None
+        if docs is not None:
+            for var in sorted(env_vars.REGISTRY):
+                # Backtick-delimited, as the generated table renders it:
+                # a bare substring test would let SKYTPU_KV_BLOCK hide
+                # inside the SKYTPU_KV_BLOCKS row.
+                if f'`{var}`' not in docs:
+                    relpath, line = self._entry_lines.get(
+                        var, ('skypilot_tpu/env_vars.py', 1))
+                    findings.append(Finding(
+                        relpath, line, 0, self.name,
+                        f'{var} is registered but missing from the '
+                        'docs env-var table (docs/serving.md) — '
+                        'regenerate it with '
+                        'env_vars.render_markdown_table()'))
+        return findings

@@ -1,0 +1,165 @@
+"""JAX host-sync / recompile hazards inside traced code.
+
+Scope: functions that are jit-compiled — decorated with
+``jax.jit``/``pjit`` (possibly through ``functools.partial``) or passed
+to a ``jax.jit(...)``/``pjit(...)`` call (the engine's
+``self._step = jax.jit(self._step_impl)`` pattern) — plus every same-file
+function transitively reachable from them. Inside that traced scope,
+flag operations that either force a device->host sync per call or make
+compilation depend on ambient host state:
+
+- ``.item()`` / ``.tolist()`` / ``.numpy()`` on any value, and
+  ``jax.device_get`` / ``.block_until_ready()`` — host syncs;
+- bare ``int(...)`` / ``float(...)`` / ``bool(...)`` casts — on a traced
+  value these force a sync (and fail under jit for non-concrete values);
+  traced code uses ``jnp``/``lax`` casts instead;
+- ``np.asarray`` / ``np.array`` / ``numpy.asarray`` of anything — pulls
+  a device array to host;
+- ``os.environ`` / ``os.getenv`` reads — a Python branch on env state
+  inside traced code bakes the value into the compiled program, so two
+  processes (or one process before/after an env change) silently compile
+  different programs: the recompile/divergence hazard the runtime
+  ``StepProfiler`` recompile counter can only observe after the fact.
+  This check is the build-time half of that guarantee.
+
+Analysis is per-file (cross-module calls are not followed) — the engine
+keeps its traced math in one module precisely so this stays sound.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from skypilot_tpu.lint.core import (Checker, FileContext, Finding,
+                                    FunctionEntry, register)
+
+_SYNC_METHODS = {'item', 'tolist', 'numpy', 'block_until_ready'}
+_HOST_CASTS = {'int', 'float', 'bool'}
+
+
+def _is_jit_name(node: ast.AST) -> bool:
+    """jax.jit / jax.pjit / jit / pjit (as Name or Attribute)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr in ('jit', 'pjit')
+    if isinstance(node, ast.Name):
+        return node.id in ('jit', 'pjit')
+    return False
+
+
+def _jit_call_target(call: ast.Call) -> Optional[str]:
+    """For ``jax.jit(X, ...)`` / ``partial(jax.jit, ...)(X)`` return X's
+    referenced function name (bare name or self.<name>)."""
+    func = call.func
+    is_jit = _is_jit_name(func)
+    if not is_jit and isinstance(func, ast.Call):
+        # functools.partial(jax.jit, ...) applied to the target.
+        inner = func.func
+        if (isinstance(inner, (ast.Name, ast.Attribute))
+                and (getattr(inner, 'attr', None) == 'partial'
+                     or getattr(inner, 'id', None) == 'partial')):
+            is_jit = any(_is_jit_name(a) for a in func.args)
+    if not is_jit or not call.args:
+        return None
+    target = call.args[0]
+    if isinstance(target, ast.Name):
+        return target.id
+    if (isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id in ('self', 'cls')):
+        return target.attr
+    return None
+
+
+def _is_jit_decorated(node: ast.AST) -> bool:
+    for dec in getattr(node, 'decorator_list', []):
+        if _is_jit_name(dec):
+            return True
+        if isinstance(dec, ast.Call):
+            if _is_jit_name(dec.func):
+                return True
+            # @partial(jax.jit, static_argnums=...)
+            if any(_is_jit_name(a) for a in dec.args):
+                return True
+    return False
+
+
+@register
+class JaxHazardChecker(Checker):
+    name = 'jax-host-sync'
+    description = ('host syncs and env-dependent branches inside '
+                   'jit-traced code')
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        index = ctx.functions
+        roots: List[FunctionEntry] = []
+        jit_target_names: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                target = _jit_call_target(node)
+                if target is not None:
+                    jit_target_names.add(target)
+        for entry in index.entries:
+            if (_is_jit_decorated(entry.node)
+                    or entry.name in jit_target_names):
+                roots.append(entry)
+        if not roots:
+            return []
+        findings: List[Finding] = []
+        for entry in index.reachable_from(roots):
+            findings.extend(self._check_traced(ctx, entry))
+        return findings
+
+    def _check_traced(self, ctx: FileContext,
+                      entry: FunctionEntry) -> List[Finding]:
+        findings: List[Finding] = []
+        where = f'traced scope of {entry.qualname}'
+        for node in ast.walk(entry.node):
+            if not isinstance(node, ast.Call):
+                # os.environ[...] subscripts (rare inside traced code).
+                if (isinstance(node, ast.Attribute)
+                        and node.attr == 'environ'
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id == 'os'):
+                    findings.append(ctx.finding(
+                        node, self.name,
+                        f'os.environ read in {where}: the value is '
+                        'baked into the compiled program — hoist it to '
+                        'the host side and pass it as an argument or '
+                        'static config'))
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                if func.attr in _SYNC_METHODS:
+                    findings.append(ctx.finding(
+                        node, self.name,
+                        f'.{func.attr}() in {where} forces a '
+                        'device->host sync per call — keep values on '
+                        'device (jnp ops) or fetch once outside the '
+                        'traced/step path'))
+                elif (func.attr in ('asarray', 'array')
+                      and isinstance(func.value, ast.Name)
+                      and func.value.id in ('np', 'numpy')):
+                    findings.append(ctx.finding(
+                        node, self.name,
+                        f'{func.value.id}.{func.attr}() in {where} '
+                        'materializes on host — use jnp.asarray or keep '
+                        'the array on device'))
+                elif (func.attr in ('device_get', 'getenv')
+                      and isinstance(func.value, ast.Name)
+                      and func.value.id in ('jax', 'os')):
+                    what = ('jax.device_get' if func.attr == 'device_get'
+                            else 'os.getenv')
+                    findings.append(ctx.finding(
+                        node, self.name,
+                        f'{what} in {where}: '
+                        + ('host sync' if func.attr == 'device_get'
+                           else 'env-dependent compile') + ' — hoist '
+                        'out of the traced path'))
+            elif isinstance(func, ast.Name) and func.id in _HOST_CASTS:
+                findings.append(ctx.finding(
+                    node, self.name,
+                    f'{func.id}() in {where}: on a traced value this is '
+                    'a host sync (and a trace error for non-concrete '
+                    'values) — use jnp/lax casts inside jit, or hoist '
+                    'the host scalar out'))
+        return findings

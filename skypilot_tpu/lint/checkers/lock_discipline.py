@@ -1,0 +1,172 @@
+"""Lock-discipline race detector.
+
+Per class: collect every ``self.<x>`` attribute that is MUTATED inside a
+``with self.<lock>:`` block — those attributes are, by the author's own
+declaration, lock-protected shared state. Any access (read or write) of
+the same attribute outside any lock, in a *different* method, is a
+cross-thread race candidate and is flagged.
+
+Deliberate scope cuts (kept small so every finding is actionable):
+
+- a method that itself mutates the attribute under the lock may also
+  touch it unguarded (fast-path check-then-lock idioms) — only
+  cross-method unguarded access flags;
+- ``__init__`` is exempt (the object is not shared yet) and so are
+  methods whose name ends ``_locked`` (convention: caller holds the
+  lock — the convention this checker makes load-bearing).
+
+The motivating sites: the paged-KV BlockAllocator, the replica manager's
+metrics maps, and the generation scheduler's backlog/emission queues —
+all mutated on scheduler/controller threads while HTTP handler threads
+read them for /stats and admission.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from skypilot_tpu.lint.core import Checker, FileContext, Finding, register
+
+# self.<attr>.<method>() calls that mutate the container in place.
+_MUTATORS = {
+    'append', 'appendleft', 'add', 'extend', 'insert', 'remove',
+    'discard', 'pop', 'popitem', 'popleft', 'clear', 'update',
+    'setdefault', 'put', 'put_nowait', 'sort', 'reverse', 'move_to_end',
+}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == 'self'):
+        return node.attr
+    return None
+
+
+class _Access:
+    __slots__ = ('attr', 'method', 'node', 'is_mutation', 'lock')
+
+    def __init__(self, attr, method, node, is_mutation, lock):
+        self.attr = attr
+        self.method = method
+        self.node = node
+        self.is_mutation = is_mutation
+        self.lock = lock
+
+
+@register
+class LockDisciplineChecker(Checker):
+    name = 'lock-discipline'
+    description = ('cross-method unguarded access to attributes that are '
+                   'elsewhere mutated under a lock')
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._check_class(ctx, node))
+        return findings
+
+    # -- per class ----------------------------------------------------------
+    def _check_class(self, ctx: FileContext,
+                     cls: ast.ClassDef) -> List[Finding]:
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        locks = self._lock_attrs(methods)
+        if not locks:
+            return []
+        accesses: List[_Access] = []
+        for m in methods:
+            self._visit(m, m.name, locks, None, accesses, ctx.parents)
+        # attr -> {method names that mutate it under a lock}, and the
+        # lock(s) that guard it (for the message).
+        guarded_in: Dict[str, Set[str]] = {}
+        guard_lock: Dict[str, str] = {}
+        for a in accesses:
+            if a.is_mutation and a.lock is not None:
+                guarded_in.setdefault(a.attr, set()).add(a.method)
+                guard_lock.setdefault(a.attr, a.lock)
+        findings = []
+        for a in accesses:
+            if (a.lock is None and a.attr in guarded_in
+                    and a.method not in guarded_in[a.attr]
+                    and a.method != '__init__'
+                    and not a.method.endswith('_locked')):
+                kind = 'write' if a.is_mutation else 'read'
+                where = ', '.join(sorted(guarded_in[a.attr]))
+                findings.append(ctx.finding(
+                    a.node, self.name,
+                    f'{cls.name}.{a.attr} is mutated under '
+                    f'self.{guard_lock[a.attr]} (in {where}) but '
+                    f'{kind} here without the lock — cross-thread '
+                    f'race; guard it or suppress with a justifying '
+                    f'comment'))
+        return findings
+
+    def _lock_attrs(self, methods) -> Set[str]:
+        locks: Set[str] = set()
+        for m in methods:
+            for node in ast.walk(m):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        attr = _self_attr(item.context_expr)
+                        if attr is not None:
+                            locks.add(attr)
+                elif isinstance(node, ast.Assign):
+                    # self._x = threading.Lock() / RLock()
+                    if (isinstance(node.value, ast.Call)
+                            and isinstance(node.value.func, ast.Attribute)
+                            and node.value.func.attr in ('Lock', 'RLock')):
+                        for t in node.targets:
+                            attr = _self_attr(t)
+                            if attr is not None:
+                                locks.add(attr)
+        # Only attrs actually used as context managers are locks; the
+        # Lock()-assignment pass alone would also catch locks handed to
+        # other objects. Keep any attr found either way: with-use is the
+        # primary signal, the assignment covers locks used via helpers.
+        return locks
+
+    # -- access collection ---------------------------------------------------
+    def _visit(self, node: ast.AST, method: str, locks: Set[str],
+               lock: Optional[str], out: List[_Access],
+               parents: Dict[ast.AST, ast.AST]) -> None:
+        if isinstance(node, ast.With):
+            held = lock
+            for item in node.items:
+                attr = _self_attr(item.context_expr)
+                if attr is not None and attr in locks:
+                    held = attr
+            for item in node.items:
+                self._visit(item.context_expr, method, locks, lock, out,
+                            parents)
+            for child in node.body:
+                self._visit(child, method, locks, held, out, parents)
+            return
+        attr = _self_attr(node)
+        if (attr is not None
+                and attr not in locks):
+            out.append(_Access(attr, method, node,
+                               _is_mutation(node, parents), lock))
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, method, locks, lock, out, parents)
+
+
+def _is_mutation(node: ast.Attribute,
+                 parents: Dict[ast.AST, ast.AST]) -> bool:
+    """Store/Del of the attribute itself, a subscript store/del through
+    it, or an in-place mutator method call on it."""
+    if isinstance(node.ctx, (ast.Store, ast.Del)):
+        return True
+    parent = parents.get(node)
+    if (isinstance(parent, ast.Subscript) and parent.value is node
+            and isinstance(parent.ctx, (ast.Store, ast.Del))):
+        return True
+    if (isinstance(parent, ast.Attribute) and parent.value is node
+            and parent.attr in _MUTATORS):
+        grandparent = parents.get(parent)
+        if isinstance(grandparent, ast.Call) \
+                and grandparent.func is parent:
+            return True
+    return False

@@ -1,0 +1,77 @@
+"""Metric naming convention + family coverage (the former
+scripts/check_metric_names.py, folded into the skylint framework —
+the script remains as a thin shim over this checker).
+
+Per file: every ``counter(``/``gauge(``/``histogram(`` call whose first
+argument is a string literal must satisfy
+``utils.metrics.validate_name`` (``skytpu_<subsystem>_<name>_<unit>``).
+The registry enforces the same rule at registration time; the static
+scan catches names on code paths tests never execute.
+
+Full tree only: the load-bearing metric FAMILIES (bench records,
+dashboards, docs tables reference them by prefix) must each have at
+least one registration — a refactor that renames a family away silently
+breaks every consumer, so its existence is a tier-1 guarantee.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from skypilot_tpu.lint.core import Checker, FileContext, Finding, register
+
+EXPECTED_FAMILIES = (
+    'skytpu_serve_',      # scheduler/admission plane
+    'skytpu_engine_',     # decode engine step profiling
+    'skytpu_engine_kv_',  # paged-KV pool + prefix cache
+    'skytpu_lb_',         # load balancer proxy series
+)
+
+_CONSTRUCTORS = {'counter', 'gauge', 'histogram'}
+
+
+@register
+class MetricNameChecker(Checker):
+    name = 'metric-name'
+    description = ('metric names must follow '
+                   'skytpu_<subsystem>_<name>_<unit>; expected families '
+                   'must stay registered')
+
+    def __init__(self):
+        self._all_names: List[str] = []
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        from skypilot_tpu.utils.metrics import validate_name
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            fname = (func.id if isinstance(func, ast.Name)
+                     else func.attr if isinstance(func, ast.Attribute)
+                     else None)
+            if fname not in _CONSTRUCTORS or not node.args:
+                continue
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                continue
+            name = arg.value
+            self._all_names.append(name)
+            err = validate_name(name)
+            if err:
+                findings.append(ctx.finding(arg, self.name, err))
+        return findings
+
+    def finalize(self, run) -> List[Finding]:
+        if not run.full_tree:
+            return []
+        findings: List[Finding] = []
+        for family in EXPECTED_FAMILIES:
+            if not any(n.startswith(family) for n in self._all_names):
+                findings.append(Finding(
+                    'skypilot_tpu/utils/metrics.py', 1, 0, self.name,
+                    f'expected metric family {family}* has no '
+                    'registration in the tree (renamed away? update '
+                    'EXPECTED_FAMILIES and every consumer)'))
+        return findings

@@ -1,0 +1,323 @@
+"""skylint framework: checker registry, AST file contexts, suppressions.
+
+A checker subclasses :class:`Checker` and registers with
+:func:`register`. Per file it gets a :class:`FileContext` (source, AST,
+parent links, a function index with intra-file call resolution); checks
+that need cross-file aggregation stash state on ``self`` during
+``check_file`` and emit the aggregate findings from ``finalize``.
+
+Suppressions: a finding is dropped when its line (or a pure-comment line
+directly above it) carries ``# skylint: disable=<check>[,<check>]`` (a
+bare ``# skylint: disable`` suppresses every check on that line). Each
+suppression is expected to carry a justification in the surrounding
+comment — that is the reviewable record of "yes, this is deliberate".
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+_DISABLE_RE = re.compile(
+    r'#\s*skylint:\s*disable(?:=(?P<checks>[A-Za-z0-9_,\- ]+))?')
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str          # repo-relative
+    line: int
+    col: int
+    check: str
+    message: str
+
+    def render(self) -> str:
+        return f'{self.path}:{self.line}:{self.col}: ' \
+               f'[{self.check}] {self.message}'
+
+
+class FileContext:
+    """One parsed file: source, AST, parent links, function index."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        self._functions: Optional['FunctionIndex'] = None
+        self._suppressions: Optional[Dict[int, Optional[Set[str]]]] = None
+
+    @property
+    def functions(self) -> 'FunctionIndex':
+        if self._functions is None:
+            self._functions = FunctionIndex(self.tree)
+        return self._functions
+
+    def finding(self, node_or_line, check: str, message: str) -> Finding:
+        if isinstance(node_or_line, int):
+            line, col = node_or_line, 0
+        else:
+            line = getattr(node_or_line, 'lineno', 1)
+            col = getattr(node_or_line, 'col_offset', 0)
+        return Finding(self.relpath, line, col, check, message)
+
+    # -- suppressions -------------------------------------------------------
+    def _suppression_map(self) -> Dict[int, Optional[Set[str]]]:
+        """line -> None (suppress all) or set of check names."""
+        if self._suppressions is None:
+            out: Dict[int, Optional[Set[str]]] = {}
+            for i, text in enumerate(self.lines, start=1):
+                m = _DISABLE_RE.search(text)
+                if not m:
+                    continue
+                checks = m.group('checks')
+                if checks is None:
+                    out[i] = None
+                else:
+                    out[i] = {c.strip() for c in checks.split(',')
+                              if c.strip()}
+            self._suppressions = out
+        return self._suppressions
+
+    def is_suppressed(self, finding: Finding) -> bool:
+        sup = self._suppression_map()
+        for line in (finding.line, finding.line - 1):
+            if line in sup:
+                checks = sup[line]
+                if checks is None or finding.check in checks:
+                    # A directive on the line above only counts when that
+                    # line is a pure comment (not trailing another stmt).
+                    if (line == finding.line
+                            or self.lines[line - 1].lstrip()
+                            .startswith('#')):
+                        return True
+        return False
+
+
+@dataclasses.dataclass
+class FunctionEntry:
+    node: ast.AST               # FunctionDef / AsyncFunctionDef
+    name: str
+    qualname: str               # dotted path through classes/functions
+    class_name: Optional[str]   # nearest enclosing class, if any
+
+
+class FunctionIndex:
+    """Every function/method in a file, with intra-file call resolution
+    (``self.x()`` -> method of the same class; bare ``f()`` -> module or
+    enclosing-scope function). Cross-module calls resolve to None — the
+    analyses here are deliberately per-file."""
+
+    def __init__(self, tree: ast.Module):
+        self.entries: List[FunctionEntry] = []
+        self.by_node: Dict[ast.AST, FunctionEntry] = {}
+        self._walk(tree, prefix='', class_name=None)
+
+    def _walk(self, node: ast.AST, prefix: str,
+              class_name: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f'{prefix}{child.name}'
+                entry = FunctionEntry(child, child.name, qual, class_name)
+                self.entries.append(entry)
+                self.by_node[child] = entry
+                self._walk(child, prefix=qual + '.', class_name=class_name)
+            elif isinstance(child, ast.ClassDef):
+                self._walk(child, prefix=f'{prefix}{child.name}.',
+                           class_name=child.name)
+            else:
+                self._walk(child, prefix=prefix, class_name=class_name)
+
+    def lookup(self, name: str,
+               class_name: Optional[str]) -> Optional[FunctionEntry]:
+        # Same-class method first, then module level.
+        if class_name is not None:
+            for e in self.entries:
+                if e.name == name and e.class_name == class_name:
+                    return e
+        for e in self.entries:
+            if e.name == name and e.class_name is None:
+                return e
+        return None
+
+    def resolve_call(self, call: ast.Call,
+                     current: FunctionEntry) -> Optional[FunctionEntry]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            return self.lookup(func.id, current.class_name) \
+                or self.lookup(func.id, None)
+        if (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id in ('self', 'cls')):
+            return self.lookup(func.attr, current.class_name)
+        return None
+
+    def reachable_from(self, roots: Sequence[FunctionEntry]
+                       ) -> List[FunctionEntry]:
+        """Roots plus every same-file function transitively called."""
+        seen: Set[ast.AST] = set()
+        order: List[FunctionEntry] = []
+        stack = list(roots)
+        while stack:
+            entry = stack.pop()
+            if entry.node in seen:
+                continue
+            seen.add(entry.node)
+            order.append(entry)
+            for node in ast.walk(entry.node):
+                if isinstance(node, ast.Call):
+                    target = self.resolve_call(node, entry)
+                    if target is not None and target.node not in seen:
+                        stack.append(target)
+        return order
+
+
+class Checker:
+    """Base checker. Subclasses set ``name``/``description`` and
+    implement ``check_file``; cross-file checks also implement
+    ``finalize`` (called once after every file, with ``run``)."""
+
+    name = 'base'
+    description = ''
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self, run: 'LintRun') -> Iterable[Finding]:
+        return ()
+
+
+_CHECKERS: List[type] = []
+
+
+def register(cls: type) -> type:
+    _CHECKERS.append(cls)
+    return cls
+
+
+def all_checkers() -> List[type]:
+    # Import for side effect: each module registers its checker class.
+    from skypilot_tpu.lint import checkers  # noqa: F401
+    return list(_CHECKERS)
+
+
+class LintRun:
+    """One lint pass over a file tree.
+
+    ``full_tree`` gates the aggregate contracts (metric-family coverage,
+    dead env-var entries, docs table): they are only meaningful over the
+    whole package, and a narrower root — a fixture dir, one subpackage —
+    must not fail for legitimately lacking the rest of the tree.
+    """
+
+    def __init__(self, roots: Sequence[str], full_tree: bool = False,
+                 checks: Optional[Sequence[str]] = None):
+        self.roots = [os.path.abspath(r) for r in roots]
+        self.full_tree = full_tree
+        self.repo_root = _repo_root()
+        known = {cls.name for cls in all_checkers()}
+        selected = set(checks) if checks else None
+        if selected is not None and selected - known:
+            # A typo'd --check would otherwise select zero checkers and
+            # report a false-clean tree with exit 0.
+            raise ValueError(
+                f'unknown check(s) {sorted(selected - known)}; '
+                f'known: {sorted(known)}')
+        self.checkers: List[Checker] = [
+            cls() for cls in all_checkers()
+            if selected is None or cls.name in selected]
+        self.contexts: List[FileContext] = []
+        self.findings: List[Finding] = []
+        self.suppressed: List[Finding] = []
+        self.parse_errors: List[Finding] = []
+
+    def _iter_files(self) -> Iterable[str]:
+        for root in self.roots:
+            if os.path.isfile(root):
+                yield root
+                continue
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d != '__pycache__')
+                for fn in sorted(filenames):
+                    if fn.endswith('.py'):
+                        yield os.path.join(dirpath, fn)
+
+    def run(self) -> List[Finding]:
+        for path in self._iter_files():
+            relpath = os.path.relpath(path, self.repo_root)
+            try:
+                with open(path, encoding='utf-8') as f:
+                    source = f.read()
+                ctx = FileContext(path, relpath, source)
+            except (SyntaxError, ValueError, OSError) as e:
+                self.parse_errors.append(Finding(
+                    relpath, getattr(e, 'lineno', 1) or 1, 0, 'parse',
+                    f'cannot analyze: {type(e).__name__}: {e}'))
+                continue
+            self.contexts.append(ctx)
+            for checker in self.checkers:
+                for finding in checker.check_file(ctx):
+                    self._collect(ctx, finding)
+        ctx_by_rel = {c.relpath: c for c in self.contexts}
+        for checker in self.checkers:
+            for finding in checker.finalize(self):
+                ctx = ctx_by_rel.get(finding.path)
+                if ctx is not None:
+                    self._collect(ctx, finding)
+                else:
+                    self.findings.append(finding)
+        self.findings.extend(self.parse_errors)
+        self.findings.sort(key=lambda f: (f.path, f.line, f.check))
+        return self.findings
+
+    def _collect(self, ctx: FileContext, finding: Finding) -> None:
+        if ctx.is_suppressed(finding):
+            self.suppressed.append(finding)
+        else:
+            self.findings.append(finding)
+
+    # -- output -------------------------------------------------------------
+    def render_human(self) -> str:
+        out = [f.render() for f in self.findings]
+        out.append(f'skylint: {len(self.contexts)} files, '
+                   f'{len(self.findings)} findings '
+                   f'({len(self.suppressed)} suppressed)')
+        return '\n'.join(out)
+
+    def to_json(self) -> str:
+        return json.dumps({
+            'roots': [os.path.relpath(r, self.repo_root)
+                      for r in self.roots],
+            'files_scanned': len(self.contexts),
+            'checks': [c.name for c in self.checkers],
+            'findings': [dataclasses.asdict(f) for f in self.findings],
+            'suppressed': [dataclasses.asdict(f)
+                           for f in self.suppressed],
+        }, indent=2)
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def run_skylint(roots: Optional[Sequence[str]] = None,
+                full_tree: Optional[bool] = None,
+                checks: Optional[Sequence[str]] = None) -> LintRun:
+    """Convenience entry: default roots = the whole package tree."""
+    default_root = os.path.join(_repo_root(), 'skypilot_tpu')
+    if not roots:
+        roots = [default_root]
+        if full_tree is None:
+            full_tree = True
+    run = LintRun(roots, full_tree=bool(full_tree), checks=checks)
+    run.run()
+    return run

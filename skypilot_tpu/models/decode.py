@@ -28,7 +28,6 @@ Design (vs the correctness-oracle ``LlamaModel.decode_step``):
 from __future__ import annotations
 
 import dataclasses
-import os
 import time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
@@ -37,6 +36,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from skypilot_tpu import env_vars
 from skypilot_tpu.models import paged_kv
 from skypilot_tpu.models.llama import LlamaConfig, LlamaModel, Params
 from skypilot_tpu.ops import attention as attention_ops
@@ -157,7 +157,7 @@ class DecodeEngine:
         self.batch_slots = batch_slots
         self.max_len = max_len or config.max_seq_len
         if kv_block is None:
-            kv_block = int(os.environ.get('SKYTPU_KV_BLOCK', '64') or 0)
+            kv_block = env_vars.get_int('SKYTPU_KV_BLOCK')
         self.kv_block = max(0, int(kv_block))
         self.paged = self.kv_block > 0
         if self.paged:
@@ -166,8 +166,7 @@ class DecodeEngine:
             # not a block multiple (the overhang is always masked).
             self.m_pad = self.max_blocks * self.kv_block
             if kv_blocks is None:
-                kv_blocks = int(os.environ.get('SKYTPU_KV_BLOCKS', '0')
-                                or 0) or None
+                kv_blocks = env_vars.get_int('SKYTPU_KV_BLOCKS') or None
             if kv_blocks is None:
                 kv_blocks = batch_slots * self.max_blocks + 1
             self.kv_blocks = max(int(kv_blocks), 2)
@@ -711,6 +710,7 @@ class DecodeEngine:
         return _sample(logits[None], sub, temperature, top_k)[0], rng
 
     # -- decode step --------------------------------------------------------
+    # skylint: hot-path
     def step(self, params: Params, state: DecodeState, rng: jax.Array,
              temperature=0.0, top_k=0
              ) -> Tuple[DecodeState, jax.Array, jax.Array]:

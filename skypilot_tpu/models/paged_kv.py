@@ -109,11 +109,13 @@ class BlockAllocator:
         self.reserved = reserved
         self._lock = threading.Lock()
         self._m = _KvMetrics() if metrics_lib.enabled() else None
-        self._init_tables()
+        self._init_tables_locked()
         if self._m is not None:
             self._m.pool_blocks.set(self.capacity)
 
-    def _init_tables(self) -> None:
+    def _init_tables_locked(self) -> None:
+        """Caller holds ``_lock`` (``reset``) or the allocator is not
+        yet shared (``__init__``)."""
         self._free: List[int] = list(range(self.reserved,
                                            self.num_blocks))
         self._ref: Dict[int, int] = {}
@@ -304,7 +306,7 @@ class BlockAllocator:
         """Forget everything (crash recovery alongside a fresh
         ``init_state``)."""
         with self._lock:
-            self._init_tables()
+            self._init_tables_locked()
             self._update_gauges_locked()
 
     def stats(self) -> Dict[str, float]:

@@ -18,6 +18,7 @@ like the reference's tests/test_serve_autoscaler.py drive.
 from __future__ import annotations
 
 import math
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -30,6 +31,12 @@ class RequestRateAutoscaler:
                  decision_interval_seconds: float = 20.0):
         self.policy = spec.replica_policy
         self.interval = max(decision_interval_seconds, 1e-6)
+        # The request history and the fleet-signal snapshot cross
+        # threads: the controller's HTTP /load handler appends
+        # timestamps while the tick thread windows/reads them (an
+        # unlocked filter-and-rebind here dropped whole LB report
+        # batches that landed mid-evaluate).
+        self._lock = threading.Lock()
         self._request_times: List[float] = []
         # Hysteresis state: how many consecutive evaluations proposed a
         # higher/lower target than the adopted one.
@@ -50,7 +57,14 @@ class RequestRateAutoscaler:
     def observe_fleet(self, signals: Dict[str, float]) -> None:
         """Adopt the controller's per-tick fleet metrics snapshot (keyed
         by metric name, summed across replicas)."""
-        self.fleet_signals = dict(signals)
+        with self._lock:
+            self.fleet_signals = dict(signals)
+
+    def latest_fleet_signals(self) -> Dict[str, float]:
+        """Snapshot of the last observed fleet signals (what the
+        SLO-scaling policy will consume from evaluate())."""
+        with self._lock:
+            return dict(self.fleet_signals)
 
     def update_spec(self, spec: spec_lib.ServiceSpec) -> None:
         """Adopt a new replica policy (rolling update) without losing the
@@ -67,14 +81,16 @@ class RequestRateAutoscaler:
                          now: Optional[float] = None) -> None:
         now = time.time() if now is None else now
         cutoff = now - self.policy.qps_window_seconds
-        self._request_times = (
-            [t for t in self._request_times if t >= cutoff]
-            + [t for t in timestamps if t >= cutoff])
+        with self._lock:
+            self._request_times = (
+                [t for t in self._request_times if t >= cutoff]
+                + [t for t in timestamps if t >= cutoff])
 
     def observed_qps(self, now: Optional[float] = None) -> float:
         now = time.time() if now is None else now
         cutoff = now - self.policy.qps_window_seconds
-        n = sum(1 for t in self._request_times if t >= cutoff)
+        with self._lock:
+            n = sum(1 for t in self._request_times if t >= cutoff)
         return n / self.policy.qps_window_seconds
 
     # -- target computation ---------------------------------------------------

@@ -27,6 +27,7 @@ import time
 import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from skypilot_tpu import env_vars
 from skypilot_tpu.serve import autoscaler as autoscaler_lib
 from skypilot_tpu.serve import replica_manager as rm_lib
 from skypilot_tpu.serve import serve_state
@@ -38,7 +39,7 @@ ReplicaStatus = serve_state.ReplicaStatus
 
 
 def _tick() -> float:
-    return float(os.environ.get('SKYTPU_SERVE_TICK', '20'))
+    return float(env_vars.get('SKYTPU_SERVE_TICK'))
 
 
 class _ControlHandler(BaseHTTPRequestHandler):

@@ -32,7 +32,6 @@ the LB's flow events in Perfetto. Metrics instrumentation is a single
 from __future__ import annotations
 
 import json
-import os
 import queue
 import threading
 import time
@@ -40,6 +39,7 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu import env_vars
 from skypilot_tpu.models import paged_kv
 from skypilot_tpu.models.decode import (DecodeEngine, chunk_spans,
                                         prefill_bucket)
@@ -200,7 +200,7 @@ class GenerationScheduler:
     # exactly this group size so each traffic bucket compiles exactly
     # ONE extra variant (free N would compile N=2/N=3 variants
     # mid-traffic, each a multi-10s XLA stall).
-    ADMIT_BATCH_MAX = int(os.environ.get('SKYTPU_ADMIT_BATCH', '1') or 1)
+    ADMIT_BATCH_MAX = int(env_vars.get('SKYTPU_ADMIT_BATCH') or 1)
 
     def __init__(self, config: LlamaConfig, params: Any,
                  batch_slots: int = 8, max_len: Optional[int] = None,
@@ -254,20 +254,20 @@ class GenerationScheduler:
         self._rng = jax.random.key(0)
         self.prefill_chunk = int(
             prefill_chunk if prefill_chunk is not None
-            else os.environ.get('SKYTPU_PREFILL_CHUNK', '0') or 0)
+            else env_vars.get('SKYTPU_PREFILL_CHUNK') or 0)
         self.prefill_budget = int(
             prefill_budget if prefill_budget is not None
-            else os.environ.get('SKYTPU_PREFILL_BUDGET', '0') or 0)
+            else env_vars.get('SKYTPU_PREFILL_BUDGET') or 0)
         self.ttft_slo_ms = float(
             ttft_slo_ms if ttft_slo_ms is not None
-            else os.environ.get('SKYTPU_TTFT_SLO_MS', '0') or 0)
+            else env_vars.get('SKYTPU_TTFT_SLO_MS') or 0)
         # Effective prefill throughput (tokens/s) EMA, measured by the
         # emitter from admit-start -> first-token-emitted per request, so
         # it reflects the real interleaved rate under load. None until
         # the first measurement unless seeded ($SKYTPU_PREFILL_TOKENS_
         # PER_S) — without evidence, admission control never rejects.
         self._prefill_rate: Optional[float] = float(
-            os.environ.get('SKYTPU_PREFILL_TOKENS_PER_S', '0') or 0) or None
+            env_vars.get('SKYTPU_PREFILL_TOKENS_PER_S') or 0) or None
         # Full-weight EMA reference length (~ the anchor prompt when
         # chunked): shorter prompts update the rate proportionally less.
         self._rate_ref_len = (8 * self.prefill_chunk
@@ -396,7 +396,7 @@ class GenerationScheduler:
         prefill cost via a successful admission_check (which reserves
         atomically with its estimate); direct submitters leave it False
         and the cost is added here."""
-        self.counters['requests'] += 1
+        self._count('requests')
         if self._m is not None:
             self._m.requests.inc()
         if req.prefill_cost is None:
@@ -406,6 +406,15 @@ class GenerationScheduler:
                 self._backlog_tokens += req.prefill_cost
         self._pending.put(req)
         self._wake.set()
+
+    def _count(self, key: str, amount: int = 1) -> None:
+        """Bump an ad-hoc counter under ``_backlog_lock``: the counters
+        dict is written by HTTP handler threads (requests, rejected) and
+        the emitter (tokens_out) and snapshotted by /stats — unlocked
+        ``+=`` read-modify-writes lose increments under a handler
+        stampede, which skews the serve-bench reject/req counts."""
+        with self._backlog_lock:
+            self.counters[key] += amount
 
     def admission_check(self, request) -> Optional[Dict[str, Any]]:
         """SLO-gated early reject: estimate this request's TTFT (queue
@@ -468,9 +477,17 @@ class GenerationScheduler:
         pending = self._pending.qsize()
         active = sum(r is not None and not r.done for r in self._slots)
         blocked = 1 if self._blocked is not None else 0
+        # /stats runs on HTTP handler threads: the emission queue and
+        # the counters dict are mutated by the scheduler/emitter threads
+        # under their locks, so the backlog-length and counter reads
+        # here take the same locks (a torn read of a mid-swap list is a
+        # crash, not just a stale number).
+        with self._emit_lock:
+            emit_backlog = len(self._emit_q)
         with self._backlog_lock:
             prefill_tokens = (self._backlog_tokens
                               + self._inflight_prefill_tokens)
+            counters = dict(self.counters)
         rate = self._prefill_rate
         out = {
             'slots_total': self.engine.batch_slots,
@@ -478,7 +495,7 @@ class GenerationScheduler:
             # applied yet is not "active" to callers.
             'slots_active': active,
             'pending': pending,
-            'emit_backlog': len(self._emit_q),
+            'emit_backlog': emit_backlog,
             # Queue-depth signal for the load balancer's least_load
             # policy: requests holding or waiting for replica capacity
             # (incl. the head-of-line request waiting for KV blocks).
@@ -488,7 +505,7 @@ class GenerationScheduler:
             'prefill_chunk': self.prefill_chunk,
             'ttft_slo_ms': self.ttft_slo_ms,
             'prefill_tokens_per_s': round(rate, 1) if rate else None,
-            **self.counters,
+            **counters,
         }
         if self.engine.paged:
             # Block-pool + prefix-cache series: kv_block_utilization and
@@ -1116,7 +1133,7 @@ class GenerationScheduler:
                 self.engine.reset_kv()
                 self.state = self.engine.init_state()
 
-    def _tick(self) -> None:
+    def _tick(self) -> None:  # skylint: hot-path
         self._apply_releases()
         self._admit()
         # Step only while some request still needs tokens; slots that have
@@ -1132,10 +1149,15 @@ class GenerationScheduler:
             self._wake.wait(timeout=0.05)
             self._wake.clear()
             return
-        if len(self._emit_q) >= self.MAX_BACKLOG:
-            # Emitter is behind (slow D2H link): bound the in-flight window.
+        with self._emit_lock:
+            emit_backlog = len(self._emit_q)
+        if emit_backlog >= self.MAX_BACKLOG:
+            # Emitter is behind (slow D2H link): bound the in-flight
+            # window. The 2ms pause is a deliberate bounded backoff —
+            # spinning on the backlog check would burn the core the
+            # emitter needs for its D2H fetch.
             self._emit_event.set()
-            time.sleep(0.002)
+            time.sleep(0.002)  # skylint: disable=blocking-hot-path
             return
         # Per-slot sampling settings; traced [B] args, so heterogeneous
         # values share one compiled step. Device arrays are cached until
@@ -1221,7 +1243,7 @@ class GenerationScheduler:
                             self._releases.put((slot, req))
                 self._wake.set()
 
-    def _emit_batch(self, batch: List[tuple]) -> None:
+    def _emit_batch(self, batch: List[tuple]) -> None:  # skylint: hot-path
         """ONE device-to-host transfer for every queued token array, then
         route values + make EOS/max_tokens/full decisions in order."""
         import jax.numpy as jnp
@@ -1309,7 +1331,7 @@ class GenerationScheduler:
         req.out_queue.put(tok)
         req.emitted += 1
         req.last_token_at = now
-        self.counters['tokens_out'] += 1
+        self._count('tokens_out')
         if self._m is not None:
             self._m.tokens_out.inc()
         if timeline.enabled():
@@ -1547,7 +1569,6 @@ def main() -> None:
     each gets its own free port); ``--port`` overrides for standalone use.
     """
     import argparse
-    import os
 
     import jax
 
@@ -1558,7 +1579,7 @@ def main() -> None:
                         help='PRESETS key of the chosen --model family')
     parser.add_argument(
         '--port', type=int,
-        default=int(os.environ.get('SKYTPU_SERVE_REPLICA_PORT', '8001')))
+        default=int(env_vars.get('SKYTPU_SERVE_REPLICA_PORT')))
     parser.add_argument('--batch-slots', type=int, default=8)
     parser.add_argument('--max-len', type=int, default=None)
     parser.add_argument('--kv-block', type=int, default=None,

@@ -36,6 +36,7 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List
 
+from skypilot_tpu import env_vars
 from skypilot_tpu.serve import load_balancing_policies as policies_lib
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.utils import metrics as metrics_lib
@@ -49,7 +50,7 @@ _HOP_HEADERS = {'connection', 'keep-alive', 'proxy-authenticate',
 
 
 def _sync_interval() -> float:
-    return float(os.environ.get('SKYTPU_SERVE_LB_SYNC', '5'))
+    return float(env_vars.get('SKYTPU_SERVE_LB_SYNC'))
 
 
 class _LbMetrics:
@@ -104,8 +105,7 @@ class LoadBalancer:
         # Prometheus-instrumented app) set $SKYTPU_LB_METRICS_PATH to
         # relocate the LB's endpoint (or '' to disable interception
         # entirely and proxy /metrics through to replicas).
-        self.metrics_path = os.environ.get('SKYTPU_LB_METRICS_PATH',
-                                           '/metrics')
+        self.metrics_path = env_vars.get('SKYTPU_LB_METRICS_PATH')
 
     # -- controller sync ------------------------------------------------------
     def _sync_loop(self) -> None:
@@ -191,6 +191,9 @@ class LoadBalancer:
             def log_message(self, fmt, *args):
                 pass
 
+            # The proxy IS an upstream network call (allow=network);
+            # a sleep or disk write on this path would stall a client.
+            # skylint: hot-path allow=network
             def _proxy(self):
                 lb.record_request()
                 # Trace correlation id: minted here (kept if the client
@@ -402,7 +405,7 @@ class LoadBalancer:
                          daemon=True).start()
         # Bind port 0 (or a pinned $SKYTPU_SERVE_LB_PORT) and publish the
         # assigned port — serve.core.up waits for it to report the endpoint.
-        pinned = int(os.environ.get('SKYTPU_SERVE_LB_PORT', '0'))
+        pinned = int(env_vars.get('SKYTPU_SERVE_LB_PORT'))
         server = ThreadingHTTPServer(('0.0.0.0', pinned), Handler)
         lb_port = server.server_address[1]
         serve_state.update_service(self.name, lb_pid=os.getpid(),

@@ -29,6 +29,7 @@ import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
+from skypilot_tpu import env_vars
 from skypilot_tpu import exceptions
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve import service_spec as spec_lib
@@ -57,7 +58,7 @@ class ReplicaManager:
         self.placer = spot_placer_lib.make(spec.replica_policy.spot_placer)
         self._inflight: Dict[int, threading.Thread] = {}
         self._lock = threading.Lock()
-        self._debug = bool(os.environ.get('SKYTPU_SERVE_DEBUG'))
+        self._debug = bool(env_vars.get('SKYTPU_SERVE_DEBUG'))
         self._probe_pool = ThreadPoolExecutor(
             max_workers=_PROBE_POOL, thread_name_prefix='probe')
         # Latest PARSED /metrics samples per replica id (scraped each
@@ -159,7 +160,7 @@ class ReplicaManager:
         # to sync its URL into the routing pool — terminating the old
         # replica the instant the new turns READY would leave a stale-pool
         # window where the only routable URL is the one being killed.
-        grace = 2 * float(os.environ.get('SKYTPU_SERVE_LB_SYNC', '5'))
+        grace = 2 * float(env_vars.get('SKYTPU_SERVE_LB_SYNC'))
         now = time.time()
         ready_new = sum(
             1 for r in new if r['status'] == ReplicaStatus.READY

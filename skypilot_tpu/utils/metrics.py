@@ -100,11 +100,13 @@ class Counter:
 
     @property
     def value(self) -> float:
-        return self._value
+        with self._lock:
+            return self._value
 
     def samples(self) -> List[Tuple[str, Tuple[Tuple[str, str], ...],
                                     float]]:
-        return [(self.name, self.labels, self._value)]
+        with self._lock:
+            return [(self.name, self.labels, self._value)]
 
 
 class Gauge:
@@ -133,11 +135,13 @@ class Gauge:
 
     @property
     def value(self) -> float:
-        return self._value
+        with self._lock:
+            return self._value
 
     def samples(self) -> List[Tuple[str, Tuple[Tuple[str, str], ...],
                                     float]]:
-        return [(self.name, self.labels, self._value)]
+        with self._lock:
+            return [(self.name, self.labels, self._value)]
 
 
 class Histogram:
@@ -173,11 +177,13 @@ class Histogram:
 
     @property
     def count(self) -> int:
-        return self._count
+        with self._lock:
+            return self._count
 
     @property
     def sum(self) -> float:
-        return self._sum
+        with self._lock:
+            return self._sum
 
     def _snapshot(self) -> Tuple[List[int], float, int]:
         """Consistent (counts, sum, count) under one lock hold, so a
@@ -299,11 +305,13 @@ class Registry:
 
     def get(self, name: str,
             labels: Optional[Dict[str, str]] = None) -> Optional[Any]:
-        return self._metrics.get(
-            (name, tuple(sorted((labels or {}).items()))))
+        with self._lock:
+            return self._metrics.get(
+                (name, tuple(sorted((labels or {}).items()))))
 
     def __len__(self) -> int:
-        return len(self._metrics)
+        with self._lock:
+            return len(self._metrics)
 
     def render(self) -> str:
         """Prometheus text exposition (format version 0.0.4)."""

@@ -1,0 +1,13 @@
+"""Fixture: blocking-hot-path clean counterpart — allow= exempts the
+category that IS the path's purpose; unmarked functions may block."""
+import time
+import urllib.request
+
+
+# skylint: hot-path allow=network
+def _proxy(url):
+    return urllib.request.urlopen(url)
+
+
+def background_loop():
+    time.sleep(1.0)  # not hot: clean

@@ -1,0 +1,17 @@
+"""Fixture: blocking-hot-path violations (direct + transitive)."""
+import time
+import urllib.request
+
+
+def fetch(url):
+    return urllib.request.urlopen(url)  # not hot: clean
+
+
+def _tick():  # skylint: hot-path
+    _wait()
+    with open('/tmp/skylint-fixture') as f:  # LINE 12: file-io in hot path
+        return f.read()
+
+
+def _wait():
+    time.sleep(0.1)  # LINE 17: sleep reached from the hot root

@@ -1,0 +1,8 @@
+"""Fixture: env-contract clean counterpart — registered reads only."""
+import os
+
+from skypilot_tpu import env_vars
+
+_METRICS = os.environ.get('SKYTPU_METRICS', '1')
+_TICK = env_vars.get('SKYTPU_SERVE_TICK')
+_OTHER = os.environ.get('NOT_A_SKYTPU_VAR')  # out of contract scope

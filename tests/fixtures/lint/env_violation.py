@@ -1,0 +1,7 @@
+"""Fixture: env-contract violations — unregistered SKYTPU_* reads."""
+import os
+
+_DIRECT = os.environ.get('SKYTPU_FIXTURE_UNREGISTERED')  # LINE 4
+_GETENV = os.getenv('SKYTPU_FIXTURE_ALSO_UNREGISTERED', '1')  # LINE 5
+ENV_THIRD = 'SKYTPU_FIXTURE_THIRD_UNREGISTERED'
+_VIA_CONST = os.environ.get(ENV_THIRD)  # LINE 7

@@ -1,0 +1,21 @@
+"""Fixture: jax-host-sync clean counterpart — traced code stays on
+device; host-side helpers outside traced scope may sync freely."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def decorated_step(x):
+    return jnp.sum(x).astype(jnp.float32)
+
+
+def _step_impl(x):
+    return x * jnp.asarray(2.0)
+
+
+_step = jax.jit(_step_impl)
+
+
+def host_fetch(x):
+    # Not reachable from any traced root: syncs are fine here.
+    return float(jnp.sum(x))

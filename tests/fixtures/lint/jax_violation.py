@@ -1,0 +1,25 @@
+"""Fixture: jax-host-sync violations inside traced scope."""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def decorated_step(x):
+    y = jnp.sum(x)
+    return float(y)  # LINE 12: host cast in traced scope
+
+
+def _step_impl(x):
+    if os.environ.get('SKYTPU_KV_BLOCK'):  # LINE 16: env-dependent trace
+        x = x + 1
+    return _helper(x)
+
+
+def _helper(x):
+    return np.asarray(x)  # LINE 22: host materialization (reached)
+
+
+_step = jax.jit(_step_impl)

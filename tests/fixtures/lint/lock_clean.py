@@ -1,0 +1,36 @@
+"""Fixture: lock-discipline clean counterpart — every cross-method
+access of lock-guarded state holds the lock, uses the ``_locked``
+caller-holds-it convention, or mixes guarded mutation with a fast-path
+check in the SAME method (check-then-lock idiom)."""
+import threading
+
+
+class Tracker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+        self._count = 0
+
+    def add(self, item):
+        with self._lock:
+            self._items.append(item)
+            self._count += 1
+
+    def snapshot(self):
+        with self._lock:
+            return list(self._items)
+
+    def reset(self):
+        with self._lock:
+            self._reset_locked()
+
+    def _reset_locked(self):
+        self._count = 0
+        self._items = []
+
+    def add_fast(self, item):
+        if self._count > 100:  # same-method fast path is exempt
+            return
+        with self._lock:
+            self._items.append(item)
+            self._count += 1

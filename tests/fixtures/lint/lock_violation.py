@@ -1,0 +1,24 @@
+"""Fixture: lock-discipline violations (lines asserted by tests)."""
+import threading
+
+
+class Tracker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+        self._count = 0
+
+    def add(self, item):
+        with self._lock:
+            self._items.append(item)
+            self._count += 1
+
+    def snapshot(self):
+        return list(self._items)  # LINE 17: unguarded read
+
+    def reset(self):
+        self._count = 0  # LINE 20: unguarded write
+
+    def peek(self):
+        # Suppressed: read-only diagnostic, staleness acceptable here.
+        return len(self._items)  # skylint: disable=lock-discipline

@@ -1,0 +1,4 @@
+"""Fixture: metric-name clean counterpart."""
+reqs = registry.counter('skytpu_serve_requests_total')  # noqa: F821
+depth = registry.gauge('skytpu_serve_depth_count')  # noqa: F821
+lat = registry.histogram('skytpu_lb_proxy_ms')  # noqa: F821

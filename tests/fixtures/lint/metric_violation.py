@@ -1,0 +1,3 @@
+"""Fixture: metric-name violation next to a valid registration."""
+bad = registry.counter('skytpu_bad_total')  # noqa: F821  LINE 2
+ok = registry.gauge('skytpu_serve_depth_count')  # noqa: F821

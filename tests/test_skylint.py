@@ -1,0 +1,332 @@
+"""skylint static-analysis suite: tier-1 tree enforcement, per-checker
+fixture tests (exact finding lines + clean counterparts + suppression),
+the env-var registry contract, and concurrency regression tests for the
+lock-discipline fixes this suite surfaced (generation scheduler counters
+under ``_backlog_lock``; autoscaler request history under its lock).
+
+The tree-clean test doubles as the seeded-bug guard: reverting one of
+the applied lock fixes (e.g. the ``_emit_q`` reads in
+``generation_server.stats``/``_tick``) or deleting an env-var registry
+entry re-introduces a finding and fails it.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from skypilot_tpu import env_vars  # noqa: E402
+from skypilot_tpu.lint import core  # noqa: E402
+
+FIXTURES = os.path.join(REPO_ROOT, 'tests', 'fixtures', 'lint')
+SKYLINT = os.path.join(REPO_ROOT, 'scripts', 'skylint.py')
+
+
+def lint_fixture(filename, check):
+    run = core.LintRun([os.path.join(FIXTURES, filename)],
+                       full_tree=False, checks=[check])
+    run.run()
+    return run
+
+
+def finding_lines(run):
+    return sorted(f.line for f in run.findings)
+
+
+# ---- tier-1 tree enforcement ------------------------------------------------
+class TestTreeClean:
+
+    def test_skylint_tree_is_clean(self):
+        """THE tier-1 gate: zero un-suppressed findings over the whole
+        package. Reverting an applied lock fix or deleting an env-var
+        registry entry makes this fail."""
+        proc = subprocess.run([sys.executable, SKYLINT],
+                              capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr or proc.stdout
+
+    def test_json_mode_reports_fixture_findings(self):
+        """--json (the bench-archivable form) carries path/line/check."""
+        proc = subprocess.run(
+            [sys.executable, SKYLINT, '--json', '--check',
+             'lock-discipline',
+             os.path.join(FIXTURES, 'lock_violation.py')],
+            capture_output=True, text=True)
+        assert proc.returncode == 1
+        payload = json.loads(proc.stdout)
+        assert payload['files_scanned'] == 1
+        lines = sorted(f['line'] for f in payload['findings'])
+        assert lines == [17, 20]
+        assert all(f['check'] == 'lock-discipline'
+                   for f in payload['findings'])
+        assert len(payload['suppressed']) == 1
+
+    def test_unknown_check_name_is_an_error(self):
+        """A typo'd --check must not select zero checkers and report a
+        false-clean tree."""
+        proc = subprocess.run(
+            [sys.executable, SKYLINT, '--check', 'lock_discipline'],
+            capture_output=True, text=True)
+        assert proc.returncode == 2
+        assert 'unknown check' in proc.stderr
+
+    def test_list_checks(self):
+        proc = subprocess.run([sys.executable, SKYLINT, '--list-checks'],
+                              capture_output=True, text=True)
+        assert proc.returncode == 0
+        for name in ('lock-discipline', 'jax-host-sync',
+                     'blocking-hot-path', 'env-contract', 'metric-name'):
+            assert name in proc.stdout
+
+    def test_check_metric_names_shim_delegates(self, tmp_path):
+        """The historical CLI contract survives the framework fold-in."""
+        bad = tmp_path / 'bad.py'
+        bad.write_text("m = registry.counter('skytpu_bad_total')\n")
+        shim = os.path.join(REPO_ROOT, 'scripts', 'check_metric_names.py')
+        proc = subprocess.run([sys.executable, shim, str(tmp_path)],
+                              capture_output=True, text=True)
+        assert proc.returncode == 1
+        assert 'skytpu_bad_total' in proc.stderr
+
+
+# ---- lock-discipline --------------------------------------------------------
+class TestLockDiscipline:
+
+    def test_flags_cross_method_unguarded_access(self):
+        run = lint_fixture('lock_violation.py', 'lock-discipline')
+        assert finding_lines(run) == [17, 20]
+        read, write = sorted(run.findings, key=lambda f: f.line)
+        assert 'read here without the lock' in read.message
+        assert 'write here without the lock' in write.message
+        assert '_items' in read.message and '_count' in write.message
+
+    def test_suppression_comment_works(self):
+        run = lint_fixture('lock_violation.py', 'lock-discipline')
+        assert len(run.suppressed) == 1
+        assert run.suppressed[0].line == 24
+
+    def test_clean_counterpart_passes(self):
+        run = lint_fixture('lock_clean.py', 'lock-discipline')
+        assert run.findings == []
+
+
+# ---- jax-host-sync ----------------------------------------------------------
+class TestJaxHostSync:
+
+    def test_flags_hazards_at_exact_lines(self):
+        run = lint_fixture('jax_violation.py', 'jax-host-sync')
+        assert finding_lines(run) == [12, 16, 22]
+        by_line = {f.line: f.message for f in run.findings}
+        assert 'float()' in by_line[12]
+        assert 'os.environ' in by_line[16]
+        assert 'np.asarray' in by_line[22]
+        # Reachability attribution: _helper is flagged via _step_impl.
+        assert 'traced scope' in by_line[22]
+
+    def test_clean_counterpart_passes(self):
+        """jnp-only traced code passes; the float() sync in the
+        unreachable host helper is out of scope."""
+        run = lint_fixture('jax_clean.py', 'jax-host-sync')
+        assert run.findings == []
+
+
+# ---- blocking-hot-path ------------------------------------------------------
+class TestBlockingHotPath:
+
+    def test_flags_direct_and_transitive_blocking_calls(self):
+        run = lint_fixture('blocking_violation.py', 'blocking-hot-path')
+        assert finding_lines(run) == [12, 17]
+        by_line = {f.line: f.message for f in run.findings}
+        assert 'file-io' in by_line[12]
+        assert 'sleep' in by_line[17]
+        assert '_wait' in by_line[17]  # transitive attribution
+
+    def test_allow_category_and_unmarked_functions_pass(self):
+        run = lint_fixture('blocking_clean.py', 'blocking-hot-path')
+        assert run.findings == []
+
+    def test_marker_attaches_through_decorators_and_one_liners(
+            self, tmp_path):
+        """A standalone marker above a decorated def points at the
+        decorator line; a one-line def has its body on the signature
+        line — both must still arm the check."""
+        src = (
+            'import functools\n'
+            'import time\n'
+            '\n'
+            '\n'
+            'def deco(f):\n'
+            '    return f\n'
+            '\n'
+            '\n'
+            '# skylint: hot-path\n'
+            '@deco\n'
+            '@functools.lru_cache(None)\n'
+            'def decorated_hot():\n'
+            '    time.sleep(0.5)\n'
+            '\n'
+            '\n'
+            'def one_liner(): time.sleep(0.1)  # skylint: hot-path\n')
+        p = tmp_path / 'marker_edge.py'
+        p.write_text(src)
+        run = core.LintRun([str(p)], checks=['blocking-hot-path'])
+        run.run()
+        assert sorted(f.line for f in run.findings) == [13, 16]
+
+
+# ---- env-contract -----------------------------------------------------------
+class TestEnvContract:
+
+    def test_flags_unregistered_reads(self):
+        run = lint_fixture('env_violation.py', 'env-contract')
+        assert finding_lines(run) == [4, 5, 7]
+        for f in run.findings:
+            assert 'not registered' in f.message
+
+    def test_clean_counterpart_passes(self):
+        run = lint_fixture('env_clean.py', 'env-contract')
+        assert run.findings == []
+
+    def test_registry_defaults_and_errors(self):
+        assert env_vars.get('SKYTPU_SERVE_TICK') == \
+            os.environ.get('SKYTPU_SERVE_TICK', '20')
+        with pytest.raises(KeyError):
+            env_vars.get('SKYTPU_NOT_A_REAL_VAR')
+        entry = env_vars.REGISTRY['SKYTPU_KV_BLOCK']
+        assert entry.default == '64' and entry.subsystem == 'engine'
+
+    def test_empty_value_passes_through(self, monkeypatch):
+        """'' must NOT collapse to the default: SKYTPU_KV_BLOCK='' means
+        contiguous KV (0), distinct from unset (64)."""
+        monkeypatch.setenv('SKYTPU_KV_BLOCK', '')
+        assert env_vars.get('SKYTPU_KV_BLOCK') == ''
+        assert int(env_vars.get('SKYTPU_KV_BLOCK') or 0) == 0
+        monkeypatch.delenv('SKYTPU_KV_BLOCK')
+        assert int(env_vars.get('SKYTPU_KV_BLOCK') or 0) == 64
+
+    def test_docs_table_matches_registry(self):
+        """Every registered var appears in docs/serving.md — the same
+        contract the full-tree lint enforces, asserted directly so a
+        docs regression names the variable."""
+        with open(os.path.join(REPO_ROOT, 'docs', 'serving.md'),
+                  encoding='utf-8') as f:
+            docs = f.read()
+        # Backticked form: a bare substring test would let a prefix var
+        # (SKYTPU_KV_BLOCK) hide inside its longer sibling's row.
+        missing = [v for v in env_vars.REGISTRY if f'`{v}`' not in docs]
+        assert not missing, f'not in docs/serving.md table: {missing}'
+
+    def test_render_table_is_complete(self):
+        table = env_vars.render_markdown_table()
+        for v in env_vars.REGISTRY:
+            assert f'`{v}`' in table
+
+
+# ---- metric-name ------------------------------------------------------------
+class TestMetricName:
+
+    def test_flags_bad_name_at_exact_line(self):
+        run = lint_fixture('metric_violation.py', 'metric-name')
+        assert finding_lines(run) == [2]
+        assert 'skytpu_bad_total' in run.findings[0].message
+
+    def test_clean_counterpart_passes(self):
+        run = lint_fixture('metric_clean.py', 'metric-name')
+        assert run.findings == []
+
+
+# ---- regression tests for the applied lock-discipline fixes -----------------
+class TestLockFixRegressions:
+
+    def test_autoscaler_request_history_is_thread_safe(self):
+        """PR fix: /load handler threads append request timestamps while
+        the controller tick thread windows/reads them. Pre-fix the
+        unlocked filter-and-rebind in collect_requests dropped whole
+        batches that landed mid-evaluate; with the lock every timestamp
+        must survive."""
+        from skypilot_tpu.serve import autoscaler as autoscaler_lib
+        from skypilot_tpu.serve import service_spec as spec_lib
+        spec = spec_lib.ServiceSpec(
+            replica_policy=spec_lib.ReplicaPolicy(
+                min_replicas=1, max_replicas=4,
+                target_qps_per_replica=1.0,
+                qps_window_seconds=3600.0))
+        a = autoscaler_lib.RequestRateAutoscaler(spec, 20.0)
+        import time as time_lib
+        now = time_lib.time()
+        n_threads, per_thread = 8, 200
+        stop = threading.Event()
+
+        def reporter():
+            for _ in range(per_thread):
+                a.collect_requests([now])
+
+        def reader():
+            while not stop.is_set():
+                a.observed_qps(now)
+                a.evaluate(now)
+                a.observe_fleet({'skytpu_serve_queue_depth_requests': 1})
+                a.latest_fleet_signals()
+
+        readers = [threading.Thread(target=reader) for _ in range(2)]
+        for t in readers:
+            t.start()
+        writers = [threading.Thread(target=reporter)
+                   for _ in range(n_threads)]
+        for t in writers:
+            t.start()
+        for t in writers:
+            t.join()
+        stop.set()
+        for t in readers:
+            t.join()
+        assert len(a._request_times) == n_threads * per_thread
+        assert a.latest_fleet_signals() == {
+            'skytpu_serve_queue_depth_requests': 1}
+
+    @pytest.mark.compute
+    def test_scheduler_counters_survive_handler_stampede(self):
+        """PR fix: the scheduler's ad-hoc counters dict is bumped from
+        HTTP handler threads (requests/rejected) and the emitter
+        (tokens_out) and snapshotted by /stats; the unlocked ``+=`` lost
+        increments under a stampede. All mutations now go through
+        ``_count`` under ``_backlog_lock`` — N concurrent submits must
+        count exactly N, with /stats snapshotting concurrently."""
+        from skypilot_tpu.models.llama import PRESETS
+        from skypilot_tpu.serve.generation_server import (
+            GenerationScheduler, _Request)
+        cfg = PRESETS['test-tiny']
+        sched = GenerationScheduler(cfg, params=None, batch_slots=2,
+                                    max_len=64)  # threads NOT started
+        n_threads, per_thread = 8, 50
+        stop = threading.Event()
+
+        def submitter():
+            for _ in range(per_thread):
+                req = _Request(tokens=[1, 2, 3], max_tokens=4,
+                               temperature=0.0, top_k=0, eos_id=None)
+                sched.submit(req)
+                sched._count('tokens_out')
+
+        def stats_reader():
+            while not stop.is_set():
+                sched.stats()
+
+        reader = threading.Thread(target=stats_reader)
+        reader.start()
+        threads = [threading.Thread(target=submitter)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stop.set()
+        reader.join()
+        total = n_threads * per_thread
+        stats = sched.stats()
+        assert stats['requests'] == total
+        assert stats['tokens_out'] == total

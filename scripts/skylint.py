@@ -5,25 +5,69 @@ Usage::
 
     python scripts/skylint.py                  # whole package, all checks
     python scripts/skylint.py path [path ...]  # narrower roots
-    python scripts/skylint.py --check lock-discipline --json
+    python scripts/skylint.py --check lock-order --json
+    python scripts/skylint.py --changed        # git-diff + rev-dep closure
+    python scripts/skylint.py --json-out /tmp/skylint.json
+    python scripts/skylint.py --baseline skylint-baseline.json
     python scripts/skylint.py --list-checks
 
-Exit 0 = no un-suppressed findings; 1 = findings (listed on stderr in
-human mode, on stdout as JSON with --json — bench.py archives the JSON
-per round). Aggregate contracts (dead env-var entries, docs table,
-metric-family coverage) only run over the full default tree; explicit
-roots get per-file checks only. See docs/static_analysis.md.
+Exit 0 = no un-suppressed findings (after baseline waivers); 1 =
+findings (listed on stderr in human mode, on stdout as JSON with
+--json — bench.py archives the JSON per round); 2 = usage error.
+``--json-out`` writes the same JSON report to a file regardless of the
+console mode — the CI artifact. ``--changed`` still parses and indexes
+the whole tree (cross-module closures need it) but reports only
+findings in files named by ``git diff`` plus their reverse-dependency
+closure. ``--baseline`` waives findings matching a frozen
+``{path, check}`` list (``--write-baseline`` regenerates it); an empty
+baseline — the preferred state — waives nothing. Aggregate contracts
+(dead env-var entries, docs table, metric-family coverage) only run
+over the full default tree; explicit roots get per-file checks only.
+See docs/static_analysis.md.
 """
 from __future__ import annotations
 
 import argparse
+import dataclasses
+import json
 import os
+import subprocess
 import sys
+from typing import List, Set
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO_ROOT)
 
 from skypilot_tpu.lint import core  # noqa: E402
+
+
+def _git_changed_files() -> List[str]:
+    """Repo-relative .py paths touched per git: unstaged + staged +
+    untracked. Any git failure is fatal — silently linting nothing
+    would report a false-clean tree."""
+    out: Set[str] = set()
+    for args in (['git', 'diff', '--name-only'],
+                 ['git', 'diff', '--name-only', '--cached'],
+                 ['git', 'ls-files', '--others', '--exclude-standard']):
+        proc = subprocess.run(args, cwd=_REPO_ROOT, capture_output=True,
+                              text=True, check=True)
+        out.update(line.strip() for line in proc.stdout.splitlines()
+                   if line.strip().endswith('.py'))
+    return sorted(out)
+
+
+def _apply_baseline(run: 'core.LintRun', baseline_path: str) -> List[dict]:
+    """Waive findings matching baseline {path, check} entries (each
+    entry waives any number of findings at that path+check — a frozen
+    known-findings list for fixes that must be deferred). Returns the
+    waived findings as dicts."""
+    with open(baseline_path, encoding='utf-8') as f:
+        entries = json.load(f).get('findings', [])
+    keys = {(e['path'], e['check']) for e in entries}
+    waived = [f for f in run.findings if (f.path, f.check) in keys]
+    run.findings = [f for f in run.findings
+                    if (f.path, f.check) not in keys]
+    return [dataclasses.asdict(f) for f in waived]
 
 
 def main(argv=None) -> int:
@@ -34,6 +78,21 @@ def main(argv=None) -> int:
                         help='run only this check (repeatable)')
     parser.add_argument('--json', action='store_true',
                         help='machine-readable output on stdout')
+    parser.add_argument('--json-out', metavar='FILE',
+                        help='also write the JSON report to FILE '
+                             '(CI artifact)')
+    parser.add_argument('--changed', action='store_true',
+                        help='report only findings in git-changed files '
+                             'plus their reverse-dependency closure')
+    parser.add_argument('--no-cross-module', action='store_true',
+                        help='pre-v2 same-file semantics (regression '
+                             'pinning; not for CI)')
+    parser.add_argument('--baseline', metavar='FILE',
+                        help='waive findings matching this frozen '
+                             '{path, check} list')
+    parser.add_argument('--write-baseline', metavar='FILE',
+                        help='write current findings as a baseline '
+                             'and exit 0')
     parser.add_argument('--list-checks', action='store_true')
     args = parser.parse_args(argv)
 
@@ -42,14 +101,77 @@ def main(argv=None) -> int:
             print(f'{cls.name}: {cls.description}')
         return 0
 
+    report_paths = None
+    if args.changed:
+        if args.roots:
+            print('skylint: --changed implies the default full-tree '
+                  'root', file=sys.stderr)
+            return 2
+        if args.no_cross_module:
+            # The closure needs the project index; silently reporting
+            # the full tree instead would be a scope lie.
+            print('skylint: --changed requires cross-module analysis '
+                  '(drop --no-cross-module)', file=sys.stderr)
+            return 2
+        try:
+            changed = _git_changed_files()
+        except (subprocess.CalledProcessError, OSError) as e:
+            print(f'skylint: --changed requires git: {e}',
+                  file=sys.stderr)
+            return 2
+        report_paths = changed  # closure expanded below, post-index
+
     try:
         run = core.run_skylint(roots=args.roots or None,
-                               checks=args.checks)
+                               checks=args.checks,
+                               cross_module=not args.no_cross_module)
     except ValueError as e:  # unknown --check name
         print(f'skylint: {e}', file=sys.stderr)
         return 2
+    if report_paths is not None and run.project is not None:
+        # Union with the raw changed set: a changed file that failed to
+        # parse never entered the index, and dropping its parse-error
+        # finding here would report a false-clean tree.
+        closure = run.project.reverse_closure(report_paths) \
+            | set(report_paths)
+        run.report_paths = closure
+        run.findings = [f for f in run.findings if f.path in closure]
+
+    waived = []
+    if args.baseline:
+        try:
+            waived = _apply_baseline(run, args.baseline)
+        except (OSError, ValueError, KeyError, TypeError,
+                AttributeError) as e:
+            # Shape errors too (a top-level list, a string entry):
+            # anything malformed must be the friendly exit-2 message,
+            # not a traceback.
+            print(f'skylint: bad baseline {args.baseline}: '
+                  f'{type(e).__name__}: {e}', file=sys.stderr)
+            return 2
+
+    report = run.to_json()
+    if waived:
+        payload = json.loads(report)
+        payload['baseline_waived'] = waived
+        report = json.dumps(payload, indent=2)
+    if args.json_out:
+        with open(args.json_out, 'w', encoding='utf-8') as f:
+            f.write(report + '\n')
+
+    if args.write_baseline:
+        uniq = [{'path': p, 'check': c} for p, c in
+                sorted({(f.path, f.check) for f in run.findings})]
+        payload = {'findings': uniq}
+        with open(args.write_baseline, 'w', encoding='utf-8') as f:
+            json.dump(payload, f, indent=2)
+            f.write('\n')
+        print(f'skylint: wrote baseline with {len(uniq)} entries to '
+              f'{args.write_baseline}')
+        return 0
+
     if args.json:
-        print(run.to_json())
+        print(report)
     else:
         stream = sys.stderr if run.findings else sys.stdout
         print(run.render_human(), file=stream)

@@ -135,8 +135,11 @@ class RetryingProvisioner:
                 try:
                     provision_lib.terminate_instances(
                         cloud.NAME, cluster_name, region)
-                except Exception:
-                    pass
+                except Exception as terr:  # noqa: BLE001
+                    # Failover must continue, but a failed teardown can
+                    # leak quota-holding objects — leave a trace.
+                    print(f'WARNING: cleanup of failed attempt in '
+                          f'{region} failed: {terr}', file=sys.stderr)
                 continue
             except exceptions.ProvisionError as e:
                 # Partial creation (operation timeout, half-created group):
@@ -147,8 +150,10 @@ class RetryingProvisioner:
                 try:
                     provision_lib.terminate_instances(
                         cloud.NAME, cluster_name, region)
-                except Exception:
-                    pass
+                except Exception as terr:  # noqa: BLE001
+                    print(f'WARNING: teardown of partially-created '
+                          f'cluster in {region} failed: {terr}',
+                          file=sys.stderr)
                 continue
             except exceptions.CloudError as e:
                 history.append(e)   # config/quota-ish: skip region

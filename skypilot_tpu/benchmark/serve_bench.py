@@ -400,8 +400,10 @@ def _bench_service(*, task, service_name: str, vocab_size: int,
     finally:
         try:
             serve_core.down(service_name)
-        except Exception:  # noqa: BLE001 — bench must not die on teardown
-            pass
+        except Exception as e:  # noqa: BLE001 — bench must not die on teardown
+            print(f'serve bench WARNING: teardown of {service_name} '
+                  f'failed ({e}); replicas may still be running',
+                  file=sys.stderr)
     return out
 
 

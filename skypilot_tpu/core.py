@@ -246,7 +246,11 @@ def cost_report() -> List[Dict[str, Any]]:
                 cost_per_hour = cloud.hourly_cost(resources,
                                                   resources.region,
                                                   resources.zone)
-        except Exception:
+        except (exceptions.SkyTpuError, AssertionError, KeyError,
+                ValueError, NotImplementedError):
+            # Historical rows can name clouds/shapes no longer in the
+            # catalog; the report shows cost 0.0 for them rather than
+            # dying — but programming errors must still surface.
             pass
         duration = row.get('duration_s')
         if duration is None:

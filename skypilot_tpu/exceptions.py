@@ -215,6 +215,8 @@ def deserialize_exception(d: Dict[str, Any]) -> Exception:
     for k, v in d.get('attrs', {}).items():
         try:
             setattr(e, k, v)
-        except Exception:  # pylint: disable=broad-except
+        except (AttributeError, TypeError):
+            # Read-only properties / __slots__ mismatches on exception
+            # subclasses: keep the attrs that do restore.
             pass
     return e

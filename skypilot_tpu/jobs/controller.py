@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import sys
 import time
 import traceback
 from typing import Optional
@@ -109,8 +110,10 @@ class JobsController:
                     record['handle'], cluster_job_id, follow=False,
                     stream_to=f)
             os.replace(path + '.tmp', path)
-        except Exception:  # noqa: BLE001 — archival must never stop a job
-            pass
+        except Exception as e:  # noqa: BLE001 — archival must never stop a job
+            print(f'jobs controller: task-log archival failed for job '
+                  f'{self.job_id} task {self.task_id}: {e}',
+                  file=sys.stderr)
 
     def _set_task_and_job_status(self, status: ManagedJobStatus,
                                  failure_reason: Optional[str] = None,

@@ -7,8 +7,10 @@ above) the ``def`` line::
     # skylint: hot-path allow=network
     def _proxy(self):
 
-The marked function plus every same-file function it transitively calls
-is hot scope. Inside it, flag:
+The marked function plus every function it transitively calls — across
+module boundaries, via the whole-program :class:`ProjectIndex` call
+graph (same-file only when the index is disabled) — is hot scope.
+Inside it, flag:
 
 - ``sleep``      — ``time.sleep(...)``
 - ``network``    — synchronous urllib (``urlopen``), ``socket`` /
@@ -105,20 +107,38 @@ class BlockingCallChecker(Checker):
         end = max(node.body[0].lineno, node.lineno + 1)
         return range(start, end)
 
-    def check_file(self, ctx: FileContext) -> List[Finding]:
+    def _roots(self, ctx: FileContext):
+        """(entry, allow) for every hot-path-marked function in a file."""
         marked = self._markers(ctx)
         if not marked:
             return []
-        index = ctx.functions
-        findings: List[Finding] = []
-        for entry in index.entries:
-            allow = None
+        roots = []
+        for entry in ctx.functions.entries:
             for line in self._marker_span(entry.node):
                 if line in marked:
-                    allow = marked[line]
+                    roots.append((entry, marked[line]))
                     break
-            if allow is None:
-                continue
+        return roots
+
+    @staticmethod
+    def _flag(ctx: FileContext, check: str, node: ast.Call, cat: str,
+              root_name: str, via: str) -> Finding:
+        return ctx.finding(
+            node, check,
+            f'{cat} call inside hot path {root_name}'
+            f'{via}: this blocks the latency-critical loop '
+            f'— move it off-path, or suppress with a '
+            f'justifying comment / allow={cat} on the '
+            f'marker')
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        if ctx.project is not None:
+            # Whole-program mode: closures cross files, so findings can
+            # land in files checked earlier — defer to finalize.
+            return []
+        findings: List[Finding] = []
+        index = ctx.functions
+        for entry, allow in self._roots(ctx):
             root_name = entry.qualname
             for reached in index.reachable_from([entry]):
                 for node in ast.walk(reached.node):
@@ -129,11 +149,33 @@ class BlockingCallChecker(Checker):
                         continue
                     via = ('' if reached is entry
                            else f' (reached via {reached.qualname})')
-                    findings.append(ctx.finding(
-                        node, self.name,
-                        f'{cat} call inside hot path {root_name}'
-                        f'{via}: this blocks the latency-critical loop '
-                        f'— move it off-path, or suppress with a '
-                        f'justifying comment / allow={cat} on the '
-                        f'marker'))
+                    findings.append(self._flag(ctx, self.name, node, cat,
+                                               root_name, via))
+        return findings
+
+    def finalize(self, run) -> List[Finding]:
+        project = run.project
+        if project is None:
+            return []
+        findings: List[Finding] = []
+        for ctx in run.contexts:
+            for entry, allow in self._roots(ctx):
+                root = project.project_function(ctx, entry)
+                for reached in project.reachable_from([root]):
+                    for node in ast.walk(reached.entry.node):
+                        if not isinstance(node, ast.Call):
+                            continue
+                        cat = _call_category(node)
+                        if not cat or cat in allow:
+                            continue
+                        if reached is root:
+                            via = ''
+                        elif reached.ctx is ctx:
+                            via = f' (reached via {reached.entry.qualname})'
+                        else:
+                            via = f' (reached via {reached.qualname})'
+                        findings.append(self._flag(
+                            reached.ctx, self.name, node, cat,
+                            root.qualname if reached.ctx is not ctx
+                            else entry.qualname, via))
         return findings

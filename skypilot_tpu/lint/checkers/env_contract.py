@@ -76,7 +76,7 @@ class EnvContractChecker(Checker):
     def check_file(self, ctx: FileContext) -> List[Finding]:
         is_registry = ctx.relpath.replace(os.sep, '/').endswith(
             'skypilot_tpu/env_vars.py')
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes:
             if isinstance(node, ast.Assign):
                 # Module/class-level NAME = 'SKYTPU_X' constants.
                 if (isinstance(node.value, ast.Constant)

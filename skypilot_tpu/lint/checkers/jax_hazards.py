@@ -22,8 +22,15 @@ compilation depend on ambient host state:
   ``StepProfiler`` recompile counter can only observe after the fact.
   This check is the build-time half of that guarantee.
 
-Analysis is per-file (cross-module calls are not followed) — the engine
-keeps its traced math in one module precisely so this stays sound.
+Traced scope follows the whole-program :class:`ProjectIndex` call graph
+when available — ``DecodeEngine._step_impl`` calling into
+``models/llama.py`` block math or ``ops/attention.py`` is traversed,
+so a host sync hidden one import away is no longer invisible. The bare
+``int()/float()/bool()`` cast heuristic stays same-file-as-the-root
+only: across modules it cannot distinguish casts of static Python
+config (ubiquitous, legitimate) from casts of traced values, and a
+checker that cries wolf gets suppressed wholesale. Without a project
+index (cross_module=False) the analysis is per-file as before.
 """
 from __future__ import annotations
 
@@ -31,7 +38,8 @@ import ast
 from typing import List, Optional, Set
 
 from skypilot_tpu.lint.core import (Checker, FileContext, Finding,
-                                    FunctionEntry, register)
+                                    FunctionEntry, ProjectFunction,
+                                    register)
 
 _SYNC_METHODS = {'item', 'tolist', 'numpy', 'block_until_ready'}
 _HOST_CASTS = {'int', 'float', 'bool'}
@@ -46,9 +54,9 @@ def _is_jit_name(node: ast.AST) -> bool:
     return False
 
 
-def _jit_call_target(call: ast.Call) -> Optional[str]:
-    """For ``jax.jit(X, ...)`` / ``partial(jax.jit, ...)(X)`` return X's
-    referenced function name (bare name or self.<name>)."""
+def _jit_wrapped(call: ast.Call) -> Optional[ast.expr]:
+    """For ``jax.jit(X, ...)`` / ``partial(jax.jit, ...)(X)`` return
+    the wrapped expression X (whatever its shape)."""
     func = call.func
     is_jit = _is_jit_name(func)
     if not is_jit and isinstance(func, ast.Call):
@@ -60,7 +68,15 @@ def _jit_call_target(call: ast.Call) -> Optional[str]:
             is_jit = any(_is_jit_name(a) for a in func.args)
     if not is_jit or not call.args:
         return None
-    target = call.args[0]
+    return call.args[0]
+
+
+def _jit_call_target(call: ast.Call) -> Optional[str]:
+    """X's referenced function name (bare name or self.<name>) — the
+    same-file matching path."""
+    target = _jit_wrapped(call)
+    if target is None:
+        return None
     if isinstance(target, ast.Name):
         return target.id
     if (isinstance(target, ast.Attribute)
@@ -89,28 +105,88 @@ class JaxHazardChecker(Checker):
     description = ('host syncs and env-dependent branches inside '
                    'jit-traced code')
 
-    def check_file(self, ctx: FileContext) -> List[Finding]:
-        index = ctx.functions
-        roots: List[FunctionEntry] = []
+    def _roots(self, ctx: FileContext) -> List[FunctionEntry]:
         jit_target_names: Set[str] = set()
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes:
             if isinstance(node, ast.Call):
                 target = _jit_call_target(node)
                 if target is not None:
                     jit_target_names.add(target)
-        for entry in index.entries:
-            if (_is_jit_decorated(entry.node)
-                    or entry.name in jit_target_names):
-                roots.append(entry)
+        return [entry for entry in ctx.functions.entries
+                if (_is_jit_decorated(entry.node)
+                    or entry.name in jit_target_names)]
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        if ctx.project is not None:
+            return []  # whole-program mode: handled in finalize
+        roots = self._roots(ctx)
         if not roots:
             return []
         findings: List[Finding] = []
-        for entry in index.reachable_from(roots):
+        for entry in ctx.functions.reachable_from(roots):
             findings.extend(self._check_traced(ctx, entry))
         return findings
 
-    def _check_traced(self, ctx: FileContext,
-                      entry: FunctionEntry) -> List[Finding]:
+    def _project_roots(self, ctx: FileContext, project):
+        """jit targets the same-file pass can't see: imported functions
+        (``jax.jit(imported_fn)``) and methods on typed locals/attrs
+        (``jax.jit(model.init)``), resolved through the ProjectIndex."""
+        out = []
+        for node in ctx.nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            target = _jit_wrapped(node)
+            if target is None or not isinstance(
+                    target, (ast.Name, ast.Attribute)):
+                continue
+            enclosing = node
+            entry = None
+            while enclosing is not None:
+                enclosing = ctx.parents.get(enclosing)
+                if isinstance(enclosing, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                    entry = ctx.functions.by_node.get(enclosing)
+                    break
+            if entry is not None:
+                current = project.project_function(ctx, entry)
+            else:
+                # Module level: a synthetic frame whose "body" is the
+                # module, so bindings and module-level typed locals
+                # (``model = LlamaModel(cfg)``) resolve.
+                current = ProjectFunction(
+                    ctx.module,
+                    FunctionEntry(ctx.tree, '<module>', '<module>',
+                                  None), ctx)
+            fake = ast.Call(func=target, args=[], keywords=[])
+            resolved = project.resolve_call(fake, current)
+            if resolved is not None:
+                out.append(resolved)
+        return out
+
+    def finalize(self, run) -> List[Finding]:
+        project = run.project
+        if project is None:
+            return []
+        roots = []
+        root_modules: Set[str] = set()
+        for ctx in run.contexts:
+            for entry in self._roots(ctx):
+                roots.append(project.project_function(ctx, entry))
+                root_modules.add(ctx.module)
+            for pf in self._project_roots(ctx, project):
+                roots.append(pf)
+                root_modules.add(pf.module)
+        findings: List[Finding] = []
+        for reached in project.reachable_from(roots):
+            findings.extend(self._check_traced(
+                reached.ctx, reached.entry,
+                # Cast heuristic only inside modules that own jit roots
+                # (see module docstring).
+                casts=reached.module in root_modules))
+        return findings
+
+    def _check_traced(self, ctx: FileContext, entry: FunctionEntry,
+                      casts: bool = True) -> List[Finding]:
         findings: List[Finding] = []
         where = f'traced scope of {entry.qualname}'
         for node in ast.walk(entry.node):
@@ -155,7 +231,8 @@ class JaxHazardChecker(Checker):
                         + ('host sync' if func.attr == 'device_get'
                            else 'env-dependent compile') + ' — hoist '
                         'out of the traced path'))
-            elif isinstance(func, ast.Name) and func.id in _HOST_CASTS:
+            elif (casts and isinstance(func, ast.Name)
+                  and func.id in _HOST_CASTS):
                 findings.append(ctx.finding(
                     node, self.name,
                     f'{func.id}() in {where}: on a traced value this is '

@@ -62,7 +62,7 @@ class LockDisciplineChecker(Checker):
 
     def check_file(self, ctx: FileContext) -> List[Finding]:
         findings: List[Finding] = []
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes:
             if isinstance(node, ast.ClassDef):
                 findings.extend(self._check_class(ctx, node))
         return findings

@@ -43,7 +43,7 @@ class MetricNameChecker(Checker):
     def check_file(self, ctx: FileContext) -> List[Finding]:
         from skypilot_tpu.utils.metrics import validate_name
         findings: List[Finding] = []
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes:
             if not isinstance(node, ast.Call):
                 continue
             func = node.func

@@ -1,0 +1,319 @@
+"""Sharding / mesh-axis consistency (GSPMD-style annotation checking).
+
+The parallel layer decouples model code from mesh layout through
+*logical* axis names (``parallel/sharding.py``): a ``LogicalRules``
+table maps each logical name to mesh axes, and model code asks for
+specs by name (``rules.spec('batch', 'seq')``). That indirection is
+exactly where typos become silent performance bugs: an unknown logical
+name resolves to ``None`` — *unsharded* — and nothing crashes; the
+model just quietly replicates a weight that should have been split.
+This checker treats the annotation system as checkable, whole-program:
+
+- every logical-axis name used at a rule lookup site (``<...>rules
+  .spec(...)``, ``logical_sharding(...)``, ``shard_constraint(...)``,
+  ``with_overrides(name=...)``) must exist in a declared
+  ``LogicalRules({...})`` table somewhere in the program;
+- every mesh axis named in a rule *value* must be a declared mesh axis
+  (the ``MESH_AXES`` tuple), and a mesh axis may appear at most once
+  within one rule value — the invariant documented at
+  ``parallel/sharding.py`` ("a mesh axis may appear at most once in a
+  PartitionSpec");
+- literal ``P(...)``/``PartitionSpec(...)`` constructions must not
+  repeat a mesh axis across their dims (same invariant, stated
+  directly — GSPMD rejects it at run time deep inside jit, with a
+  far worse error);
+- ``jax.jit``/``pjit`` call sites wrapping a resolvable function are
+  arity-checked: each ``donate_argnums`` index must name a real
+  positional parameter, and a literal ``in_shardings`` *tuple* must
+  match the parameter count — the off-by-one that otherwise surfaces
+  as an opaque tracer error (or worse, silently donates the wrong
+  buffer). ``out_shardings`` is out of scope: it matches *return*
+  arity, which the wrapped signature cannot tell us.
+
+Rule-lookup sites are recognized syntactically: a ``.spec(...)`` call
+whose receiver's last component contains ``rule`` (``rules.spec``,
+``DEFAULT_RULES.spec``, ``self.model.rules.spec``) with only string /
+None constant args. Checks that need a declared universe (logical
+names, mesh axes) stay quiet when the linted root declares none — a
+fixture dir or subpackage without ``sharding.py`` must not flag.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from skypilot_tpu.lint.core import (Checker, FileContext, Finding,
+                                    FunctionEntry, register)
+from skypilot_tpu.lint.checkers.jax_hazards import _is_jit_name
+
+
+def _const_str(node: ast.expr) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _axis_strings(node: ast.expr) -> List[ast.Constant]:
+    """Flatten a rule value / P() dim: 'x' or ('x', 'y') -> constants."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node]
+    if isinstance(node, ast.Tuple):
+        return [e for e in node.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, str)]
+    return []
+
+
+def _receiver_tail(func: ast.Attribute) -> Optional[str]:
+    base = func.value
+    if isinstance(base, ast.Name):
+        return base.id
+    if isinstance(base, ast.Attribute):
+        return base.attr
+    return None
+
+
+@register
+class ShardingConsistencyChecker(Checker):
+    name = 'sharding-consistency'
+    description = ('unknown logical-axis names, repeated mesh axes in '
+                   'a PartitionSpec, jit donate/in_shardings arity')
+
+    # -- pass 1: declared universe -------------------------------------------
+    def _declared(self, contexts) -> Tuple[Set[str], Set[str]]:
+        logical: Set[str] = set()
+        mesh: Set[str] = set()
+        for ctx in contexts:
+            for node in ctx.nodes:
+                if (isinstance(node, ast.Call)
+                        and self._is_rules_ctor(node.func)
+                        and node.args
+                        and isinstance(node.args[0], ast.Dict)):
+                    for k in node.args[0].keys:
+                        s = _const_str(k) if k is not None else None
+                        if s is not None:
+                            logical.add(s)
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, ast.AnnAssign) and node.value:
+                    targets = [node.target]
+                for t in targets:
+                    if (isinstance(t, ast.Name) and t.id == 'MESH_AXES'
+                            and isinstance(node.value, ast.Tuple)):
+                        for e in node.value.elts:
+                            s = _const_str(e)
+                            if s is not None:
+                                mesh.add(s)
+        return logical, mesh
+
+    @staticmethod
+    def _is_rules_ctor(func: ast.expr) -> bool:
+        name = func.id if isinstance(func, ast.Name) else \
+            func.attr if isinstance(func, ast.Attribute) else ''
+        return name == 'LogicalRules'
+
+    # -- main ----------------------------------------------------------------
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        return ()  # needs the project-wide declared universe
+
+    def finalize(self, run) -> List[Finding]:
+        logical, mesh = self._declared(run.contexts)
+        findings: List[Finding] = []
+        for ctx in run.contexts:
+            findings.extend(self._check_ctx(ctx, logical, mesh))
+        return findings
+
+    def _check_ctx(self, ctx: FileContext, logical: Set[str],
+                   mesh: Set[str]) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ctx.nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            # LogicalRules({...}) values + with_overrides(values).
+            if self._is_rules_ctor(func) and node.args \
+                    and isinstance(node.args[0], ast.Dict):
+                for v in node.args[0].values:
+                    findings.extend(self._check_rule_value(ctx, v, mesh))
+            if isinstance(func, ast.Attribute) \
+                    and func.attr == 'with_overrides':
+                for kw in node.keywords:
+                    if kw.arg is None:
+                        continue
+                    if logical and kw.arg not in logical:
+                        findings.append(ctx.finding(
+                            kw.value, self.name,
+                            f'with_overrides({kw.arg}=...): '
+                            f'{kw.arg!r} is not a declared logical '
+                            f'axis — the override creates a dead rule '
+                            f'and the real axis keeps its old '
+                            f'sharding'))
+                    findings.extend(self._check_rule_value(
+                        ctx, kw.value, mesh))
+            # rules.spec('batch', ...) — logical-name lookups.
+            if logical and isinstance(func, ast.Attribute) \
+                    and func.attr == 'spec' and node.args:
+                tail = _receiver_tail(func)
+                if tail is not None and 'rule' in tail.lower():
+                    consts = [a for a in node.args
+                              if isinstance(a, ast.Constant)]
+                    if len(consts) == len(node.args):
+                        for a in consts:
+                            s = _const_str(a)
+                            if s is not None and s not in logical:
+                                findings.append(self._unknown_logical(
+                                    ctx, a, s))
+            # logical_sharding(mesh, rules, 'a', ...) /
+            # shard_constraint(x, mesh, rules, 'a', ...).
+            if logical:
+                name = func.id if isinstance(func, ast.Name) else \
+                    func.attr if isinstance(func, ast.Attribute) else ''
+                if name in ('logical_sharding', 'shard_constraint'):
+                    for a in node.args:
+                        s = _const_str(a)
+                        if s is not None and s not in logical:
+                            findings.append(self._unknown_logical(
+                                ctx, a, s))
+            # P(...) / PartitionSpec(...): each mesh axis at most once.
+            ctor = func.id if isinstance(func, ast.Name) else \
+                func.attr if isinstance(func, ast.Attribute) else ''
+            if ctor in ('P', 'PartitionSpec') and node.args:
+                seen: Dict[str, ast.Constant] = {}
+                for dim in node.args:
+                    for c in _axis_strings(dim):
+                        if c.value in seen:
+                            findings.append(ctx.finding(
+                                c, self.name,
+                                f'mesh axis {c.value!r} appears more '
+                                f'than once in this PartitionSpec — '
+                                f'an axis may appear at most once '
+                                f'(GSPMD rejects it inside jit with a '
+                                f'far less helpful error)'))
+                        seen.setdefault(c.value, c)
+            # jax.jit / pjit arity cross-checks.
+            if _is_jit_name(func) and node.args:
+                findings.extend(self._check_jit(ctx, node))
+        return findings
+
+    def _unknown_logical(self, ctx: FileContext, node: ast.expr,
+                         name: str) -> Finding:
+        return ctx.finding(
+            node, self.name,
+            f'unknown logical axis {name!r}: not in any declared '
+            f'LogicalRules table — it resolves to None (unsharded) '
+            f'silently; fix the name or declare the axis')
+
+    def _check_rule_value(self, ctx: FileContext, value: ast.expr,
+                          mesh: Set[str]) -> List[Finding]:
+        findings: List[Finding] = []
+        consts = _axis_strings(value)
+        seen: Set[str] = set()
+        for c in consts:
+            if mesh and c.value not in mesh:
+                findings.append(ctx.finding(
+                    c, self.name,
+                    f'rule maps to unknown mesh axis {c.value!r} '
+                    f'(declared: {", ".join(sorted(mesh))})'))
+            if c.value in seen:
+                findings.append(ctx.finding(
+                    c, self.name,
+                    f'mesh axis {c.value!r} repeated within one rule '
+                    f'value — an axis may appear at most once per '
+                    f'PartitionSpec'))
+            seen.add(c.value)
+        return findings
+
+    # -- jit arity -----------------------------------------------------------
+    def _check_jit(self, ctx: FileContext,
+                   call: ast.Call) -> List[Finding]:
+        entry = self._wrapped_entry(ctx, call)
+        if entry is None:
+            return []
+        args = entry.node.args
+        if args.vararg is not None:
+            return []  # *args: any arity is legal
+        nparams = len(getattr(args, 'posonlyargs', [])) + len(args.args)
+        # Only a DIRECT method binds self/cls before jit sees it — a
+        # closure nested inside a method inherits class_name from the
+        # FunctionIndex walk but takes every parameter it declares.
+        is_method = isinstance(ctx.parents.get(entry.node),
+                               ast.ClassDef)
+        if is_method and nparams and not any(
+                isinstance(d, ast.Name) and d.id == 'staticmethod'
+                for d in entry.node.decorator_list):
+            nparams -= 1  # self/cls is bound before jit sees it
+        findings: List[Finding] = []
+        for kw in call.keywords:
+            if kw.arg == 'donate_argnums':
+                for idx_node in self._int_items(kw.value):
+                    idx = idx_node.value
+                    if not 0 <= idx < nparams:
+                        findings.append(ctx.finding(
+                            idx_node, self.name,
+                            f'donate_argnums index {idx} out of range '
+                            f'for {entry.qualname} ({nparams} '
+                            f'positional parameter(s)) — the donation '
+                            f'misses (or hits the wrong) buffer'))
+            elif kw.arg == 'in_shardings':
+                # (out_shardings matches *return* arity, which a
+                # signature can't tell us — deliberately unchecked.)
+                if isinstance(kw.value, ast.Tuple) \
+                        and not args.defaults \
+                        and len(kw.value.elts) != nparams:
+                    findings.append(ctx.finding(
+                        kw.value, self.name,
+                        f'in_shardings has {len(kw.value.elts)} '
+                        f'entries but {entry.qualname} takes '
+                        f'{nparams} positional parameter(s)'))
+        return findings
+
+    @staticmethod
+    def _int_items(node: ast.expr) -> List[ast.Constant]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            return [node]
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return [e for e in node.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, int)]
+        return []
+
+    def _wrapped_entry(self, ctx: FileContext,
+                       call: ast.Call) -> Optional[FunctionEntry]:
+        """Resolve jit's wrapped function within the file, preferring
+        the call site's own nesting scope (train/step.py jits a closure
+        defined inside the builder method)."""
+        target = call.args[0]
+        enclosing = self._enclosing_entry(ctx, call)
+        if isinstance(target, ast.Name):
+            candidates = [e for e in ctx.functions.entries
+                          if e.name == target.id]
+            if not candidates:
+                return None
+            if enclosing is not None:
+                scoped = [e for e in candidates
+                          if e.qualname.startswith(
+                              enclosing.qualname + '.')]
+                if scoped:
+                    return scoped[0]
+            module_level = [e for e in candidates
+                            if '.' not in e.qualname]
+            return (module_level or candidates)[0]
+        if (isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id in ('self', 'cls')
+                and enclosing is not None
+                and enclosing.class_name is not None):
+            return ctx.functions.lookup(target.attr,
+                                        enclosing.class_name)
+        return None
+
+    @staticmethod
+    def _enclosing_entry(ctx: FileContext,
+                         node: ast.AST) -> Optional[FunctionEntry]:
+        p = ctx.parents.get(node)
+        while p is not None:
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return ctx.functions.by_node.get(p)
+            p = ctx.parents.get(p)
+        return None

@@ -1,0 +1,66 @@
+"""Silently-swallowed exceptions.
+
+``except: pass`` / ``except Exception: pass`` (``BaseException`` too,
+bare or inside a tuple) hides every failure mode behind it — including
+the ones the author never imagined (KeyboardInterrupt under a bare
+``except``, OOM, a typo'd attribute). Each such site either narrows to
+the exception it actually expects, does *something* (log, count,
+re-raise), or carries a ``# skylint: disable=silent-except`` with a
+justification — making "we really do want to drop everything here" a
+reviewed, written-down decision instead of an accident.
+
+Only handlers whose body is *nothing but* ``pass``/``...`` are flagged:
+a broad handler that logs or cleans up is a different (human) review
+question.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from skypilot_tpu.lint.core import Checker, FileContext, Finding, register
+
+_BROAD = ('Exception', 'BaseException')
+
+
+def _is_broad(type_node) -> bool:
+    if type_node is None:
+        return True  # bare except:
+    if isinstance(type_node, ast.Name):
+        return type_node.id in _BROAD
+    if isinstance(type_node, ast.Attribute):
+        return type_node.attr in _BROAD
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad(e) for e in type_node.elts)
+    return False
+
+
+def _is_silent(body) -> bool:
+    return all(
+        isinstance(stmt, ast.Pass)
+        or (isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Constant)
+            and stmt.value.value is ...)
+        for stmt in body)
+
+
+@register
+class SilentExceptChecker(Checker):
+    name = 'silent-except'
+    description = ('bare/broad except whose body is only pass — '
+                   'failures vanish without a trace')
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ctx.nodes:
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if _is_broad(node.type) and _is_silent(node.body):
+                what = ('bare except' if node.type is None
+                        else 'except ' + ast.unparse(node.type))
+                findings.append(ctx.finding(
+                    node, self.name,
+                    f'{what}: pass swallows every failure silently — '
+                    f'narrow the exception, handle/log it, or suppress '
+                    f'with a justifying comment'))
+        return findings

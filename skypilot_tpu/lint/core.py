@@ -1,10 +1,19 @@
-"""skylint framework: checker registry, AST file contexts, suppressions.
+"""skylint framework: checker registry, AST file contexts, suppressions,
+and the whole-program :class:`ProjectIndex`.
 
 A checker subclasses :class:`Checker` and registers with
 :func:`register`. Per file it gets a :class:`FileContext` (source, AST,
 parent links, a function index with intra-file call resolution); checks
 that need cross-file aggregation stash state on ``self`` during
 ``check_file`` and emit the aggregate findings from ``finalize``.
+
+Every file is parsed exactly once per run: :class:`LintRun` builds all
+:class:`FileContext` objects up front, constructs one
+:class:`ProjectIndex` over them (import-binding resolution + a
+cross-module call graph), and hands both to every checker. Checkers that
+can use whole-program reachability read ``ctx.project``; when it is
+``None`` (``cross_module=False``, the pre-v2 semantics) they fall back
+to per-file analysis.
 
 Suppressions: a finding is dropped when its line (or a pure-comment line
 directly above it) carries ``# skylint: disable=<check>[,<check>]`` (a
@@ -15,6 +24,7 @@ comment — that is the reviewable record of "yes, this is deliberate".
 from __future__ import annotations
 
 import ast
+import collections
 import dataclasses
 import json
 import os
@@ -47,12 +57,21 @@ class FileContext:
         self.source = source
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=path)
+        # One walk per file, ever: every whole-tree scan (checkers,
+        # ProjectIndex) iterates this cached list instead of re-walking
+        # — the difference between O(checkers) and O(1) traversals.
+        self.nodes: List[ast.AST] = list(ast.walk(self.tree))
         self.parents: Dict[ast.AST, ast.AST] = {}
-        for node in ast.walk(self.tree):
+        for node in self.nodes:
             for child in ast.iter_child_nodes(node):
                 self.parents[child] = node
         self._functions: Optional['FunctionIndex'] = None
         self._suppressions: Optional[Dict[int, Optional[Set[str]]]] = None
+        # Set by LintRun before checkers run: the whole-program index
+        # (None under cross_module=False) and this file's dotted module
+        # name ('' when the file is not importable as a module).
+        self.project: Optional['ProjectIndex'] = None
+        self.module: str = ''
 
     @property
     def functions(self) -> 'FunctionIndex':
@@ -119,6 +138,9 @@ class FunctionIndex:
         self.entries: List[FunctionEntry] = []
         self.by_node: Dict[ast.AST, FunctionEntry] = {}
         self._walk(tree, prefix='', class_name=None)
+        self._by_name: Dict[str, List[FunctionEntry]] = {}
+        for e in self.entries:
+            self._by_name.setdefault(e.name, []).append(e)
 
     def _walk(self, node: ast.AST, prefix: str,
               class_name: Optional[str]) -> None:
@@ -138,12 +160,13 @@ class FunctionIndex:
     def lookup(self, name: str,
                class_name: Optional[str]) -> Optional[FunctionEntry]:
         # Same-class method first, then module level.
+        candidates = self._by_name.get(name, ())
         if class_name is not None:
-            for e in self.entries:
-                if e.name == name and e.class_name == class_name:
+            for e in candidates:
+                if e.class_name == class_name:
                     return e
-        for e in self.entries:
-            if e.name == name and e.class_name is None:
+        for e in candidates:
+            if e.class_name is None:
                 return e
         return None
 
@@ -177,6 +200,484 @@ class FunctionIndex:
                     if target is not None and target.node not in seen:
                         stack.append(target)
         return order
+
+
+def module_name_for(path: str) -> str:
+    """Dotted module name from package layout: walk up while the parent
+    directory is a package (has ``__init__.py``). A file outside any
+    package resolves to its bare stem — that is what makes fixture
+    directories (no ``__init__.py``) analyzable as flat module sets."""
+    path = os.path.abspath(path)
+    base = os.path.basename(path)
+    stem = base[:-3] if base.endswith('.py') else base
+    parts = [] if stem == '__init__' else [stem]
+    d = os.path.dirname(path)
+    while os.path.isfile(os.path.join(d, '__init__.py')):
+        parts.insert(0, os.path.basename(d))
+        d = os.path.dirname(d)
+    return '.'.join(parts)
+
+
+@dataclasses.dataclass(frozen=True)
+class ProjectFunction:
+    """A function/method with its whole-program identity."""
+    module: str
+    entry: FunctionEntry
+    ctx: FileContext
+
+    @property
+    def qualname(self) -> str:
+        return f'{self.module}:{self.entry.qualname}'
+
+
+class ProjectIndex:
+    """Whole-program view: every module parsed once, import bindings
+    resolved, and a cross-module call graph.
+
+    Resolution is deliberately syntactic (no execution, no type
+    inference beyond ``self.<attr> = ClassName(...)`` constructor
+    assignments): a call resolves when its target is a same-class
+    method, a module-level function, an imported function/class, a
+    method through a module alias (``metrics_lib.enabled()``), a method
+    on a typed ``self`` attribute (``self.engine.step()`` where
+    ``self.engine = DecodeEngine(...)``), or a base-class method.
+    Anything else — dynamic dispatch, locals, higher-order calls —
+    resolves to None and the analyses stay sound-but-incomplete, which
+    is the right trade for a linter gate.
+    """
+
+    def __init__(self, contexts: Sequence[FileContext]):
+        self.contexts = list(contexts)
+        self.modules: Dict[str, FileContext] = {}
+        self.module_of: Dict[str, str] = {}        # relpath -> module
+        self.imports: Dict[str, Dict[str, str]] = {}
+        self.classes: Dict[Tuple[str, str], ast.ClassDef] = {}
+        self._methods: Dict[Tuple[str, str], Dict[str, FunctionEntry]] = {}
+        self._bases: Dict[Tuple[str, str], List[ast.expr]] = {}
+        self.attr_types: Dict[Tuple[str, str],
+                              Dict[str, Tuple[str, str]]] = {}
+        self._pf: Dict[Tuple[str, ast.AST], ProjectFunction] = {}
+        for ctx in self.contexts:
+            mod = module_name_for(ctx.path)
+            ctx.module = mod
+            self.modules[mod] = ctx
+            self.module_of[ctx.relpath] = mod
+            is_init = os.path.basename(ctx.path) == '__init__.py'
+            self.imports[mod] = self._collect_imports(ctx.tree, mod,
+                                                      is_init)
+            for node in ctx.nodes:
+                if isinstance(node, ast.ClassDef):
+                    key = (mod, node.name)
+                    self.classes[key] = node
+                    self._bases[key] = list(node.bases)
+                    methods = {}
+                    for e in ctx.functions.entries:
+                        if (e.class_name == node.name
+                                and self._owning_class(ctx, e.node)
+                                is node):
+                            methods[e.name] = e
+                    self._methods[key] = methods
+            for e in ctx.functions.entries:
+                self._pf[(mod, id(e.node))] = ProjectFunction(mod, e, ctx)
+        for ctx in self.contexts:
+            self._collect_attr_types(ctx)
+        self._importers: Optional[Dict[str, Set[str]]] = None
+        self._local_type_cache: Dict[Tuple[str, int],
+                                     Dict[str, Tuple[str, str]]] = {}
+        # Call-node -> resolution memo: the three whole-program
+        # checkers each traverse the same call graph; a call node's
+        # resolution never changes within a run. The node itself is
+        # kept in the value so a recycled id() (a GC'd synthetic call)
+        # can never alias a stale entry.
+        self._call_cache: Dict[
+            int, Tuple[ast.Call, Optional[ProjectFunction]]] = {}
+
+    # -- construction helpers ------------------------------------------------
+    @staticmethod
+    def _owning_class(ctx: FileContext,
+                      node: ast.AST) -> Optional[ast.ClassDef]:
+        p = ctx.parents.get(node)
+        while p is not None and not isinstance(p, ast.ClassDef):
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return None  # nested function, not a direct method
+            p = ctx.parents.get(p)
+        return p if isinstance(p, ast.ClassDef) else None
+
+    def _collect_imports(self, tree: ast.Module, module: str,
+                         is_init: bool = False) -> Dict[str, str]:
+        """local binding name -> dotted target (module or module.symbol).
+        Function-local imports are included: the serve layer imports
+        lazily inside methods and those calls must still resolve."""
+        out: Dict[str, str] = {}
+        # Relative imports resolve against the containing package: for
+        # a plain module that is the parent, but an __init__.py IS its
+        # package — ``from .mod import f`` there must land in
+        # ``<module>.mod``, not one level higher.
+        if not module:
+            pkg_parts = []
+        elif is_init:
+            pkg_parts = module.split('.')
+        else:
+            pkg_parts = module.split('.')[:-1]
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.split('.')[0]
+                    target = alias.name if alias.asname else \
+                        alias.name.split('.')[0]
+                    out[local] = target
+                    if alias.asname is None and '.' in alias.name:
+                        # `import a.b.c` binds `a` but makes a.b.c
+                        # addressable via the dotted path at call sites;
+                        # record the full form under its dotted name.
+                        out[alias.name] = alias.name
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    up = len(pkg_parts) - (node.level - 1)
+                    if up < 0:
+                        continue
+                    base_parts = pkg_parts[:up]
+                    base = '.'.join(base_parts + (
+                        [node.module] if node.module else []))
+                else:
+                    base = node.module or ''
+                for alias in node.names:
+                    if alias.name == '*':
+                        continue
+                    local = alias.asname or alias.name
+                    out[local] = f'{base}.{alias.name}' if base \
+                        else alias.name
+        return out
+
+    def _resolve_binding(self, module: str, name: str,
+                         _seen: Optional[Set[Tuple[str, str]]] = None
+                         ) -> Optional[str]:
+        """Follow an import binding (possibly re-exported through
+        package ``__init__`` chains) to a dotted target."""
+        if _seen is None:
+            _seen = set()
+        if (module, name) in _seen:
+            return None
+        _seen.add((module, name))
+        target = self.imports.get(module, {}).get(name)
+        if target is None:
+            return None
+        if target in self.modules:
+            return target
+        head, _, sym = target.rpartition('.')
+        if head in self.modules:
+            hctx = self.modules[head]
+            if ((head, sym) in self.classes
+                    or hctx.functions.lookup(sym, None) is not None):
+                return target
+            # Re-export: __init__ imports the symbol from a submodule.
+            chained = self._resolve_binding(head, sym, _seen)
+            if chained is not None:
+                return chained
+        return target
+
+    def _collect_attr_types(self, ctx: FileContext) -> None:
+        """``self.X = ClassName(...)`` in any method types attribute X
+        for the whole class — the one-hop inference that lets
+        ``self.engine.step()`` resolve into models/decode.py."""
+        mod = ctx.module
+        for e in ctx.functions.entries:
+            if e.class_name is None:
+                continue
+            owner = self._owning_class(ctx, e.node)
+            if owner is None:
+                continue
+            key = (mod, owner.name)
+            for node in ast.walk(e.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                # Constructor call, possibly behind a default:
+                # ``self.model = model or LlamaModel(config)``.
+                values = [node.value]
+                if isinstance(node.value, ast.BoolOp):
+                    values = node.value.values
+                cls_key = None
+                for v in values:
+                    if isinstance(v, ast.Call):
+                        cls_key = self._class_of_call(mod, v.func)
+                        if cls_key is not None:
+                            break
+                if cls_key is None:
+                    continue
+                for t in node.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == 'self'):
+                        self.attr_types.setdefault(key, {})[t.attr] = \
+                            cls_key
+    def _class_of_call(self, module: str,
+                       func: ast.expr) -> Optional[Tuple[str, str]]:
+        """Resolve a constructor expression to a (module, class) key."""
+        if isinstance(func, ast.Name):
+            if (module, func.id) in self.classes:
+                return (module, func.id)
+            target = self._resolve_binding(module, func.id)
+            if target:
+                head, _, sym = target.rpartition('.')
+                if (head, sym) in self.classes:
+                    return (head, sym)
+        elif (isinstance(func, ast.Attribute)
+              and isinstance(func.value, ast.Name)):
+            target = self._resolve_binding(module, func.value.id)
+            if target in self.modules \
+                    and (target, func.attr) in self.classes:
+                return (target, func.attr)
+        return None
+
+    # -- lookup --------------------------------------------------------------
+    def project_function(self, ctx: FileContext,
+                         entry: FunctionEntry) -> ProjectFunction:
+        return self._pf[(ctx.module, id(entry.node))]
+
+    def functions_in(self, ctx: FileContext) -> List[ProjectFunction]:
+        return [self.project_function(ctx, e)
+                for e in ctx.functions.entries]
+
+    def method(self, cls_key: Tuple[str, str], name: str,
+               _seen: Optional[Set[Tuple[str, str]]] = None
+               ) -> Optional[ProjectFunction]:
+        """Method lookup walking base classes (cross-module)."""
+        if _seen is None:
+            _seen = set()
+        if cls_key in _seen or cls_key not in self.classes:
+            return None
+        _seen.add(cls_key)
+        entry = self._methods.get(cls_key, {}).get(name)
+        if entry is not None:
+            return self._pf[(cls_key[0], id(entry.node))]
+        for base in self._bases.get(cls_key, []):
+            base_key = self._class_of_call(cls_key[0], base)
+            if base_key is not None:
+                found = self.method(base_key, name, _seen)
+                if found is not None:
+                    return found
+        return None
+
+    def module_function(self, module: str,
+                        name: str) -> Optional[ProjectFunction]:
+        ctx = self.modules.get(module)
+        if ctx is None:
+            return None
+        entry = ctx.functions.lookup(name, None)
+        if entry is None:
+            return None
+        return self._pf[(module, id(entry.node))]
+
+    def _resolve_target_callable(self, dotted: str,
+                                 _seen: Optional[Set[str]] = None
+                                 ) -> Optional[ProjectFunction]:
+        """Dotted target -> function, or class -> its __init__,
+        following re-export bindings (``pkg.helper`` where ``pkg/
+        __init__.py`` does ``from .mod import helper``)."""
+        if _seen is None:
+            _seen = set()
+        if dotted in _seen:
+            return None
+        _seen.add(dotted)
+        head, _, sym = dotted.rpartition('.')
+        if not head:
+            return None
+        if (head, sym) in self.classes:
+            return self.method((head, sym), '__init__')
+        fn = self.module_function(head, sym)
+        if fn is not None:
+            return fn
+        if head in self.modules:
+            chained = self._resolve_binding(head, sym)
+            if chained is not None and chained != dotted:
+                return self._resolve_target_callable(chained, _seen)
+        return None
+
+    @staticmethod
+    def _flatten_dotted(node: ast.expr) -> Optional[List[str]]:
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.insert(0, node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.insert(0, node.id)
+            return parts
+        return None
+
+    def resolve_call(self, call: ast.Call,
+                     current: ProjectFunction) -> Optional[ProjectFunction]:
+        key = id(call)
+        cached = self._call_cache.get(key)
+        if cached is not None and cached[0] is call:
+            return cached[1]
+        resolved = self._resolve_call_uncached(call, current)
+        self._call_cache[key] = (call, resolved)
+        return resolved
+
+    def _resolve_call_uncached(self, call: ast.Call,
+                               current: ProjectFunction
+                               ) -> Optional[ProjectFunction]:
+        func = call.func
+        mod = current.module
+        ctx = current.ctx
+        cls_name = current.entry.class_name
+        owner = self._owning_class(ctx, current.entry.node) \
+            if cls_name else None
+        cls_key = (mod, owner.name) if owner is not None else None
+        if isinstance(func, ast.Name):
+            local = ctx.functions.lookup(func.id, None)
+            if local is not None:
+                return self._pf[(mod, id(local.node))]
+            if (mod, func.id) in self.classes:
+                return self.method((mod, func.id), '__init__')
+            target = self._resolve_binding(mod, func.id)
+            if target is not None:
+                return self._resolve_target_callable(target)
+            return None
+        if not isinstance(func, ast.Attribute):
+            return None
+        base = func.value
+        # self.m() / cls.m() — class methods, walking bases.
+        if isinstance(base, ast.Name) and base.id in ('self', 'cls'):
+            if cls_key is not None:
+                return self.method(cls_key, func.attr)
+            return None
+        # mod_alias.f() / ClassName.m() / pkg.sub.f()
+        parts = self._flatten_dotted(base)
+        if parts is not None:
+            if len(parts) == 1:
+                name = parts[0]
+                if (mod, name) in self.classes:
+                    return self.method((mod, name), func.attr)
+                target = self._resolve_binding(mod, name)
+                if target is not None:
+                    if target in self.modules:
+                        return self._resolve_target_callable(
+                            f'{target}.{func.attr}')
+                    head, _, sym = target.rpartition('.')
+                    if (head, sym) in self.classes:
+                        return self.method((head, sym), func.attr)
+            else:
+                dotted = '.'.join(parts)
+                if dotted in self.modules:
+                    return self._resolve_target_callable(
+                        f'{dotted}.{func.attr}')
+        # self.<attr>.m() through the constructor-typed attribute map.
+        if (isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == 'self' and cls_key is not None):
+            typed = self.attr_types.get(cls_key, {}).get(base.attr)
+            if typed is not None:
+                return self.method(typed, func.attr)
+        # local.m() where ``local = self.<typed attr>`` / ``local =
+        # Ctor(...)`` in the same function — the engine impls alias
+        # ``model = self.model`` before the layer loop.
+        if isinstance(base, ast.Name):
+            typed = self._local_types(current).get(base.id)
+            if typed is not None:
+                return self.method(typed, func.attr)
+        return None
+
+    def _local_types(self, pf: ProjectFunction
+                     ) -> Dict[str, Tuple[str, str]]:
+        key = (pf.module, id(pf.entry.node))
+        cached = self._local_type_cache.get(key)
+        if cached is not None:
+            return cached
+        out: Dict[str, Tuple[str, str]] = {}
+        owner = self._owning_class(pf.ctx, pf.entry.node) \
+            if pf.entry.class_name else None
+        cls_key = (pf.module, owner.name) if owner is not None else None
+        # Scoped walk: nested function (and lambda) bodies are their
+        # own frames — their assignments must not type THIS frame's
+        # locals (and for the synthetic module frame, only module-level
+        # statements count).
+        nodes: List[ast.AST] = []
+        stack: List[ast.AST] = [pf.entry.node]
+        while stack:
+            n = stack.pop()
+            for child in ast.iter_child_nodes(n):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue
+                nodes.append(child)
+                stack.append(child)
+        for node in nodes:
+            if not isinstance(node, ast.Assign) \
+                    or len(node.targets) != 1 \
+                    or not isinstance(node.targets[0], ast.Name):
+                continue
+            name = node.targets[0].id
+            values = [node.value]
+            if isinstance(node.value, ast.BoolOp):
+                values = node.value.values
+            for v in values:
+                if isinstance(v, ast.Call):
+                    ck = self._class_of_call(pf.module, v.func)
+                    if ck is not None:
+                        out[name] = ck
+                        break
+                elif (isinstance(v, ast.Attribute)
+                      and isinstance(v.value, ast.Name)
+                      and v.value.id == 'self' and cls_key is not None):
+                    typed = self.attr_types.get(cls_key, {}).get(v.attr)
+                    if typed is not None:
+                        out[name] = typed
+                        break
+        self._local_type_cache[key] = out
+        return out
+
+    def reachable_from(self, roots: Sequence[ProjectFunction]
+                       ) -> List[ProjectFunction]:
+        """Roots plus every function transitively called, across
+        modules. Order: BFS from the roots (deterministic)."""
+        seen: Set[Tuple[str, int]] = set()
+        order: List[ProjectFunction] = []
+        queue = collections.deque(roots)
+        while queue:
+            pf = queue.popleft()
+            key = (pf.module, id(pf.entry.node))
+            if key in seen:
+                continue
+            seen.add(key)
+            order.append(pf)
+            for node in ast.walk(pf.entry.node):
+                if isinstance(node, ast.Call):
+                    target = self.resolve_call(node, pf)
+                    if target is not None:
+                        queue.append(target)
+        return order
+
+    # -- reverse dependencies ------------------------------------------------
+    def _importer_map(self) -> Dict[str, Set[str]]:
+        if self._importers is None:
+            out: Dict[str, Set[str]] = {}
+            for mod, imports in self.imports.items():
+                for target in imports.values():
+                    t = target
+                    if t not in self.modules:
+                        t = target.rpartition('.')[0]
+                    if t and t in self.modules and t != mod:
+                        out.setdefault(t, set()).add(mod)
+            self._importers = out
+        return self._importers
+
+    def reverse_closure(self, relpaths: Iterable[str]) -> Set[str]:
+        """Relpaths of the given files plus every file that
+        (transitively) imports them — the re-lint set for
+        ``--changed``."""
+        importers = self._importer_map()
+        queue = [self.module_of[p] for p in relpaths
+                 if p in self.module_of]
+        seen: Set[str] = set(queue)
+        while queue:
+            mod = queue.pop()
+            for dep in importers.get(mod, ()):
+                if dep not in seen:
+                    seen.add(dep)
+                    queue.append(dep)
+        return {self.modules[m].relpath for m in seen}
 
 
 class Checker:
@@ -218,9 +719,18 @@ class LintRun:
     """
 
     def __init__(self, roots: Sequence[str], full_tree: bool = False,
-                 checks: Optional[Sequence[str]] = None):
+                 checks: Optional[Sequence[str]] = None,
+                 cross_module: bool = True,
+                 report_paths: Optional[Iterable[str]] = None):
         self.roots = [os.path.abspath(r) for r in roots]
         self.full_tree = full_tree
+        self.cross_module = cross_module
+        # When set (the --changed mode): every file is still parsed and
+        # indexed — cross-module resolution needs the whole tree — but
+        # only findings landing in these relpaths are reported.
+        self.report_paths: Optional[Set[str]] = (
+            set(report_paths) if report_paths is not None else None)
+        self.project: Optional[ProjectIndex] = None
         self.repo_root = _repo_root()
         known = {cls.name for cls in all_checkers()}
         selected = set(checks) if checks else None
@@ -251,6 +761,8 @@ class LintRun:
                         yield os.path.join(dirpath, fn)
 
     def run(self) -> List[Finding]:
+        # Phase 1: parse every file exactly once — all checkers share
+        # these ASTs (and the ProjectIndex built over them).
         for path in self._iter_files():
             relpath = os.path.relpath(path, self.repo_root)
             try:
@@ -263,6 +775,15 @@ class LintRun:
                     f'cannot analyze: {type(e).__name__}: {e}'))
                 continue
             self.contexts.append(ctx)
+        # Phase 2: whole-program index (skipped under the pre-v2
+        # same-file semantics, which pins the cross-module regression
+        # fixtures).
+        if self.cross_module:
+            self.project = ProjectIndex(self.contexts)
+        for ctx in self.contexts:
+            ctx.project = self.project
+        # Phase 3: checkers.
+        for ctx in self.contexts:
             for checker in self.checkers:
                 for finding in checker.check_file(ctx):
                     self._collect(ctx, finding)
@@ -275,6 +796,9 @@ class LintRun:
                 else:
                     self.findings.append(finding)
         self.findings.extend(self.parse_errors)
+        if self.report_paths is not None:
+            self.findings = [f for f in self.findings
+                             if f.path in self.report_paths]
         self.findings.sort(key=lambda f: (f.path, f.line, f.check))
         return self.findings
 
@@ -297,6 +821,9 @@ class LintRun:
             'roots': [os.path.relpath(r, self.repo_root)
                       for r in self.roots],
             'files_scanned': len(self.contexts),
+            'cross_module': self.cross_module,
+            'changed_only': sorted(self.report_paths)
+            if self.report_paths is not None else None,
             'checks': [c.name for c in self.checkers],
             'findings': [dataclasses.asdict(f) for f in self.findings],
             'suppressed': [dataclasses.asdict(f)
@@ -311,13 +838,16 @@ def _repo_root() -> str:
 
 def run_skylint(roots: Optional[Sequence[str]] = None,
                 full_tree: Optional[bool] = None,
-                checks: Optional[Sequence[str]] = None) -> LintRun:
+                checks: Optional[Sequence[str]] = None,
+                cross_module: bool = True,
+                report_paths: Optional[Iterable[str]] = None) -> LintRun:
     """Convenience entry: default roots = the whole package tree."""
     default_root = os.path.join(_repo_root(), 'skypilot_tpu')
     if not roots:
         roots = [default_root]
         if full_tree is None:
             full_tree = True
-    run = LintRun(roots, full_tree=bool(full_tree), checks=checks)
+    run = LintRun(roots, full_tree=bool(full_tree), checks=checks,
+                  cross_module=cross_module, report_paths=report_paths)
     run.run()
     return run

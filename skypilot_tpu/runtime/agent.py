@@ -209,8 +209,11 @@ class JobDriver(threading.Thread):
                     f'test -f {pid_file} && kill -KILL -- -$(cat {pid_file}) '
                     f'2>/dev/null; {rmc}rm -f {pid_file}; true',
                     timeout=30)
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001
+                # The host may already be gone (preemption/teardown);
+                # anything else leaves the job group running — say so.
+                print(f'runtime agent: remote kill cleanup failed: {e}',
+                      file=sys.stderr)
 
 
 class Agent:

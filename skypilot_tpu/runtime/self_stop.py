@@ -31,6 +31,11 @@ def main() -> None:
     try:
         global_user_state.remove_cluster(args.cluster,
                                          terminate=args.down)
+    # On cloud hosts the state db lives on the client machine, so this
+    # is EXPECTED to fail there (the client's status refresh reconciles
+    # instead) — any error class, since sqlite surfaces unreachable
+    # paths in several ways.
+    # skylint: disable=silent-except
     except Exception:
         pass
 

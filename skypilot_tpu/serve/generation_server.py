@@ -881,6 +881,10 @@ class GenerationScheduler:
             if prep and prep['blocks']:
                 try:
                     self.state = self.engine.release(self.state, slot)
+                # A failing release dispatch is survivable here (see
+                # docstring): the crash-recovery caller replaces the
+                # whole device state, so the stale table dies with it.
+                # skylint: disable=silent-except
                 except Exception:  # noqa: BLE001 — crash path resets
                     pass
             self._free_prep(prep)
@@ -1414,8 +1418,8 @@ class GenerationServer:
                 except Exception as e:  # noqa: BLE001 — report to client
                     try:
                         self._json(400, {'error': str(e)})
-                    except Exception:
-                        pass
+                    except OSError:
+                        pass  # client hung up before the error reply
 
             def _json(self, code, payload):
                 data = json.dumps(payload).encode()

@@ -7,6 +7,7 @@ API-server HTML pages (sky/server/html/) in one dependency-free page at
 from __future__ import annotations
 
 import html
+import sqlite3
 import time
 from typing import Any, List
 
@@ -127,7 +128,7 @@ def render() -> str:
                 _esc(j['schedule_state'].value),
                 _esc(j['recovery_count']), _esc(j['cluster_name']),
             ])
-    except Exception:  # jobs db absent on a fresh install
+    except (sqlite3.Error, OSError):  # jobs db absent on a fresh install
         pass
 
     service_rows = []
@@ -161,8 +162,8 @@ def render() -> str:
                 serve_metric_rows = [
                     row for row in pool.map(fetch, metric_targets)
                     if row is not None]
-    except Exception:
-        pass
+    except (sqlite3.Error, OSError):
+        pass  # serve db absent on a fresh install
 
     request_rows = []
     try:
@@ -177,8 +178,8 @@ def render() -> str:
                 _esc(time.strftime('%H:%M:%S', time.localtime(created))
                      if created else '-'),
             ])
-    except Exception:
-        pass
+    except (sqlite3.Error, OSError):
+        pass  # requests db absent on a fresh install
 
     return _PAGE.format(
         now=html.escape(time.strftime('%Y-%m-%d %H:%M:%S')),

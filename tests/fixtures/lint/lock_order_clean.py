@@ -1,0 +1,41 @@
+"""lock-order clean counterpart: same lock pair, one global order
+(A before B everywhere), a Condition aliased to its lock with a wait,
+and the ``*_locked`` convention — no cycle, no findings."""
+import threading
+
+
+class Ordered:
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._cond = threading.Condition(self._a)
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                return 1
+
+    def also_forward(self):
+        with self._a:
+            self._take_b()
+
+    def _take_b(self):
+        with self._b:
+            return 2
+
+    def waiter(self):
+        with self._cond:
+            while not self._ready():
+                self._cond.wait()
+            return 3
+
+    def _ready(self):
+        return True
+
+    def reentrant_by_convention(self):
+        with self._a:
+            return self._sum_locked()
+
+    def _sum_locked(self):
+        return 4

@@ -1,0 +1,34 @@
+"""lock-order fixture: an A->B / B->A inversion (cycle via a
+cross-method edge) plus a non-reentrant self-deadlock, and one
+suppressed instance."""
+import threading
+
+
+class Inverted:
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                return 1
+
+    def backward(self):
+        with self._b:
+            self._take_a()
+
+    def _take_a(self):
+        with self._a:
+            return 2
+
+    def self_deadlock(self):
+        with self._a:
+            self._take_a()
+
+    def justified(self):
+        with self._a:
+            # Single-threaded setup path, runs before any thread starts.
+            # skylint: disable=lock-order
+            self._take_a()

@@ -1,0 +1,43 @@
+"""sharding-consistency clean counterpart: every logical name
+declared, every rule value a real mesh axis used at most once, literal
+PartitionSpecs duplicate-free, jit arity consistent."""
+from typing import Tuple
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+MESH_AXES: Tuple[str, ...] = ('dp', 'fsdp', 'tp')
+
+
+class LogicalRules:
+
+    def __init__(self, rules):
+        self.rules = dict(rules)
+
+    def spec(self, *axes):
+        return axes
+
+    def with_overrides(self, **kw):
+        return LogicalRules({**self.rules, **kw})
+
+
+RULES = LogicalRules({
+    'batch': ('dp', 'fsdp'),
+    'embed': 'fsdp',
+    'heads': 'tp',
+})
+
+GOOD_SPEC = RULES.spec('batch', None, 'embed')
+GOOD_OVERRIDE = RULES.with_overrides(heads=('fsdp', 'tp'))
+GOOD_P = P('dp', ('fsdp', 'tp'))
+# Not a rules table: string args to other .spec() calls are out of
+# scope (the receiver-name heuristic requires 'rule' in the name).
+OTHER = type('X', (), {'spec': staticmethod(lambda *a: a)})
+OTHER_SPEC = OTHER.spec('not_an_axis')
+
+
+def _impl(x, y):
+    return x + y
+
+
+step = jax.jit(_impl, donate_argnums=(0, 1), in_shardings=(None, None))

@@ -1,0 +1,25 @@
+"""silent-except clean counterpart: narrow handlers may pass; broad
+handlers that actually do something are out of scope."""
+import sys
+
+
+def narrow():
+    try:
+        return 1
+    except KeyError:
+        pass
+
+
+def narrow_tuple():
+    try:
+        return 2
+    except (ValueError, OSError):
+        pass
+
+
+def broad_but_handled():
+    try:
+        return 3
+    except Exception as e:  # noqa: BLE001
+        print(f'recovered: {e}', file=sys.stderr)
+        return None

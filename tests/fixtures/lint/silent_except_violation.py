@@ -1,0 +1,32 @@
+"""silent-except fixture: bare / broad / tuple-broad handlers whose
+body is only pass, plus one justified suppression."""
+
+
+def bare():
+    try:
+        return 1
+    except:  # noqa: E722
+        pass
+
+
+def broad():
+    try:
+        return 2
+    except Exception:
+        pass
+
+
+def tuple_broad():
+    try:
+        return 3
+    except (ValueError, Exception):
+        pass
+
+
+def justified():
+    try:
+        return 4
+    # Probe of an optional capability: any failure means "absent".
+    # skylint: disable=silent-except
+    except Exception:
+        pass

@@ -1,0 +1,15 @@
+"""Cross-module fixture, callee side: the blocking call lives HERE —
+one import away from the hot-path root in hot_root.py. Under the old
+same-file semantics this file is invisible from the root and the
+fixture passes; the whole-program call graph traverses into it."""
+import time
+import urllib.request
+
+
+def refresh_metadata(url):
+    with urllib.request.urlopen(url) as resp:  # network
+        return resp.read()
+
+
+def backoff():
+    time.sleep(0.5)  # sleep

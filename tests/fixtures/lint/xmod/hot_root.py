@@ -1,0 +1,11 @@
+"""Cross-module fixture, root side: a hot-path-marked step loop whose
+blocking work hides behind an import (see blocky.py)."""
+import blocky
+
+
+class Engine:
+
+    def step(self):  # skylint: hot-path
+        data = blocky.refresh_metadata('http://metadata/latest')
+        blocky.backoff()
+        return data

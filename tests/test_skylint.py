@@ -27,6 +27,17 @@ FIXTURES = os.path.join(REPO_ROOT, 'tests', 'fixtures', 'lint')
 SKYLINT = os.path.join(REPO_ROOT, 'scripts', 'skylint.py')
 
 
+@pytest.fixture(scope='module')
+def tree_run():
+    """ONE shared full-tree run (parse + ProjectIndex of ~170 files)
+    for every in-process whole-tree assertion — the suite must not pay
+    that cost per test."""
+    import time
+    t0 = time.monotonic()
+    run = core.run_skylint()
+    return run, time.monotonic() - t0
+
+
 def lint_fixture(filename, check):
     run = core.LintRun([os.path.join(FIXTURES, filename)],
                        full_tree=False, checks=[check])
@@ -79,7 +90,9 @@ class TestTreeClean:
                               capture_output=True, text=True)
         assert proc.returncode == 0
         for name in ('lock-discipline', 'jax-host-sync',
-                     'blocking-hot-path', 'env-contract', 'metric-name'):
+                     'blocking-hot-path', 'env-contract', 'metric-name',
+                     'lock-order', 'sharding-consistency',
+                     'silent-except'):
             assert name in proc.stdout
 
     def test_check_metric_names_shim_delegates(self, tmp_path):
@@ -237,6 +250,404 @@ class TestMetricName:
     def test_clean_counterpart_passes(self):
         run = lint_fixture('metric_clean.py', 'metric-name')
         assert run.findings == []
+
+
+# ---- lock-order -------------------------------------------------------------
+class TestLockOrder:
+
+    def test_flags_cycle_and_self_deadlock(self):
+        run = lint_fixture('lock_order_violation.py', 'lock-order')
+        assert finding_lines(run) == [15, 28]
+        cycle, selfdead = sorted(run.findings, key=lambda f: f.line)
+        # The cycle finding carries BOTH acquisition paths.
+        assert 'lock-order cycle' in cycle.message
+        assert 'Inverted.forward' in cycle.message
+        assert 'Inverted.backward' in cycle.message
+        assert '_a -> ' in cycle.message and '_b -> ' in cycle.message
+        assert 'self-deadlock' in selfdead.message
+        assert '_take_a' in selfdead.message
+
+    def test_suppression_comment_works(self):
+        run = lint_fixture('lock_order_violation.py', 'lock-order')
+        assert sorted(f.line for f in run.suppressed) == [34]
+
+    def test_clean_counterpart_passes(self):
+        """Consistent global order + Condition aliased to its lock +
+        the *_locked convention: no findings."""
+        run = lint_fixture('lock_order_clean.py', 'lock-order')
+        assert run.findings == []
+
+
+# ---- sharding-consistency ---------------------------------------------------
+class TestShardingConsistency:
+
+    def test_flags_each_inconsistency_at_exact_lines(self):
+        run = lint_fixture('sharding_violation.py',
+                           'sharding-consistency')
+        assert finding_lines(run) == [27, 28, 31, 32, 33, 44]
+        by_line = {f.line: f.message for f in run.findings}
+        assert "unknown mesh axis 'fsdpp'" in by_line[27]
+        assert "'tp' repeated within one rule value" in by_line[28]
+        assert "unknown logical axis 'embedz'" in by_line[31]
+        assert "'batchz' is not a declared logical axis" in by_line[32]
+        assert "'dp' appears more than once" in by_line[33]
+        assert 'donate_argnums index 2 out of range' in by_line[44]
+
+    def test_suppression_comment_works(self):
+        run = lint_fixture('sharding_violation.py',
+                           'sharding-consistency')
+        assert sorted(f.line for f in run.suppressed) == [37]
+
+    def test_clean_counterpart_passes(self):
+        run = lint_fixture('sharding_clean.py', 'sharding-consistency')
+        assert run.findings == []
+
+    def test_closure_in_method_keeps_all_params(self, tmp_path):
+        """A closure jitted inside a method is NOT a method: it must
+        not lose a parameter to the self adjustment (the train/step.py
+        builder pattern)."""
+        p = tmp_path / 'builder.py'
+        p.write_text(
+            'import jax\n\n\n'
+            'class Builder:\n\n'
+            '    def make(self):\n'
+            '        def _step(params, batch):\n'
+            '            return params, batch\n'
+            '        return jax.jit(_step, donate_argnums=(1,))\n')
+        run = core.LintRun([str(p)], checks=['sharding-consistency'])
+        run.run()
+        assert run.findings == []
+
+    def test_real_tree_rules_are_consistent(self, tree_run):
+        """The real parallel/ + ops/ + models/ sharding annotations
+        pass — the invariant the tensor-parallel serving PR will lean
+        on."""
+        run, _ = tree_run
+        assert [f for f in run.findings
+                if f.check == 'sharding-consistency'] == []
+
+
+# ---- silent-except ----------------------------------------------------------
+class TestSilentExcept:
+
+    def test_flags_bare_broad_and_tuple_broad(self):
+        run = lint_fixture('silent_except_violation.py', 'silent-except')
+        assert finding_lines(run) == [8, 15, 22]
+        by_line = {f.line: f.message for f in run.findings}
+        assert 'bare except' in by_line[8]
+        assert 'except Exception' in by_line[15]
+        assert '(ValueError, Exception)' in by_line[22]
+
+    def test_suppression_comment_works(self):
+        run = lint_fixture('silent_except_violation.py', 'silent-except')
+        assert sorted(f.line for f in run.suppressed) == [31]
+
+    def test_clean_counterpart_passes(self):
+        """Narrow handlers may pass; broad handlers that log/handle are
+        out of scope."""
+        run = lint_fixture('silent_except_clean.py', 'silent-except')
+        assert run.findings == []
+
+
+# ---- cross-module reachability (the ProjectIndex upgrade) -------------------
+class TestCrossModuleReachability:
+
+    def test_blocking_call_behind_an_import_is_caught(self):
+        """Acceptance fixture: hot-path root in hot_root.py, blocking
+        calls defined in blocky.py — the whole-program call graph
+        traverses the import and attributes the findings to the callee
+        file with the root named."""
+        run = core.LintRun([os.path.join(FIXTURES, 'xmod')],
+                           checks=['blocking-hot-path'])
+        run.run()
+        assert [(os.path.basename(f.path), f.line)
+                for f in sorted(run.findings, key=lambda f: f.line)] == \
+            [('blocky.py', 10), ('blocky.py', 15)]
+        for f in run.findings:
+            assert 'hot_root:Engine.step' in f.message
+            assert 'reached via blocky:' in f.message
+
+    def test_same_code_passes_under_old_samefile_semantics(self):
+        """Regression pin: pre-v2 semantics (cross_module=False) cannot
+        see through the import — the same fixture reports nothing.
+        Guards against silently reverting to per-file analysis."""
+        run = core.LintRun([os.path.join(FIXTURES, 'xmod')],
+                           checks=['blocking-hot-path'],
+                           cross_module=False)
+        run.run()
+        assert run.findings == []
+
+    def test_jit_of_imported_function_is_traced(self, tmp_path):
+        """``from helper import pull; jax.jit(pull)`` has no same-file
+        def to match — the ProjectIndex must resolve the wrapped
+        target so helper.py's host sync is flagged."""
+        (tmp_path / 'helper.py').write_text(
+            'def pull(x):\n    return x.item()\n')
+        (tmp_path / 'traced.py').write_text(
+            'import jax\nfrom helper import pull\n\n'
+            'step = jax.jit(pull)\n')
+        run = core.LintRun([str(tmp_path)], checks=['jax-host-sync'])
+        run.run()
+        assert len(run.findings) == 1
+        assert '.item()' in run.findings[0].message
+        assert run.findings[0].path.endswith('helper.py')
+
+    def test_module_frame_ignores_function_local_types(self, tmp_path):
+        """Resolving a module-level ``jax.jit(model.init)`` must not
+        borrow a function-local ``model = Ctor()`` from elsewhere in
+        the file: frames are scoped."""
+        (tmp_path / 'other.py').write_text(
+            'class Other:\n'
+            '    def init(self, key):\n'
+            '        return key.item()\n')
+        (tmp_path / 'm.py').write_text(
+            'import jax\n'
+            'from other import Other\n\n\n'
+            'def unrelated():\n'
+            '    model = Other()\n'
+            '    return model\n\n\n'
+            'model = load_model()  # dynamic, unresolvable\n'
+            'params = jax.jit(model.init)(jax.random.key(0))\n')
+        run = core.LintRun([str(tmp_path)], checks=['jax-host-sync'])
+        run.run()
+        assert run.findings == []  # Other.init is never actually jitted
+
+    def test_reexport_through_package_init_resolves(self, tmp_path):
+        """A call through a package __init__ re-export (``pkg.helper``
+        backed by ``from .mod import helper``) must land in the
+        defining module — relative imports inside __init__.py resolve
+        against the package itself, not its parent."""
+        pkg = tmp_path / 'pkg'
+        pkg.mkdir()
+        (pkg / '__init__.py').write_text('from .mod import helper\n')
+        (pkg / 'mod.py').write_text(
+            'import time\n\n\ndef helper():\n    time.sleep(1)\n')
+        (tmp_path / 'hot.py').write_text(
+            'import pkg\n\n\ndef step():  # skylint: hot-path\n'
+            '    pkg.helper()\n')
+        run = core.LintRun([str(tmp_path)], checks=['blocking-hot-path'])
+        run.run()
+        assert [os.path.basename(f.path) for f in run.findings] == \
+            ['mod.py'], [f.render() for f in run.findings]
+
+    def test_engine_step_closure_crosses_modules_in_real_tree(
+            self, tree_run):
+        """The motivating example: GenerationScheduler._tick's hot
+        scope must traverse into models/decode.py and
+        models/paged_kv.py, and the jit-traced closure must reach the
+        llama block math — otherwise the gate is same-file again."""
+        run, _ = tree_run
+        project = run.project
+        ctx = project.modules['skypilot_tpu.serve.generation_server']
+        tick = next(e for e in ctx.functions.entries
+                    if e.qualname == 'GenerationScheduler._tick')
+        reached = {pf.module for pf in project.reachable_from(
+            [project.project_function(ctx, tick)])}
+        assert 'skypilot_tpu.models.decode' in reached
+        assert 'skypilot_tpu.models.paged_kv' in reached
+
+
+# ---- seeded bugs: the tier-1 gate must catch these --------------------------
+class TestSeededBugs:
+
+    def test_seeded_lock_inversion_in_serve_class_fails(self, tmp_path):
+        """Reversing two lock acquisitions in GenerationScheduler must
+        produce a lock-order cycle finding (and hence fail the tier-1
+        tree gate if ever committed)."""
+        src_path = os.path.join(REPO_ROOT, 'skypilot_tpu', 'serve',
+                                'generation_server.py')
+        with open(src_path, encoding='utf-8') as f:
+            source = f.read()
+        anchor = '    def _tick(self) -> None:'
+        assert anchor in source
+        seeded_methods = (
+            '    def _seed_fill(self):\n'
+            '        with self._backlog_lock:\n'
+            '            with self._emit_lock:\n'
+            '                return len(self._emit_q)\n'
+            '\n'
+            '    def _seed_drain(self):\n'
+            '        with self._emit_lock:\n'
+            '            with self._backlog_lock:\n'
+            '                return self._backlog_tokens\n'
+            '\n')
+        seeded = source.replace(anchor, seeded_methods + anchor, 1)
+        p = tmp_path / 'generation_server_seeded.py'
+        p.write_text(seeded)
+        run = core.LintRun([str(p)], checks=['lock-order'])
+        run.run()
+        assert any('lock-order cycle' in f.message
+                   and '_backlog_lock' in f.message
+                   and '_emit_lock' in f.message
+                   for f in run.findings), \
+            [f.message for f in run.findings]
+        # The unseeded file is clean (so the gate only trips on the
+        # inversion, not on today's code).
+        clean = core.LintRun([src_path], checks=['lock-order'])
+        clean.run()
+        assert clean.findings == []
+
+    def test_seeded_unknown_logical_axis_in_sharding_user_fails(
+            self, tmp_path):
+        """An axis-name typo in a parallel/sharding.py user must be
+        flagged against the declared rule tables."""
+        import shutil
+        for name in ('parallel/sharding.py', 'parallel/mesh.py',
+                     'ops/embedding.py'):
+            shutil.copy(
+                os.path.join(REPO_ROOT, 'skypilot_tpu', name),
+                tmp_path / os.path.basename(name))
+        emb = tmp_path / 'embedding.py'
+        text = emb.read_text()
+        assert "rules.spec('vocab', 'embed')" in text
+        emb.write_text(text.replace("rules.spec('vocab', 'embed')",
+                                    "rules.spec('vocabz', 'embed')", 1))
+        run = core.LintRun([str(tmp_path)],
+                           checks=['sharding-consistency'])
+        run.run()
+        assert len(run.findings) == 1
+        assert "unknown logical axis 'vocabz'" in run.findings[0].message
+
+    def test_seeded_blocking_call_in_cross_module_callee_fails(
+            self, tmp_path):
+        """Planting a sleep in a function the engine step reaches only
+        through an import must trip blocking-hot-path — the check the
+        old same-file semantics could never make."""
+        import shutil
+        xmod = os.path.join(FIXTURES, 'xmod')
+        for fn in os.listdir(xmod):
+            shutil.copy(os.path.join(xmod, fn), tmp_path / fn)
+        (tmp_path / 'blocky.py').write_text(
+            'def refresh_metadata(url):\n'
+            '    return None\n'
+            '\n'
+            '\n'
+            'def backoff():\n'
+            '    return None\n')
+        run = core.LintRun([str(tmp_path)], checks=['blocking-hot-path'])
+        run.run()
+        assert run.findings == []  # sanitized callee: clean baseline
+        (tmp_path / 'blocky.py').write_text(
+            'import time\n'
+            '\n'
+            '\n'
+            'def refresh_metadata(url):\n'
+            '    return None\n'
+            '\n'
+            '\n'
+            'def backoff():\n'
+            '    time.sleep(0.5)\n')
+        run = core.LintRun([str(tmp_path)], checks=['blocking-hot-path'])
+        run.run()
+        assert [f.line for f in run.findings] == [9]
+
+
+# ---- --changed mode, perf budget, baseline ----------------------------------
+class TestChangedModeAndPerf:
+
+    def test_reverse_closure_includes_importers(self, tree_run):
+        """--changed's re-lint set: editing utils/metrics.py must pull
+        in the serve plane that imports it (transitively)."""
+        run, _ = tree_run
+        closure = run.project.reverse_closure(
+            ['skypilot_tpu/utils/metrics.py'])
+        assert 'skypilot_tpu/utils/metrics.py' in closure
+        assert 'skypilot_tpu/serve/generation_server.py' in closure
+        assert 'skypilot_tpu/serve/replica_manager.py' in closure
+        # Transitive: controller.py imports replica_manager.
+        assert 'skypilot_tpu/serve/controller.py' in closure
+        # Not everything: provisioning backends don't import metrics.
+        assert 'skypilot_tpu/provision/vast_api.py' not in closure
+
+    def test_changed_cli_runs(self):
+        proc = subprocess.run([sys.executable, SKYLINT, '--changed'],
+                              capture_output=True, text=True)
+        assert proc.returncode in (0, 1), proc.stderr
+        assert 'skylint:' in (proc.stdout + proc.stderr)
+
+    def test_changed_rejects_no_cross_module(self):
+        """--changed needs the index for its closure; silently linting
+        the whole tree instead would be a scope lie."""
+        proc = subprocess.run(
+            [sys.executable, SKYLINT, '--changed', '--no-cross-module'],
+            capture_output=True, text=True)
+        assert proc.returncode == 2
+        assert 'cross-module' in proc.stderr
+
+    def test_report_paths_filter_findings(self):
+        run = core.LintRun(
+            [os.path.join(FIXTURES, 'silent_except_violation.py'),
+             os.path.join(FIXTURES, 'silent_except_clean.py')],
+            checks=['silent-except'],
+            report_paths=['tests/fixtures/lint/silent_except_clean.py'])
+        run.run()
+        assert run.findings == []  # violations filtered out by path
+
+    def test_full_tree_run_stays_under_budget(self, tree_run):
+        """The tier-1 gate must stay cheap as the tree grows: one
+        shared parse + index for all checkers. Budget is ~10x current
+        cost — trip it and the fix is performance work, not a bump."""
+        run, elapsed = tree_run
+        assert len(run.contexts) > 150  # really the whole tree
+        assert elapsed < 60.0, f'full-tree skylint took {elapsed:.1f}s'
+
+
+class TestBaseline:
+
+    def test_checked_in_baseline_is_empty_and_tree_matches(self):
+        """Snapshot: the committed baseline stays the preferred empty
+        state, and the tree holds zero findings against it. A deferred
+        fix may add {path, check} entries — reviewed, frozen, and
+        removed when fixed."""
+        with open(os.path.join(REPO_ROOT, 'skylint-baseline.json'),
+                  encoding='utf-8') as f:
+            baseline = json.load(f)
+        assert baseline == {'findings': []}
+        proc = subprocess.run(
+            [sys.executable, SKYLINT, '--baseline',
+             os.path.join(REPO_ROOT, 'skylint-baseline.json')],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr or proc.stdout
+
+    def test_baseline_waives_matching_findings(self, tmp_path):
+        bl = tmp_path / 'bl.json'
+        bl.write_text(json.dumps({'findings': [
+            {'path': 'tests/fixtures/lint/silent_except_violation.py',
+             'check': 'silent-except'}]}))
+        fixture = os.path.join(FIXTURES, 'silent_except_violation.py')
+        proc = subprocess.run(
+            [sys.executable, SKYLINT, '--check', 'silent-except',
+             '--baseline', str(bl), '--json', fixture],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr or proc.stdout
+        payload = json.loads(proc.stdout)
+        assert payload['findings'] == []
+        assert len(payload['baseline_waived']) == 3
+
+    def test_json_out_writes_report_artifact(self, tmp_path):
+        out = tmp_path / 'report.json'
+        fixture = os.path.join(FIXTURES, 'silent_except_violation.py')
+        proc = subprocess.run(
+            [sys.executable, SKYLINT, '--check', 'silent-except',
+             '--json-out', str(out), fixture],
+            capture_output=True, text=True)
+        assert proc.returncode == 1
+        payload = json.loads(out.read_text())
+        assert len(payload['findings']) == 3
+        assert payload['cross_module'] is True
+
+    def test_write_baseline_roundtrip(self, tmp_path):
+        bl = tmp_path / 'bl.json'
+        fixture = os.path.join(FIXTURES, 'silent_except_violation.py')
+        proc = subprocess.run(
+            [sys.executable, SKYLINT, '--check', 'silent-except',
+             '--write-baseline', str(bl), fixture],
+            capture_output=True, text=True)
+        assert proc.returncode == 0
+        entries = json.loads(bl.read_text())['findings']
+        assert entries == [
+            {'path': 'tests/fixtures/lint/silent_except_violation.py',
+             'check': 'silent-except'}]
 
 
 # ---- regression tests for the applied lock-discipline fixes -----------------

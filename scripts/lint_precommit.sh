@@ -1,0 +1,15 @@
+#!/usr/bin/env sh
+# Pre-commit skylint: lint the git-changed files plus their
+# reverse-dependency closure, against the committed baseline, and leave
+# a machine-readable report behind for CI archiving.
+#
+#   scripts/lint_precommit.sh                 # report to /tmp
+#   SKYLINT_REPORT=out.json scripts/lint_precommit.sh
+#   scripts/lint_precommit.sh --check shapecheck   # extra args pass through
+#
+# Exit codes follow scripts/skylint.py: 0 clean, 1 findings, 2 usage.
+set -e
+cd "$(dirname "$0")/.."
+exec python scripts/skylint.py --changed \
+    --baseline skylint-baseline.json \
+    --json-out "${SKYLINT_REPORT:-/tmp/skylint_precommit.json}" "$@"

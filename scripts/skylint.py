@@ -20,7 +20,11 @@ the whole tree (cross-module closures need it) but reports only
 findings in files named by ``git diff`` plus their reverse-dependency
 closure. ``--baseline`` waives findings matching a frozen
 ``{path, check}`` list (``--write-baseline`` regenerates it); an empty
-baseline — the preferred state — waives nothing. Aggregate contracts
+baseline — the preferred state — waives nothing. Baseline entries whose
+{path, check} no longer match any finding are reported as STALE on
+stderr (and under ``baseline_stale`` in the JSON report) — the fix
+landed, so the waiver only masks future regressions; regenerating with
+``--write-baseline`` prunes them. Aggregate contracts
 (dead env-var entries, docs table, metric-family coverage) only run
 over the full default tree; explicit roots get per-file checks only.
 See docs/static_analysis.md.
@@ -33,7 +37,7 @@ import json
 import os
 import subprocess
 import sys
-from typing import List, Set
+from typing import List, Set, Tuple
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO_ROOT)
@@ -56,18 +60,46 @@ def _git_changed_files() -> List[str]:
     return sorted(out)
 
 
-def _apply_baseline(run: 'core.LintRun', baseline_path: str) -> List[dict]:
+def _apply_baseline(run: 'core.LintRun', baseline_path: str
+                    ) -> Tuple[List[dict], List[dict]]:
     """Waive findings matching baseline {path, check} entries (each
     entry waives any number of findings at that path+check — a frozen
-    known-findings list for fixes that must be deferred). Returns the
-    waived findings as dicts."""
+    known-findings list for fixes that must be deferred). Returns
+    (waived findings, stale entries): an entry whose path the run
+    examined with that check armed — on a FULL-TREE run, since narrowed
+    roots skip the aggregate contracts — but that matches no finding is
+    stale; so is any entry whose path no longer exists on disk — the deferred fix
+    landed (or the file moved) and the waiver now only masks future
+    regressions at that path+check. Entries outside the reported scope
+    (a ``--changed`` closure, an explicit narrower root) are never
+    judged: staleness can only be decided by a run that actually
+    looked. Stale entries are reported on stderr and pruned by a
+    standalone ``--write-baseline`` run."""
     with open(baseline_path, encoding='utf-8') as f:
         entries = json.load(f).get('findings', [])
     keys = {(e['path'], e['check']) for e in entries}
     waived = [f for f in run.findings if (f.path, f.check) in keys]
+    live = {(f.path, f.check) for f in waived}
+    examined = {c.relpath for c in run.contexts}
+    if run.report_paths is not None:
+        examined &= run.report_paths
+    ran = {c.name for c in run.checkers}
+    if not run.full_tree:
+        # Narrowed roots skip the aggregate contracts (dead env
+        # entries, metric-family coverage), so "no finding" proves
+        # nothing there — only a full-tree run may judge staleness.
+        examined = set()
+    # A path that no longer exists on disk is stale regardless of
+    # scope: the file was deleted or renamed, and the waiver would
+    # silently re-arm if the old path ever reappeared.
+    missing = {p for p, _ in keys
+               if not os.path.exists(os.path.join(_REPO_ROOT, p))}
+    stale = [{'path': p, 'check': c}
+             for p, c in sorted(keys - live)
+             if (p in examined and c in ran) or p in missing]
     run.findings = [f for f in run.findings
                     if (f.path, f.check) not in keys]
-    return [dataclasses.asdict(f) for f in waived]
+    return [dataclasses.asdict(f) for f in waived], stale
 
 
 def main(argv=None) -> int:
@@ -100,6 +132,15 @@ def main(argv=None) -> int:
         for cls in core.all_checkers():
             print(f'{cls.name}: {cls.description}')
         return 0
+
+    if args.write_baseline and (args.baseline or args.changed):
+        # Composing would regenerate from an already-waived /
+        # closure-filtered finding set and silently drop every live
+        # waiver outside it — the opposite of "prune stale entries".
+        print('skylint: --write-baseline regenerates from a full '
+              'un-waived run; drop --baseline/--changed',
+              file=sys.stderr)
+        return 2
 
     report_paths = None
     if args.changed:
@@ -137,10 +178,11 @@ def main(argv=None) -> int:
         run.report_paths = closure
         run.findings = [f for f in run.findings if f.path in closure]
 
-    waived = []
+    waived: List[dict] = []
+    stale: List[dict] = []
     if args.baseline:
         try:
-            waived = _apply_baseline(run, args.baseline)
+            waived, stale = _apply_baseline(run, args.baseline)
         except (OSError, ValueError, KeyError, TypeError,
                 AttributeError) as e:
             # Shape errors too (a top-level list, a string entry):
@@ -149,11 +191,20 @@ def main(argv=None) -> int:
             print(f'skylint: bad baseline {args.baseline}: '
                   f'{type(e).__name__}: {e}', file=sys.stderr)
             return 2
+        for entry in stale:
+            print(f'skylint: stale baseline entry {entry["path"]} '
+                  f'({entry["check"]}): no matching finding — the '
+                  f'waiver now only masks future regressions; a '
+                  f'standalone --write-baseline run prunes it',
+                  file=sys.stderr)
 
     report = run.to_json()
-    if waived:
+    if args.baseline:
+        # Always present under --baseline (even when empty): the report
+        # schema is a contract CI consumers key on.
         payload = json.loads(report)
         payload['baseline_waived'] = waived
+        payload['baseline_stale'] = stale
         report = json.dumps(payload, indent=2)
     if args.json_out:
         with open(args.json_out, 'w', encoding='utf-8') as f:

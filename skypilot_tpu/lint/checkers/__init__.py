@@ -5,5 +5,6 @@ from skypilot_tpu.lint.checkers import jax_hazards  # noqa: F401
 from skypilot_tpu.lint.checkers import lock_discipline  # noqa: F401
 from skypilot_tpu.lint.checkers import lock_order  # noqa: F401
 from skypilot_tpu.lint.checkers import metric_names  # noqa: F401
+from skypilot_tpu.lint.checkers import shapecheck  # noqa: F401
 from skypilot_tpu.lint.checkers import sharding_consistency  # noqa: F401
 from skypilot_tpu.lint.checkers import silent_except  # noqa: F401

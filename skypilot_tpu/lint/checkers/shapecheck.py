@@ -1,0 +1,3367 @@
+"""Symbolic shape & dtype abstract interpreter over jit-traced code.
+
+``shapecheck`` is the array-value half of skylint's whole-program
+analysis: where ``sharding-consistency`` validates axis *names*, this
+checker validates the *arrays* — shapes, dtypes, divisibility — by
+abstractly interpreting the jit-traced regions that ``jax-host-sync``'s
+root discovery already identifies (pytype-style abstract interpretation
+over the ``ProjectIndex`` call graph).
+
+Symbolic dimensions are seeded from three places, all statically:
+
+- ``*Config`` dataclass field defaults (and every ``PRESETS`` entry),
+  bound to parameters via their type annotations — ``def __init__(self,
+  config: LlamaConfig, ...)`` seeds ``self.config.embed_dim`` etc.;
+- the ``env_vars.py`` registry defaults (``SKYTPU_KV_BLOCK`` and
+  friends) — calls into ``env_vars.get_int`` evaluate to the registered
+  default, exactly the engine's canonical operating point;
+- host-level ``__init__`` interpretation of the classes that own jit
+  roots (``DecodeEngine.__init__`` computing ``max_blocks``/``m_pad``),
+  plus ``init``/``init_state``/``init_cache`` interpretation to build
+  the param/state shape tables that seed root arguments named
+  ``params``/``state``/``cache``.
+
+Checks emitted (all under the single check name ``shapecheck``):
+
+1. rank/dim mismatches — einsum spec unification (letters bound to two
+   provably different dims, operand rank vs subscript), elementwise
+   broadcast conflicts, matmul contraction dims, reshape element
+   counts, concatenate non-axis dims, scan carry shape drift;
+2. bf16 hygiene — arithmetic/einsum/matmul mixing a *strong* bf16/f16
+   operand with a *strong* f32/f64 operand silently promotes the wide
+   side's memory footprint; intentional f32 compute is written with an
+   explicit ``astype`` or ``preferred_element_type`` and never flags;
+3. mesh divisibility — a dim mapped by the declared ``LogicalRules``
+   onto a mesh axis with a declared ``MESH_AXIS_DIVISORS`` factor
+   (``parallel/mesh.py``) must be statically divisible by it; checked
+   for every model preset's param table against ``logical_axes()`` and
+   at ``_constrain``/``shard_constraint`` call sites;
+4. donation aliasing — a ``donate_argnums`` donor whose leaves are all
+   known must find a shape-and-dtype-matching output leaf, else the
+   donation can never alias and silently costs a copy;
+5. paged-KV pool consistency — a ``BlockAllocator(...)`` must keep
+   ``reserved >= 1`` (the null-block-0 convention) and agree with the
+   engine's ``init_state`` pool on block count and block size.
+
+Everything the interpreter cannot prove degrades to TOP (see
+``lint/shapes.py``): no false positives by construction. Root arguments
+the conventions above cannot seed may be annotated in a comment
+directly above the ``def``::
+
+    # shapecheck: tokens = i32[16, 128]
+
+Unknown ops need no annotation — they simply return TOP.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from skypilot_tpu.lint import shapes as sh
+from skypilot_tpu.lint.core import (Checker, FileContext, Finding,
+                                    FunctionEntry, ProjectFunction,
+                                    register)
+from skypilot_tpu.lint.checkers.jax_hazards import (_is_jit_decorated,
+                                                    _jit_wrapped)
+
+TOP = sh.TOP
+AVal = sh.AVal
+Sym = sh.Sym
+
+_ANNOT_RE = re.compile(
+    r'#\s*shapecheck:\s*(\w+)\s*=\s*([A-Za-z0-9_]+)\[([^\]]*)\]')
+_ANNOT_DTYPES = {'f32': 'float32', 'f64': 'float64', 'f16': 'float16',
+                 'bf16': 'bfloat16', 'i8': 'int8', 'i32': 'int32',
+                 'i64': 'int64', 'u8': 'uint8', 'bool': 'bool'}
+
+_MAX_DEPTH = 24
+_STEP_BUDGET = 400_000
+
+
+class _Bail(Exception):
+    """Interpretation budget exhausted — degrade silently."""
+
+
+# ---------------------------------------------------------------------------
+# Host-level abstract values (beyond shapes.AVal / shapes.Sym).
+# ---------------------------------------------------------------------------
+class AConst:
+    """Known non-int Python constant (str / float / bool / None)."""
+
+    __slots__ = ('value',)
+
+    def __init__(self, value):
+        self.value = value
+
+    def __repr__(self):
+        return f'AConst({self.value!r})'
+
+
+class DtypeConst:
+    __slots__ = ('name',)
+
+    def __init__(self, name: str):
+        self.name = name
+
+
+class ATuple:
+    __slots__ = ('items', 'node')
+
+    def __init__(self, items, node=None):
+        self.items = list(items)
+        self.node = node
+
+
+class ADict:
+    """Dict or dataclass-instance record. ``complete`` False once a key
+    the analysis could not track was involved."""
+
+    __slots__ = ('entries', 'complete')
+
+    def __init__(self, entries=None, complete=True):
+        self.entries = dict(entries or {})
+        self.complete = complete
+
+
+class FuncRef:
+    """A function with its defining lexical frame (closures)."""
+
+    __slots__ = ('pf', 'frame')
+
+    def __init__(self, pf: ProjectFunction, frame):
+        self.pf = pf
+        self.frame = frame
+
+
+class LambdaRef:
+    __slots__ = ('node', 'ctx', 'frame')
+
+    def __init__(self, node, ctx, frame):
+        self.node = node
+        self.ctx = ctx
+        self.frame = frame
+
+
+class BoundMethod:
+    __slots__ = ('fn', 'inst')
+
+    def __init__(self, fn, inst):
+        self.fn = fn          # FuncRef
+        self.inst = inst
+
+
+class PartialRef:
+    __slots__ = ('target', 'args', 'kwargs')
+
+    def __init__(self, target, args, kwargs):
+        self.target = target
+        self.args = list(args)
+        self.kwargs = dict(kwargs)
+
+
+class ShardMapRef:
+    __slots__ = ('inner',)
+
+    def __init__(self, inner):
+        self.inner = inner
+
+
+class VagRef:
+    __slots__ = ('inner', 'value_and')
+
+    def __init__(self, inner, value_and=True):
+        self.inner = inner
+        self.value_and = value_and
+
+
+class InstanceRef:
+    __slots__ = ('cls_key', 'attrs')
+
+    def __init__(self, cls_key, attrs=None):
+        self.cls_key = cls_key
+        self.attrs = dict(attrs or {})
+
+
+class ConfigRef:
+    """Abstract *Config dataclass instance: field name -> value."""
+
+    __slots__ = ('name', 'fields')
+
+    def __init__(self, name: str, fields: Dict[str, Any]):
+        self.name = name
+        self.fields = fields
+
+
+class ClassRef:
+    __slots__ = ('cls_key',)
+
+    def __init__(self, cls_key):
+        self.cls_key = cls_key
+
+
+class ModuleRef:
+    __slots__ = ('dotted',)
+
+    def __init__(self, dotted: str):
+        self.dotted = dotted
+
+
+class OpRef:
+    __slots__ = ('name',)
+
+    def __init__(self, name: str):
+        self.name = name
+
+
+class AtProxy:
+    __slots__ = ('base',)
+
+    def __init__(self, base: AVal):
+        self.base = base
+
+
+class AtIndexed:
+    __slots__ = ('base',)
+
+    def __init__(self, base: AVal):
+        self.base = base
+
+
+class RangeVal:
+    __slots__ = ('length',)
+
+    def __init__(self, length):
+        self.length = length  # Sym
+
+
+class UnknownShape:
+    """``x.shape`` of an unknown-rank array: length unknown, but every
+    element is known to be a Python int (an unknown Sym) — so
+    ``x.shape[-1] ** -0.5`` stays a weak scalar instead of TOP."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+
+UNKNOWN_SHAPE = UnknownShape()
+
+
+_JNP_DTYPES = {'float32', 'float64', 'float16', 'bfloat16', 'int8',
+               'int16', 'int32', 'int64', 'uint8', 'uint32', 'bool_'}
+
+
+
+def _to_aval(v) -> AVal:
+    """Coerce an interpreter value to an abstract array operand."""
+    if isinstance(v, AVal):
+        return v
+    if isinstance(v, Sym):
+        return sh.scalar('int32', weak=True)
+    if isinstance(v, AConst):
+        if isinstance(v.value, bool):
+            return sh.scalar('bool', weak=True)
+        if isinstance(v.value, float):
+            return sh.scalar('float32', weak=True)
+        if isinstance(v.value, int):
+            return sh.scalar('int32', weak=True)
+    return AVal(None, None)
+
+
+def _truth(v) -> Optional[bool]:
+    """Three-valued truthiness."""
+    if isinstance(v, Sym):
+        return bool(v.value) if v.known else None
+    if isinstance(v, AConst):
+        try:
+            return bool(v.value)
+        except Exception:  # noqa: BLE001 — any odd constant: unknown
+            return None
+    if isinstance(v, ATuple):
+        return bool(v.items)
+    if isinstance(v, ADict):
+        return bool(v.entries) if v.complete else None
+    if isinstance(v, (InstanceRef, ConfigRef, ClassRef, FuncRef,
+                      BoundMethod, LambdaRef, PartialRef, DtypeConst)):
+        return True
+    return None
+
+
+def _join(a, b):
+    """Structural lattice join over interpreter values."""
+    if a is b:
+        return a
+    if isinstance(a, ATuple) and isinstance(b, ATuple) \
+            and len(a.items) == len(b.items):
+        return ATuple([_join(x, y) for x, y in zip(a.items, b.items)])
+    if isinstance(a, ADict) and isinstance(b, ADict) \
+            and set(a.entries) == set(b.entries):
+        return ADict({k: _join(a.entries[k], b.entries[k])
+                      for k in a.entries},
+                     complete=a.complete and b.complete)
+    if isinstance(a, Sym) and isinstance(b, Sym):
+        return sh.dims_join(a, b)
+    if isinstance(a, AConst) and isinstance(b, AConst) \
+            and a.value == b.value:
+        return a
+    if isinstance(a, AVal) or isinstance(b, AVal):
+        return sh.join_values(_to_aval(a), _to_aval(b))
+    return TOP
+
+
+def _copy_value(v, memo=None):
+    """Deep-copy mutable containers so memoized results stay pristine."""
+    if memo is None:
+        memo = {}
+    if id(v) in memo:
+        return memo[id(v)]
+    if isinstance(v, ADict):
+        out = ADict({}, complete=v.complete)
+        memo[id(v)] = out
+        out.entries = {k: _copy_value(x, memo)
+                       for k, x in v.entries.items()}
+        return out
+    if isinstance(v, ATuple):
+        out = ATuple([], node=v.node)
+        memo[id(v)] = out
+        out.items = [_copy_value(x, memo) for x in v.items]
+        return out
+    return v
+
+
+def _degrade_dims(v):
+    """Keep rank and dtype, forget dims (shard_map local views)."""
+    if isinstance(v, AVal):
+        if v.shape is None:
+            return v
+        return AVal(tuple(sh.UNKNOWN_DIM for _ in v.shape), v.dtype,
+                    v.weak)
+    if isinstance(v, ATuple):
+        return ATuple([_degrade_dims(x) for x in v.items])
+    if isinstance(v, ADict):
+        return ADict({k: _degrade_dims(x)
+                      for k, x in v.entries.items()}, v.complete)
+    return v
+
+
+class Frame:
+    """One lexical scope. Name lookups fall back to the parent chain,
+    then to the owning module scope."""
+
+    __slots__ = ('vars', 'parent', 'ctx', 'returns', 'terminated',
+                 '_pf', '_self', '_cls')
+
+    def __init__(self, ctx: FileContext, parent: Optional['Frame']):
+        self.vars: Dict[str, Any] = {}
+        self.parent = parent
+        self.ctx = ctx
+        self.returns: List[Any] = []
+        self.terminated = False
+        self._pf: Optional[str] = None
+        self._self = None
+        self._cls = None
+
+    def lookup(self, name: str):
+        f = self
+        while f is not None:
+            if name in f.vars:
+                return f.vars[name]
+            f = f.parent
+        return None  # caller falls through to module scope / builtins
+
+    def has(self, name: str) -> bool:
+        f = self
+        while f is not None:
+            if name in f.vars:
+                return True
+            f = f.parent
+        return False
+
+    def fork(self) -> 'Frame':
+        child = Frame(self.ctx, self.parent)
+        child.vars = dict(self.vars)
+        child.returns = self.returns       # shared: returns join later
+        child._pf = self._pf
+        child._self = self._self
+        child._cls = self._cls
+        return child
+
+    def merge(self, branches: Sequence['Frame']) -> None:
+        live = [b for b in branches if not b.terminated]
+        if not live:
+            self.terminated = True
+            return
+        names = set()
+        for b in live:
+            names.update(b.vars)
+        out = {}
+        for n in names:
+            vals = [b.vars.get(n, _MISSING) for b in live]
+            if any(v is _MISSING for v in vals):
+                if n in self.vars:
+                    vals = [self.vars[n] if v is _MISSING else v
+                            for v in vals]
+                else:
+                    out[n] = TOP
+                    continue
+            v0 = vals[0]
+            for v in vals[1:]:
+                v0 = _join(v0, v)
+            out[n] = v0
+        self.vars = out
+
+
+_MISSING = object()
+
+
+# ---------------------------------------------------------------------------
+# The abstract interpreter.
+# ---------------------------------------------------------------------------
+class Interp:
+    """Total abstract interpreter: never raises (beyond the budget
+    bail), degrades to TOP on anything unmodeled."""
+
+    def __init__(self, checker: 'ShapeChecker', project, contexts):
+        self.checker = checker
+        self.project = project
+        self.contexts = contexts
+        self.steps = 0
+        self.depth = 0
+        self.emit_on = False
+        self.memo: Dict[Tuple, Any] = {}
+        self.in_progress: Set[Tuple] = set()
+        self.module_scopes: Dict[str, Frame] = {}
+        self.module_pending: Set[Tuple[str, str]] = set()
+        self.instances: Dict[Tuple, InstanceRef] = {}
+        self.tables: Dict[Tuple, Any] = {}
+        self.alloc_calls: List[Tuple] = []  # (cls_key, ctx, node, args)
+        self.current_cls: Optional[Tuple[str, str]] = None
+        self._pinned: List[Any] = []
+
+    # -- findings -----------------------------------------------------------
+    def report(self, problems: List[sh.Problem], node, frame: Frame,
+               where: str) -> None:
+        if not self.emit_on:
+            del problems[:]
+            return
+        for p in problems:
+            msg = p.message
+            if p.kind == 'dtype':
+                msg += (' — accumulate with preferred_element_type='
+                        'jnp.float32 (operands stay half precision) or '
+                        'make the promotion explicit with astype')
+            self.checker.add_finding(frame.ctx, p.node or node,
+                                     f'{msg} [{where}]')
+        del problems[:]
+
+    # -- module scope -------------------------------------------------------
+    def module_scope(self, ctx: FileContext) -> Frame:
+        scope = self.module_scopes.get(ctx.module)
+        if scope is None:
+            scope = Frame(ctx, None)
+            self.module_scopes[ctx.module] = scope
+            for e in ctx.functions.entries:
+                if e.class_name is None and '.' not in e.qualname:
+                    pf = self._pf(ctx, e)
+                    if pf is not None:
+                        scope.vars[e.name] = FuncRef(pf, scope)
+            for node in ctx.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    scope.vars[node.name] = ClassRef(
+                        (ctx.module, node.name))
+        return scope
+
+    def _pf(self, ctx, entry) -> Optional[ProjectFunction]:
+        try:
+            return self.project.project_function(ctx, entry)
+        except KeyError:
+            return None
+
+    def module_name(self, ctx: FileContext, name: str):
+        """Module-scope resolution: defs/classes (eager), module-level
+        constants (lazy), imports, op table, builtins."""
+        scope = self.module_scope(ctx)
+        if name in scope.vars:
+            return scope.vars[name]
+        key = (ctx.module, name)
+        if key not in self.module_pending:
+            node = self._module_assign(ctx, name)
+            if node is not None:
+                self.module_pending.add(key)
+                try:
+                    val = self.eval(node, scope)
+                except _Bail:
+                    val = TOP
+                finally:
+                    self.module_pending.discard(key)
+                scope.vars[name] = val
+                return val
+        target = self.project.imports.get(ctx.module, {}).get(name)
+        if target is not None:
+            val = self.resolve_dotted(target)
+            scope.vars[name] = val
+            return val
+        return self._builtin(name)
+
+    def _module_assign(self, ctx, name) -> Optional[ast.expr]:
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == name:
+                        return node.value
+            elif isinstance(node, ast.AnnAssign) and node.value \
+                    and isinstance(node.target, ast.Name) \
+                    and node.target.id == name:
+                return node.value
+        return None
+
+    def resolve_dotted(self, dotted: str):
+        """A dotted import target -> abstract value."""
+        if dotted in self.project.modules:
+            return ModuleRef(dotted)
+        head, _, sym = dotted.rpartition('.')
+        if head and head in self.project.modules:
+            hctx = self.project.modules[head]
+            if (head, sym) in self.project.classes:
+                return ClassRef((head, sym))
+            entry = hctx.functions.lookup(sym, None)
+            if entry is not None:
+                pf = self._pf(hctx, entry)
+                if pf is not None:
+                    return FuncRef(pf, self.module_scope(hctx))
+            chained = self.project._resolve_binding(head, sym)
+            if chained and chained != dotted:
+                return self.resolve_dotted(chained)
+            return self.module_name(hctx, sym)
+        return self._op_or_dtype(dotted)
+
+    def _op_or_dtype(self, dotted: str):
+        if dotted in _OPS:
+            return OpRef(dotted)
+        if any(k.startswith(dotted + '.') for k in _OPS):
+            return ModuleRef(dotted)
+        if dotted in ('jax', 'jax.numpy', 'numpy', 'jax.lax',
+                      'jax.nn', 'jax.random', 'jax.tree',
+                      'jax.tree_util', 'jax.ad_checkpoint',
+                      'functools', 'jax.experimental',
+                      'jax.experimental.shard_map'):
+            return ModuleRef(dotted)
+        tail = dotted.rpartition('.')[2]
+        if dotted.startswith(('jax.numpy.', 'numpy.')) \
+                and tail in _JNP_DTYPES:
+            return DtypeConst(sh.canon_dtype(tail) or tail)
+        if dotted in ('jax.numpy.inf', 'numpy.inf'):
+            return AConst(float('inf'))
+        if dotted in _OP_ALIASES:
+            return OpRef(_OP_ALIASES[dotted])
+        return TOP
+
+    @staticmethod
+    def _builtin(name: str):
+        if name in ('int',):
+            return DtypeConst('int32')
+        if name in ('float',):
+            return DtypeConst('float32')
+        if name == 'bool':
+            return DtypeConst('bool')
+        if name in ('min', 'max', 'len', 'range', 'dict', 'tuple',
+                    'list', 'abs', 'sum', 'sorted', 'enumerate', 'zip',
+                    'isinstance', 'getattr', 'hasattr', 'print'):
+            return OpRef(f'builtins.{name}')
+        return TOP
+
+    # -- expression dispatch ------------------------------------------------
+    def eval(self, node: ast.AST, frame: Frame):
+        self.steps += 1
+        if self.steps > _STEP_BUDGET:
+            raise _Bail()
+        m = getattr(self, '_e_' + type(node).__name__, None)
+        if m is None:
+            return TOP
+        return m(node, frame)
+
+    def _e_Constant(self, node, frame):
+        v = node.value
+        if isinstance(v, bool):
+            return AConst(v)
+        if isinstance(v, int):
+            return Sym(v)
+        return AConst(v)
+
+    def _e_Name(self, node, frame):
+        if frame.has(node.id):
+            return frame.lookup(node.id)
+        return self.module_name(frame.ctx, node.id)
+
+    def _e_Tuple(self, node, frame):
+        return ATuple([self.eval(e, frame) for e in node.elts], node)
+
+    _e_List = _e_Tuple
+
+    def _e_Dict(self, node, frame):
+        out = ADict()
+        for k, v in zip(node.keys, node.values):
+            if k is None:
+                out.complete = False
+                continue
+            kv = self.eval(k, frame)
+            val = self.eval(v, frame)
+            if isinstance(kv, AConst) and isinstance(kv.value, str):
+                out.entries[kv.value] = val
+            elif isinstance(kv, Sym) and kv.known:
+                out.entries[kv.value] = val
+            else:
+                out.complete = False
+        return out
+
+    def _e_Starred(self, node, frame):
+        return self.eval(node.value, frame)
+
+    def _e_Lambda(self, node, frame):
+        return LambdaRef(node, frame.ctx, frame)
+
+    def _e_IfExp(self, node, frame):
+        t = _truth(self.eval(node.test, frame))
+        if t is True:
+            return self.eval(node.body, frame)
+        if t is False:
+            return self.eval(node.orelse, frame)
+        return _join(self.eval(node.body, frame),
+                     self.eval(node.orelse, frame))
+
+    def _e_BoolOp(self, node, frame):
+        is_and = isinstance(node.op, ast.And)
+        result = None
+        for v in node.values:
+            val = self.eval(v, frame)
+            t = _truth(val)
+            if t is None:
+                rest = [self.eval(x, frame) for x in
+                        node.values[node.values.index(v) + 1:]]
+                out = val
+                for r in rest:
+                    out = _join(out, r)
+                return out
+            if is_and and t is False:
+                return val
+            if not is_and and t is True:
+                return val
+            result = val
+        return result if result is not None else TOP
+
+    def _e_UnaryOp(self, node, frame):
+        v = self.eval(node.operand, frame)
+        if isinstance(node.op, ast.Not):
+            t = _truth(v)
+            return AConst(not t) if t is not None else TOP
+        if isinstance(node.op, ast.USub):
+            if isinstance(v, Sym):
+                return sh.sym_neg(v)
+            if isinstance(v, AConst) and isinstance(v.value,
+                                                    (int, float)):
+                return AConst(-v.value)
+            if isinstance(v, AVal):
+                return v
+        return TOP if not isinstance(v, AVal) else v
+
+    def _e_Compare(self, node, frame):
+        left = self.eval(node.left, frame)
+        rights = [self.eval(c, frame) for c in node.comparators]
+        if len(rights) != 1:
+            return TOP
+        right = rights[0]
+        op = node.ops[0]
+        if isinstance(op, (ast.Is, ast.IsNot)):
+            ln = isinstance(left, AConst) and left.value is None
+            rn = isinstance(right, AConst) and right.value is None
+            if ln or rn:
+                both = ln and rn
+                if isinstance(op, ast.Is):
+                    if both:
+                        return AConst(True)
+                    if (ln and not self._maybe_none(right)) \
+                            or (rn and not self._maybe_none(left)):
+                        return AConst(False)
+                else:
+                    if both:
+                        return AConst(False)
+                    if (ln and not self._maybe_none(right)) \
+                            or (rn and not self._maybe_none(left)):
+                        return AConst(True)
+            return TOP
+        lnum = self._num(left)
+        rnum = self._num(right)
+        if lnum is not None and rnum is not None:
+            try:
+                res = {ast.Eq: lnum == rnum, ast.NotEq: lnum != rnum,
+                       ast.Lt: lnum < rnum, ast.LtE: lnum <= rnum,
+                       ast.Gt: lnum > rnum,
+                       ast.GtE: lnum >= rnum}.get(type(op))
+            except TypeError:
+                res = None
+            if res is not None:
+                return AConst(res)
+        ls = left.value if isinstance(left, AConst) else None
+        rs = right.value if isinstance(right, AConst) else None
+        if isinstance(ls, str) and isinstance(rs, str) \
+                and isinstance(op, (ast.Eq, ast.NotEq)):
+            return AConst((ls == rs) == isinstance(op, ast.Eq))
+        if isinstance(left, AVal) or isinstance(right, AVal):
+            problems: List[sh.Problem] = []
+            shape = sh.broadcast_shapes(
+                [_to_aval(left).shape, _to_aval(right).shape], problems)
+            self.report(problems, node, frame, self._where(frame))
+            return AVal(shape, 'bool')
+        return TOP
+
+    @staticmethod
+    def _maybe_none(v) -> bool:
+        if isinstance(v, (Sym, AVal, ATuple, ADict, InstanceRef,
+                          ConfigRef, DtypeConst)):
+            return False
+        if isinstance(v, AConst):
+            return v.value is None
+        return True
+
+    @staticmethod
+    def _num(v):
+        if isinstance(v, Sym) and v.known:
+            return v.value
+        if isinstance(v, AConst) and isinstance(v.value, (int, float)) \
+                and not isinstance(v.value, bool):
+            return v.value
+        if isinstance(v, AConst) and isinstance(v.value, bool):
+            return int(v.value)
+        return None
+
+    def _e_BinOp(self, node, frame):
+        a = self.eval(node.left, frame)
+        b = self.eval(node.right, frame)
+        op = node.op
+        if isinstance(op, ast.MatMult):
+            return self._matmul(a, b, node, frame)
+        # host scalar arithmetic
+        if isinstance(a, (Sym, AConst)) and isinstance(b, (Sym, AConst)):
+            return self._scalar_arith(op, a, b)
+        if isinstance(a, ATuple) and isinstance(b, ATuple) \
+                and isinstance(op, ast.Add):
+            return ATuple(a.items + b.items)
+        if isinstance(a, (AVal, Sym, AConst)) \
+                and isinstance(b, (AVal, Sym, AConst)):
+            return self._elementwise([a, b], node, frame)
+        return TOP
+
+    def _scalar_arith(self, op, a, b):
+        an, bn = self._num(a), self._num(b)
+        sym_op = {ast.Add: '+', ast.Sub: '-', ast.Mult: '*',
+                  ast.FloorDiv: '//', ast.Mod: '%'}.get(type(op))
+        if isinstance(a, Sym) and isinstance(b, Sym) and sym_op:
+            return sh.sym_binop(sym_op, a, b)
+        if an is None or bn is None:
+            # Unknown scalar-on-scalar result (e.g. dim ** -0.5 with a
+            # symbolic dim): a weak Python scalar, NOT TOP — so dtype
+            # tracking survives `x * scale` chains. (Sym/Sym int ops
+            # already returned a symbolic Sym above.)
+            return sh.scalar(None, weak=True)
+        try:
+            if isinstance(op, ast.Add):
+                r = an + bn
+            elif isinstance(op, ast.Sub):
+                r = an - bn
+            elif isinstance(op, ast.Mult):
+                r = an * bn
+            elif isinstance(op, ast.Div):
+                r = an / bn
+            elif isinstance(op, ast.FloorDiv):
+                r = an // bn
+            elif isinstance(op, ast.Mod):
+                r = an % bn
+            elif isinstance(op, ast.Pow):
+                r = an ** bn
+            else:
+                return TOP
+        except (ZeroDivisionError, OverflowError, ValueError):
+            return TOP
+        if isinstance(r, int) and not isinstance(r, bool):
+            return Sym(r)
+        return AConst(r)
+
+    def _elementwise(self, operands, node, frame, result_dtype=None,
+                     what='operands'):
+        avals = [_to_aval(v) for v in operands]
+        problems: List[sh.Problem] = []
+        shape = sh.broadcast_shapes([v.shape for v in avals], problems,
+                                    what=what)
+        dt, mix = sh.promote_dtypes([(v.dtype, v.weak) for v in avals])
+        if mix is not None:
+            problems.append(sh.Problem(
+                'dtype',
+                f'arithmetic mixes strong {mix.half} and {mix.wide} '
+                f'operands: the {mix.half} side is silently promoted '
+                f'to {mix.wide}'))
+        self.report(problems, node, frame, self._where(frame))
+        weak = all(v.weak for v in avals)
+        return AVal(shape, result_dtype or dt, weak)
+
+    def _matmul(self, a, b, node, frame):
+        av, bv = _to_aval(a), _to_aval(b)
+        problems: List[sh.Problem] = []
+        dt, mix = sh.promote_dtypes([(av.dtype, av.weak),
+                                     (bv.dtype, bv.weak)])
+        if mix is not None:
+            problems.append(sh.Problem(
+                'dtype',
+                f'matmul mixes strong {mix.half} and {mix.wide} '
+                f'operands: the {mix.half} side is silently promoted '
+                f'to {mix.wide}'))
+        shape = None
+        if av.shape is not None and bv.shape is not None \
+                and av.rank >= 1 and bv.rank >= 1:
+            contract_a = av.shape[-1]
+            contract_b = bv.shape[-2] if bv.rank >= 2 else bv.shape[0]
+            if sh.dims_conflict(contract_a, contract_b):
+                problems.append(sh.Problem(
+                    'dim',
+                    f'matmul contraction dim mismatch: {av.render()} @ '
+                    f'{bv.render()} contracts {contract_a.expr} against '
+                    f'{contract_b.expr}'))
+            if av.rank == 1 and bv.rank == 1:
+                shape = ()
+            elif av.rank == 1:
+                shape = bv.shape[:-2] + bv.shape[-1:]
+            elif bv.rank == 1:
+                shape = av.shape[:-1]
+            else:
+                batch = sh.broadcast_shapes(
+                    [av.shape[:-2], bv.shape[:-2]], problems)
+                if batch is not None:
+                    shape = batch + (av.shape[-2], bv.shape[-1])
+        self.report(problems, node, frame, self._where(frame))
+        return AVal(shape, dt)
+
+    def _where(self, frame: Frame) -> str:
+        pf = getattr(frame, '_pf', None)
+        return pf if isinstance(pf, str) else 'jit-traced code'
+
+    # -- attributes ---------------------------------------------------------
+    def _e_Attribute(self, node, frame):
+        base = self.eval(node.value, frame)
+        name = node.attr
+        if isinstance(base, ModuleRef):
+            return self.resolve_dotted(f'{base.dotted}.{name}')
+        if isinstance(base, ConfigRef):
+            return base.fields.get(name, TOP)
+        if isinstance(base, InstanceRef):
+            if name in base.attrs:
+                return base.attrs[name]
+            meth = self.project.method(base.cls_key, name)
+            if meth is not None:
+                if self._is_property(meth):
+                    return self.call_function(meth, [base], {}, node,
+                                              frame)
+                return BoundMethod(
+                    FuncRef(meth, self.module_scope(meth.ctx)), base)
+            return TOP
+        if isinstance(base, ADict):
+            if name in base.entries:
+                return base.entries[name]
+            if name in ('append', 'pop', 'update', 'get', 'keys',
+                        'values', 'items', 'setdefault'):
+                return PartialRef(OpRef(f'container.{name}'),
+                                  [base], {})
+            return TOP
+        if isinstance(base, AVal):
+            if name == 'shape':
+                if base.shape is None:
+                    return UNKNOWN_SHAPE
+                return ATuple(list(base.shape))
+            if name == 'ndim':
+                return Sym(base.rank) if base.rank is not None else \
+                    Sym(None)
+            if name == 'dtype':
+                return DtypeConst(base.dtype) if base.dtype else TOP
+            if name == 'T':
+                if base.shape is None:
+                    return base
+                return base.with_shape(tuple(reversed(base.shape)))
+            if name == 'at':
+                return AtProxy(base)
+            if name in _ARRAY_METHODS:
+                return PartialRef(OpRef(f'array.{name}'), [base], {})
+            return TOP
+        if isinstance(base, ATuple) and name in ('append', 'pop'):
+            return PartialRef(OpRef(f'container.{name}'), [base], {})
+        if isinstance(base, AtIndexed):
+            if name in ('set', 'add', 'multiply', 'max', 'min',
+                        'divide', 'power', 'apply'):
+                return PartialRef(OpRef('array.at_update'),
+                                  [base.base], {})
+            return TOP
+        if isinstance(base, SuperRef):
+            for b in self.project._bases.get(base.cls_key, []):
+                bk = self.project._class_of_call(base.cls_key[0], b)
+                if bk is None:
+                    continue
+                m = self.project.method(bk, name)
+                if m is not None:
+                    return BoundMethod(
+                        FuncRef(m, self.module_scope(m.ctx)),
+                        base.inst)
+            return TOP
+        return TOP
+
+    @staticmethod
+    def _is_property(pf: ProjectFunction) -> bool:
+        for dec in getattr(pf.entry.node, 'decorator_list', []):
+            if isinstance(dec, ast.Name) and dec.id == 'property':
+                return True
+        return False
+
+    # -- subscripts ---------------------------------------------------------
+    def _e_Subscript(self, node, frame):
+        base = self.eval(node.value, frame)
+        if isinstance(base, AtProxy):
+            return AtIndexed(base.base)
+        if isinstance(base, ADict):
+            key = self.eval(node.slice, frame)
+            if isinstance(key, AConst) and isinstance(key.value, str):
+                return _copy_value(base.entries.get(key.value, TOP))
+            if isinstance(key, Sym) and key.known:
+                return _copy_value(base.entries.get(key.value, TOP))
+            return TOP
+        if isinstance(base, ATuple):
+            if isinstance(node.slice, ast.Slice):
+                lo = self._slice_val(node.slice.lower, frame)
+                hi = self._slice_val(node.slice.upper, frame)
+                step = self._slice_val(node.slice.step, frame)
+                if lo is not False and hi is not False \
+                        and step is not False and step != 0:
+                    return ATuple(base.items[lo:hi:step])
+                return TOP
+            key = self.eval(node.slice, frame)
+            if isinstance(key, Sym) and key.known:
+                try:
+                    return base.items[key.value]
+                except IndexError:
+                    return TOP
+            return TOP
+        if isinstance(base, AVal):
+            return self._index(base, node.slice, node, frame)
+        if isinstance(base, UnknownShape):
+            if isinstance(node.slice, ast.Slice):
+                return UNKNOWN_SHAPE
+            return Sym(None)
+        return TOP
+
+    def _slice_val(self, expr, frame):
+        """Const slice bound -> int or None; False when unknown."""
+        if expr is None:
+            return None
+        v = self.eval(expr, frame)
+        if isinstance(v, Sym) and v.known:
+            return v.value
+        return False
+
+    def _index(self, base: AVal, slc, node, frame) -> AVal:
+        if base.shape is None:
+            items = slc.elts if isinstance(slc, ast.Tuple) else [slc]
+            for it in items:
+                if not isinstance(it, (ast.Slice, ast.Constant)):
+                    self.eval(it, frame)
+            return AVal(None, base.dtype)
+        items = list(slc.elts) if isinstance(slc, ast.Tuple) else [slc]
+        # Expand ellipsis to full slices.
+        n_explicit = sum(1 for it in items
+                         if not (isinstance(it, ast.Constant)
+                                 and it.value is Ellipsis)
+                         and not (isinstance(it, ast.Constant)
+                                  and it.value is None))
+        out: List[Sym] = []
+        advanced: List[Tuple[int, AVal]] = []  # (position in out basis)
+        axis = 0
+        expanded: List = []
+        for it in items:
+            if isinstance(it, ast.Constant) and it.value is Ellipsis:
+                for _ in range(len(base.shape) - n_explicit):
+                    expanded.append('slice_all')
+            else:
+                expanded.append(it)
+        while len([e for e in expanded
+                   if not (isinstance(e, ast.Constant)
+                           and e.value is None)]) < len(base.shape):
+            expanded.append('slice_all')
+        result_positions: List = []
+        for it in expanded:
+            if isinstance(it, ast.Constant) and it.value is None:
+                result_positions.append(Sym(1))
+                continue
+            if axis >= len(base.shape):
+                return AVal(None, base.dtype)
+            dim = base.shape[axis]
+            if it == 'slice_all':
+                result_positions.append(dim)
+            elif isinstance(it, ast.Slice):
+                result_positions.append(self._slice_dim(it, dim, frame))
+            else:
+                v = self.eval(it, frame)
+                if isinstance(v, Sym):
+                    if v.known and dim.known and v.value >= 0 \
+                            and v.value >= dim.value and self.emit_on:
+                        self.checker.add_finding(
+                            frame.ctx, node,
+                            f'index {v.value} out of bounds for dim '
+                            f'{dim.expr} of {base.render()} '
+                            f'[{self._where(frame)}]')
+                    result_positions.append(None)  # dropped dim
+                elif isinstance(v, AVal):
+                    if v.dtype == 'bool':
+                        return AVal(None, base.dtype)
+                    result_positions.append(('adv', v))
+                else:
+                    result_positions.append('unknown')
+            axis += 1
+        # Assemble: basic dims in order; advanced indices broadcast and
+        # splice at the first advanced position (contiguous case).
+        adv_vals = [p[1] for p in result_positions
+                    if isinstance(p, tuple)]
+        if any(p == 'unknown' for p in result_positions):
+            return AVal(None, base.dtype)
+        if adv_vals:
+            problems: List[sh.Problem] = []
+            bshape = sh.broadcast_shapes([v.shape for v in adv_vals],
+                                         problems, what='indices')
+            self.report(problems, node, frame, self._where(frame))
+            out_dims: List[Sym] = []
+            placed = False
+            i = 0
+            positions = result_positions
+            # contiguity of advanced positions
+            adv_idx = [j for j, p in enumerate(positions)
+                       if isinstance(p, tuple)]
+            contiguous = adv_idx == list(range(adv_idx[0],
+                                               adv_idx[0] + len(adv_idx)))
+            for j, p in enumerate(positions):
+                if isinstance(p, tuple):
+                    if not placed:
+                        placed = True
+                        if bshape is None:
+                            return AVal(None, base.dtype)
+                        if contiguous:
+                            out_dims.extend(bshape)
+                    continue
+                if p is None:
+                    continue
+                out_dims.append(p)
+            if not contiguous:
+                if bshape is None:
+                    return AVal(None, base.dtype)
+                out_dims = list(bshape) + out_dims
+            return AVal(tuple(out_dims), base.dtype)
+        dims = [p for p in result_positions if p is not None]
+        return AVal(tuple(dims), base.dtype)
+
+    def _slice_dim(self, slc: ast.Slice, dim: Sym, frame) -> Sym:
+        lo = self._slice_val(slc.lower, frame)
+        hi = self._slice_val(slc.upper, frame)
+        step = self._slice_val(slc.step, frame)
+        if lo is False or hi is False or step is False:
+            return Sym(None)
+        if step not in (None, 1):
+            return Sym(None)
+        if lo is None and hi is None:
+            return dim
+        if not dim.known:
+            return Sym(None)
+        n = dim.value
+        lo_i = 0 if lo is None else (lo if lo >= 0 else max(0, n + lo))
+        hi_i = n if hi is None else (min(hi, n) if hi >= 0
+                                     else max(0, n + hi))
+        return Sym(max(0, hi_i - lo_i))
+
+    # -- calls --------------------------------------------------------------
+    def _e_Call(self, node, frame):
+        fnval = self.eval(node.func, frame)
+        args: List[Any] = []
+        unknown_arity = False
+        for a in node.args:
+            if isinstance(a, ast.Starred):
+                v = self.eval(a.value, frame)
+                if isinstance(v, ATuple):
+                    args.extend(v.items)
+                else:
+                    # *x of unknown length: the positional arity is
+                    # unknown — any structural conclusion from it
+                    # (reshape rank, einsum operand count) would be
+                    # fabricated. Poison the whole call.
+                    unknown_arity = True
+            else:
+                args.append(self.eval(a, frame))
+        kwargs: Dict[str, Any] = {}
+        for kw in node.keywords:
+            if kw.arg is None:
+                v = self.eval(kw.value, frame)
+                if isinstance(v, ADict):
+                    for k, x in v.entries.items():
+                        if isinstance(k, str):
+                            kwargs[k] = x
+                continue
+            kwargs[kw.arg] = self.eval(kw.value, frame)
+        if unknown_arity:
+            return TOP
+        return self.do_call(fnval, args, kwargs, node, frame)
+
+    def do_call(self, fnval, args, kwargs, node, frame):
+        self.steps += 1
+        if self.steps > _STEP_BUDGET:
+            raise _Bail()
+        if isinstance(fnval, OpRef):
+            return self.op_dispatch(fnval.name, args, kwargs, node,
+                                    frame)
+        if isinstance(fnval, DtypeConst):
+            return self._cast_call(fnval, args)
+        if isinstance(fnval, PartialRef):
+            return self.do_call(fnval.target, fnval.args + args,
+                                {**fnval.kwargs, **kwargs}, node, frame)
+        if isinstance(fnval, ShardMapRef):
+            d_args = [_degrade_dims(a) for a in args]
+            out = self.do_call(fnval.inner, d_args, kwargs, node, frame)
+            return _degrade_dims(out)
+        if isinstance(fnval, VagRef):
+            val = self.do_call(fnval.inner, args, kwargs, node, frame)
+            grads = args[0] if args else TOP
+            if fnval.value_and:
+                return ATuple([val, grads])
+            return grads
+        if isinstance(fnval, ClassRef):
+            return self.instantiate(fnval.cls_key, args, kwargs, node,
+                                    frame)
+        if isinstance(fnval, BoundMethod):
+            return self.call_function(fnval.fn.pf,
+                                      [fnval.inst] + args, kwargs,
+                                      node, frame,
+                                      closure=fnval.fn.frame)
+        if isinstance(fnval, FuncRef):
+            return self.call_function(fnval.pf, args, kwargs, node,
+                                      frame, closure=fnval.frame)
+        if isinstance(fnval, LambdaRef):
+            return self.call_lambda(fnval, args, kwargs)
+        return TOP
+
+    @staticmethod
+    def _cast_call(dt: DtypeConst, args):
+        if not args:
+            return TOP
+        v = args[0]
+        if dt.name == 'int32' and isinstance(v, Sym):
+            return v  # int() on a host int
+        if isinstance(v, Sym):
+            if dt.name == 'float32' and v.known:
+                return AConst(float(v.value))
+            return sh.scalar(dt.name, weak=False)
+        if isinstance(v, AConst) and isinstance(v.value, (int, float)):
+            if dt.name == 'int32':
+                return Sym(int(v.value))
+            return sh.scalar(dt.name)
+        if isinstance(v, AVal):
+            return v.with_dtype(dt.name)
+        return TOP
+
+    # -- user-function interpretation ---------------------------------------
+    def call_lambda(self, lam: LambdaRef, args, kwargs):
+        frame = Frame(lam.ctx, lam.frame)
+        self._bind_params(lam.node.args, args, kwargs, frame, None)
+        try:
+            return self.eval(lam.node.body, frame)
+        except _Bail:
+            raise
+        except RecursionError:
+            return TOP
+
+    def _canon_key(self, v, depth: int = 0):
+        if isinstance(v, AVal):
+            shape = None if v.shape is None else tuple(
+                d.value for d in v.shape)
+            return ('av', shape, v.dtype, v.weak)
+        if isinstance(v, Sym):
+            return ('s', v.value)
+        if isinstance(v, AConst):
+            try:
+                hash(v.value)
+                return ('c', v.value)
+            except TypeError:
+                return ('c?',)
+        if isinstance(v, DtypeConst):
+            return ('dt', v.name)
+        if v is TOP:
+            return ('T',)
+        if depth < 5:
+            if isinstance(v, ATuple) and len(v.items) <= 32:
+                return ('t',) + tuple(self._canon_key(x, depth + 1)
+                                      for x in v.items)
+            if isinstance(v, ADict) and len(v.entries) <= 32:
+                return ('d', v.complete) + tuple(
+                    (k, self._canon_key(x, depth + 1))
+                    for k, x in sorted(v.entries.items(),
+                                       key=lambda kv: str(kv[0])))
+        # Identity-keyed values are PINNED so a recycled id() can
+        # never alias a dead object's memo entry.
+        self._pinned.append(v)
+        return ('id', id(v))
+
+    def call_function(self, pf: ProjectFunction, args, kwargs, node,
+                      frame, closure: Optional[Frame] = None):
+        self.checker.interpreted.add(pf.qualname)
+        mod = pf.module.rpartition('.')[2]
+        if mod == 'env_vars' and pf.entry.name in ('get', 'get_int'):
+            return self._env_read(pf.entry.name, args)
+        fname = pf.entry.name
+        if fname in ('_constrain', 'shard_constraint') and self.emit_on:
+            self._check_constraint_site(fname, args, node, frame)
+        # A nested closure's behavior depends on captured frame values
+        # the arg-based memo key cannot see — only module-scope
+        # functions (stable closure = their module scope) are safe to
+        # memoize across call sites.
+        memoizable = closure is None \
+            or closure is self.module_scopes.get(pf.ctx.module)
+        key = (id(pf.entry.node), self.emit_on,
+               0 if memoizable else id(closure),
+               tuple(self._canon_key(a) for a in args),
+               tuple(sorted((k, self._canon_key(v))
+                            for k, v in kwargs.items())))
+        if key in self.in_progress:
+            return TOP
+        if memoizable and key in self.memo:
+            return _copy_value(self.memo[key])
+        if self.depth >= _MAX_DEPTH:
+            return TOP
+        fn_node = pf.entry.node
+        if closure is None:
+            closure = self.module_scope(pf.ctx)
+        new_frame = Frame(pf.ctx, closure)
+        new_frame._pf = pf.qualname
+        if pf.entry.class_name is not None and args \
+                and isinstance(args[0], InstanceRef):
+            new_frame._self = args[0]
+            new_frame._cls = args[0].cls_key
+        self._bind_params(fn_node.args, args, kwargs, new_frame, pf)
+        self.in_progress.add(key)
+        self.depth += 1
+        try:
+            self.exec_block(fn_node.body, new_frame)
+            ret = self._joined_returns(new_frame)
+        except RecursionError:
+            ret = TOP
+        finally:
+            self.depth -= 1
+            self.in_progress.discard(key)
+        if memoizable:
+            self.memo[key] = _copy_value(ret)
+        return ret
+
+    @staticmethod
+    def _joined_returns(frame: Frame):
+        if not frame.returns:
+            return AConst(None)
+        out = frame.returns[0]
+        for r in frame.returns[1:]:
+            out = _join(out, r)
+        return out
+
+    def _bind_params(self, arg_spec: ast.arguments, args, kwargs,
+                     frame: Frame, pf: Optional[ProjectFunction]):
+        params = list(getattr(arg_spec, 'posonlyargs', [])) \
+            + list(arg_spec.args)
+        defaults = list(arg_spec.defaults)
+        # defaults align right
+        default_map: Dict[str, ast.expr] = {}
+        for p, d in zip(params[len(params) - len(defaults):], defaults):
+            default_map[p.arg] = d
+        for p, d in zip(arg_spec.kwonlyargs, arg_spec.kw_defaults):
+            if d is not None:
+                default_map[p.arg] = d
+        pos = list(args)
+        for i, p in enumerate(params):
+            if i < len(pos):
+                frame.vars[p.arg] = pos[i]
+            elif p.arg in kwargs:
+                frame.vars[p.arg] = kwargs.pop(p.arg)
+            elif p.arg in default_map:
+                frame.vars[p.arg] = self._eval_default(
+                    default_map[p.arg], frame)
+            else:
+                frame.vars[p.arg] = TOP
+        if arg_spec.vararg is not None:
+            frame.vars[arg_spec.vararg.arg] = ATuple(
+                pos[len(params):])
+        for p in arg_spec.kwonlyargs:
+            if p.arg in kwargs:
+                frame.vars[p.arg] = kwargs.pop(p.arg)
+            elif p.arg in default_map:
+                frame.vars[p.arg] = self._eval_default(
+                    default_map[p.arg], frame)
+            else:
+                frame.vars[p.arg] = TOP
+        if arg_spec.kwarg is not None:
+            frame.vars[arg_spec.kwarg.arg] = ADict(
+                {k: v for k, v in kwargs.items()}, complete=True)
+
+    def _eval_default(self, expr, frame: Frame):
+        try:
+            return self.eval(expr, frame.parent or frame)
+        except _Bail:
+            raise
+        except RecursionError:
+            return TOP
+
+    # -- instantiation ------------------------------------------------------
+    def instantiate(self, cls_key, args, kwargs, node, frame):
+        mod, name = cls_key
+        if name == 'BlockAllocator':
+            self.alloc_calls.append(
+                (self.current_cls, frame.ctx, node,
+                 list(args), dict(kwargs)))
+        cfg = self.checker.config_classes.get(name)
+        if cfg is not None:
+            fields = dict(cfg)
+            for k, v in kwargs.items():
+                fields[k] = v
+            return ConfigRef(name, fields)
+        init = self.project.method(cls_key, '__init__')
+        inst = InstanceRef(cls_key)
+        if init is not None:
+            self.call_function(init, [inst] + list(args), dict(kwargs),
+                               node, frame)
+            return inst
+        # dataclass-style: map args/kwargs onto AnnAssign field order
+        fields = self.checker.dataclass_fields(cls_key)
+        for i, fname in enumerate(fields):
+            if i < len(args):
+                inst.attrs[fname] = args[i]
+            elif fname in kwargs:
+                inst.attrs[fname] = kwargs[fname]
+        return inst
+
+    def _env_read(self, fname, args):
+        if args and isinstance(args[0], AConst) \
+                and isinstance(args[0].value, str):
+            default = self.checker.env_defaults.get(args[0].value,
+                                                    _MISSING)
+            if default is _MISSING:
+                return TOP
+            if fname == 'get_int':
+                try:
+                    return Sym(int(default or 0))
+                except (TypeError, ValueError):
+                    return Sym(None)
+            return AConst(default)
+        return TOP
+
+    # -- constraint-site divisibility check ---------------------------------
+    def _check_constraint_site(self, fname, args, node, frame):
+        x_idx, axes_start = (1, 2) if fname == '_constrain' else (0, 3)
+        if len(args) <= axes_start:
+            return
+        x = args[x_idx] if x_idx < len(args) else TOP
+        if not isinstance(x, AVal) or x.shape is None:
+            return
+        axes = args[axes_start:]
+        if len(axes) > len(x.shape):
+            return
+        for i, av in enumerate(axes):
+            if not (isinstance(av, AConst)
+                    and isinstance(av.value, str)):
+                continue
+            self.checker.check_divisibility(
+                frame.ctx, node, av.value, x.shape[i],
+                f'dim {i} of {x.render()} at this '
+                f'{fname} site [{self._where(frame)}]')
+
+    # -- statements ---------------------------------------------------------
+    def exec_block(self, stmts, frame: Frame) -> None:
+        for stmt in stmts:
+            if frame.terminated:
+                return
+            self.exec_stmt(stmt, frame)
+
+    def exec_stmt(self, stmt, frame: Frame) -> None:
+        self.steps += 1
+        if self.steps > _STEP_BUDGET:
+            raise _Bail()
+        m = getattr(self, '_s_' + type(stmt).__name__, None)
+        if m is not None:
+            m(stmt, frame)
+
+    def _s_Expr(self, stmt, frame):
+        self.eval(stmt.value, frame)
+
+    def _s_Return(self, stmt, frame):
+        frame.returns.append(
+            self.eval(stmt.value, frame) if stmt.value
+            else AConst(None))
+        frame.terminated = True
+
+    def _s_Raise(self, stmt, frame):
+        frame.terminated = True
+
+    def _s_Assign(self, stmt, frame):
+        val = self.eval(stmt.value, frame)
+        for t in stmt.targets:
+            self._assign_target(t, val, frame)
+
+    def _s_AnnAssign(self, stmt, frame):
+        if stmt.value is not None:
+            self._assign_target(stmt.target,
+                                self.eval(stmt.value, frame), frame)
+
+    def _s_AugAssign(self, stmt, frame):
+        synth = ast.BinOp(left=stmt.target, op=stmt.op,
+                          right=stmt.value)
+        ast.copy_location(synth, stmt)
+        ast.fix_missing_locations(synth)
+        load_target = ast.copy_location(
+            ast.Name(id=stmt.target.id, ctx=ast.Load()), stmt) \
+            if isinstance(stmt.target, ast.Name) else None
+        if load_target is None:
+            # self.x += v / d[k] += v: the new value is unmodeled —
+            # degrade the target to TOP rather than keep a stale
+            # 'known' fact (no false positives by construction).
+            self.eval(stmt.value, frame)
+            self._assign_target(stmt.target, TOP, frame)
+            return
+        synth.left = load_target
+        val = self.eval(synth, frame)
+        self._assign_target(stmt.target, val, frame)
+
+    def _assign_target(self, target, val, frame: Frame):
+        if isinstance(target, ast.Name):
+            frame.vars[target.id] = val
+            return
+        if isinstance(target, (ast.Tuple, ast.List)):
+            items = self._unpack(val, len(target.elts))
+            for t, v in zip(target.elts, items):
+                if isinstance(t, ast.Starred):
+                    self._assign_target(t.value, TOP, frame)
+                else:
+                    self._assign_target(t, v, frame)
+            return
+        if isinstance(target, ast.Subscript):
+            base = self.eval(target.value, frame)
+            if isinstance(base, ADict):
+                key = self.eval(target.slice, frame)
+                if isinstance(key, AConst) \
+                        and isinstance(key.value, str):
+                    base.entries[key.value] = val
+                elif isinstance(key, Sym) and key.known:
+                    base.entries[key.value] = val
+                else:
+                    base.complete = False
+            return
+        if isinstance(target, ast.Attribute):
+            base = self.eval(target.value, frame)
+            if isinstance(base, InstanceRef):
+                base.attrs[target.attr] = val
+
+    @staticmethod
+    def _unpack(val, n: int):
+        if isinstance(val, ATuple):
+            if len(val.items) == n:
+                return val.items
+            return [TOP] * n
+        if isinstance(val, UnknownShape):
+            return [Sym(None)] * n
+        return [TOP] * n
+
+    def _s_If(self, stmt, frame):
+        t = _truth(self.eval(stmt.test, frame))
+        if t is True:
+            self.exec_block(stmt.body, frame)
+            return
+        if t is False:
+            self.exec_block(stmt.orelse, frame)
+            return
+        b1 = frame.fork()
+        b2 = frame.fork()
+        self.exec_block(stmt.body, b1)
+        self.exec_block(stmt.orelse, b2)
+        frame.merge([b1, b2])
+        self._degrade_heap_stores(stmt.body + stmt.orelse, frame)
+
+    def _s_For(self, stmt, frame):
+        it = self.eval(stmt.iter, frame)
+        if isinstance(it, ATuple) and len(it.items) <= 16:
+            for item in it.items:
+                self._assign_target(stmt.target, item, frame)
+                self.exec_block(stmt.body, frame)
+                frame.terminated = False
+            self.exec_block(stmt.orelse, frame)
+            return
+        elem = TOP
+        if isinstance(it, RangeVal):
+            elem = Sym(None)
+        elif isinstance(it, ADict):
+            elem = TOP
+        body = frame.fork()
+        self._assign_target(stmt.target, elem, body)
+        self.exec_block(stmt.body, body)
+        body.terminated = False
+        frame.merge([body, frame.fork()])
+        self._degrade_heap_stores(stmt.body, frame)
+        self.exec_block(stmt.orelse, frame)
+
+    def _s_While(self, stmt, frame):
+        t = _truth(self.eval(stmt.test, frame))
+        if t is False:
+            self.exec_block(stmt.orelse, frame)
+            return
+        body = frame.fork()
+        self.exec_block(stmt.body, body)
+        body.terminated = False
+        frame.merge([body, frame.fork()])
+        self._degrade_heap_stores(stmt.body, frame)
+        self.exec_block(stmt.orelse, frame)
+
+    def _s_Try(self, stmt, frame):
+        body = frame.fork()
+        self.exec_block(stmt.body, body)
+        branches = [body]
+        for handler in stmt.handlers:
+            h = frame.fork()
+            self.exec_block(handler.body, h)
+            branches.append(h)
+        frame.merge(branches)
+        self.exec_block(stmt.finalbody, frame)
+
+    def _degrade_heap_stores(self, stmts, frame: Frame) -> None:
+        """Frame forks copy name bindings but share heap objects
+        (InstanceRef.attrs, ADict entries) — a store through an
+        attribute/subscript inside a MAYBE-executed branch would
+        otherwise win unconditionally and fabricate a 'known' fact.
+        Degrade every such target to TOP after the join."""
+        for stmt in stmts:
+            for node in ast.walk(stmt):
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                    targets = [node.target]
+                for t in targets:
+                    if isinstance(t, (ast.Attribute, ast.Subscript)):
+                        try:
+                            self._assign_target(t, TOP, frame)
+                        except _Bail:
+                            raise
+                        except RecursionError:
+                            pass
+
+    def _s_With(self, stmt, frame):
+        for item in stmt.items:
+            self.eval(item.context_expr, frame)
+        self.exec_block(stmt.body, frame)
+
+    def _s_FunctionDef(self, stmt, frame):
+        entry = frame.ctx.functions.by_node.get(stmt)
+        if entry is not None:
+            pf = self._pf(frame.ctx, entry)
+            if pf is not None:
+                frame.vars[stmt.name] = FuncRef(pf, frame)
+
+    _s_AsyncFunctionDef = _s_FunctionDef
+
+    def _s_Import(self, stmt, frame):
+        for alias in stmt.names:
+            local = alias.asname or alias.name.split('.')[0]
+            target = alias.name if alias.asname \
+                else alias.name.split('.')[0]
+            frame.vars[local] = self.resolve_dotted(target)
+
+    def _s_ImportFrom(self, stmt, frame):
+        if stmt.level:
+            return  # relative import inside a function: rare, skip
+        base = stmt.module or ''
+        for alias in stmt.names:
+            if alias.name == '*':
+                continue
+            local = alias.asname or alias.name
+            frame.vars[local] = self.resolve_dotted(
+                f'{base}.{alias.name}' if base else alias.name)
+
+    def _s_Delete(self, stmt, frame):
+        for t in stmt.targets:
+            if isinstance(t, ast.Name):
+                frame.vars.pop(t.id, None)
+
+    # -- op models ----------------------------------------------------------
+    def op_dispatch(self, name, args, kwargs, node, frame):
+        handler = _OPS.get(name)
+        if handler is None:
+            return TOP
+        try:
+            return getattr(self, handler)(args, kwargs, node, frame)
+        except _Bail:
+            raise
+        except RecursionError:
+            return TOP
+
+    # shared helpers
+    def _shape_arg(self, v) -> Optional[List[Sym]]:
+        if isinstance(v, ATuple):
+            out = []
+            for item in v.items:
+                out.append(item if isinstance(item, Sym)
+                           else sh.UNKNOWN_DIM)
+            return out
+        if isinstance(v, Sym):
+            return [v]
+        return None
+
+    @staticmethod
+    def _dtype_arg(v) -> Optional[str]:
+        if isinstance(v, DtypeConst):
+            return v.name
+        return None
+
+    def _axis_arg(self, args, kwargs, pos, default=_MISSING):
+        v = kwargs.get('axis', args[pos] if len(args) > pos else None)
+        if v is None:
+            return default if default is not _MISSING else None
+        if isinstance(v, Sym) and v.known:
+            return v.value
+        if isinstance(v, ATuple):
+            out = []
+            for item in v.items:
+                if isinstance(item, Sym) and item.known:
+                    out.append(item.value)
+                else:
+                    return False
+            return tuple(out)
+        return False  # unknown axis
+
+    # builtins
+    def _op_minmax(self, args, kwargs, node, frame, is_min):
+        if len(args) == 1:
+            return TOP
+        nums = [self._num(a) for a in args]
+        if any(n is None for n in nums):
+            if all(isinstance(a, (Sym, AConst)) for a in args):
+                return Sym(None)
+            return TOP
+        v = min(nums) if is_min else max(nums)
+        return Sym(v) if isinstance(v, int) else AConst(v)
+
+    def _op_min(self, args, kwargs, node, frame):
+        return self._op_minmax(args, kwargs, node, frame, True)
+
+    def _op_max(self, args, kwargs, node, frame):
+        return self._op_minmax(args, kwargs, node, frame, False)
+
+    def _op_len(self, args, kwargs, node, frame):
+        if args and isinstance(args[0], ATuple):
+            return Sym(len(args[0].items))
+        if args and isinstance(args[0], ADict) and args[0].complete:
+            return Sym(len(args[0].entries))
+        if args and isinstance(args[0], AVal) \
+                and args[0].shape is not None and args[0].rank >= 1:
+            return args[0].shape[0]
+        return Sym(None)
+
+    def _op_range(self, args, kwargs, node, frame):
+        if len(args) == 1:
+            n = args[0] if isinstance(args[0], Sym) else Sym(None)
+            return RangeVal(n)
+        return RangeVal(Sym(None))
+
+    def _op_dict(self, args, kwargs, node, frame):
+        if args and isinstance(args[0], ADict):
+            return ADict(dict(args[0].entries), args[0].complete)
+        if not args:
+            return ADict({k: v for k, v in kwargs.items()})
+        return ADict({}, complete=False)
+
+    def _op_tuple(self, args, kwargs, node, frame):
+        if args and isinstance(args[0], ATuple):
+            return ATuple(list(args[0].items))
+        if not args:
+            return ATuple([])
+        return TOP
+
+    _op_list = _op_tuple
+
+    def _op_abs(self, args, kwargs, node, frame):
+        if args and isinstance(args[0], Sym) and args[0].known:
+            return Sym(abs(args[0].value))
+        if args and isinstance(args[0], AVal):
+            return args[0]
+        return TOP
+
+    def _op_noop_host(self, args, kwargs, node, frame):
+        return TOP
+
+    # containers
+    def _op_cont_append(self, args, kwargs, node, frame):
+        if len(args) >= 2 and isinstance(args[0], ATuple):
+            args[0].items.append(args[1])
+        return AConst(None)
+
+    def _op_cont_pop(self, args, kwargs, node, frame):
+        if isinstance(args[0], ADict) and len(args) >= 2 \
+                and isinstance(args[1], AConst) \
+                and isinstance(args[1].value, str):
+            return args[0].entries.pop(args[1].value, TOP)
+        if isinstance(args[0], ATuple) and args[0].items:
+            return args[0].items.pop()
+        return TOP
+
+    def _op_cont_update(self, args, kwargs, node, frame):
+        if isinstance(args[0], ADict) and len(args) >= 2 \
+                and isinstance(args[1], ADict):
+            args[0].entries.update(args[1].entries)
+            args[0].complete = args[0].complete and args[1].complete
+        return AConst(None)
+
+    def _op_cont_get(self, args, kwargs, node, frame):
+        if isinstance(args[0], ADict) and len(args) >= 2 \
+                and isinstance(args[1], AConst) \
+                and isinstance(args[1].value, str):
+            default = args[2] if len(args) >= 3 else AConst(None)
+            if args[0].complete:
+                return _copy_value(
+                    args[0].entries.get(args[1].value, default))
+            return _copy_value(
+                args[0].entries.get(args[1].value, TOP))
+        return TOP
+
+    # array constructors
+    def _make_filled(self, args, kwargs, node, frame, default_dt,
+                     dtype_pos):
+        shape = self._shape_arg(args[0]) if args else None
+        dt = self._dtype_arg(kwargs.get('dtype')) \
+            or (self._dtype_arg(args[dtype_pos])
+                if len(args) > dtype_pos else None) or default_dt
+        return AVal(tuple(shape) if shape is not None else None, dt)
+
+    def _op_zeros(self, args, kwargs, node, frame):
+        return self._make_filled(args, kwargs, node, frame,
+                                 'float32', 1)
+
+    _op_ones = _op_zeros
+    _op_empty = _op_zeros
+
+    def _op_full(self, args, kwargs, node, frame):
+        shape = self._shape_arg(args[0]) if args else None
+        fill = _to_aval(args[1]) if len(args) > 1 else AVal(None, None)
+        dt = self._dtype_arg(kwargs.get('dtype')) \
+            or (self._dtype_arg(args[2]) if len(args) > 2 else None) \
+            or fill.dtype
+        return AVal(tuple(shape) if shape is not None else None, dt)
+
+    def _op_like(self, args, kwargs, node, frame):
+        if args and isinstance(args[0], AVal):
+            dt = self._dtype_arg(kwargs.get('dtype')) or args[0].dtype
+            return AVal(args[0].shape, dt)
+        if args and isinstance(args[0], (ATuple, ADict)):
+            return _copy_value(args[0])
+        return TOP
+
+    def _op_arange(self, args, kwargs, node, frame):
+        dt = self._dtype_arg(kwargs.get('dtype')) or 'int32'
+        nums = [self._num(a) for a in args[:3]]
+        if len(args) == 1:
+            n = args[0] if isinstance(args[0], Sym) else Sym(None)
+            return AVal((n,), dt)
+        if len(nums) >= 2 and all(n is not None for n in nums):
+            start, stop = nums[0], nums[1]
+            step = nums[2] if len(nums) > 2 else 1
+            try:
+                length = max(0, -(-(stop - start) // step))
+            except ZeroDivisionError:
+                length = None
+            return AVal((Sym(length),), dt)
+        return AVal((sh.UNKNOWN_DIM,), dt)
+
+    def _op_asarray(self, args, kwargs, node, frame):
+        if not args:
+            return TOP
+        v = args[0]
+        dt = self._dtype_arg(kwargs.get('dtype')) \
+            or (self._dtype_arg(args[1]) if len(args) > 1 else None)
+        av = _to_aval(v)
+        if isinstance(v, ATuple):
+            av = AVal((Sym(len(v.items)),), None)
+        if dt is not None:
+            return av.with_dtype(dt)
+        if isinstance(v, (Sym, AConst)):
+            return av  # keeps weak flag
+        return av
+
+    def _op_iota(self, args, kwargs, node, frame):
+        dt = self._dtype_arg(args[0]) if args else None
+        n = args[1] if len(args) > 1 and isinstance(args[1], Sym) \
+            else sh.UNKNOWN_DIM
+        return AVal((n,), dt or 'int32')
+
+    # einsum & friends
+    def _op_einsum(self, args, kwargs, node, frame):
+        if not args or not (isinstance(args[0], AConst)
+                            and isinstance(args[0].value, str)):
+            return AVal(None, None)
+        spec = args[0].value
+        operands = [_to_aval(a) for a in args[1:]]
+        preferred = self._dtype_arg(kwargs.get('preferred_element_type'))
+        problems: List[sh.Problem] = []
+        out = sh.einsum_apply(spec, operands, preferred, problems)
+        self.report(problems, node, frame, self._where(frame))
+        return out
+
+    def _op_dot(self, args, kwargs, node, frame):
+        if len(args) >= 2:
+            return self._matmul(args[0], args[1], node, frame)
+        return TOP
+
+    def _op_outer(self, args, kwargs, node, frame):
+        a, b = (_to_aval(args[0]), _to_aval(args[1])) \
+            if len(args) >= 2 else (AVal(None, None), AVal(None, None))
+        da = a.shape[0] if a.shape is not None and a.rank == 1 \
+            else sh.UNKNOWN_DIM
+        db = b.shape[0] if b.shape is not None and b.rank == 1 \
+            else sh.UNKNOWN_DIM
+        dt, _ = sh.promote_dtypes([(a.dtype, a.weak), (b.dtype, b.weak)])
+        return AVal((da, db), dt)
+
+    # elementwise
+    def _op_elem2(self, args, kwargs, node, frame):
+        ops = [a for a in args if isinstance(a, (AVal, Sym, AConst))]
+        if not ops:
+            return TOP
+        return self._elementwise(ops, node, frame)
+
+    def _op_where(self, args, kwargs, node, frame):
+        if len(args) >= 3:
+            cond = _to_aval(args[0])
+            a, b = _to_aval(args[1]), _to_aval(args[2])
+            problems: List[sh.Problem] = []
+            shape = sh.broadcast_shapes(
+                [cond.shape, a.shape, b.shape], problems)
+            dt, mix = sh.promote_dtypes([(a.dtype, a.weak),
+                                         (b.dtype, b.weak)])
+            if mix is not None:
+                problems.append(sh.Problem(
+                    'dtype',
+                    f'jnp.where mixes strong {mix.half} and '
+                    f'{mix.wide} branches: the {mix.half} side is '
+                    f'silently promoted to {mix.wide}'))
+            self.report(problems, node, frame, self._where(frame))
+            return AVal(shape, dt, a.weak and b.weak)
+        return TOP
+
+    def _op_unary(self, args, kwargs, node, frame):
+        if args and isinstance(args[0], (AVal, Sym, AConst)):
+            v = _to_aval(args[0])
+            return AVal(v.shape, v.dtype, v.weak)
+        return TOP
+
+    def _op_softmax(self, args, kwargs, node, frame):
+        return self._op_unary(args, kwargs, node, frame)
+
+    # reductions
+    def _reduce(self, args, kwargs, node, frame, dtype_map=None):
+        if not args or not isinstance(args[0], AVal):
+            return TOP
+        x = args[0]
+        axis = self._axis_arg(args, kwargs, 1)
+        keep = kwargs.get('keepdims')
+        keepdims = isinstance(keep, AConst) and keep.value is True
+        dt = x.dtype
+        if dtype_map and dt in dtype_map:
+            dt = dtype_map[dt]
+        if x.shape is None:
+            return AVal(None, dt, x.weak)
+        if axis is None:
+            return AVal((Sym(1),) * len(x.shape) if keepdims else (),
+                        dt, x.weak)
+        if axis is False:
+            return AVal(None, dt, x.weak)
+        axes = axis if isinstance(axis, tuple) else (axis,)
+        rank = len(x.shape)
+        axes = tuple(a % rank for a in axes if -rank <= a < rank)
+        out = []
+        for i, d in enumerate(x.shape):
+            if i in axes:
+                if keepdims:
+                    out.append(Sym(1))
+            else:
+                out.append(d)
+        return AVal(tuple(out), dt, x.weak)
+
+    def _op_sum(self, args, kwargs, node, frame):
+        return self._reduce(args, kwargs, node, frame,
+                            dtype_map={'bool': 'int32'})
+
+    def _op_reduce(self, args, kwargs, node, frame):
+        return self._reduce(args, kwargs, node, frame)
+
+    def _op_argmax(self, args, kwargs, node, frame):
+        # int32 under the default x64-disabled config this repo runs.
+        out = self._reduce(args, kwargs, node, frame)
+        if isinstance(out, AVal):
+            return out.with_dtype('int32')
+        return out
+
+    def _op_sort(self, args, kwargs, node, frame):
+        return args[0] if args and isinstance(args[0], AVal) else TOP
+
+    _op_cumsum = _op_sort
+
+    def _op_top_k(self, args, kwargs, node, frame):
+        if args and isinstance(args[0], AVal) \
+                and args[0].shape is not None and args[0].rank >= 1:
+            k = args[1] if len(args) > 1 and isinstance(args[1], Sym) \
+                else sh.UNKNOWN_DIM
+            shape = args[0].shape[:-1] + (k,)
+            return ATuple([AVal(shape, args[0].dtype),
+                           AVal(shape, 'int32')])
+        return ATuple([TOP, TOP])
+
+    # structural ops
+    def _op_reshape(self, args, kwargs, node, frame):
+        if not args or not isinstance(args[0], AVal):
+            return TOP
+        x = args[0]
+        dims_args = args[1:]
+        if len(dims_args) == 1 and isinstance(dims_args[0], ATuple):
+            dims_args = dims_args[0].items
+        target = [d if isinstance(d, Sym) else sh.UNKNOWN_DIM
+                  for d in dims_args]
+        if not target:
+            return AVal(None, x.dtype)
+        problems: List[sh.Problem] = []
+        out = sh.reshape_apply(x, target, problems)
+        self.report(problems, node, frame, self._where(frame))
+        return out
+
+    def _op_transpose(self, args, kwargs, node, frame):
+        if not args or not isinstance(args[0], AVal):
+            return TOP
+        x = args[0]
+        perm = args[1:]
+        if len(perm) == 1 and isinstance(perm[0], ATuple):
+            perm = perm[0].items
+        if x.shape is None:
+            return x
+        if not perm:
+            return x.with_shape(tuple(reversed(x.shape)))
+        idx = [p.value if isinstance(p, Sym) and p.known else None
+               for p in perm]
+        if any(i is None for i in idx) or len(idx) != len(x.shape) \
+                or sorted(idx) != list(range(len(x.shape))):
+            return AVal(tuple(sh.UNKNOWN_DIM for _ in x.shape),
+                        x.dtype)
+        return x.with_shape(tuple(x.shape[i] for i in idx))
+
+    def _op_swapaxes(self, args, kwargs, node, frame):
+        if len(args) >= 3 and isinstance(args[0], AVal) \
+                and args[0].shape is not None:
+            a = self._num(args[1])
+            b = self._num(args[2])
+            rank = len(args[0].shape)
+            if a is not None and b is not None \
+                    and -rank <= a < rank and -rank <= b < rank:
+                shape = list(args[0].shape)
+                shape[a], shape[b] = shape[b], shape[a]
+                return args[0].with_shape(tuple(shape))
+            return AVal(tuple(sh.UNKNOWN_DIM for _ in args[0].shape),
+                        args[0].dtype)
+        return args[0] if args and isinstance(args[0], AVal) else TOP
+
+    def _op_concatenate(self, args, kwargs, node, frame):
+        if not args:
+            return TOP
+        parts = args[0]
+        axis = self._axis_arg(args, kwargs, 1, default=0)
+        if not isinstance(parts, ATuple) or axis is False \
+                or isinstance(axis, tuple):
+            return TOP
+        avals = [_to_aval(p) for p in parts.items]
+        problems: List[sh.Problem] = []
+        out = sh.concat_apply(avals, axis if axis is not None else 0,
+                              problems)
+        self.report(problems, node, frame, self._where(frame))
+        return out
+
+    def _op_stack(self, args, kwargs, node, frame):
+        if not args or not isinstance(args[0], ATuple):
+            return TOP
+        avals = [_to_aval(p) for p in args[0].items]
+        axis = self._axis_arg(args, kwargs, 1, default=0)
+        problems: List[sh.Problem] = []
+        shape0 = None
+        for v in avals:
+            if v.shape is None:
+                shape0 = None
+                break
+            if shape0 is None:
+                shape0 = list(v.shape)
+            elif len(shape0) != len(v.shape):
+                problems.append(sh.Problem(
+                    'rank', 'stack operands have different ranks: '
+                    + ', '.join(p.render() for p in avals)))
+                shape0 = None
+                break
+            else:
+                for i, (a, b) in enumerate(zip(shape0, v.shape)):
+                    if sh.dims_conflict(a, b):
+                        problems.append(sh.Problem(
+                            'dim',
+                            f'stack operand dims differ at axis {i}: '
+                            f'{a.expr} vs {b.expr}'))
+                    shape0[i] = sh.dims_join(a, b)
+        dt, _ = sh.promote_dtypes([(v.dtype, v.weak) for v in avals])
+        self.report(problems, node, frame, self._where(frame))
+        if shape0 is None or axis is False or isinstance(axis, tuple) \
+                or axis is None:
+            return AVal(None, dt)
+        ax = axis % (len(shape0) + 1)
+        shape0.insert(ax, Sym(len(avals)))
+        return AVal(tuple(shape0), dt)
+
+    def _op_split(self, args, kwargs, node, frame):
+        if len(args) >= 2 and isinstance(args[0], AVal) \
+                and isinstance(args[1], Sym) and args[1].known:
+            n = args[1].value
+            x = args[0]
+            axis = self._axis_arg(args, kwargs, 2, default=0)
+            if x.shape is not None and isinstance(axis, int):
+                rank = len(x.shape)
+                if -rank <= axis < rank:
+                    ax = axis % rank
+                    dim = x.shape[ax]
+                    part = Sym(dim.value // n) \
+                        if dim.known and n and dim.value % n == 0 \
+                        else sh.UNKNOWN_DIM
+                    shape = x.shape[:ax] + (part,) + x.shape[ax + 1:]
+                    return ATuple([AVal(shape, x.dtype)] * n)
+            return ATuple([AVal(None, x.dtype)] * n)
+        return TOP
+
+    def _op_pad(self, args, kwargs, node, frame):
+        if not args or not isinstance(args[0], AVal) \
+                or args[0].shape is None:
+            return args[0] if args and isinstance(args[0], AVal) \
+                else TOP
+        x = args[0]
+        spec = args[1] if len(args) > 1 else None
+        if isinstance(spec, ATuple) \
+                and len(spec.items) == len(x.shape):
+            out = []
+            for d, p in zip(x.shape, spec.items):
+                if isinstance(p, ATuple) and len(p.items) == 2 \
+                        and all(isinstance(i, Sym) and i.known
+                                for i in p.items):
+                    total = p.items[0].value + p.items[1].value
+                    out.append(sh.sym_binop('+', d, Sym(total)))
+                else:
+                    out.append(sh.UNKNOWN_DIM)
+            return x.with_shape(tuple(out))
+        return AVal(tuple(sh.UNKNOWN_DIM for _ in x.shape), x.dtype)
+
+    def _op_repeat(self, args, kwargs, node, frame):
+        if not args or not isinstance(args[0], AVal) \
+                or args[0].shape is None:
+            return args[0] if args and isinstance(args[0], AVal) \
+                else TOP
+        x = args[0]
+        rep = args[1] if len(args) > 1 else None
+        axis = self._axis_arg(args, kwargs, 2)
+        if not isinstance(axis, int):
+            return AVal(None, x.dtype)
+        rank = len(x.shape)
+        if not (-rank <= axis < rank):
+            return AVal(None, x.dtype)
+        ax = axis % rank
+        rep_sym = rep if isinstance(rep, Sym) else sh.UNKNOWN_DIM
+        new = sh.sym_binop('*', x.shape[ax], rep_sym)
+        return x.with_shape(x.shape[:ax] + (new,) + x.shape[ax + 1:])
+
+    def _op_take(self, args, kwargs, node, frame):
+        if len(args) >= 2 and isinstance(args[0], AVal) \
+                and args[0].shape is not None:
+            x = args[0]
+            idx = _to_aval(args[1])
+            axis = self._axis_arg(args, kwargs, 2)
+            if not isinstance(axis, int) or idx.shape is None:
+                return AVal(None, x.dtype)
+            rank = len(x.shape)
+            ax = axis % rank if -rank <= axis < rank else None
+            if ax is None:
+                return AVal(None, x.dtype)
+            return x.with_shape(x.shape[:ax] + idx.shape
+                                + x.shape[ax + 1:])
+        return TOP
+
+    def _op_take_along_axis(self, args, kwargs, node, frame):
+        if len(args) >= 2 and isinstance(args[1], (AVal,)):
+            idx = args[1]
+            x = args[0] if isinstance(args[0], AVal) \
+                else AVal(None, None)
+            if idx.shape is not None:
+                return AVal(idx.shape, x.dtype)
+        return TOP
+
+    def _op_broadcast_to(self, args, kwargs, node, frame):
+        if len(args) >= 2:
+            x = _to_aval(args[0])
+            shape = self._shape_arg(args[1])
+            if shape is not None:
+                problems: List[sh.Problem] = []
+                sh.broadcast_shapes([x.shape, tuple(shape)], problems,
+                                    what='broadcast_to')
+                self.report(problems, node, frame, self._where(frame))
+                return AVal(tuple(shape), x.dtype, x.weak)
+            return AVal(None, x.dtype, x.weak)
+        return TOP
+
+    def _op_one_hot(self, args, kwargs, node, frame):
+        if args:
+            x = _to_aval(args[0])
+            n = args[1] if len(args) > 1 and isinstance(args[1], Sym) \
+                else sh.UNKNOWN_DIM
+            dt = self._dtype_arg(kwargs.get('dtype')) or 'float32'
+            if x.shape is not None:
+                return AVal(x.shape + (n,), dt)
+            return AVal(None, dt)
+        return TOP
+
+    def _op_clip(self, args, kwargs, node, frame):
+        ops = [a for a in args if isinstance(a, (AVal, Sym, AConst))]
+        if not ops:
+            return TOP
+        out = self._elementwise(ops, node, frame)
+        first = _to_aval(args[0]) if args else out
+        return AVal(out.shape, first.dtype, first.weak)
+
+    # dynamic slice family
+    def _op_dynamic_update_slice(self, args, kwargs, node, frame):
+        if len(args) >= 2 and isinstance(args[0], AVal):
+            x, u = args[0], _to_aval(args[1])
+            if x.shape is not None and u.shape is not None \
+                    and len(x.shape) != len(u.shape) and self.emit_on:
+                self.checker.add_finding(
+                    frame.ctx, node,
+                    f'dynamic_update_slice rank mismatch: operand '
+                    f'{x.render()} vs update {u.render()} '
+                    f'[{self._where(frame)}]')
+            return x
+        return TOP
+
+    def _op_dynamic_slice(self, args, kwargs, node, frame):
+        if args and isinstance(args[0], AVal):
+            sizes = None
+            if len(args) >= 3 and isinstance(args[2], ATuple):
+                sizes = self._shape_arg(args[2])
+            if sizes is not None:
+                return AVal(tuple(sizes), args[0].dtype)
+            if args[0].shape is not None:
+                return AVal(tuple(sh.UNKNOWN_DIM
+                                  for _ in args[0].shape),
+                            args[0].dtype)
+        return TOP
+
+    def _op_dynamic_index_in_dim(self, args, kwargs, node, frame):
+        if args and isinstance(args[0], AVal) \
+                and args[0].shape is not None:
+            x = args[0]
+            axis = self._num(kwargs.get('axis', args[2]
+                                        if len(args) > 2 else None))
+            keep = kwargs.get('keepdims', args[3]
+                              if len(args) > 3 else None)
+            keepdims = not (isinstance(keep, AConst)
+                            and keep.value is False)
+            ax = axis if axis is not None else 0
+            rank = len(x.shape)
+            if -rank <= ax < rank:
+                ax = ax % rank
+                if keepdims:
+                    return x.with_shape(x.shape[:ax] + (Sym(1),)
+                                        + x.shape[ax + 1:])
+                return x.with_shape(x.shape[:ax] + x.shape[ax + 1:])
+        return TOP
+
+    def _op_dynamic_update_index_in_dim(self, args, kwargs, node,
+                                        frame):
+        return args[0] if args and isinstance(args[0], AVal) else TOP
+
+    # control flow
+    def _op_scan(self, args, kwargs, node, frame):
+        if not args:
+            return TOP
+        body = args[0]
+        init = args[1] if len(args) > 1 else kwargs.get('init', TOP)
+        xs = args[2] if len(args) > 2 else kwargs.get('xs',
+                                                      AConst(None))
+        length_dim, xs_slice = self._scan_slice(xs)
+        result = self.do_call(body, [init, xs_slice], {}, node, frame)
+        carry, ys = TOP, TOP
+        if isinstance(result, ATuple) and len(result.items) == 2:
+            carry, ys = result.items
+        self._check_carry(init, carry, node, frame)
+        carry = _join(init, carry)
+        ys_stacked = self._stack_ys(ys, length_dim)
+        return ATuple([carry, ys_stacked])
+
+    def _scan_slice(self, xs):
+        """(leading dim, per-step slice) of a scan's xs tree."""
+        if isinstance(xs, AVal):
+            if xs.shape is not None and len(xs.shape) >= 1:
+                return xs.shape[0], AVal(xs.shape[1:], xs.dtype)
+            return sh.UNKNOWN_DIM, AVal(None, xs.dtype)
+        if isinstance(xs, ATuple):
+            dims, slices = zip(*[self._scan_slice(x)
+                                 for x in xs.items]) \
+                if xs.items else ((sh.UNKNOWN_DIM,), ())
+            dim = sh.UNKNOWN_DIM
+            for d in dims:
+                if isinstance(d, Sym) and d.known:
+                    dim = d
+                    break
+            return dim, ATuple(list(slices))
+        if isinstance(xs, ADict):
+            out = {}
+            dim = sh.UNKNOWN_DIM
+            for k, v in xs.entries.items():
+                d, s = self._scan_slice(v)
+                if isinstance(d, Sym) and d.known \
+                        and not (isinstance(dim, Sym) and dim.known):
+                    dim = d
+                out[k] = s
+            return dim, ADict(out, xs.complete)
+        return sh.UNKNOWN_DIM, TOP
+
+    def _stack_ys(self, ys, length_dim):
+        if isinstance(ys, AVal):
+            if ys.shape is not None:
+                return AVal((length_dim,) + ys.shape, ys.dtype)
+            return AVal(None, ys.dtype)
+        if isinstance(ys, ATuple):
+            return ATuple([self._stack_ys(y, length_dim)
+                           for y in ys.items])
+        if isinstance(ys, ADict):
+            return ADict({k: self._stack_ys(v, length_dim)
+                          for k, v in ys.entries.items()},
+                         ys.complete)
+        if isinstance(ys, AConst) and ys.value is None:
+            return ys
+        return TOP
+
+    def _check_carry(self, init, carry, node, frame):
+        if not self.emit_on:
+            return
+        for a, b, path in self._zip_leaves(init, carry, ''):
+            if isinstance(a, AVal) and isinstance(b, AVal) \
+                    and a.shape is not None and b.shape is not None:
+                if len(a.shape) == len(b.shape):
+                    for i, (x, y) in enumerate(zip(a.shape, b.shape)):
+                        if sh.dims_conflict(x, y):
+                            self.checker.add_finding(
+                                frame.ctx, node,
+                                f'scan carry leaf{path or ""} changes '
+                                f'shape across iterations: init '
+                                f'{a.render()} vs body result '
+                                f'{b.render()} '
+                                f'[{self._where(frame)}]')
+                            break
+                else:
+                    self.checker.add_finding(
+                        frame.ctx, node,
+                        f'scan carry leaf{path or ""} changes rank: '
+                        f'init {a.render()} vs body result '
+                        f'{b.render()} [{self._where(frame)}]')
+
+    def _zip_leaves(self, a, b, path):
+        if isinstance(a, ATuple) and isinstance(b, ATuple) \
+                and len(a.items) == len(b.items):
+            for i, (x, y) in enumerate(zip(a.items, b.items)):
+                yield from self._zip_leaves(x, y, f'{path}[{i}]')
+            return
+        if isinstance(a, ADict) and isinstance(b, ADict):
+            for k in a.entries:
+                if k in b.entries:
+                    yield from self._zip_leaves(
+                        a.entries[k], b.entries[k], f'{path}[{k!r}]')
+            return
+        yield a, b, path
+
+    def _op_cond(self, args, kwargs, node, frame):
+        if len(args) >= 3:
+            operands = args[3:]
+            t = self.do_call(args[1], list(operands), {}, node, frame)
+            f = self.do_call(args[2], list(operands), {}, node, frame)
+            return _join(t, f)
+        return TOP
+
+    def _op_fori_loop(self, args, kwargs, node, frame):
+        if len(args) >= 4:
+            out = self.do_call(args[2], [TOP, args[3]], {}, node,
+                               frame)
+            return _join(args[3], out)
+        return TOP
+
+    # jax wrappers
+    def _op_identity1(self, args, kwargs, node, frame):
+        return args[0] if args else TOP
+
+    def _op_jit(self, args, kwargs, node, frame):
+        return args[0] if args else TOP
+
+    def _op_vag(self, args, kwargs, node, frame):
+        return VagRef(args[0]) if args else TOP
+
+    def _op_grad(self, args, kwargs, node, frame):
+        return VagRef(args[0], value_and=False) if args else TOP
+
+    def _op_shard_map(self, args, kwargs, node, frame):
+        inner = args[0] if args else kwargs.get('f')
+        return ShardMapRef(inner) if inner is not None else TOP
+
+    def _op_partial(self, args, kwargs, node, frame):
+        if not args:
+            return TOP
+        return PartialRef(args[0], args[1:], kwargs)
+
+    def _op_tree_map(self, args, kwargs, node, frame):
+        if len(args) < 2:
+            return TOP
+        fn = args[0]
+        trees = args[1:]
+        first = trees[0]
+        if isinstance(first, ADict):
+            out = {}
+            for k in first.entries:
+                leaf_args = [first.entries[k]]
+                rest_ok = True
+                for t in trees[1:]:
+                    if isinstance(t, ADict) and k in t.entries:
+                        leaf_args.append(t.entries[k])
+                    else:
+                        rest_ok = False
+                        break
+                if not rest_ok:
+                    out[k] = TOP
+                    continue
+                if isinstance(leaf_args[0], (ADict, ATuple)):
+                    out[k] = self._op_tree_map(
+                        [fn] + leaf_args, {}, node, frame)
+                else:
+                    out[k] = self.do_call(fn, leaf_args, {}, node,
+                                          frame)
+            return ADict(out, first.complete)
+        if isinstance(first, ATuple):
+            return ATuple([
+                self.do_call(fn, [x], {}, node, frame)
+                if not isinstance(x, (ADict, ATuple))
+                else self._op_tree_map([fn, x], {}, node, frame)
+                for x in first.items])
+        if isinstance(first, AVal):
+            return self.do_call(fn, list(trees), {}, node, frame)
+        return TOP
+
+    def _op_random_split(self, args, kwargs, node, frame):
+        return TOP
+
+    def _op_random_normal(self, args, kwargs, node, frame):
+        shape = self._shape_arg(args[1]) if len(args) > 1 else None
+        dt = self._dtype_arg(kwargs.get('dtype')) \
+            or (self._dtype_arg(args[2]) if len(args) > 2 else None) \
+            or 'float32'
+        return AVal(tuple(shape) if shape is not None else None, dt)
+
+    _op_random_uniform = _op_random_normal
+
+    def _op_random_categorical(self, args, kwargs, node, frame):
+        if len(args) >= 2 and isinstance(args[1], AVal):
+            logits = args[1]
+            axis = self._axis_arg(args, kwargs, 2, default=-1)
+            if logits.shape is not None and isinstance(axis, int):
+                rank = len(logits.shape)
+                if -rank <= axis < rank:
+                    ax = axis % rank
+                    return AVal(logits.shape[:ax]
+                                + logits.shape[ax + 1:], 'int32')
+            return AVal(None, 'int32')
+        return TOP
+
+    # collectives (inside shard_map bodies)
+    def _op_psum(self, args, kwargs, node, frame):
+        return args[0] if args else TOP
+
+    _op_ppermute = _op_psum
+    _op_stop_gradient = _op_psum
+
+    def _op_all_gather(self, args, kwargs, node, frame):
+        if args and isinstance(args[0], AVal) \
+                and args[0].shape is not None:
+            axis = self._num(kwargs.get('axis'))
+            shape = list(args[0].shape)
+            tiled = kwargs.get('tiled')
+            if isinstance(tiled, AConst) and tiled.value is True \
+                    and axis is not None and 0 <= axis < len(shape):
+                shape[axis] = sh.UNKNOWN_DIM
+                return args[0].with_shape(tuple(shape))
+            return AVal(None, args[0].dtype)
+        return TOP
+
+    def _op_all_to_all(self, args, kwargs, node, frame):
+        if args and isinstance(args[0], AVal) \
+                and args[0].shape is not None:
+            shape = list(args[0].shape)
+            for k in ('split_axis', 'concat_axis'):
+                ax = self._num(kwargs.get(k))
+                if ax is not None and 0 <= ax < len(shape):
+                    shape[ax] = sh.UNKNOWN_DIM
+            return args[0].with_shape(tuple(shape))
+        return TOP
+
+    def _op_axis_scalar(self, args, kwargs, node, frame):
+        return sh.scalar('int32')
+
+    def _op_with_sharding_constraint(self, args, kwargs, node, frame):
+        return args[0] if args else TOP
+
+    # array methods (dispatched as 'array.<name>' with base as args[0])
+    def _op_m_astype(self, args, kwargs, node, frame):
+        if args and isinstance(args[0], AVal):
+            dt = self._dtype_arg(args[1]) if len(args) > 1 else None
+            return args[0].with_dtype(dt)
+        return TOP
+
+    def _op_m_reshape(self, args, kwargs, node, frame):
+        return self._op_reshape(args, kwargs, node, frame)
+
+    def _op_m_at_set(self, args, kwargs, node, frame):
+        if args and isinstance(args[0], AVal):
+            return args[0]
+        return TOP
+
+    def _op_m_item(self, args, kwargs, node, frame):
+        return TOP
+
+
+_ARRAY_METHODS = {'astype', 'reshape', 'transpose', 'swapaxes', 'sum',
+                  'mean', 'max', 'min', 'argmax', 'argmin', 'sort',
+                  'item', 'tolist', 'ravel', 'flatten', 'block_until_ready',
+                  'copy'}
+
+# dotted op name -> Interp method name
+_OPS: Dict[str, str] = {}
+
+
+def _reg_ops(method: str, *names: str) -> None:
+    for n in names:
+        _OPS[n] = method
+
+
+for _mod in ('jax.numpy', 'numpy'):
+    _reg_ops('_op_zeros', f'{_mod}.zeros', f'{_mod}.ones',
+             f'{_mod}.empty')
+    _reg_ops('_op_full', f'{_mod}.full')
+    _reg_ops('_op_like', f'{_mod}.zeros_like', f'{_mod}.ones_like',
+             f'{_mod}.full_like', f'{_mod}.empty_like')
+    _reg_ops('_op_arange', f'{_mod}.arange')
+    _reg_ops('_op_asarray', f'{_mod}.asarray', f'{_mod}.array')
+    _reg_ops('_op_einsum', f'{_mod}.einsum')
+    _reg_ops('_op_dot', f'{_mod}.dot', f'{_mod}.matmul')
+    _reg_ops('_op_outer', f'{_mod}.outer')
+    _reg_ops('_op_where', f'{_mod}.where')
+    _reg_ops('_op_elem2', f'{_mod}.maximum', f'{_mod}.minimum',
+             f'{_mod}.add', f'{_mod}.multiply', f'{_mod}.subtract',
+             f'{_mod}.divide', f'{_mod}.logical_and',
+             f'{_mod}.logical_or', f'{_mod}.power')
+    _reg_ops('_op_unary', f'{_mod}.exp', f'{_mod}.log', f'{_mod}.sqrt',
+             f'{_mod}.square', f'{_mod}.cos', f'{_mod}.sin',
+             f'{_mod}.tanh', f'{_mod}.abs', f'{_mod}.negative',
+             f'{_mod}.logical_not', f'{_mod}.floor', f'{_mod}.ceil',
+             f'{_mod}.round', f'{_mod}.sign')
+    _reg_ops('_op_sum', f'{_mod}.sum')
+    _reg_ops('_op_reduce', f'{_mod}.mean', f'{_mod}.max',
+             f'{_mod}.min', f'{_mod}.prod', f'{_mod}.any',
+             f'{_mod}.all', f'{_mod}.var', f'{_mod}.std')
+    _reg_ops('_op_argmax', f'{_mod}.argmax', f'{_mod}.argmin')
+    _reg_ops('_op_sort', f'{_mod}.sort')
+    _reg_ops('_op_cumsum', f'{_mod}.cumsum')
+    _reg_ops('_op_reshape', f'{_mod}.reshape')
+    _reg_ops('_op_transpose', f'{_mod}.transpose')
+    _reg_ops('_op_swapaxes', f'{_mod}.swapaxes')
+    _reg_ops('_op_concatenate', f'{_mod}.concatenate')
+    _reg_ops('_op_stack', f'{_mod}.stack')
+    _reg_ops('_op_split', f'{_mod}.split')
+    _reg_ops('_op_pad', f'{_mod}.pad')
+    _reg_ops('_op_repeat', f'{_mod}.repeat', f'{_mod}.tile')
+    _reg_ops('_op_take', f'{_mod}.take')
+    _reg_ops('_op_take_along_axis', f'{_mod}.take_along_axis')
+    _reg_ops('_op_broadcast_to', f'{_mod}.broadcast_to')
+    _reg_ops('_op_clip', f'{_mod}.clip')
+
+_reg_ops('_op_iota', 'jax.lax.iota', 'jax.lax.broadcasted_iota')
+_reg_ops('_op_scan', 'jax.lax.scan')
+_reg_ops('_op_cond', 'jax.lax.cond')
+_reg_ops('_op_fori_loop', 'jax.lax.fori_loop')
+_reg_ops('_op_dynamic_update_slice', 'jax.lax.dynamic_update_slice')
+_reg_ops('_op_dynamic_slice', 'jax.lax.dynamic_slice')
+_reg_ops('_op_dynamic_index_in_dim', 'jax.lax.dynamic_index_in_dim')
+_reg_ops('_op_dynamic_update_index_in_dim',
+         'jax.lax.dynamic_update_index_in_dim')
+_reg_ops('_op_top_k', 'jax.lax.top_k')
+_reg_ops('_op_elem2', 'jax.lax.max', 'jax.lax.min', 'jax.lax.add',
+         'jax.lax.mul', 'jax.lax.sub')
+_reg_ops('_op_unary', 'jax.lax.rsqrt', 'jax.lax.exp', 'jax.lax.log',
+         'jax.lax.erf')
+_reg_ops('_op_where', 'jax.lax.select')
+_reg_ops('_op_psum', 'jax.lax.psum', 'jax.lax.pmean',
+         'jax.lax.ppermute', 'jax.lax.pvary',
+         'jax.lax.stop_gradient')
+_reg_ops('_op_all_gather', 'jax.lax.all_gather')
+_reg_ops('_op_all_to_all', 'jax.lax.all_to_all')
+_reg_ops('_op_axis_scalar', 'jax.lax.axis_size', 'jax.lax.axis_index')
+_reg_ops('_op_with_sharding_constraint',
+         'jax.lax.with_sharding_constraint',
+         'jax.lax.with_sharding_constraint_p')
+_reg_ops('_op_softmax', 'jax.nn.softmax', 'jax.nn.log_softmax',
+         'jax.nn.silu', 'jax.nn.relu', 'jax.nn.gelu',
+         'jax.nn.sigmoid', 'jax.nn.swish')
+_reg_ops('_op_one_hot', 'jax.nn.one_hot')
+_reg_ops('_op_jit', 'jax.jit', 'jax.pjit', 'jax.checkpoint',
+         'jax.remat', 'jax.ad_checkpoint.checkpoint',
+         'jax.experimental.pjit.pjit')
+_reg_ops('_op_identity1', 'jax.ad_checkpoint.checkpoint_name',
+         'jax.device_put', 'jax.block_until_ready')
+_reg_ops('_op_vag', 'jax.value_and_grad')
+_reg_ops('_op_grad', 'jax.grad')
+_reg_ops('_op_shard_map', 'jax.shard_map',
+         'jax.experimental.shard_map.shard_map')
+_reg_ops('_op_partial', 'functools.partial')
+_reg_ops('_op_tree_map', 'jax.tree.map', 'jax.tree_util.tree_map',
+         'jax.tree_map')
+_reg_ops('_op_random_split', 'jax.random.split', 'jax.random.fold_in',
+         'jax.random.key', 'jax.random.PRNGKey')
+_reg_ops('_op_random_normal', 'jax.random.normal')
+_reg_ops('_op_random_uniform', 'jax.random.uniform')
+_reg_ops('_op_random_categorical', 'jax.random.categorical')
+_reg_ops('_op_min', 'builtins.min')
+_reg_ops('_op_max', 'builtins.max')
+_reg_ops('_op_len', 'builtins.len')
+_reg_ops('_op_range', 'builtins.range')
+_reg_ops('_op_dict', 'builtins.dict')
+_reg_ops('_op_tuple', 'builtins.tuple')
+_reg_ops('_op_list', 'builtins.list')
+_reg_ops('_op_abs', 'builtins.abs')
+_reg_ops('_op_noop_host', 'builtins.sum', 'builtins.sorted',
+         'builtins.enumerate', 'builtins.zip', 'builtins.isinstance',
+         'builtins.getattr', 'builtins.hasattr', 'builtins.print')
+_reg_ops('_op_cont_append', 'container.append')
+_reg_ops('_op_cont_pop', 'container.pop')
+_reg_ops('_op_cont_update', 'container.update')
+_reg_ops('_op_cont_get', 'container.get')
+_reg_ops('_op_noop_host', 'container.keys', 'container.values',
+         'container.items', 'container.setdefault')
+# array methods
+_reg_ops('_op_m_astype', 'array.astype')
+_reg_ops('_op_m_reshape', 'array.reshape')
+_reg_ops('_op_transpose', 'array.transpose')
+_reg_ops('_op_swapaxes', 'array.swapaxes')
+_reg_ops('_op_sum', 'array.sum')
+_reg_ops('_op_reduce', 'array.mean', 'array.max', 'array.min')
+_reg_ops('_op_argmax', 'array.argmax', 'array.argmin')
+_reg_ops('_op_sort', 'array.sort', 'array.copy',
+         'array.block_until_ready')
+_reg_ops('_op_m_item', 'array.item', 'array.tolist')
+_reg_ops('_op_m_at_set', 'array.at_update')
+
+
+def _op_m_flatten(self, args, kwargs, node, frame):
+    if args and isinstance(args[0], AVal) and args[0].shape is not None:
+        n = sh.shape_numel(args[0].shape)
+        return AVal((Sym(n),) if n is not None else (sh.UNKNOWN_DIM,),
+                    args[0].dtype)
+    return TOP
+
+
+Interp._op_m_flatten = _op_m_flatten
+_reg_ops('_op_m_flatten', 'array.ravel', 'array.flatten')
+
+_OP_ALIASES: Dict[str, str] = {
+    'jax.numpy.float_power': 'jax.numpy.power',
+}
+
+
+class SuperRef:
+    __slots__ = ('cls_key', 'inst')
+
+    def __init__(self, cls_key, inst):
+        self.cls_key = cls_key
+        self.inst = inst
+
+
+def _op_super(self, args, kwargs, node, frame):
+    f = frame
+    while f is not None:
+        cls = getattr(f, '_cls', None)
+        slf = getattr(f, '_self', None)
+        if cls is not None and slf is not None:
+            return SuperRef(cls, slf)
+        f = f.parent
+    return TOP
+
+
+Interp._op_super = _op_super
+_reg_ops('_op_super', 'builtins.super')
+
+
+
+@register
+class ShapeChecker(Checker):
+    name = 'shapecheck'
+    description = ('symbolic shape/dtype abstract interpretation of '
+                   'jit-traced code: rank/dim mismatches, bf16 '
+                   'hygiene, mesh divisibility, donation aliasing, '
+                   'paged-KV pool consistency')
+
+    def __init__(self):
+        self.interpreted: Set[str] = set()
+        self._findings: List[Finding] = []
+        self._seen: Set[Tuple[str, int, str]] = set()
+        self.config_classes: Dict[str, Dict[str, Any]] = {}
+        self.env_defaults: Dict[str, Optional[str]] = {}
+        self.rules_map: Dict[str, Tuple[str, ...]] = {}
+        self.divisors: Dict[str, int] = {}
+        self._dc_fields: Dict[Tuple[str, str], List[str]] = {}
+        self._project = None
+        self._interp: Optional[Interp] = None
+        self.root_returns: Dict[int, Tuple[List[Any], Any]] = {}
+
+    # -- finding plumbing ----------------------------------------------------
+    def add_finding(self, ctx: FileContext, node, message: str) -> None:
+        line = getattr(node, 'lineno', 1)
+        key = (ctx.relpath, line, message)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self._findings.append(ctx.finding(node, self.name, message))
+
+    def check_divisibility(self, ctx, node, logical: str, dim: Sym,
+                           desc: str) -> None:
+        if not self.divisors or not self.rules_map or not dim.known:
+            return
+        axes = self.rules_map.get(logical)
+        if not axes:
+            return
+        divisor = 1
+        for a in axes:
+            divisor *= self.divisors.get(a, 1)
+        if divisor > 1 and dim.value % divisor:
+            self.add_finding(
+                ctx, node,
+                f'dim {dim.expr} carries logical axis {logical!r} -> '
+                f'mesh axes ({", ".join(axes)}) but is not divisible '
+                f'by {divisor} (MESH_AXIS_DIVISORS): {desc} — a mesh '
+                f'sizing that axis > 1 cannot shard it evenly')
+
+    # -- table builders ------------------------------------------------------
+    def _build_tables(self, contexts) -> None:
+        raw: Dict[str, Tuple[ast.ClassDef, str]] = {}
+        for ctx in contexts:
+            mod_tail = ctx.module.rpartition('.')[2]
+            for node in ctx.nodes:
+                if isinstance(node, ast.ClassDef) \
+                        and self._is_dataclass(node):
+                    raw[node.name] = (node, ctx.module)
+                elif isinstance(node, ast.Call) \
+                        and mod_tail == 'env_vars' \
+                        and isinstance(node.func, ast.Name) \
+                        and node.func.id == '_v' and len(node.args) >= 2:
+                    k = node.args[0]
+                    v = node.args[1]
+                    if isinstance(k, ast.Constant) \
+                            and isinstance(k.value, str) \
+                            and isinstance(v, ast.Constant):
+                        self.env_defaults[k.value] = v.value
+                elif isinstance(node, ast.Call) \
+                        and self._ctor_name(node.func) == 'LogicalRules' \
+                        and node.args \
+                        and isinstance(node.args[0], ast.Dict):
+                    self._collect_rules(node.args[0])
+                elif isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == 'with_overrides':
+                    for kw in node.keywords:
+                        if kw.arg is not None:
+                            self._add_rule(kw.arg, kw.value)
+                elif isinstance(node, (ast.Assign, ast.AnnAssign)) \
+                        and node.value is not None:
+                    targets = node.targets \
+                        if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for t in targets:
+                        if isinstance(t, ast.Name) \
+                                and t.id == 'MESH_AXIS_DIVISORS' \
+                                and isinstance(node.value, ast.Dict):
+                            for k, v in zip(node.value.keys,
+                                            node.value.values):
+                                if isinstance(k, ast.Constant) \
+                                        and isinstance(k.value, str) \
+                                        and isinstance(v, ast.Constant) \
+                                        and isinstance(v.value, int):
+                                    self.divisors[k.value] = v.value
+        for name in raw:
+            self._resolve_config(name, raw, set())
+
+    @staticmethod
+    def _ctor_name(func) -> str:
+        if isinstance(func, ast.Name):
+            return func.id
+        if isinstance(func, ast.Attribute):
+            return func.attr
+        return ''
+
+    def _collect_rules(self, d: ast.Dict) -> None:
+        for k, v in zip(d.keys, d.values):
+            if k is not None and isinstance(k, ast.Constant) \
+                    and isinstance(k.value, str):
+                self._add_rule(k.value, v)
+
+    def _add_rule(self, name: str, value: ast.expr) -> None:
+        axes: List[str] = []
+        if isinstance(value, ast.Constant) \
+                and isinstance(value.value, str):
+            axes = [value.value]
+        elif isinstance(value, ast.Tuple):
+            axes = [e.value for e in value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)]
+        existing = set(self.rules_map.get(name, ()))
+        existing.update(axes)
+        self.rules_map[name] = tuple(sorted(existing))
+
+    @staticmethod
+    def _is_dataclass(node: ast.ClassDef) -> bool:
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            name = target.attr if isinstance(target, ast.Attribute) \
+                else getattr(target, 'id', '')
+            if name == 'dataclass':
+                return True
+        return False
+
+    def _resolve_config(self, name, raw, seen) -> Dict[str, Any]:
+        if name in self.config_classes:
+            return self.config_classes[name]
+        if name in seen or name not in raw:
+            return {}
+        seen.add(name)
+        node, mod = raw[name]
+        fields: Dict[str, Any] = {}
+        for base in node.bases:
+            base_name = self._ctor_name(base)
+            if base_name in raw:
+                fields.update(self._resolve_config(base_name, raw,
+                                                   seen))
+        for stmt in node.body:
+            if isinstance(stmt, ast.AnnAssign) \
+                    and isinstance(stmt.target, ast.Name) \
+                    and stmt.value is not None:
+                fields[stmt.target.id] = self._field_value(stmt.value)
+        self.config_classes[name] = fields
+        return fields
+
+    @staticmethod
+    def _field_value(value: ast.expr):
+        if isinstance(value, ast.Constant):
+            v = value.value
+            if isinstance(v, bool):
+                return AConst(v)
+            if isinstance(v, int):
+                return Sym(v)
+            return AConst(v)
+        if isinstance(value, ast.Attribute) \
+                and value.attr in _JNP_DTYPES:
+            return DtypeConst(sh.canon_dtype(value.attr) or value.attr)
+        if isinstance(value, ast.UnaryOp) \
+                and isinstance(value.op, ast.USub) \
+                and isinstance(value.operand, ast.Constant) \
+                and isinstance(value.operand.value, (int, float)):
+            v = value.operand.value
+            return Sym(-v) if isinstance(v, int) else AConst(-v)
+        return TOP
+
+    def dataclass_fields(self, cls_key) -> List[str]:
+        cached = self._dc_fields.get(cls_key)
+        if cached is not None:
+            return cached
+        out: List[str] = []
+        project = self._project
+        node = project.classes.get(cls_key) if project else None
+        if node is not None:
+            for base in node.bases:
+                base_key = project._class_of_call(cls_key[0], base)
+                if base_key is not None:
+                    out.extend(self.dataclass_fields(base_key))
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) \
+                        and isinstance(stmt.target, ast.Name) \
+                        and stmt.target.id not in out:
+                    out.append(stmt.target.id)
+        self._dc_fields[cls_key] = out
+        return out
+
+    # -- root discovery ------------------------------------------------------
+    def _discover_roots(self, contexts):
+        """-> (roots: {id(node): (pf, donate)}, sites: [(ctx, node,
+        pf, donate)])."""
+        project = self._project
+        roots: Dict[int, ProjectFunction] = {}
+        sites = []
+        for ctx in contexts:
+            for entry in ctx.functions.entries:
+                if _is_jit_decorated(entry.node):
+                    pf = self._safe_pf(ctx, entry)
+                    if pf is None:
+                        continue
+                    roots[id(entry.node)] = pf
+                    donate = self._donate_from_decorator(entry.node)
+                    if donate:
+                        sites.append((ctx, entry.node, pf, donate,
+                                      'decorator'))
+            for node in ctx.nodes:
+                if not isinstance(node, ast.Call):
+                    continue
+                target = _jit_wrapped(node)
+                if target is None or not isinstance(
+                        target, (ast.Name, ast.Attribute)):
+                    continue
+                pf = self._resolve_wrapped(ctx, node, target)
+                if pf is None:
+                    continue
+                roots[id(pf.entry.node)] = pf
+                donate = self._donate_ints(node.keywords)
+                if donate:
+                    sites.append((ctx, node, pf, donate, 'call'))
+        return roots, sites
+
+    def _safe_pf(self, ctx, entry):
+        try:
+            return self._project.project_function(ctx, entry)
+        except KeyError:
+            return None
+
+    def _resolve_wrapped(self, ctx, call, target):
+        project = self._project
+        enclosing = call
+        entry = None
+        while enclosing is not None:
+            enclosing = ctx.parents.get(enclosing)
+            if isinstance(enclosing, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                entry = ctx.functions.by_node.get(enclosing)
+                break
+        if entry is not None:
+            current = project.project_function(ctx, entry)
+        else:
+            current = ProjectFunction(
+                ctx.module,
+                FunctionEntry(ctx.tree, '<module>', '<module>', None),
+                ctx)
+        fake = ast.Call(func=target, args=[], keywords=[])
+        return project.resolve_call(fake, current)
+
+    @staticmethod
+    def _donate_ints(keywords) -> Tuple[int, ...]:
+        for kw in keywords:
+            if kw.arg == 'donate_argnums':
+                v = kw.value
+                if isinstance(v, ast.Constant) \
+                        and isinstance(v.value, int):
+                    return (v.value,)
+                if isinstance(v, (ast.Tuple, ast.List)):
+                    return tuple(e.value for e in v.elts
+                                 if isinstance(e, ast.Constant)
+                                 and isinstance(e.value, int))
+        return ()
+
+    def _donate_from_decorator(self, fn_node) -> Tuple[int, ...]:
+        for dec in getattr(fn_node, 'decorator_list', []):
+            if isinstance(dec, ast.Call):
+                donate = self._donate_ints(dec.keywords)
+                if donate:
+                    return donate
+        return ()
+
+    # -- seeding -------------------------------------------------------------
+    def _annotations_for(self, ctx: FileContext,
+                         fn_node) -> Dict[str, AVal]:
+        out: Dict[str, AVal] = {}
+        start = fn_node.lineno
+        first_deco = min((d.lineno for d in fn_node.decorator_list),
+                         default=start)
+        # Only the CONTIGUOUS comment block directly above the def (or
+        # its first decorator) plus the def/decorator lines themselves:
+        # a comment buried in the preceding function's body must never
+        # seed this one.
+        lines = [ln for ln in range(first_deco, start + 1)]
+        ln = first_deco - 1
+        while ln >= 1 and ln - 1 < len(ctx.lines) \
+                and ctx.lines[ln - 1].lstrip().startswith('#'):
+            lines.append(ln)
+            ln -= 1
+        for lineno in lines:
+            if lineno - 1 >= len(ctx.lines):
+                continue
+            text = ctx.lines[lineno - 1]
+            for m in _ANNOT_RE.finditer(text):
+                name, dt_code, dims = m.groups()
+                dt = _ANNOT_DTYPES.get(dt_code)
+                shape = []
+                for part in dims.split(','):
+                    part = part.strip()
+                    if not part:
+                        continue
+                    if part.lstrip('-').isdigit():
+                        shape.append(Sym(int(part)))
+                    else:
+                        shape.append(Sym(None, part))
+                out[name] = AVal(tuple(shape), dt)
+        return out
+
+    def _standalone_instance(self, cls_key) -> InstanceRef:
+        interp = self._interp
+        cached = interp.instances.get(cls_key)
+        if cached is not None:
+            return cached
+        inst = InstanceRef(cls_key)
+        interp.instances[cls_key] = inst
+        init = self._project.method(cls_key, '__init__')
+        if init is None:
+            return inst
+        args = [inst]
+        spec = init.entry.node.args
+        params = list(getattr(spec, 'posonlyargs', [])) \
+            + list(spec.args)
+        for p in params[1:]:
+            cfg = self._config_from_annotation(p.annotation)
+            args.append(cfg if cfg is not None else _MISSING)
+        # _MISSING -> let defaults bind; trim trailing missing args
+        bound = []
+        for a in args:
+            bound.append(TOP if a is _MISSING else a)
+        while len(bound) > 1 and bound[-1] is TOP:
+            n_defaults = len(spec.defaults)
+            has_default = (len(bound) - 1) >= len(params) - n_defaults
+            if not has_default:
+                break
+            bound.pop()
+        prev_cls = interp.current_cls
+        interp.current_cls = cls_key
+        try:
+            interp.call_function(init, bound, {}, init.entry.node,
+                                 interp.module_scope(init.ctx))
+        except _Bail:
+            pass
+        finally:
+            interp.current_cls = prev_cls
+        return inst
+
+    def _config_from_annotation(self, annot) -> Optional[ConfigRef]:
+        if annot is None:
+            return None
+        name = self._ctor_name(annot) if isinstance(
+            annot, (ast.Name, ast.Attribute)) else ''
+        if isinstance(annot, ast.Constant) \
+                and isinstance(annot.value, str):
+            name = annot.value
+        fields = self.config_classes.get(name)
+        if fields is None:
+            return None
+        return ConfigRef(name, dict(fields))
+
+    def _table(self, cls_key, method_name: str, inst: InstanceRef,
+               extra_args: int = 0):
+        interp = self._interp
+        key = (cls_key, method_name, id(inst))
+        if key in interp.tables:
+            return interp.tables[key]
+        meth = self._project.method(cls_key, method_name)
+        if meth is None:
+            interp.tables[key] = None
+            return None
+        n_params = len(meth.entry.node.args.args) - 1
+        args = [inst] + [TOP] * max(0, n_params)
+        try:
+            val = interp.call_function(meth, args, {},
+                                       meth.entry.node,
+                                       interp.module_scope(meth.ctx))
+        except _Bail:
+            val = None
+        interp.tables[key] = val
+        return val
+
+    def _seed_args(self, pf: ProjectFunction) -> List[Any]:
+        ctx = pf.ctx
+        fn_node = pf.entry.node
+        annots = self._annotations_for(ctx, fn_node)
+        is_method = isinstance(ctx.parents.get(fn_node), ast.ClassDef)
+        cls_key = (pf.module, pf.entry.class_name) \
+            if pf.entry.class_name else None
+        inst = None
+        args: List[Any] = []
+        spec = fn_node.args
+        params = list(getattr(spec, 'posonlyargs', [])) \
+            + list(spec.args)
+        start = 0
+        if is_method and cls_key is not None and params \
+                and params[0].arg in ('self', 'cls'):
+            inst = self._standalone_instance(cls_key)
+            args.append(inst)
+            start = 1
+        for p in params[start:]:
+            name = p.arg
+            if name in annots:
+                args.append(annots[name])
+                continue
+            cfg = self._config_from_annotation(p.annotation)
+            if cfg is not None:
+                args.append(cfg)
+                continue
+            val: Any = TOP
+            if inst is not None and cls_key is not None:
+                if name == 'params':
+                    model = inst.attrs.get('model')
+                    if isinstance(model, InstanceRef):
+                        val = self._table(model.cls_key, 'init',
+                                          model) or TOP
+                    elif self._project.method(cls_key, 'init') \
+                            is not None:
+                        val = self._table(cls_key, 'init', inst) or TOP
+                elif name == 'state':
+                    val = self._table(cls_key, 'init_state',
+                                      inst) or TOP
+                elif name == 'cache':
+                    val = self._table(cls_key, 'init_cache',
+                                      inst) or TOP
+            args.append(val)
+        return args
+
+    # -- finalize ------------------------------------------------------------
+    def check_file(self, ctx: FileContext):
+        return ()
+
+    def finalize(self, run) -> List[Finding]:
+        project = run.project
+        if project is None:
+            return []
+        self._project = project
+        self._build_tables(run.contexts)
+        interp = Interp(self, project, run.contexts)
+        self._interp = interp
+        roots, donate_sites = self._discover_roots(run.contexts)
+        for pf in roots.values():
+            self._run_root(pf)
+        self._model_entry_roots(run.contexts, roots)
+        self._check_donations(donate_sites)
+        self._check_allocators()
+        self._check_presets(run.contexts)
+        return self._findings
+
+    def _run_root(self, pf: ProjectFunction) -> None:
+        interp = self._interp
+        try:
+            seeded = self._seed_args(pf)
+        except _Bail:
+            return
+        interp.emit_on = True
+        try:
+            ret = interp.call_function(
+                pf, seeded, {}, pf.entry.node,
+                interp.module_scope(pf.ctx))
+            self.root_returns[id(pf.entry.node)] = (seeded, ret)
+        except _Bail:
+            pass
+        finally:
+            interp.emit_on = False
+
+    def _model_entry_roots(self, contexts, roots) -> None:
+        """Model classes (init + apply/decode_step) interpreted with
+        their own param tables and an unconstrained mesh, so the
+        sharded/sp>1 paths (ring attention, pipeline) are traversed."""
+        project = self._project
+        for cls_key, node in list(project.classes.items()):
+            has_init = project.method(cls_key, 'init') is not None
+            entry_names = [n for n in ('apply_with_aux', 'decode_step')
+                           if project.method(cls_key, n) is not None]
+            if not has_init or not entry_names:
+                continue
+            init = project.method(cls_key, '__init__')
+            cfg = None
+            if init is not None:
+                spec = init.entry.node.args
+                for p in spec.args[1:]:
+                    cfg = self._config_from_annotation(p.annotation)
+                    if cfg is not None:
+                        break
+            inst = InstanceRef(cls_key)
+            if cfg is not None:
+                inst.attrs['config'] = cfg
+            params = self._table(cls_key, 'init', inst)
+            interp = self._interp
+            for name in entry_names:
+                meth = project.method(cls_key, name)
+                if meth is None or id(meth.entry.node) in roots:
+                    continue
+                fn_args = meth.entry.node.args.args
+                args: List[Any] = [inst]
+                for p in fn_args[1:]:
+                    if p.arg == 'params':
+                        args.append(params or TOP)
+                    elif p.arg == 'cache':
+                        args.append(self._table(cls_key, 'init_cache',
+                                                inst) or TOP)
+                    else:
+                        args.append(TOP)
+                interp.emit_on = True
+                try:
+                    interp.call_function(meth, args, {},
+                                         meth.entry.node,
+                                         interp.module_scope(meth.ctx))
+                except _Bail:
+                    pass
+                finally:
+                    interp.emit_on = False
+
+    # -- donation check ------------------------------------------------------
+    def _check_donations(self, sites) -> None:
+        for ctx, node, pf, donate, kind in sites:
+            rec = self.root_returns.get(id(pf.entry.node))
+            if rec is None:
+                continue
+            args, ret = rec
+            is_method = isinstance(
+                pf.ctx.parents.get(pf.entry.node), ast.ClassDef)
+            # Call-site jit wraps the BOUND method (self already
+            # consumed: argnums start at the first real param), while a
+            # decorator jits the unbound function (argnums include
+            # self). Our args list always has self at 0 for methods.
+            offset = 1 if (is_method and kind == 'call') else 0
+            ret_leaves = self._leaves(ret)
+            if ret_leaves is None:
+                continue
+            pool: Dict[Tuple, int] = {}
+            for leaf in ret_leaves:
+                pool[leaf] = pool.get(leaf, 0) + 1
+            for idx in donate:
+                ai = idx + offset
+                if ai >= len(args):
+                    continue
+                donor_leaves = self._leaves(args[ai])
+                if donor_leaves is None:
+                    continue
+                for leaf in donor_leaves:
+                    if pool.get(leaf, 0) > 0:
+                        pool[leaf] -= 1
+                    else:
+                        dt, shape = leaf
+                        dims = ', '.join(str(d) for d in shape)
+                        self.add_finding(
+                            ctx, node,
+                            f'donate_argnums={idx} donates a '
+                            f'{dt}[{dims}] buffer into '
+                            f'{pf.entry.qualname} but no output '
+                            f'matches its shape and dtype — XLA '
+                            f'cannot alias the donation, it silently '
+                            f'copies')
+                        break
+
+    def _leaves(self, val) -> Optional[List[Tuple]]:
+        """Flatten to hashable (dtype, dims) leaves; None if any leaf
+        is unknown (skip the check — no false positives)."""
+        out: List[Tuple] = []
+        stack = [val]
+        while stack:
+            v = stack.pop()
+            if isinstance(v, ADict):
+                if not v.complete:
+                    return None
+                stack.extend(v.entries.values())
+            elif isinstance(v, ATuple):
+                stack.extend(v.items)
+            elif isinstance(v, InstanceRef):
+                if not v.attrs:
+                    return None
+                stack.extend(v.attrs.values())
+            elif isinstance(v, ConfigRef):
+                if not v.fields:
+                    return None
+                stack.extend(v.fields.values())
+            elif isinstance(v, AVal):
+                if v.shape is None or v.dtype is None \
+                        or any(not d.known for d in v.shape):
+                    return None
+                out.append((v.dtype,
+                            tuple(d.value for d in v.shape)))
+            else:
+                return None
+        return out
+
+    # -- allocator / pool consistency ----------------------------------------
+    def _check_allocators(self) -> None:
+        interp = self._interp
+        for cls_key, ctx, node, args, kwargs in interp.alloc_calls:
+            num = args[0] if args else TOP
+            block = args[1] if len(args) > 1 else TOP
+            reserved = kwargs.get(
+                'reserved', args[2] if len(args) > 2 else Sym(1))
+            if isinstance(reserved, Sym) and reserved.known \
+                    and reserved.value < 1:
+                self.add_finding(
+                    ctx, node,
+                    f'BlockAllocator(reserved={reserved.value}) '
+                    f'removes the null block: unassigned block-table '
+                    f'entries point at block 0 by convention, so '
+                    f'block 0 must stay reserved (reserved >= 1)')
+            if cls_key is None:
+                continue
+            state = self._state_for(cls_key)
+            if state is None:
+                continue
+            fields = state.attrs if isinstance(state, InstanceRef) \
+                else state.fields if isinstance(state, ConfigRef) \
+                else {}
+            k_pool = fields.get('k')
+            tables = fields.get('block_tables')
+            if not (isinstance(k_pool, AVal) and k_pool.shape
+                    and len(k_pool.shape) == 5
+                    and isinstance(tables, AVal) and tables.shape
+                    and len(tables.shape) == 2
+                    and tables.shape[1].known
+                    and tables.shape[1].value > 0):
+                continue
+            pool_blocks, pool_block = k_pool.shape[1], k_pool.shape[3]
+            for got, want, what in ((num, pool_blocks, 'block count'),
+                                    (block, pool_block, 'block size')):
+                if isinstance(got, Sym) and got.known and want.known \
+                        and got.value != want.value:
+                    self.add_finding(
+                        ctx, node,
+                        f'BlockAllocator {what} {got.value} does not '
+                        f'match the init_state KV pool '
+                        f'({k_pool.render()}: {what} '
+                        f'{want.value}) — block-table entries can '
+                        f'index out of the pool (or strand blocks)')
+
+    def _state_for(self, cls_key):
+        interp = self._interp
+        for (ck, mname, _iid), v in list(interp.tables.items()):
+            if ck == cls_key and mname == 'init_state':
+                return v
+        inst = interp.instances.get(cls_key)
+        if inst is not None:
+            return self._table(cls_key, 'init_state', inst)
+        return None
+
+    # -- per-preset param-table divisibility ---------------------------------
+    def _check_presets(self, contexts) -> None:
+        if not self.divisors or not self.rules_map:
+            return
+        project = self._project
+        interp = self._interp
+        for ctx in contexts:
+            presets = self._presets_in(ctx)
+            if not presets:
+                continue
+            model_classes = [
+                (ctx.module, node.name)
+                for node in ctx.tree.body
+                if isinstance(node, ast.ClassDef)
+                and project.method((ctx.module, node.name), 'init')
+                is not None
+                and project.method((ctx.module, node.name),
+                                   'logical_axes') is not None]
+            for cls_key in model_classes:
+                for pname, cfg, pnode in presets:
+                    inst = InstanceRef(cls_key, {'config': cfg})
+                    table = None
+                    axes = None
+                    try:
+                        init = project.method(cls_key, 'init')
+                        lax_m = project.method(cls_key, 'logical_axes')
+                        table = interp.call_function(
+                            init, [inst, TOP], {}, init.entry.node,
+                            interp.module_scope(init.ctx))
+                        axes = interp.call_function(
+                            lax_m, [inst], {}, lax_m.entry.node,
+                            interp.module_scope(lax_m.ctx))
+                    except _Bail:
+                        continue
+                    self._align(table, axes, ctx, pnode, pname, '')
+
+    def _presets_in(self, ctx):
+        out = []
+        for node in ctx.tree.body:
+            targets = []
+            value = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value:
+                targets, value = [node.target], node.value
+            if not any(isinstance(t, ast.Name) and t.id == 'PRESETS'
+                       for t in targets):
+                continue
+            if not isinstance(value, ast.Dict):
+                continue
+            for k, v in zip(value.keys, value.values):
+                if k is None or not isinstance(k, ast.Constant) \
+                        or not isinstance(v, ast.Call):
+                    continue
+                cname = self._ctor_name(v.func)
+                fields = self.config_classes.get(cname)
+                if fields is None:
+                    continue
+                cfg_fields = dict(fields)
+                for kw in v.keywords:
+                    if kw.arg is None:
+                        continue
+                    cfg_fields[kw.arg] = self._field_value(kw.value)
+                out.append((k.value, ConfigRef(cname, cfg_fields), v))
+        return out
+
+    def _align(self, table, axes, ctx, pnode, pname, path) -> None:
+        if isinstance(table, ADict) and isinstance(axes, ADict):
+            for key in table.entries:
+                if key in axes.entries:
+                    self._align(table.entries[key], axes.entries[key],
+                                ctx, pnode, pname,
+                                f'{path}.{key}' if path else key)
+            return
+        if not (isinstance(table, AVal) and isinstance(axes, ATuple)):
+            return
+        names: List[Optional[str]] = []
+        for item in axes.items:
+            if isinstance(item, AConst) \
+                    and isinstance(item.value, (str, type(None))):
+                names.append(item.value)
+            else:
+                names.append(None)
+        if table.shape is None:
+            return
+        if len(names) != len(table.shape):
+            self.add_finding(
+                ctx, pnode,
+                f'logical_axes declares {len(names)} axis name(s) for '
+                f'params[{path!r}] but init builds rank '
+                f'{len(table.shape)} ({table.render()}) in preset '
+                f'{pname!r} — the sharding annotation cannot apply')
+            return
+        for i, (axis_name, dim) in enumerate(zip(names, table.shape)):
+            if axis_name is None:
+                continue
+            self.check_divisibility(
+                ctx, pnode, axis_name, dim,
+                f'params[{path!r}] dim {i} in preset {pname!r}')

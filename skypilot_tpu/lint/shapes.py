@@ -1,0 +1,511 @@
+"""Symbolic shape & dtype abstract domain for skylint's ``shapecheck``.
+
+The domain is deliberately three-valued everywhere: a quantity is either
+*known* (a concrete int / dtype name, possibly carrying the symbolic
+expression it came from for messages), *unknown* (``TOP`` — the lattice
+top), or structurally absent. Every operation the abstract interpreter
+in ``checkers/shapecheck.py`` models degrades to TOP on anything it
+cannot prove, so a finding is only ever emitted from two *known*,
+*provably inconsistent* facts — no false positives by construction.
+
+Contents:
+
+- :class:`Sym` — an abstract integer (dim sizes, host ints): an optional
+  concrete value plus the source expression for messages. Arithmetic
+  (:func:`sym_binop`, :func:`sym_unary`) computes the value when both
+  sides are known and keeps a readable expr either way.
+- :class:`AVal` — an abstract array: optional shape tuple of ``Sym``
+  (None = unknown rank), optional canonical dtype name, and a ``weak``
+  flag for Python scalars (JAX weak types never force a promotion).
+- dtype lattice helpers — :func:`canon_dtype`, :func:`promote_dtypes`.
+  The one *flagged* promotion is mixing a strong half-precision float
+  (bf16/f16) with a strong f32/f64 operand: that is the silent 2x
+  HBM/bandwidth regression the bf16-hygiene check exists for.
+- structural ops — :func:`broadcast_shapes`, :func:`einsum_apply`,
+  :func:`reshape_apply`, :func:`concat_apply` — each returns the result
+  plus a list of :class:`Problem` records for provable inconsistencies.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class Top:
+    """Lattice top: 'no information'. A single shared instance."""
+
+    _instance: Optional['Top'] = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self):
+        return 'TOP'
+
+
+TOP = Top()
+
+
+# ---------------------------------------------------------------------------
+# Abstract integers (dims and host ints).
+# ---------------------------------------------------------------------------
+class Sym:
+    """Abstract integer: optional concrete value + source expression."""
+
+    __slots__ = ('value', 'expr')
+
+    def __init__(self, value: Optional[int] = None, expr: str = '?'):
+        self.value = value
+        self.expr = expr if value is None else str(value)
+
+    def __repr__(self):
+        return self.expr
+
+    @property
+    def known(self) -> bool:
+        return self.value is not None
+
+
+UNKNOWN_DIM = Sym(None, '?')
+
+
+def as_sym(x) -> Sym:
+    if isinstance(x, Sym):
+        return x
+    if isinstance(x, bool):
+        return Sym(int(x))
+    if isinstance(x, int):
+        return Sym(x)
+    return UNKNOWN_DIM
+
+
+def sym_binop(op: str, a: Sym, b: Sym) -> Sym:
+    expr = f'({a.expr}{op}{b.expr})'
+    if not (a.known and b.known):
+        return Sym(None, expr)
+    x, y = a.value, b.value
+    try:
+        if op == '+':
+            return Sym(x + y, expr)
+        if op == '-':
+            return Sym(x - y, expr)
+        if op == '*':
+            return Sym(x * y, expr)
+        if op == '//':
+            return Sym(x // y, expr)
+        if op == '%':
+            return Sym(x % y, expr)
+    except (ZeroDivisionError, OverflowError):
+        pass
+    return Sym(None, expr)
+
+
+def sym_neg(a: Sym) -> Sym:
+    if a.known:
+        return Sym(-a.value)
+    return Sym(None, f'(-{a.expr})')
+
+
+def dims_conflict(a: Sym, b: Sym) -> bool:
+    """Provably different — both concrete and unequal."""
+    return a.known and b.known and a.value != b.value
+
+
+def dims_join(a: Sym, b: Sym) -> Sym:
+    if a.known and b.known and a.value == b.value:
+        return a
+    return UNKNOWN_DIM
+
+
+# ---------------------------------------------------------------------------
+# Dtypes.
+# ---------------------------------------------------------------------------
+_CANON: Dict[str, str] = {
+    'float32': 'float32', 'float64': 'float64', 'float16': 'float16',
+    'bfloat16': 'bfloat16', 'float_': 'float64', 'double': 'float64',
+    'int32': 'int32', 'int64': 'int64', 'int16': 'int16', 'int8': 'int8',
+    'uint8': 'uint8', 'uint32': 'uint32', 'int_': 'int64',
+    'bool_': 'bool', 'bool': 'bool',
+    'int': 'int32', 'float': 'float32',
+}
+
+HALF_FLOATS = ('bfloat16', 'float16')
+WIDE_FLOATS = ('float32', 'float64')
+FLOATS = HALF_FLOATS + WIDE_FLOATS
+INTS = ('int8', 'int16', 'int32', 'int64', 'uint8', 'uint32')
+
+
+def canon_dtype(name: str) -> Optional[str]:
+    return _CANON.get(name)
+
+
+def _kind(dt: str) -> str:
+    if dt in FLOATS:
+        return 'f'
+    if dt in INTS:
+        return 'i'
+    return 'b'
+
+
+_FLOAT_ORDER = {'bfloat16': 1, 'float16': 1, 'float32': 2, 'float64': 3}
+_INT_ORDER = {'int8': 1, 'uint8': 1, 'int16': 2, 'int32': 3,
+              'uint32': 3, 'int64': 4}
+
+
+@dataclasses.dataclass
+class Mix:
+    """A provable half-float x wide-float operand mix."""
+    half: str
+    wide: str
+
+
+def promote_dtypes(operands: Sequence[Tuple[Optional[str], bool]]
+                   ) -> Tuple[Optional[str], Optional[Mix]]:
+    """JAX-style promotion over (dtype, weak) operand pairs.
+
+    Returns (result dtype or None when unknown, Mix when two *strong*
+    float operands straddle the half/wide boundary — the flagged case).
+    Weak Python scalars never influence the result dtype beyond kind.
+    """
+    strong = [dt for dt, weak in operands if dt is not None and not weak]
+    if any(dt is None for dt, weak in operands if not weak):
+        strong_known_all = False
+    else:
+        strong_known_all = True
+    mix = None
+    halfs = [d for d in strong if d in HALF_FLOATS]
+    wides = [d for d in strong if d in WIDE_FLOATS]
+    if halfs and wides:
+        mix = Mix(halfs[0], wides[0])
+    if not strong_known_all:
+        return None, mix
+    if not strong:
+        # all weak: result stays weak float/int
+        kinds = [dt for dt, _ in operands if dt is not None]
+        if any(k in FLOATS for k in kinds):
+            return 'float32', None
+        return 'int32', None
+    kinds = {_kind(d) for d in strong}
+    weak_kinds = {_kind(dt) for dt, weak in operands
+                  if weak and dt is not None}
+    if 'f' not in kinds and 'f' in weak_kinds:
+        # A weak Python float over int/bool strong operands promotes
+        # the result to float (f32 under the x64-disabled default).
+        return 'float32', mix
+    if 'f' in kinds:
+        floats = [d for d in strong if d in FLOATS]
+        best = max(floats, key=lambda d: _FLOAT_ORDER[d])
+        if mix is not None:
+            best = max(wides, key=lambda d: _FLOAT_ORDER[d])
+        return best, mix
+    if 'i' in kinds:
+        ints = [d for d in strong if d in INTS]
+        return max(ints, key=lambda d: _INT_ORDER[d]), mix
+    return 'bool', mix
+
+
+# ---------------------------------------------------------------------------
+# Abstract arrays.
+# ---------------------------------------------------------------------------
+class AVal:
+    """Abstract array value.
+
+    ``shape`` None means unknown rank; a tuple may still contain
+    ``UNKNOWN_DIM`` entries (known rank, unknown dims). ``dtype`` None
+    means unknown. ``weak`` marks Python-scalar weak types.
+    """
+
+    __slots__ = ('shape', 'dtype', 'weak')
+
+    def __init__(self, shape: Optional[Tuple[Sym, ...]] = None,
+                 dtype: Optional[str] = None, weak: bool = False):
+        self.shape = tuple(as_sym(d) for d in shape) \
+            if shape is not None else None
+        self.dtype = dtype
+        self.weak = weak
+
+    @property
+    def rank(self) -> Optional[int]:
+        return None if self.shape is None else len(self.shape)
+
+    def with_dtype(self, dtype: Optional[str],
+                   weak: bool = False) -> 'AVal':
+        return AVal(self.shape, dtype, weak)
+
+    def with_shape(self, shape) -> 'AVal':
+        return AVal(shape, self.dtype, self.weak)
+
+    def render(self) -> str:
+        dt = self.dtype or '?'
+        if self.shape is None:
+            return f'{dt}[...]'
+        return f'{dt}[{", ".join(d.expr for d in self.shape)}]'
+
+    def __repr__(self):
+        return self.render()
+
+
+def scalar(dtype: Optional[str], weak: bool = False) -> AVal:
+    return AVal((), dtype, weak)
+
+
+# ---------------------------------------------------------------------------
+# Problems: provable inconsistencies, formatted by the checker.
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class Problem:
+    kind: str       # 'dim', 'rank', 'reshape', 'dtype', 'operands'
+    message: str
+    node: Optional[ast.AST] = None
+
+
+# ---------------------------------------------------------------------------
+# Structural ops.
+# ---------------------------------------------------------------------------
+def broadcast_shapes(shapes: Sequence[Optional[Tuple[Sym, ...]]],
+                     problems: List[Problem],
+                     what: str = 'operands'
+                     ) -> Optional[Tuple[Sym, ...]]:
+    """NumPy broadcasting over known shapes; None in, None out.
+
+    A pair of known dims that are unequal and both != 1 is a provable
+    broadcast failure. An unknown dim aligned with a known dim > 1
+    yields that known dim (the unknown one must be 1 or equal).
+    """
+    known = [s for s in shapes if s is not None]
+    if len(known) != len(shapes) or not known:
+        return None
+    rank = max(len(s) for s in known)
+    out: List[Sym] = []
+    for i in range(1, rank + 1):
+        dims = [s[-i] for s in known if len(s) >= i]
+        result = Sym(1)
+        for d in dims:
+            if d.known and d.value == 1:
+                continue
+            if not d.known:
+                if result.known and result.value == 1:
+                    result = UNKNOWN_DIM
+                continue
+            if result.known and result.value == 1:
+                result = d
+            elif not result.known:
+                result = d
+            elif result.value != d.value:
+                problems.append(Problem(
+                    'dim',
+                    f'{what} cannot broadcast: dim {result.expr} vs '
+                    f'{d.expr} at axis -{i}'))
+                return None
+        out.insert(0, result)
+    return tuple(out)
+
+
+def einsum_apply(spec: str, operands: Sequence[AVal],
+                 preferred: Optional[str],
+                 problems: List[Problem]) -> AVal:
+    """Parse an einsum spec, unify operand dims, build the output.
+
+    Checks: operand count vs spec, operand rank vs its subscript
+    (ellipsis-aware), per-letter dim unification across operands and
+    within one operand. Dtypes go through :func:`promote_dtypes` with
+    the half/wide mix reported as a 'dtype' problem.
+    """
+    spec = spec.replace(' ', '')
+    if '->' in spec:
+        lhs, out_spec = spec.split('->', 1)
+    else:
+        lhs, out_spec = spec, None
+    in_specs = lhs.split(',')
+    if len(in_specs) != len(operands):
+        problems.append(Problem(
+            'operands',
+            f'einsum spec {spec!r} names {len(in_specs)} operand(s) '
+            f'but the call passes {len(operands)}'))
+        return AVal(None, None)
+    bindings: Dict[str, Sym] = {}
+    batch_dims: Optional[Tuple[Sym, ...]] = ()
+    for idx, (sub, op) in enumerate(zip(in_specs, operands)):
+        if op.shape is None:
+            if '...' in sub:
+                batch_dims = None
+            continue
+        shape = op.shape
+        if '...' in sub:
+            letters = sub.replace('...', '')
+            if len(shape) < len(letters):
+                problems.append(Problem(
+                    'rank',
+                    f'einsum operand {idx} is rank {len(shape)} but '
+                    f'subscript {sub!r} needs at least {len(letters)} '
+                    f'dims'))
+                continue
+            n_batch = len(shape) - len(letters)
+            if batch_dims is not None:
+                if len(batch_dims) < n_batch:
+                    batch_dims = shape[:n_batch]
+            dims = shape[n_batch:]
+        else:
+            letters = sub
+            if len(shape) != len(letters):
+                problems.append(Problem(
+                    'rank',
+                    f'einsum operand {idx} is {op.render()} (rank '
+                    f'{len(shape)}) but subscript {sub!r} has '
+                    f'{len(letters)} index(es)'))
+                continue
+            dims = shape
+        for letter, dim in zip(letters, dims):
+            prev = bindings.get(letter)
+            if prev is None:
+                bindings[letter] = dim
+            elif dims_conflict(prev, dim):
+                problems.append(Problem(
+                    'dim',
+                    f'einsum index {letter!r} binds dim {prev.expr} '
+                    f'and dim {dim.expr} of operand {idx} '
+                    f'({op.render()}) in spec {spec!r}'))
+            elif not prev.known and dim.known:
+                bindings[letter] = dim
+    # dtype
+    dtypes = [(op.dtype, op.weak) for op in operands]
+    result_dt, mix = promote_dtypes(dtypes)
+    if mix is not None and preferred is None:
+        # An explicit preferred_element_type is the sanctioned way to
+        # say "accumulate wide on purpose" — only the IMPLICIT mix is
+        # the hazard this check exists for.
+        problems.append(Problem(
+            'dtype',
+            f'einsum mixes strong {mix.half} and {mix.wide} operands: '
+            f'the {mix.half} side is silently promoted'))
+    if preferred is not None:
+        result_dt = preferred
+    if out_spec is None:
+        return AVal(None, result_dt)
+    out_dims: List[Sym] = []
+    out_shape: Optional[Tuple[Sym, ...]]
+    if '...' in out_spec:
+        if batch_dims is None:
+            out_shape = None
+        else:
+            letters = out_spec.replace('...', '')
+            out_shape = tuple(batch_dims) + tuple(
+                bindings.get(c, UNKNOWN_DIM) for c in letters)
+    else:
+        for c in out_spec:
+            out_dims.append(bindings.get(c, UNKNOWN_DIM))
+        out_shape = tuple(out_dims)
+    return AVal(out_shape, result_dt)
+
+
+def shape_numel(shape: Tuple[Sym, ...]) -> Optional[int]:
+    total = 1
+    for d in shape:
+        if not d.known:
+            return None
+        total *= d.value
+    return total
+
+
+def reshape_apply(x: AVal, target: List[Sym],
+                  problems: List[Problem]) -> AVal:
+    """x.reshape(target) with -1 inference and element-count check."""
+    neg = [i for i, d in enumerate(target) if d.known and d.value == -1]
+    src_n = shape_numel(x.shape) if x.shape is not None else None
+    if len(neg) > 1:
+        return AVal(tuple(UNKNOWN_DIM for _ in target), x.dtype)
+    if neg:
+        rest = 1
+        known_rest = True
+        for i, d in enumerate(target):
+            if i == neg[0]:
+                continue
+            if not d.known:
+                known_rest = False
+                break
+            rest *= d.value
+        if src_n is not None and known_rest and rest > 0:
+            if src_n % rest:
+                problems.append(Problem(
+                    'reshape',
+                    f'reshape of {x.render()} ({src_n} elements) to '
+                    f'[{", ".join(d.expr for d in target)}]: {src_n} '
+                    f'is not divisible by the known dims ({rest})'))
+                target = [d if i != neg[0] else UNKNOWN_DIM
+                          for i, d in enumerate(target)]
+            else:
+                target = [d if i != neg[0] else Sym(src_n // rest)
+                          for i, d in enumerate(target)]
+        else:
+            target = [d if i != neg[0] else UNKNOWN_DIM
+                      for i, d in enumerate(target)]
+        return AVal(tuple(target), x.dtype)
+    dst_n = shape_numel(tuple(target))
+    if src_n is not None and dst_n is not None and src_n != dst_n:
+        problems.append(Problem(
+            'reshape',
+            f'reshape of {x.render()} ({src_n} elements) to '
+            f'[{", ".join(d.expr for d in target)}] ({dst_n} '
+            f'elements) changes the element count'))
+    return AVal(tuple(target), x.dtype)
+
+
+def concat_apply(parts: Sequence[AVal], axis: int,
+                 problems: List[Problem]) -> AVal:
+    """jnp.concatenate along ``axis`` with non-axis dim unification."""
+    known = [p for p in parts if p.shape is not None]
+    dt, mix = promote_dtypes([(p.dtype, p.weak) for p in parts])
+    if mix is not None:
+        problems.append(Problem(
+            'dtype',
+            f'concatenate mixes strong {mix.half} and {mix.wide} '
+            f'operands: the {mix.half} side is silently promoted'))
+    if len(known) != len(parts) or not known:
+        return AVal(None, dt)
+    rank = len(known[0].shape)
+    if any(len(p.shape) != rank for p in known):
+        problems.append(Problem(
+            'rank',
+            'concatenate operands have different ranks: '
+            + ', '.join(p.render() for p in known)))
+        return AVal(None, dt)
+    ax = axis % rank if -rank <= axis < rank else axis
+    out: List[Sym] = []
+    for i in range(rank):
+        if i == ax:
+            total = Sym(0)
+            for p in known:
+                total = sym_binop('+', total, p.shape[i])
+            out.append(total)
+            continue
+        dim = known[0].shape[i]
+        for p in known[1:]:
+            if dims_conflict(dim, p.shape[i]):
+                problems.append(Problem(
+                    'dim',
+                    f'concatenate along axis {axis}: non-axis dim '
+                    f'{dim.expr} vs {p.shape[i].expr} at axis {i}'))
+            dim = dims_join(dim, p.shape[i])
+        out.append(dim)
+    return AVal(tuple(out), dt)
+
+
+def join_values(a, b):
+    """Lattice join for interpreter values (AVal/Sym/other -> TOP)."""
+    if a is b:
+        return a
+    if isinstance(a, AVal) and isinstance(b, AVal):
+        if a.shape is not None and b.shape is not None \
+                and len(a.shape) == len(b.shape):
+            shape = tuple(dims_join(x, y)
+                          for x, y in zip(a.shape, b.shape))
+        else:
+            shape = None
+        dtype = a.dtype if a.dtype == b.dtype else None
+        return AVal(shape, dtype, a.weak and b.weak)
+    if isinstance(a, Sym) and isinstance(b, Sym):
+        return dims_join(a, b)
+    return TOP

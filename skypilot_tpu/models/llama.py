@@ -29,7 +29,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 from skypilot_tpu.ops import attention as attention_ops
 from skypilot_tpu.ops.layers import apply_rotary, precompute_rotary, rms_norm
 from skypilot_tpu.parallel.ring_attention import ring_attention
-from skypilot_tpu.parallel.sharding import DEFAULT_RULES, LogicalRules
+from skypilot_tpu.parallel.sharding import (DEFAULT_RULES, LogicalRules,
+                                            shard_map)
 
 Params = Dict[str, Any]
 
@@ -219,7 +220,7 @@ class LlamaModel:
             k, v = attention_ops._maybe_repeat_kv(q, k, v)
             rules = self.rules
             qkv_spec = rules.spec('batch', 'seq', 'act_heads', None)
-            fn = jax.shard_map(
+            fn = shard_map(
                 functools.partial(ring_attention,
                                   axis_name='sp', causal=True),
                 mesh=self.mesh,

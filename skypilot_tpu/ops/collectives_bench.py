@@ -36,6 +36,8 @@ def run_bench(sizes_mb: Optional[List[float]] = None, axis_size: int = 0,
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+    from skypilot_tpu.parallel.sharding import shard_map
+
     sizes_mb = sizes_mb or [1.0, 16.0, 128.0]
     devices = jax.devices()
     n = axis_size or len(devices)
@@ -45,7 +47,7 @@ def run_bench(sizes_mb: Optional[List[float]] = None, axis_size: int = 0,
                        in_shardings=NamedSharding(mesh, P('x')),
                        out_shardings=NamedSharding(mesh, P('x')))
     def allreduce(x):
-        return jax.shard_map(lambda s: jax.lax.psum(s, 'x'), mesh=mesh,
+        return shard_map(lambda s: jax.lax.psum(s, 'x'), mesh=mesh,
                              in_specs=P('x'), out_specs=P('x'))(x)
 
     records = []

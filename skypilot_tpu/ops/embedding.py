@@ -35,7 +35,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh
 
-from skypilot_tpu.parallel.sharding import LogicalRules
+from skypilot_tpu.parallel.sharding import (LogicalRules,
+                                            shard_map)
 
 
 def _axes_tuple(rules: LogicalRules, logical: str) -> Tuple[str, ...]:
@@ -108,6 +109,6 @@ def embed_lookup(table: jax.Array, tokens: jax.Array,
     # check_vma=False: the psum's AD transpose trips the varying-mesh-axes
     # checker (residuals are replicated over more axes than the checker
     # infers); the specs above fully pin the data layout regardless.
-    return jax.shard_map(local, mesh=mesh, in_specs=(tbl_spec, tok_spec),
+    return shard_map(local, mesh=mesh, in_specs=(tbl_spec, tok_spec),
                          out_specs=out_spec,
                          check_vma=False)(table, tokens)

@@ -22,6 +22,18 @@ import numpy as np
 
 MESH_AXES: Tuple[str, ...] = ('dcn', 'pp', 'dp', 'fsdp', 'ep', 'sp', 'tp')
 
+# Static divisibility contract, enforced at lint time by skylint's
+# ``shapecheck`` checker: any array dim that a ``LogicalRules`` table maps
+# onto one of these mesh axes must be statically divisible by the listed
+# factor — the *minimum nontrivial width* of that axis (every real mesh
+# sizes an axis at 1 or a multiple of 2, so e.g. an odd head count can
+# never shard evenly over tp). Axes absent here (dp, pp, dcn) carry no
+# static dim constraint: they shard runtime batch/layer dims whose sizes
+# the configs don't fix. The tensor-parallel serving PR bumps ``tp`` to
+# its deployed width to gate the engine's shapes against the real mesh.
+MESH_AXIS_DIVISORS: Dict[str, int] = {'tp': 2, 'sp': 2, 'ep': 2,
+                                      'fsdp': 2}
+
 
 @dataclasses.dataclass(frozen=True)
 class MeshSpec:

@@ -31,6 +31,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from skypilot_tpu.parallel.sharding import shard_map
+
 
 def split_stages(params: Any, num_stages: int) -> Any:
     """Reshape layer-stacked leaves [L, ...] -> stage-major [S, L/S, ...]."""
@@ -118,7 +120,7 @@ def pipeline(stage_fn: Callable[..., Any],
     # check_vma=False: stage_fn is arbitrary user/layer code whose internal
     # scans create fresh (non-pp-varying) carries; strict varying-manual-axes
     # typing would force pcast plumbing through every op it calls.
-    f = jax.shard_map(
+    f = shard_map(
         body,
         mesh=mesh,
         in_specs=(P(axis_name), P()) + tuple(P() for _ in range(n_b)),

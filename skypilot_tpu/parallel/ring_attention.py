@@ -32,7 +32,10 @@ def _block_attend(q, k, v, m, l, o, q_offset, kv_offset, causal, scale):
     q: [B, Sq, H, D]; k/v: [B, Sk, H, D]; m/l: [B, H, Sq]; o: [B, Sq, H, D].
     Offsets are the blocks' global sequence positions (for causal masking).
     """
-    s = jnp.einsum('bqhd,bkhd->bhqk', q, k) * scale
+    # f32 MXU accumulation with bf16 operands: scores join the f32 m/l/o
+    # accumulators explicitly (skylint shapecheck flags the implicit mix).
+    s = jnp.einsum('bqhd,bkhd->bhqk', q, k,
+                   preferred_element_type=jnp.float32) * scale
     if causal:
         q_pos = q_offset + lax.iota(jnp.int32, q.shape[1])
         kv_pos = kv_offset + lax.iota(jnp.int32, k.shape[1])
@@ -43,7 +46,10 @@ def _block_attend(q, k, v, m, l, o, q_offset, kv_offset, causal, scale):
     p = jnp.exp(s - m_new[..., None])
     correction = jnp.exp(m - m_new)
     l_new = l * correction + p.sum(axis=-1)
-    pv = jnp.einsum('bhqk,bkhd->bqhd', p, v)
+    # P cast back to the KV dtype for the PV matmul (flash-kernel idiom:
+    # bf16 operands, f32 accumulate) instead of promoting v to f32.
+    pv = jnp.einsum('bhqk,bkhd->bqhd', p.astype(v.dtype), v,
+                    preferred_element_type=jnp.float32)
     o_new = o * correction.transpose(0, 2, 1)[..., None] + pv
     return m_new, l_new, o_new
 
@@ -68,7 +74,11 @@ def ring_attention(q: jax.Array,
     """
     if scale is None:
         scale = q.shape[-1]**-0.5
-    n = lax.axis_size(axis_name)
+    # Static axis size: lax.axis_size only exists on newer jax; psum of
+    # a Python 1 folds to a concrete int under shard_map on every
+    # version this runs on (scan length / permutation need it static).
+    n = (lax.axis_size(axis_name) if hasattr(lax, 'axis_size')
+         else lax.psum(1, axis_name))
     my_idx = lax.axis_index(axis_name)
     b, s_local, h, _ = q.shape
     q_offset = my_idx * s_local

@@ -16,6 +16,11 @@ from typing import Dict, Optional, Sequence, Tuple, Union
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # jax >= 0.6 exposes shard_map at the top level
+    from jax import shard_map
+except ImportError:  # 0.4.x: the experimental location
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
 AxisVal = Union[None, str, Tuple[str, ...]]
 
 

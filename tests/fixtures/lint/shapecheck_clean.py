@@ -1,0 +1,40 @@
+"""shapecheck clean counterpart: the same shapes done right — unified
+einsum dims, count-preserving reshape, explicit promotion, matching
+broadcast, a donation whose output aliases."""
+import jax
+import jax.numpy as jnp
+
+
+def _good_einsum():
+    a = jnp.zeros((4, 8), jnp.float32)
+    b = jnp.zeros((8, 32), jnp.float32)
+    return jnp.einsum('ij,jk->ik', a, b)
+
+
+def _good_reshape():
+    x = jnp.zeros((4, 6), jnp.float32)
+    return x.reshape(2, 12)
+
+
+def _explicit_promote():
+    acc = jnp.zeros((8,), jnp.float32)
+    x = jnp.zeros((8,), jnp.bfloat16)
+    return acc + x.astype(jnp.float32)
+
+
+def _good_broadcast():
+    a = jnp.zeros((4, 8), jnp.float32)
+    b = jnp.zeros((1, 8), jnp.float32)
+    return a * b
+
+
+# shapecheck: buf = f32[64]
+def _donate_hit(buf):
+    return buf * 2.0
+
+
+step1 = jax.jit(_good_einsum)
+step2 = jax.jit(_good_reshape)
+step3 = jax.jit(_explicit_promote)
+step4 = jax.jit(_good_broadcast)
+step5 = jax.jit(_donate_hit, donate_argnums=(0,))

@@ -1,0 +1,102 @@
+"""shapecheck model-level fixture: a preset dim not divisible by its
+mesh-axis divisor, a logical_axes rank mismatch, a BlockAllocator sized
+differently from the init_state pool, and a reserved=0 null-block
+violation."""
+import dataclasses
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+MESH_AXES: Tuple[str, ...] = ('dp', 'tp')
+MESH_AXIS_DIVISORS: Dict[str, int] = {'tp': 2}
+
+
+class LogicalRules:
+
+    def __init__(self, rules):
+        self.rules = dict(rules)
+
+
+RULES = LogicalRules({'embed': None, 'mlp': 'tp'})
+
+
+@dataclasses.dataclass(frozen=True)
+class TinyConfig:
+    embed: int = 16
+    mlp: int = 33
+    layers_n: int = 2
+
+
+PRESETS: Dict[str, TinyConfig] = {
+    'tiny': TinyConfig(),
+}
+
+
+def logical_axes(config):
+    return {
+        'w_up': ('embed', 'mlp'),
+        'norm': ('embed', 'mlp'),
+    }
+
+
+class TinyModel:
+
+    def __init__(self, config: TinyConfig):
+        self.config = config
+
+    def logical_axes(self):
+        return logical_axes(self.config)
+
+    def init(self, rng):
+        c = self.config
+        return {
+            'w_up': jnp.zeros((c.embed, c.mlp), jnp.float32),
+            'norm': jnp.zeros((c.embed,), jnp.float32),
+        }
+
+
+class BlockAllocator:
+
+    def __init__(self, num_blocks, block_size, reserved=1):
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.reserved = reserved
+
+
+@dataclasses.dataclass
+class State:
+    k: jax.Array
+    block_tables: jax.Array
+
+
+class EngineBadPool:
+    """Allocator says 10 blocks; the state pool holds 12."""
+
+    def __init__(self, config: TinyConfig):
+        self.config = config
+        self.pool = BlockAllocator(10, 16)
+        self._step = jax.jit(self._step_impl)
+
+    def init_state(self):
+        return State(k=jnp.zeros((2, 12, 1, 16, 4), jnp.float32),
+                     block_tables=jnp.zeros((2, 3), jnp.int32))
+
+    def _step_impl(self, state):
+        return state
+
+
+class EngineNoNull:
+    """reserved=0 removes the null block the tables rely on."""
+
+    def __init__(self, config: TinyConfig):
+        self.config = config
+        self.pool = BlockAllocator(12, 16, reserved=0)
+        self._step = jax.jit(self._step_impl)
+
+    def init_state(self):
+        return State(k=jnp.zeros((2, 12, 1, 16, 4), jnp.float32),
+                     block_tables=jnp.zeros((2, 3), jnp.int32))
+
+    def _step_impl(self, state):
+        return state

@@ -1,0 +1,49 @@
+"""shapecheck fixture: an einsum letter conflict, a reshape element-count
+mismatch, an implicit bf16 x f32 promotion, a broadcast conflict, and a
+donation that can never alias — plus one suppressed finding."""
+import jax
+import jax.numpy as jnp
+
+
+def _bad_einsum():
+    a = jnp.zeros((4, 8), jnp.float32)
+    b = jnp.zeros((16, 32), jnp.float32)
+    return jnp.einsum('ij,jk->ik', a, b)
+
+
+def _bad_reshape():
+    x = jnp.zeros((4, 6), jnp.float32)
+    return x.reshape(5, 5)
+
+
+def _promotes():
+    acc = jnp.zeros((8,), jnp.float32)
+    x = jnp.zeros((8,), jnp.bfloat16)
+    return acc + x
+
+
+def _bad_broadcast():
+    a = jnp.zeros((4, 8), jnp.float32)
+    b = jnp.zeros((3, 8), jnp.float32)
+    return a * b
+
+
+def _suppressed():
+    a = jnp.zeros((2, 2), jnp.float32)
+    b = jnp.zeros((2, 2), jnp.bfloat16)
+    # Deliberate mixed accumulate, pinned by an equivalence test.
+    return a + b  # skylint: disable=shapecheck
+
+
+# shapecheck: buf = i32[64]
+def _donate_miss(buf):
+    del buf
+    return jnp.zeros((64,), jnp.float32)
+
+
+step1 = jax.jit(_bad_einsum)
+step2 = jax.jit(_bad_reshape)
+step3 = jax.jit(_promotes)
+step4 = jax.jit(_bad_broadcast)
+step5 = jax.jit(_suppressed)
+step6 = jax.jit(_donate_miss, donate_argnums=(0,))

@@ -13,6 +13,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from skypilot_tpu.models import LlamaModel, PRESETS
 import skypilot_tpu.ops.attention as attn
 from skypilot_tpu.parallel import MeshSpec, make_mesh, ring_attention
+from skypilot_tpu.parallel.sharding import shard_map
 from skypilot_tpu.train import Trainer
 
 pytestmark = pytest.mark.compute
@@ -69,7 +70,7 @@ class TestRingAttention:
         q, k, v = _qkv(jax.random.key(4), b=2, s=64, h=4, d=16)
         ref = attn.mha_reference(q, k, v, causal=True)
         spec = P(('dp', 'fsdp'), 'sp', 'tp', None)
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(shard_map(
             lambda q, k, v: ring_attention(q, k, v, axis_name='sp'),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))
         out = fn(q, k, v)
@@ -82,7 +83,7 @@ class TestRingAttention:
         spec = P(('dp', 'fsdp'), 'sp', 'tp', None)
 
         def loss(q, k, v):
-            out = jax.shard_map(
+            out = shard_map(
                 lambda q, k, v: ring_attention(q, k, v, axis_name='sp'),
                 mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             )(q, k, v)

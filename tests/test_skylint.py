@@ -92,7 +92,7 @@ class TestTreeClean:
         for name in ('lock-discipline', 'jax-host-sync',
                      'blocking-hot-path', 'env-contract', 'metric-name',
                      'lock-order', 'sharding-consistency',
-                     'silent-except'):
+                     'silent-except', 'shapecheck'):
             assert name in proc.stdout
 
     def test_check_metric_names_shim_delegates(self, tmp_path):
@@ -741,3 +741,303 @@ class TestLockFixRegressions:
         stats = sched.stats()
         assert stats['requests'] == total
         assert stats['tokens_out'] == total
+
+
+# ---- shapecheck: fixtures ---------------------------------------------------
+class TestShapecheck:
+
+    def test_flags_each_violation_at_exact_lines(self):
+        run = lint_fixture('shapecheck_violation.py', 'shapecheck')
+        assert finding_lines(run) == [11, 16, 22, 28, 49]
+        by_line = {f.line: f.message for f in run.findings}
+        assert "einsum index 'j' binds dim 8 and dim 16" in by_line[11]
+        assert 'changes the element count' in by_line[16]
+        assert 'silently promoted to float32' in by_line[22]
+        assert 'cannot broadcast: dim 4 vs 3' in by_line[28]
+        assert 'no output matches its shape and dtype' in by_line[49]
+
+    def test_suppression_comment_works(self):
+        run = lint_fixture('shapecheck_violation.py', 'shapecheck')
+        assert sorted(f.line for f in run.suppressed) == [35]
+
+    def test_clean_counterpart_passes(self):
+        run = lint_fixture('shapecheck_clean.py', 'shapecheck')
+        assert run.findings == []
+
+    def test_model_fixture_divisibility_rank_and_pool(self):
+        """Preset divisibility vs MESH_AXIS_DIVISORS, logical_axes rank
+        drift, allocator-vs-pool mismatch, and the reserved null
+        block — the paged-KV / tensor-parallel contracts."""
+        run = lint_fixture('shapecheck_model_violation.py', 'shapecheck')
+        msgs = sorted(f.message for f in run.findings)
+        assert len(msgs) == 4, msgs
+        assert any('not divisible by 2 (MESH_AXIS_DIVISORS)' in m
+                   for m in msgs)
+        assert any('declares 2 axis name(s)' in m
+                   and 'rank 1' in m for m in msgs)
+        assert any('block count 10 does not match' in m for m in msgs)
+        assert any('reserved=0' in m for m in msgs)
+
+    def test_model_clean_counterpart_passes(self):
+        run = lint_fixture('shapecheck_model_clean.py', 'shapecheck')
+        assert run.findings == []
+
+    def test_annotation_attaches_only_through_contiguous_comments(
+            self, tmp_path):
+        """A '# shapecheck:' comment buried in the previous function's
+        body must NOT seed the next def's parameter — fabricated facts
+        would break no-false-positives-by-construction."""
+        p = tmp_path / 'annot_scope.py'
+        p.write_text(
+            'import jax\n'
+            'import jax.numpy as jnp\n\n\n'
+            'def _other():\n'
+            '    x = jnp.zeros((2,), jnp.float32)\n'
+            '    # shapecheck: buf = i32[64]\n'
+            '    return x\n\n\n'
+            'def _donate(buf):\n'
+            '    del buf\n'
+            '    return jnp.zeros((64,), jnp.float32)\n\n\n'
+            'step = jax.jit(_donate, donate_argnums=(0,))\n')
+        run = core.LintRun([str(p)], checks=['shapecheck'])
+        run.run()
+        # buf stays unknown -> the donation check must stay silent.
+        assert run.findings == []
+
+
+# ---- shapecheck: whole-tree interpretation coverage -------------------------
+class TestShapecheckTree:
+
+    def test_traced_interpretation_spans_the_engine_modules(
+            self, tree_run):
+        """The interpreter must actually walk the cross-module jit
+        closure — decode engine roots through llama block math into
+        ops/attention, and the model-entry seeds into ring attention
+        and the MoE layer. Otherwise the gate silently shrinks to
+        single-file scope."""
+        run, _ = tree_run
+        ck = next(c for c in run.checkers if c.name == 'shapecheck')
+        needed = {
+            'skypilot_tpu.models.decode:DecodeEngine._step_impl',
+            'skypilot_tpu.models.decode:DecodeEngine._prefill_impl',
+            'skypilot_tpu.models.llama:LlamaModel._qkv',
+            'skypilot_tpu.models.llama:LlamaModel._attend',
+            'skypilot_tpu.ops.attention:mha_reference',
+            'skypilot_tpu.ops.moe:moe_ffn',
+            'skypilot_tpu.parallel.ring_attention:ring_attention',
+            'skypilot_tpu.parallel.ring_attention:_block_attend',
+        }
+        missing = needed - ck.interpreted
+        assert not missing, f'shapecheck no longer reaches: {missing}'
+
+    def test_engine_state_table_is_seeded_from_env_registry(
+            self, tree_run):
+        """DecodeEngine's interpreted pool shape must reflect the
+        SKYTPU_KV_BLOCK registry default — the symbolic-dim seeding
+        contract (env_vars -> __init__ -> init_state)."""
+        run, _ = tree_run
+        ck = next(c for c in run.checkers if c.name == 'shapecheck')
+        state = ck._state_for(('skypilot_tpu.models.decode',
+                               'DecodeEngine'))
+        fields = getattr(state, 'fields', None) \
+            or getattr(state, 'attrs', None)
+        assert fields is not None
+        k = fields['k']
+        # [L, NB, kvh, SKYTPU_KV_BLOCK, d] at LlamaConfig defaults.
+        dims = [d.value for d in k.shape]
+        assert dims[3] == int(ck.env_defaults['SKYTPU_KV_BLOCK'])
+        assert dims[0] == 32 and dims[2] == 8 and dims[4] == 128
+
+
+# ---- shapecheck: seeded shape bugs must fail tier-1 -------------------------
+def _seeded_tree(tmp_path, patch_file, old, new):
+    """Copy the whole package (package layout preserved so the
+    ProjectIndex resolves cross-module), apply one seeded bug, lint."""
+    import shutil
+    dst = tmp_path / 'skypilot_tpu'
+    shutil.copytree(os.path.join(REPO_ROOT, 'skypilot_tpu'), dst,
+                    ignore=shutil.ignore_patterns('__pycache__'))
+    p = dst / patch_file
+    source = p.read_text()
+    assert old in source, f'seed anchor missing in {patch_file}'
+    p.write_text(source.replace(old, new, 1))
+    run = core.LintRun([str(dst)], checks=['shapecheck'])
+    run.run()
+    return run
+
+
+class TestShapecheckSeededBugs:
+
+    def test_transposed_einsum_spec_in_llama_fails(self, tmp_path):
+        """Transposing the QKV projection spec must be caught both at
+        the einsum (letter binds two known dims) and downstream in the
+        decode step (reshape element count) — proof the shapes really
+        flow decode.py -> llama.py."""
+        run = _seeded_tree(
+            tmp_path, 'models/llama.py',
+            "q = jnp.einsum('bse,ehd->bshd', h, lp['wq'])",
+            "q = jnp.einsum('bse,hed->bshd', h, lp['wq'])")
+        msgs = [f.message for f in run.findings]
+        assert any("einsum index 'e' binds dim 4096 and dim 32" in m
+                   for m in msgs), msgs
+        assert any('changes the element count' in m
+                   and 'decode' in f.path
+                   for f, m in zip(run.findings, msgs)), msgs
+
+    def test_dtype_promoting_accumulate_in_decode_fails(self, tmp_path):
+        """Dropping the attn astype silently promotes the residual
+        stream to f32 inside the hot decode step — bf16 hygiene."""
+        run = _seeded_tree(
+            tmp_path, 'models/decode.py',
+            'attn = attn.reshape(b, 1, c.num_heads, '
+            'c.head_dim).astype(c.dtype)',
+            'attn = attn.reshape(b, 1, c.num_heads, c.head_dim)')
+        assert any('mixes strong bfloat16 and float32' in f.message
+                   and f.path.endswith('models/decode.py')
+                   for f in run.findings), \
+            [f.render() for f in run.findings]
+
+    def test_tp_indivisible_dim_in_preset_fails(self, tmp_path):
+        """An mlp dim no tp-width can divide must fail against the
+        MESH_AXIS_DIVISORS contract — the tensor-parallel gate."""
+        run = _seeded_tree(tmp_path, 'models/llama.py',
+                           'mlp_dim=128,', 'mlp_dim=129,')
+        hits = [f for f in run.findings
+                if 'not divisible by 2 (MESH_AXIS_DIVISORS)' in
+                f.message]
+        assert hits, [f.render() for f in run.findings]
+        assert any("'mlp'" in f.message and "preset 'test-tiny'" in
+                   f.message for f in hits)
+
+
+# ---- baseline staleness -----------------------------------------------------
+class TestBaselineStale:
+
+    def test_stale_entries_reported_on_full_tree_run(self, tmp_path):
+        """A {path, check} waiver a FULL-TREE run examined with the
+        check armed but that matches no finding is stale: flagged on
+        stderr + in the JSON. Entries for unexamined paths or unarmed
+        checks are never judged."""
+        dead = 'skypilot_tpu/lint/shapes.py'
+        bl = tmp_path / 'bl.json'
+        bl.write_text(json.dumps({'findings': [
+            {'path': dead, 'check': 'silent-except'},     # stale
+            {'path': dead, 'check': 'lock-order'},        # not armed
+            {'path': 'skypilot_tpu/long_gone.py',
+             'check': 'silent-except'},                   # deleted file
+            {'path': 'tests/fixtures/lint/silent_except_violation.py',
+             'check': 'silent-except'}]}))                # not examined
+        proc = subprocess.run(
+            [sys.executable, SKYLINT, '--check', 'silent-except',
+             '--baseline', str(bl), '--json'],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr or proc.stdout
+        assert f'stale baseline entry {dead} (silent-except)' \
+            in proc.stderr
+        # Deleted/renamed paths are stale regardless of scope.
+        assert 'stale baseline entry skypilot_tpu/long_gone.py' \
+            in proc.stderr
+        assert 'lock-order' not in proc.stderr
+        assert 'fixtures' not in proc.stderr
+        payload = json.loads(proc.stdout)
+        assert payload['baseline_stale'] == [
+            {'path': dead, 'check': 'silent-except'},
+            {'path': 'skypilot_tpu/long_gone.py',
+             'check': 'silent-except'}]
+        assert payload['baseline_waived'] == []
+
+    def test_narrowed_run_never_judges_staleness(self, tmp_path):
+        """Explicit narrower roots skip the aggregate contracts, so
+        'no finding' proves nothing — waivers still apply but nothing
+        is called stale."""
+        fixture_rel = 'tests/fixtures/lint/silent_except_violation.py'
+        bl = tmp_path / 'bl.json'
+        bl.write_text(json.dumps({'findings': [
+            {'path': fixture_rel, 'check': 'silent-except'},
+            {'path': fixture_rel, 'check': 'lock-order'}]}))
+        fixture = os.path.join(FIXTURES, 'silent_except_violation.py')
+        proc = subprocess.run(
+            [sys.executable, SKYLINT,
+             '--baseline', str(bl), '--json', fixture],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr or proc.stdout
+        assert 'stale baseline entry' not in proc.stderr
+        payload = json.loads(proc.stdout)
+        assert payload['baseline_stale'] == []
+        assert len(payload['baseline_waived']) == 3
+        # regeneration prunes: the fresh baseline holds only live keys
+        # (a standalone run — composing --write-baseline with
+        # --baseline/--changed is refused, tested below)
+        out_bl = tmp_path / 'bl2.json'
+        proc = subprocess.run(
+            [sys.executable, SKYLINT, '--check', 'silent-except',
+             '--write-baseline', str(out_bl), fixture],
+            capture_output=True, text=True)
+        assert proc.returncode == 0
+        entries = json.loads(out_bl.read_text())['findings']
+        assert entries == [
+            {'path': 'tests/fixtures/lint/silent_except_violation.py',
+             'check': 'silent-except'}]
+
+    def test_write_baseline_refuses_composed_flags(self, tmp_path):
+        """--write-baseline from a waived/filtered finding set would
+        silently drop live waivers — refuse the composition."""
+        bl = tmp_path / 'bl.json'
+        bl.write_text(json.dumps({'findings': []}))
+        proc = subprocess.run(
+            [sys.executable, SKYLINT, '--baseline', str(bl),
+             '--write-baseline', str(tmp_path / 'out.json')],
+            capture_output=True, text=True)
+        assert proc.returncode == 2
+        assert 'un-waived run' in proc.stderr
+
+    def test_live_baseline_has_no_stale_report(self, tmp_path):
+        bl = tmp_path / 'bl.json'
+        bl.write_text(json.dumps({'findings': [
+            {'path': 'tests/fixtures/lint/silent_except_violation.py',
+             'check': 'silent-except'}]}))
+        fixture = os.path.join(FIXTURES, 'silent_except_violation.py')
+        proc = subprocess.run(
+            [sys.executable, SKYLINT, '--check', 'silent-except',
+             '--baseline', str(bl), fixture],
+            capture_output=True, text=True)
+        assert proc.returncode == 0
+        assert 'stale baseline entry' not in proc.stderr
+
+
+# ---- pre-commit wrapper + JSON report schema --------------------------------
+class TestLintPrecommitAndSchema:
+
+    REQUIRED_KEYS = {'roots', 'files_scanned', 'cross_module',
+                     'changed_only', 'checks', 'findings', 'suppressed'}
+    FINDING_KEYS = {'path', 'line', 'col', 'check', 'message'}
+
+    def test_precommit_wrapper_writes_report(self, tmp_path):
+        report = tmp_path / 'report.json'
+        proc = subprocess.run(
+            ['sh', os.path.join(REPO_ROOT, 'scripts',
+                                'lint_precommit.sh')],
+            env={**os.environ, 'SKYLINT_REPORT': str(report)},
+            capture_output=True, text=True)
+        assert proc.returncode in (0, 1), proc.stderr
+        payload = json.loads(report.read_text())
+        assert self.REQUIRED_KEYS <= set(payload)
+        assert payload['changed_only'] is not None  # --changed mode
+
+    def test_json_report_schema_is_stable(self, tmp_path):
+        """The archived report's shape is a contract: bench.py and CI
+        consumers key on these exact fields."""
+        out = tmp_path / 'report.json'
+        fixture = os.path.join(FIXTURES, 'shapecheck_violation.py')
+        proc = subprocess.run(
+            [sys.executable, SKYLINT, '--check', 'shapecheck',
+             '--json-out', str(out), fixture],
+            capture_output=True, text=True)
+        assert proc.returncode == 1
+        payload = json.loads(out.read_text())
+        assert set(payload) == self.REQUIRED_KEYS
+        assert payload['checks'] == ['shapecheck']
+        assert len(payload['findings']) == 5
+        for f in payload['findings'] + payload['suppressed']:
+            assert set(f) == self.FINDING_KEYS
+            assert isinstance(f['line'], int)

@@ -128,6 +128,9 @@ _v('SKYTPU_TTFT_SLO_MS', '0', 'serve',
    '429 (0 = never reject)')
 _v('SKYTPU_PREFILL_TOKENS_PER_S', '0', 'serve',
    'seed for the effective-prefill-rate EMA (0 = learn from traffic)')
+_v('SKYTPU_INFLIGHT_STEPS', '2', 'serve',
+   'decode steps dispatched back-to-back per scheduling round '
+   '(1 = synchronous one-step-per-tick oracle)')
 
 # -- decode engine ------------------------------------------------------------
 _v('SKYTPU_KV_BLOCK', '64', 'engine',

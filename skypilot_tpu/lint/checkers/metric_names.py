@@ -25,6 +25,11 @@ EXPECTED_FAMILIES = (
     'skytpu_engine_',     # decode engine step profiling
     'skytpu_engine_kv_',  # paged-KV pool + prefix cache
     'skytpu_lb_',         # load balancer proxy series
+    # Async-runtime series the dashboard + r06 bench read by name: a
+    # rename must fail here, not silently blank the dashboard column.
+    'skytpu_engine_step_gap_',            # host gap between dispatches
+    'skytpu_engine_inflight_steps_',      # dispatched-not-fetched depth
+    'skytpu_engine_kv_blocks_reclaimed_',  # early-EOS tail reclaim
 )
 
 _CONSTRUCTORS = {'counter', 'gauge', 'histogram'}

@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -80,7 +80,28 @@ class StepProfiler:
         self.occupancy = metrics_lib.gauge(
             'skytpu_engine_occupancy_ratio',
             'active slots / batch slots at the last decode step')
+        # Host-side gap between consecutive step dispatches: the time
+        # the dispatch queue is NOT being fed. With >= 2 steps in
+        # flight the device rides out these gaps; the histogram is the
+        # signal that says whether it has to. Sub-ms buckets: on local
+        # hardware the healthy gap is tens of microseconds.
+        self.step_gap_ms = metrics_lib.histogram(
+            'skytpu_engine_step_gap_ms',
+            'host gap between consecutive decode step dispatches',
+            buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10,
+                     25, 50, 100, 1000))
+        self.inflight_steps = metrics_lib.gauge(
+            'skytpu_engine_inflight_steps_count',
+            'decode steps dispatched but not yet fetched by the emitter')
         self._seen_variants: set = set()
+        # Last-N raw gap samples, per-PROFILER (one profiler per
+        # engine): the registry histogram above is process-global, so a
+        # same-process A/B (depth-1 vs depth-2 engines in one test or
+        # bench run) needs a per-engine distribution to compare.
+        self.gap_samples: 'deque[float]' = deque(
+            maxlen=self.GAP_SAMPLES_MAX)
+
+    GAP_SAMPLES_MAX = 4096
 
     def note_variant(self, kind: str, *shape) -> None:
         key = (kind, *shape)
@@ -91,6 +112,14 @@ class StepProfiler:
     def note_step(self, wall_s: float) -> None:
         self.steps.inc()
         self.step_ms.observe(wall_s * 1e3)
+
+    def note_gap(self, gap_s: float) -> None:
+        ms = gap_s * 1e3
+        self.step_gap_ms.observe(ms)
+        self.gap_samples.append(ms)
+
+    def note_inflight(self, depth: int) -> None:
+        self.inflight_steps.set(depth)
 
     def note_occupancy(self, active: int, total: int) -> None:
         self.occupancy.set(active / total if total else 0.0)
@@ -210,6 +239,16 @@ class DecodeEngine:
         # disabled: every instrumentation site below is ONE branch.
         self.profiler = (StepProfiler()
                          if metrics_lib.enabled() else None)
+        # End timestamp of the last step dispatch — the step-gap
+        # histogram's anchor. None across idle periods (see
+        # note_dispatch_break) so the first step after a lull measures
+        # host overhead, not the lull.
+        self._last_dispatch_end: Optional[float] = None
+
+    def note_dispatch_break(self) -> None:
+        """Caller (the scheduler) is about to wait for work: break the
+        step-gap chain so the idle wait is not recorded as a gap."""
+        self._last_dispatch_end = None
 
     # -- state --------------------------------------------------------------
     def init_state(self) -> DecodeState:
@@ -747,11 +786,18 @@ class DecodeEngine:
             return self._step(params, state, rng, temperature, top_k)
         # Dispatch wall time, not device time: steps are pipelined (no
         # host sync), so steady-state this tracks per-step cadence and a
-        # spike marks a compile or a backed-up dispatch queue.
+        # spike marks a compile or a backed-up dispatch queue. The gap
+        # (end of previous dispatch -> start of this one) is the host
+        # time the dispatch queue went unfed — the quantity the async
+        # runtime exists to overlap with device work.
         self.profiler.note_variant('step', b)
         t0 = time.perf_counter()
+        if self._last_dispatch_end is not None:
+            self.profiler.note_gap(t0 - self._last_dispatch_end)
         out = self._step(params, state, rng, temperature, top_k)
-        self.profiler.note_step(time.perf_counter() - t0)
+        end = time.perf_counter()
+        self.profiler.note_step(end - t0)
+        self._last_dispatch_end = end
         return out
 
     # Distinct scalar (temperature, top_k) settings are CLIENT-supplied;

@@ -90,6 +90,10 @@ class _KvMetrics:
             'skytpu_engine_kv_evictions_total',
             'cached unreferenced blocks evicted to satisfy an '
             'allocation')
+        self.reclaimed = metrics_lib.counter(
+            'skytpu_engine_kv_blocks_reclaimed_total',
+            'never-written tail blocks returned to the pool at release '
+            '(early EOS before the reserved budget was consumed)')
 
 
 class BlockAllocator:
@@ -125,7 +129,7 @@ class BlockAllocator:
         # (least recently touched) first — the eviction order.
         self._lru: 'OrderedDict[int, None]' = OrderedDict()
         self.counters = {'lookups': 0, 'hits': 0, 'lookup_tokens': 0,
-                         'hit_tokens': 0, 'evictions': 0}
+                         'hit_tokens': 0, 'evictions': 0, 'reclaimed': 0}
 
     # -- capacity -----------------------------------------------------------
     @property
@@ -233,6 +237,35 @@ class BlockAllocator:
                     bisect.insort(self._free, blk)
             self._update_gauges_locked()
 
+    def reclaim_tail(self, blocks: Sequence[int]) -> int:
+        """Return never-written tail blocks straight to the free list.
+
+        A request reserves ``ceil((prompt+max_tokens)/block)`` blocks at
+        admission; on early EOS the rows past its actual length were
+        never written, so the blocks backing them carry no cacheable
+        KV. They are exclusively owned (ref == 1) and never committed
+        to the prefix cache — both enforced here, because reclaiming a
+        shared or cached block would corrupt another sequence. Returns
+        the number reclaimed (mirrors the
+        skytpu_engine_kv_blocks_reclaimed_total counter)."""
+        if not blocks:
+            return 0
+        with self._lock:
+            for blk in blocks:
+                if self._ref.get(blk) != 1:
+                    raise ValueError(
+                        f'reclaim of shared/unreferenced block {blk}')
+                if blk in self._block_hash:
+                    raise ValueError(f'reclaim of cached block {blk}')
+            for blk in blocks:
+                del self._ref[blk]
+                bisect.insort(self._free, blk)
+            self.counters['reclaimed'] += len(blocks)
+            if self._m is not None:
+                self._m.reclaimed.inc(len(blocks))
+            self._update_gauges_locked()
+        return len(blocks)
+
     # -- prefix cache -------------------------------------------------------
     def _match_locked(self, hashes: Sequence[bytes]) -> List[int]:
         out: List[int] = []
@@ -329,6 +362,7 @@ class BlockAllocator:
                 'prefix_hit_rate': (round(
                     self.counters['hit_tokens'] / lk, 4) if lk else 0.0),
                 'prefix_evictions': self.counters['evictions'],
+                'kv_blocks_reclaimed': self.counters['reclaimed'],
             }
 
     def _update_gauges_locked(self) -> None:

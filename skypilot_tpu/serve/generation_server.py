@@ -167,13 +167,30 @@ class GenerationScheduler:
     Two threads, zero per-step host sync on the dispatch side:
 
     - the **scheduler** thread admits requests (prefill + insert) and
-      dispatches ``engine.step`` calls back-to-back WITHOUT fetching the
-      sampled tokens — each step's [B] token array is appended (still on
+      dispatches ``engine.step`` calls in bursts of up to
+      ``inflight_steps`` back-to-back WITHOUT fetching the sampled
+      tokens — each step's [B] token array is appended (still on
       device) to an emission queue;
     - the **emitter** thread drains whatever arrays are queued, stacks
       them on device, and fetches the whole batch with ONE device-to-host
       transfer, then routes token values to per-request queues and makes
       the EOS / max_tokens / slot-release decisions.
+
+    The always-async contract: host work (admission, release
+    bookkeeping, sampling-cache rebuilds, detokenization, metrics) runs
+    between dispatch BURSTS, while the device still holds >= 1 queued
+    step — so at ``inflight_steps >= 2`` host gaps no longer gate
+    device utilization. Every wait is event-driven: the scheduler
+    parks on ``_wake`` only when it has nothing to dispatch or admit,
+    and on the backlog condition variable only when the emitter is
+    more than MAX_BACKLOG steps behind; both are signalled at the
+    state change, never polled. ``inflight_steps = 1``
+    ($SKYTPU_INFLIGHT_STEPS) restores the one-step-per-tick schedule
+    and is kept as the equivalence oracle: under greedy sampling the
+    emitted token streams are bit-identical across depths, because a
+    slot's tokens depend only on its own cache rows and burst depth
+    only shifts WHEN admission/release bookkeeping runs between
+    dispatches.
 
     The fetch batch size self-adapts to the transfer latency: ~1 on local
     hardware (sub-ms D2H keeps the queue empty), ~RTT/step_time over a
@@ -209,7 +226,8 @@ class GenerationScheduler:
                  prefill_budget: Optional[int] = None,
                  ttft_slo_ms: Optional[float] = None,
                  kv_block: Optional[int] = None,
-                 kv_blocks: Optional[int] = None):
+                 kv_blocks: Optional[int] = None,
+                 inflight_steps: Optional[int] = None):
         """``model`` serves a non-Llama family through the same engine
         (e.g. a MixtralModel for MoE decode via its _mlp_delta).
 
@@ -237,6 +255,12 @@ class GenerationScheduler:
         ACTUAL sequence lengths. Requests whose leading full blocks hit
         the prefix cache map those blocks shared and prefill only their
         suffix.
+
+        ``inflight_steps`` ($SKYTPU_INFLIGHT_STEPS, default 2): decode
+        steps dispatched back-to-back per scheduling round, keeping the
+        device's dispatch queue fed while host work runs. 1 = the
+        synchronous one-step-per-tick schedule (the equivalence
+        oracle).
         """
         import jax
         self.config = config
@@ -299,11 +323,22 @@ class GenerationScheduler:
         self._sampling_key: Optional[tuple] = None
         self._temps_dev = None
         self._topks_dev = None
+        self.inflight_steps = max(1, int(
+            inflight_steps if inflight_steps is not None
+            else env_vars.get('SKYTPU_INFLIGHT_STEPS') or 1))
         # Emission pipeline: ('first', tok_scalar, req, slot|None) and
         # ('step', sampled [B], slot->req snapshot) items, in dispatch
         # order. Guarded by _emit_lock; emitter drains in batches.
         self._emit_q: List[tuple] = []
         self._emit_lock = threading.Lock()
+        # Backpressure: the dispatch loop waits here when the emitter
+        # falls MAX_BACKLOG steps behind; the emitter notifies after
+        # every drain. Shares _emit_lock so the wait predicate (queue
+        # length) and the signal are under one lock.
+        self._backlog_cv = threading.Condition(self._emit_lock)
+        # Steps dispatched whose tokens the emitter has not fetched yet
+        # (guarded by _emit_lock) — the in-flight-depth gauge's source.
+        self._inflight_now = 0
         self._emit_event = threading.Event()
         self._releases: 'queue.Queue[int]' = queue.Queue()
         self._wake = threading.Event()
@@ -727,13 +762,34 @@ class GenerationScheduler:
         if prep and prep['blocks']:
             self.engine.allocator.deref(prep['blocks'])
 
-    def _free_slot_kv(self, slot: int) -> None:
+    def _free_slot_kv(self, slot: int,
+                      used_rows: Optional[int] = None) -> None:
         """Drop the vacating slot's block references. Called exactly
         where the slot is released on device: dispatch order guarantees
-        any reuse's writes land after the released sequence's reads."""
+        any reuse's writes land after the released sequence's reads.
+
+        ``used_rows`` (when known): KV rows the device actually wrote
+        for this slot. Reserved blocks past that point were never
+        written — a request that hit EOS before consuming its
+        ceil((prompt+max_tokens)/block) budget reserved them for
+        tokens that never dispatched — so they bypass the prefix-cache
+        bookkeeping and go straight back to the pool (counted in
+        skytpu_engine_kv_blocks_reclaimed_total). Tail blocks are
+        always exclusively owned: prefix sharing and commit only ever
+        cover full PROMPT blocks, which used_rows >= prompt_len keeps
+        on the deref side of the split."""
         ids = self._slot_kv.pop(slot, None)
+        if not ids:
+            return
+        alloc = self.engine.allocator
+        if used_rows is not None:
+            used_blocks = paged_kv.blocks_for(used_rows,
+                                              self.engine.kv_block)
+            if used_blocks < len(ids):
+                alloc.reclaim_tail(ids[used_blocks:])
+                ids = ids[:used_blocks]
         if ids:
-            self.engine.allocator.deref(ids)
+            alloc.deref(ids)
 
     def _next_admittable(self) -> Optional[_Request]:
         """Head-of-line pop: the request stalled on KV blocks retries
@@ -1062,7 +1118,28 @@ class GenerationScheduler:
     def _queue_emission(self, item: tuple) -> None:
         with self._emit_lock:
             self._emit_q.append(item)
+            if item[0] == 'step':
+                self._inflight_now += 1
+                prof = self.engine.profiler
+                if prof is not None:
+                    prof.note_inflight(self._inflight_now)
         self._emit_event.set()
+
+    def _release_slot(self, slot: int) -> None:
+        """Release ``slot`` on device and free its KV blocks, returning
+        any never-written tail blocks (reserved for tokens that were
+        never dispatched — early EOS) straight to the pool."""
+        req = self._slots[slot]
+        self.state = self.engine.release(self.state, slot)
+        self._slots[slot] = None
+        # Rows actually written: the prompt's prefill plus one KV row
+        # per dispatched decode step (post-EOS in-flight steps
+        # included — the device wrote those rows even though the
+        # emitter discards their tokens).
+        used_rows = min(req.prompt_len + self._dispatched[slot],
+                        self.engine.max_len)
+        self._free_slot_kv(slot, used_rows=used_rows)
+        self._note_release()
 
     def _apply_releases(self) -> None:
         while True:
@@ -1074,10 +1151,7 @@ class GenerationScheduler:
             # racing crash recovery) must not free a slot that has since
             # been reassigned to a different live request.
             if self._slots[slot] is req and req is not None:
-                self.state = self.engine.release(self.state, slot)
-                self._slots[slot] = None
-                self._free_slot_kv(slot)
-                self._note_release()
+                self._release_slot(slot)
 
     def _loop(self) -> None:
         if getattr(self, '_do_warmup', False):
@@ -1101,8 +1175,15 @@ class GenerationScheduler:
                 # only present in queued emission items (e.g. a
                 # max_tokens<=1 request that never takes a slot) — any
                 # request left without a sentinel hangs its HTTP client.
-                with self._emit_lock:
+                with self._backlog_cv:
                     dropped, self._emit_q = self._emit_q, []
+                    # Dropped step items will never reach the emitter's
+                    # drain accounting: zero the in-flight depth here.
+                    self._inflight_now = 0
+                    prof = self.engine.profiler
+                    if prof is not None:
+                        prof.note_inflight(0)
+                    self._backlog_cv.notify_all()
                 for item in dropped:
                     reqs = ([item[2]] if item[0] == 'first'
                             else [r for r in item[2] if r is not None])
@@ -1137,119 +1218,174 @@ class GenerationScheduler:
                 self.engine.reset_kv()
                 self.state = self.engine.init_state()
 
-    def _tick(self) -> None:  # skylint: hot-path
+    def _tick(self) -> None:
+        """One scheduler round: apply releases, admit, dispatch a burst.
+
+        Host bookkeeping runs between dispatch BURSTS — with
+        ``inflight_steps >= 2`` the device still holds queued steps
+        while it runs, so these gaps no longer idle the device (the
+        skytpu_engine_step_gap_ms histogram is the receipt)."""
         self._apply_releases()
         self._admit()
-        # Step only while some request still needs tokens; slots that have
-        # all their tokens dispatched (or finished per the emitter) merely
-        # await release — stepping for them alone would be wasted work.
-        needs_step = any(
+        if self._needs_step():
+            self._dispatch_steps()
+            return
+        if self._chunking:
+            return  # chunked prefills in flight: keep ticking
+        # Idle: nothing to step, admit, or chunk. Park event-driven on
+        # _wake — submit(), the emitter's EOS releases, its failure
+        # path, and stop() all set it. Clear-then-recheck closes the
+        # lost-wakeup window (a set() landing between the admit pass
+        # above and the clear); the timeout is a missed-signal safety
+        # net, not a poll — no progress path depends on it.
+        self.engine.note_dispatch_break()
+        self._wake.clear()
+        if self._has_admittable() or not self._releases.empty():
+            return
+        self._wake.wait(timeout=1.0)
+
+    def _needs_step(self) -> bool:
+        """Some request still needs tokens; slots that have all their
+        tokens dispatched (or finished per the emitter) merely await
+        release — stepping for them alone would be wasted work."""
+        return any(
             r is not None and not r.done
             and 1 + self._dispatched[s] < r.max_tokens
             for s, r in enumerate(self._slots))
-        if not needs_step:
-            if self._chunking:
-                return  # chunked prefills in flight: keep ticking
-            self._wake.wait(timeout=0.05)
-            self._wake.clear()
-            return
-        with self._emit_lock:
-            emit_backlog = len(self._emit_q)
-        if emit_backlog >= self.MAX_BACKLOG:
-            # Emitter is behind (slow D2H link): bound the in-flight
-            # window. The 2ms pause is a deliberate bounded backoff —
-            # spinning on the backlog check would burn the core the
-            # emitter needs for its D2H fetch.
-            self._emit_event.set()
-            time.sleep(0.002)  # skylint: disable=blocking-hot-path
-            return
-        # Per-slot sampling settings; traced [B] args, so heterogeneous
-        # values share one compiled step. Device arrays are cached until
-        # the slot composition changes — steady-state decode is then a
-        # single dispatch (no host splits, no H2D transfers).
+
+    def _dispatch_steps(self) -> int:  # skylint: hot-path
+        """Dispatch up to ``inflight_steps`` decode steps back-to-back
+        without fetching, keeping the device's dispatch queue fed while
+        the caller's next host pass runs. Returns the steps dispatched.
+
+        Backpressure is a condition variable the emitter notifies after
+        every drain: when the emitter falls MAX_BACKLOG steps behind
+        (slow D2H link), the loop parks until a drain makes room
+        instead of sleeping a fixed quantum. A burst that already made
+        progress returns instead of parking — host bookkeeping runs
+        while the emitter catches up.
+        """
         import jax.numpy as jnp
-        key = tuple((r.temperature, r.top_k) if r is not None else None
-                    for r in self._slots)
-        if key != self._sampling_key:
-            self._sampling_key = key
-            self._temps_dev = jnp.asarray(
-                [r.temperature if r is not None else 0.0
-                 for r in self._slots], jnp.float32)
-            self._topks_dev = jnp.asarray(
-                [r.top_k if r is not None else 0
-                 for r in self._slots], jnp.int32)
-        self.state, sampled, self._rng = self.engine.step(
-            self.params, self.state, self._rng,
-            temperature=self._temps_dev, top_k=self._topks_dev)
-        prof = self.engine.profiler
-        if prof is not None:
-            prof.note_occupancy(
-                sum(1 for r in self._slots if r is not None),
-                self.engine.batch_slots)
-        for s, r in enumerate(self._slots):
-            if r is not None:
-                self._dispatched[s] += 1
-        self._queue_emission(('step', sampled, list(self._slots)))
-        # Eager slot turnover: once a request's FINAL token has been
-        # dispatched (prefill token + max_tokens-1 steps), its KV is dead
-        # weight — release the slot NOW so the next _admit reuses it,
-        # instead of waiting for the emitter to fetch the whole in-flight
-        # window (up to MAX_BACKLOG steps of lag, ~1s on a high-latency
-        # link) and discover completion host-side. At concurrency above
-        # the slot count, TTFT is exactly this slot-turnover wait.
-        # EOS-truncated requests still release via the emitter, whose
-        # queued release is ignored by _apply_releases' identity check
-        # once the slot has been reassigned; the emitter keeps emitting
-        # this request's remaining in-flight tokens from its snapshots.
-        for s, r in enumerate(self._slots):
-            if (r is not None and not r.done
-                    and 1 + self._dispatched[s] >= r.max_tokens):
-                self.state = self.engine.release(self.state, s)
-                self._slots[s] = None
-                self._free_slot_kv(s)
-                self._note_release()
+        dispatched = 0
+        while dispatched < self.inflight_steps and self._needs_step():
+            with self._backlog_cv:
+                if len(self._emit_q) >= self.MAX_BACKLOG:
+                    self._emit_event.set()
+                    if dispatched:
+                        return dispatched
+                    # Event-driven wait for the emitter's drain notify;
+                    # the timeout only covers a missed signal.
+                    self._backlog_cv.wait(timeout=0.05)
+                    if len(self._emit_q) >= self.MAX_BACKLOG:
+                        return dispatched
+            # Per-slot sampling settings; traced [B] args, so
+            # heterogeneous values share one compiled step. Device
+            # arrays are cached until the slot composition changes —
+            # steady-state decode is then a single dispatch (no host
+            # splits, no H2D transfers).
+            key = tuple((r.temperature, r.top_k) if r is not None
+                        else None for r in self._slots)
+            if key != self._sampling_key:
+                self._sampling_key = key
+                self._temps_dev = jnp.asarray(
+                    [r.temperature if r is not None else 0.0
+                     for r in self._slots], jnp.float32)
+                self._topks_dev = jnp.asarray(
+                    [r.top_k if r is not None else 0
+                     for r in self._slots], jnp.int32)
+            self.state, sampled, self._rng = self.engine.step(
+                self.params, self.state, self._rng,
+                temperature=self._temps_dev, top_k=self._topks_dev)
+            prof = self.engine.profiler
+            if prof is not None:
+                prof.note_occupancy(
+                    sum(1 for r in self._slots if r is not None),
+                    self.engine.batch_slots)
+            for s, r in enumerate(self._slots):
+                if r is not None:
+                    self._dispatched[s] += 1
+            self._queue_emission(('step', sampled, list(self._slots)))
+            # Eager slot turnover: once a request's FINAL token has been
+            # dispatched (prefill token + max_tokens-1 steps), its KV is
+            # dead weight — release the slot NOW so the next _admit
+            # reuses it, instead of waiting for the emitter to fetch the
+            # whole in-flight window (up to MAX_BACKLOG steps of lag,
+            # ~1s on a high-latency link) and discover completion
+            # host-side. At concurrency above the slot count, TTFT is
+            # exactly this slot-turnover wait. EOS-truncated requests
+            # still release via the emitter, whose queued release is
+            # ignored by _apply_releases' identity check once the slot
+            # has been reassigned; the emitter keeps emitting this
+            # request's remaining in-flight tokens from its snapshots.
+            for s, r in enumerate(self._slots):
+                if (r is not None and not r.done
+                        and 1 + self._dispatched[s] >= r.max_tokens):
+                    self._release_slot(s)
+            dispatched += 1
+        return dispatched
 
     # -- emitter ------------------------------------------------------------
-    def _emit_loop(self) -> None:
+    def _emit_loop(self) -> None:  # skylint: hot-path
         while not self._stop.is_set():
             if not self._emit_event.wait(timeout=0.2):
                 continue
             self._emit_event.clear()
-            with self._emit_lock:
+            with self._backlog_cv:
                 batch, self._emit_q = self._emit_q, []
+                # Drain signal: wake a dispatch loop parked on the
+                # backlog bound. Notified on EVERY drain (not just
+                # full->non-full edges) — a missed edge would strand
+                # the scheduler on its safety-net timeout.
+                if batch:
+                    self._backlog_cv.notify_all()
             if not batch:
                 continue
+            n_steps = sum(1 for item in batch if item[0] == 'step')
             try:
                 self._emit_batch(batch)
             except Exception:  # noqa: BLE001 — emitter must survive too
                 import traceback
                 traceback.print_exc()
-                # Fail EVERY request in the batch ('first' and 'step'
-                # alike) and queue their slot releases: an unterminated
-                # out_queue hangs its HTTP client forever, and an
-                # unreleased slot is leaked capacity.
-                failed = []
-                for item in batch:
-                    if item[0] == 'first':
-                        failed.append((item[2], item[3]))
-                    elif item[0] == 'firsts':
-                        failed.extend(zip(item[2], item[3]))
-                    else:
-                        failed.extend(
-                            (req, slot)
-                            for slot, req in enumerate(item[2])
-                            if req is not None)
-                for req, slot in failed:
-                    if not req.done:
-                        self._settle_prefill(req)
-                        req.fail('emission failed')
-                        if slot is not None:
-                            self._releases.put((slot, req))
-                self._wake.set()
+                self._fail_emission(batch)
+            finally:
+                if n_steps:
+                    with self._backlog_cv:
+                        self._inflight_now -= n_steps
+                        prof = self.engine.profiler
+                        if prof is not None:
+                            prof.note_inflight(self._inflight_now)
 
-    def _emit_batch(self, batch: List[tuple]) -> None:  # skylint: hot-path
+    def _fail_emission(self, batch: List[tuple]) -> None:
+        """Emitter crash recovery: fail EVERY request in the dropped
+        batch ('first', 'firsts' and 'step' items alike — with >= 2
+        steps in flight one batch spans several steps' snapshots) and
+        queue their slot releases. An unterminated out_queue hangs its
+        HTTP client forever, and an unreleased slot is leaked capacity
+        — the queued releases also free each slot's KV blocks via
+        _apply_releases."""
+        failed = []
+        for item in batch:
+            if item[0] == 'first':
+                failed.append((item[2], item[3]))
+            elif item[0] == 'firsts':
+                failed.extend(zip(item[2], item[3]))
+            else:
+                failed.extend(
+                    (req, slot)
+                    for slot, req in enumerate(item[2])
+                    if req is not None)
+        for req, slot in failed:
+            if not req.done:
+                self._settle_prefill(req)
+                req.fail('emission failed')
+                if slot is not None:
+                    self._releases.put((slot, req))
+        self._wake.set()
+
+    def _emit_batch(self, batch: List[tuple]) -> None:
         """ONE device-to-host transfer for every queued token array, then
-        route values + make EOS/max_tokens/full decisions in order."""
+        route values + make EOS/max_tokens/full decisions in order.
+        Hot-path covered via its root caller ``_emit_loop``."""
         import jax.numpy as jnp
         arrays = [item[1].reshape(-1) if item[0] in ('step', 'firsts')
                   else item[1].reshape(1) for item in batch]

@@ -89,6 +89,11 @@ def _service_metrics_row(name: str, controller_port: int) -> List[Any]:
         v = metrics_lib.histogram_quantile(cum, q)
         return '-' if v is None else f'{v:.0f}'
 
+    def quantile_fine(metric, q):
+        cum = metrics_lib.histogram_cumulative(samples, metric)
+        v = metrics_lib.histogram_quantile(cum, q)
+        return '-' if v is None else f'{v:.2f}'
+
     return [
         _esc(name),
         _esc(val('skytpu_serve_requests_total')),
@@ -97,6 +102,10 @@ def _service_metrics_row(name: str, controller_port: int) -> List[Any]:
         _esc(quantile('skytpu_serve_ttft_ms', 0.5)),
         _esc(quantile('skytpu_serve_ttft_ms', 0.99)),
         _esc(quantile('skytpu_serve_tpot_ms', 0.5)),
+        # Async-runtime health: sub-ms step-gap p50 = host work fully
+        # overlapped; gap approaching tpot p50 = device waiting on host.
+        _esc(quantile_fine('skytpu_engine_step_gap_ms', 0.5)),
+        _esc(val('skytpu_engine_inflight_steps_count')),
         _esc(val('skytpu_engine_recompiles_total')),
     ]
 
@@ -195,7 +204,7 @@ def render() -> str:
         serve_metrics=_table(
             ['service', 'requests', '429s', 'queue depth',
              'ttft p50 (ms)', 'ttft p99 (ms)', 'tpot p50 (ms)',
-             'recompiles'],
+             'step gap p50 (ms)', 'in-flight', 'recompiles'],
             serve_metric_rows),
         requests=_table(['id', 'op', 'user', 'status', 'created'],
                         request_rows),

@@ -479,7 +479,7 @@ def phase_serve(out_path: str, on_tpu: bool, chip_kind: str) -> None:
                 ready_timeout_s=150 * _SCALE, warmup_deadline_s=90 * _SCALE,
                 prefill_chunk=256, ttft_slo_ms=4500.0, ab_monolithic=True,
                 prefix_share_len=2048, kv_block=64, kv_blocks=2049,
-                progress=progress)
+                spec_tokens=4, progress=progress)
         else:
             out = serve_bench.run(
                 preset='test-tiny', batch_slots=2, max_len=128,
@@ -488,7 +488,7 @@ def phase_serve(out_path: str, on_tpu: bool, chip_kind: str) -> None:
                 ready_timeout_s=120 * _SCALE, warmup_deadline_s=60 * _SCALE,
                 prefill_chunk=8, ttft_slo_ms=2000.0, ab_monolithic=True,
                 prefix_share_len=16, kv_block=8,
-                progress=progress)
+                spec_tokens=4, progress=progress)
     except Exception as e:  # noqa: BLE001 — a failed serve phase must
         # still contribute an explanatory record, not just rc!=0
         _write_record(out_path,

@@ -7,7 +7,10 @@ the SAME fixed-batch decode loop twice — contiguous per-slot KV
 (``kv_block=0``) and paged (``--kv-block``) — on one process's device
 and reports per-step wall time plus the exact KV state bytes, so a
 paged-path regression (gather/scatter overhead creeping up, pool
-mis-sizing) shows up in CI-adjacent tooling without a serve run::
+mis-sizing) shows up in CI-adjacent tooling without a serve run.
+Two further arms ride along: sync-vs-async dispatch (``--async-depths``)
+and speculative decode (``--spec-ks``: accepted-tokens-per-step +
+effective tok/s per draft length on a repetitive prompt)::
 
     python scripts/kv_microbench.py                      # CPU tiny
     python scripts/kv_microbench.py --preset llama-1b \
@@ -147,6 +150,70 @@ def bench_async(config, params, *, slots: int, max_len: int,
     }
 
 
+def bench_spec(config, params, *, max_len: int, prompt_len: int,
+               k: int, ngram: int = 3, out_tokens: int = 160,
+               kv_block: int = 64) -> dict:
+    """Spec-decode arm: accepted-tokens-per-step and effective tok/s
+    for one draft length ``k`` on a repetitive prompt (the traffic
+    shape prompt-lookup drafting targets). One slot is driven
+    end-to-end exactly like the scheduler drives it — host-side
+    ``draft_tokens`` over the request's own emitted history, one
+    ``step_verify`` per round, 1..k+1 tokens banked per round — so the
+    reported tok/s includes the drafter's host cost, not just device
+    time. ``k=0`` runs the plain one-token step loop as the baseline
+    (accepted_per_step is 1.0 by construction there)."""
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.models.decode import (DecodeEngine, draft_tokens,
+                                            prefill_bucket)
+
+    slots = 2  # slot 1 stays inactive: exercises the masked-slot path
+    engine = DecodeEngine(config, batch_slots=slots, max_len=max_len,
+                          kv_block=kv_block, spec_tokens=k)
+    pattern = (5, 9, 2, 7, 11, 3)
+    prompt = [pattern[i % len(pattern)] % config.vocab_size
+              for i in range(prompt_len)]
+    bucket = prefill_bucket(prompt_len, engine.max_len)
+    padded = jnp.asarray(prompt + [0] * (bucket - prompt_len),
+                         jnp.int32)
+
+    def run():
+        state = engine.init_state()
+        rng = jax.random.key(11)
+        state, first, rng = engine.admit(params, state, padded,
+                                         prompt_len, 0, rng)
+        hist = prompt + [int(first)]
+        emitted, steps = 1, 0
+        while emitted < out_tokens:
+            if k > 0:
+                draft = jnp.asarray(
+                    [draft_tokens(hist, k, ngram), [0] * k], jnp.int32)
+                state, out, acc, rng = engine.step_verify(
+                    params, state, rng, draft)
+                take = int(acc[0]) + 1
+                hist.extend(int(t) for t in out[0][:take])
+            else:
+                state, sampled, rng = engine.step(params, state, rng)
+                take = 1
+                hist.append(int(sampled[0]))
+            emitted += take
+            steps += 1
+        return emitted, steps
+
+    run()  # compile + warm every variant the timed run hits
+    t0 = time.perf_counter()
+    emitted, steps = run()
+    dt = time.perf_counter() - t0
+    return {
+        'k': k,
+        'tokens': emitted,
+        'decode_steps': steps,
+        'accepted_per_step': round(emitted / steps, 2),
+        'effective_tokens_per_s': round(emitted / dt, 1),
+    }
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.split('\n')[0])
     parser.add_argument('--preset', default='test-tiny')
@@ -166,6 +233,12 @@ def main(argv=None) -> int:
     parser.add_argument('--host-work-ms', type=float, default=1.0,
                         help='emulated per-step host latency in the '
                              'async arm')
+    parser.add_argument('--spec-ks', type=int, nargs='*',
+                        default=(0, 2, 4, 8),
+                        help='draft lengths for the spec-decode arm '
+                             '(0 = plain-step baseline; empty = skip)')
+    parser.add_argument('--spec-ngram', type=int, default=3,
+                        help='drafter n-gram length in the spec arm')
     args = parser.parse_args(argv)
 
     import jax
@@ -204,6 +277,20 @@ def main(argv=None) -> int:
                               host_work_ms=args.host_work_ms, **common)
                   for d in (args.async_depths or ())],
     }
+    if args.spec_ks:
+        # Own max_len: the stream needs room to settle into a cycle the
+        # drafter can lock onto before the length budget runs out. Pool
+        # size is left derived (kv_blocks=None) so a --kv-blocks tuned
+        # for --max-len never undersizes this arm.
+        spec_max_len = max(args.max_len, 256)
+        spec_out = min(200,
+                       spec_max_len - common['prompt_len'] - 16)
+        record['spec'] = [
+            bench_spec(config, params, max_len=spec_max_len,
+                       prompt_len=common['prompt_len'], k=k,
+                       ngram=args.spec_ngram, out_tokens=spec_out,
+                       kv_block=args.kv_block)
+            for k in args.spec_ks]
     print(json.dumps(record))
     return 0
 

@@ -39,7 +39,12 @@ def summarize_metrics_text(text: str) -> Dict[str, Any]:
     for name in ('skytpu_serve_ttft_ms', 'skytpu_serve_tpot_ms',
                  'skytpu_serve_queue_wait_ms',
                  'skytpu_serve_ttft_estimate_error_ms',
-                 'skytpu_engine_step_ms'):
+                 'skytpu_engine_step_ms',
+                 # Spec-decode: accept histogram observes accept+1
+                 # (tokens emitted per slot per verify step), so its
+                 # mean is accepted_tokens_per_step directly.
+                 'skytpu_engine_spec_accept_tokens',
+                 'skytpu_engine_spec_verify_ms'):
         cum = metrics_lib.histogram_cumulative(samples, name)
         count = metrics_lib.sample_value(samples, f'{name}_count')
         total = metrics_lib.sample_value(samples, f'{name}_sum')
@@ -65,6 +70,7 @@ def summarize_metrics_text(text: str) -> Dict[str, Any]:
                  'skytpu_engine_kv_prefix_hit_tokens_total',
                  'skytpu_engine_kv_prefix_lookup_tokens_total',
                  'skytpu_engine_kv_evictions_total',
+                 'skytpu_engine_spec_draft_hits_total',
                  'skytpu_serve_slo_headroom_ms'):
         v = metrics_lib.sample_value(samples, name)
         if v is not None:
@@ -416,7 +422,8 @@ def run(*, preset: str = 'llama-1b', batch_slots: int = 16,
         progress=None, prefill_chunk: int = 0, ttft_slo_ms: float = 0.0,
         ab_monolithic: bool = False, prefix_share_len: int = 0,
         kv_block: Optional[int] = None,
-        kv_blocks: Optional[int] = None) -> Dict[str, Any]:
+        kv_blocks: Optional[int] = None,
+        spec_tokens: Optional[int] = None) -> Dict[str, Any]:
     """Serve-path sweep, optionally A/B'd chunked-vs-monolithic.
 
     The headline service runs with ``prefill_chunk``/``ttft_slo_ms``
@@ -434,7 +441,11 @@ def run(*, preset: str = 'llama-1b', batch_slots: int = 16,
     ``serve_kv_block_utilization``. ``kv_block``/``kv_blocks``
     (replica $SKYTPU_KV_BLOCK/$SKYTPU_KV_BLOCKS) pin the paged-KV pool
     geometry — size ``kv_blocks`` below slots x max_len to measure
-    block-budget admission under a fixed HBM budget."""
+    block-budget admission under a fixed HBM budget. ``spec_tokens``
+    (replica $SKYTPU_SPEC_TOKENS) pins the speculative draft length;
+    pass 0 for the plain-step oracle arm, and read the resulting
+    accept yield from ``skytpu_engine_spec_accept_tokens`` (mean =
+    accepted tokens per verify step) in the replica metrics summary."""
     import skypilot_tpu as sky
     from skypilot_tpu.models.llama import PRESETS
     from skypilot_tpu.serve import service_spec as spec_lib
@@ -456,6 +467,8 @@ def run(*, preset: str = 'llama-1b', batch_slots: int = 16,
             envs['SKYTPU_KV_BLOCK'] = str(int(kv_block))
         if kv_blocks is not None:
             envs['SKYTPU_KV_BLOCKS'] = str(int(kv_blocks))
+        if spec_tokens is not None:
+            envs['SKYTPU_SPEC_TOKENS'] = str(int(spec_tokens))
         task = sky.Task(
             run=(f'{sys.executable} -m '
                  'skypilot_tpu.serve.generation_server '
@@ -486,6 +499,8 @@ def run(*, preset: str = 'llama-1b', batch_slots: int = 16,
         out['serve_kv_block'] = kv_block
     if kv_blocks is not None:
         out['serve_kv_blocks'] = kv_blocks
+    if spec_tokens is not None:
+        out['serve_spec_tokens'] = spec_tokens
 
     def sub_progress(field: str):
         if progress is None:

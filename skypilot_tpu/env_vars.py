@@ -138,6 +138,12 @@ _v('SKYTPU_KV_BLOCK', '64', 'engine',
    'oracle)')
 _v('SKYTPU_KV_BLOCKS', '0', 'engine',
    'KV pool size in blocks (0 = the contiguous layout\'s HBM budget)')
+_v('SKYTPU_SPEC_TOKENS', '4', 'engine',
+   'speculative draft tokens per decode step (0 = plain one-token '
+   'steps, the bit-identity oracle)')
+_v('SKYTPU_SPEC_NGRAM', '3', 'engine',
+   'max n-gram length the prompt-lookup drafter matches against each '
+   'request\'s own token history')
 
 # -- observability ------------------------------------------------------------
 _v('SKYTPU_METRICS', '1', 'observability',

@@ -30,6 +30,9 @@ EXPECTED_FAMILIES = (
     'skytpu_engine_step_gap_',            # host gap between dispatches
     'skytpu_engine_inflight_steps_',      # dispatched-not-fetched depth
     'skytpu_engine_kv_blocks_reclaimed_',  # early-EOS tail reclaim
+    # Speculative-decode series (accept histogram feeds the dashboard
+    # accept/step column and the serve_bench spec arm).
+    'skytpu_engine_spec_',                # drafter + verify-step series
 )
 
 _CONSTRUCTORS = {'counter', 'gauge', 'histogram'}

@@ -93,6 +93,24 @@ class StepProfiler:
         self.inflight_steps = metrics_lib.gauge(
             'skytpu_engine_inflight_steps_count',
             'decode steps dispatched but not yet fetched by the emitter')
+        # Speculative-decode series. The accept histogram observes
+        # tokens EMITTED per verify step (accept + 1: the accepted
+        # draft prefix plus the corrected token), so its mean
+        # (sum/count) is accepted_tokens_per_step directly — the
+        # dashboard's "accept/step" column and the ROADMAP target
+        # (> 1.8 on repetitive traffic) read straight off it.
+        self.spec_accept = metrics_lib.histogram(
+            'skytpu_engine_spec_accept_tokens',
+            'tokens emitted per verify step (accepted prefix + 1)',
+            buckets=(1, 2, 3, 4, 5, 6, 7, 8, 9, 16))
+        self.spec_draft_hits = metrics_lib.counter(
+            'skytpu_engine_spec_draft_hits_total',
+            'draft tokens accepted by verification')
+        self.spec_verify_ms = metrics_lib.histogram(
+            'skytpu_engine_spec_verify_ms',
+            'verify step dispatch wall time',
+            buckets=(0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 250,
+                     1000, 10000, 60000))
         self._seen_variants: set = set()
         # Last-N raw gap samples, per-PROFILER (one profiler per
         # engine): the registry histogram above is process-global, so a
@@ -124,6 +142,13 @@ class StepProfiler:
     def note_occupancy(self, active: int, total: int) -> None:
         self.occupancy.set(active / total if total else 0.0)
         self.decode_tokens.inc(active)
+
+    def note_spec_accept(self, accept: int, k: int) -> None:
+        """One slot's verify outcome: ``accept`` of ``k`` draft tokens
+        survived, so accept + 1 tokens were emitted this step."""
+        self.spec_accept.observe(accept + 1)
+        if accept:
+            self.spec_draft_hits.inc(accept)
 
 
 @jax.tree_util.register_dataclass
@@ -168,7 +193,8 @@ class DecodeEngine:
                  max_len: Optional[int] = None,
                  model: Optional[LlamaModel] = None,
                  kv_block: Optional[int] = None,
-                 kv_blocks: Optional[int] = None):
+                 kv_blocks: Optional[int] = None,
+                 spec_tokens: Optional[int] = None):
         """``kv_block`` ($SKYTPU_KV_BLOCK, default 64; 0 = contiguous):
         rows per KV block. Paged mode replaces the per-slot contiguous
         [max_len] KV region with a global pool of ``kv_blocks`` blocks
@@ -178,6 +204,12 @@ class DecodeEngine:
         blocks its sequence actually fills and full prefix blocks can
         be shared across slots. The contiguous path stays selectable as
         the equivalence oracle and for microbench A/Bs.
+
+        ``spec_tokens`` ($SKYTPU_SPEC_TOKENS, default 4; 0 = plain
+        one-token steps): max draft tokens per ``step_verify`` dispatch.
+        It only gates the CALLER (the scheduler reads it to decide
+        whether to draft); ``step_verify`` itself accepts any [B, K]
+        draft, one compiled variant per K.
         """
         self.config = config
         # Engine reuses the model's block methods (_qkv/_mlp_delta) so the
@@ -221,9 +253,14 @@ class DecodeEngine:
         self._admit = jax.jit(self._admit_impl, donate_argnums=(0,))
         self._admit_many = jax.jit(self._admit_many_impl,
                                    donate_argnums=(0,))
+        if spec_tokens is None:
+            spec_tokens = env_vars.get_int('SKYTPU_SPEC_TOKENS')
+        self.spec_tokens = max(0, int(spec_tokens))
         # temperature/top_k are *traced* [B] args — any per-request sampling
         # settings reuse the one compiled step (no recompile DoS).
         self._step = jax.jit(self._step_impl, donate_argnums=(1,))
+        self._step_verify = jax.jit(self._step_verify_impl,
+                                    donate_argnums=(1,))
         self._release = jax.jit(self._release_impl, donate_argnums=(0,))
         self._sample_one = jax.jit(self._sample_one_impl)
         # Scalar sampling settings -> cached device [B] arrays. Building
@@ -934,6 +971,227 @@ class DecodeEngine:
             active=state.active,
             block_tables=state.block_tables,
         ), sampled, rng
+
+
+    # -- speculative decode step --------------------------------------------
+    # skylint: hot-path
+    def step_verify(self, params: Params, state: DecodeState,
+                    rng: jax.Array, draft, temperature=0.0, top_k=0
+                    ) -> Tuple[DecodeState, jax.Array, jax.Array,
+                               jax.Array]:
+        """One VERIFY step: score ``draft`` [B, K] host-proposed tokens
+        plus each slot's pending last token in a single [B, 1+K]
+        batched forward, accept the longest greedy prefix, and emit the
+        corrected token after it — Leviathan-style speculative decoding
+        with a model-free drafter (``draft_tokens``).
+
+        Returns (state, out [B, 1+K], accept [B], next_rng): slot b
+        emits ``out[b, 0 .. accept[b]]`` (1..K+1 tokens), exactly the
+        tokens ``accept[b] + 1`` successive plain ``step`` calls would
+        have produced — greedy output is provably unchanged, only the
+        number of forwards per token changes. Rejected draft rows are
+        rolled back by LENGTH MASKING: ``lengths`` advances only past
+        accepted rows, so rejected KV writes sit beyond every reader's
+        validity mask and are overwritten by the next step — they are
+        never committed to block accounting.
+
+        ``K = draft.shape[1]`` is a traced-shape bucket: one compiled
+        variant per K (the scheduler uses a single fixed K, so steady
+        state is recompile-free, pinned by the recompile counter).
+        Sampling slots (temperature > 0) accept zero draft tokens and
+        emit only ``out[:, 0]``, which reproduces the plain step's
+        categorical draw bit-for-bit — speculation accelerates greedy
+        rows in a mixed batch without perturbing sampled ones.
+        """
+        b = self.batch_slots
+        draft = jnp.asarray(draft, jnp.int32)
+        if not (isinstance(temperature, jax.Array)
+                and temperature.shape == (b,)
+                and temperature.dtype == jnp.float32):
+            if isinstance(temperature, (int, float)):
+                temperature = self._scalar_sampling(float(temperature),
+                                                    jnp.float32)
+            else:
+                temperature = jnp.broadcast_to(
+                    jnp.asarray(temperature, jnp.float32), (b,))
+        if not (isinstance(top_k, jax.Array) and top_k.shape == (b,)
+                and top_k.dtype == jnp.int32):
+            if isinstance(top_k, int):
+                top_k = self._scalar_sampling(top_k, jnp.int32)
+            else:
+                top_k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32),
+                                         (b,))
+        if self.profiler is None:
+            return self._step_verify(params, state, rng, temperature,
+                                     top_k, draft)
+        self.profiler.note_variant('step_verify', b, draft.shape[1])
+        t0 = time.perf_counter()
+        if self._last_dispatch_end is not None:
+            self.profiler.note_gap(t0 - self._last_dispatch_end)
+        out = self._step_verify(params, state, rng, temperature, top_k,
+                                draft)
+        end = time.perf_counter()
+        self.profiler.note_step(end - t0)
+        self.profiler.spec_verify_ms.observe((end - t0) * 1e3)
+        self._last_dispatch_end = end
+        return out
+
+    # shapecheck: draft = i32[8, 4]
+    def _step_verify_impl(self, params, state, rng, temperature, top_k,
+                          draft):
+        rng, sample_rng = jax.random.split(rng)
+        c = self.config
+        b = self.batch_slots
+        k_spec = draft.shape[1]
+        t = 1 + k_spec
+        grp = c.num_heads // c.num_kv_heads
+        cos, sin = precompute_rotary(c.head_dim, c.max_seq_len, c.rope_theta)
+        # Position t's input: the pending last token, then the draft.
+        inputs = jnp.concatenate([state.last_tokens[:, None], draft],
+                                 axis=1)                       # [B, T]
+        positions = state.lengths[:, None] + jnp.arange(t)[None]  # [B, T]
+        x = params['embed'][inputs].astype(c.dtype)            # [B, T, e]
+        kv_pos = jnp.arange(self.m_pad)
+        # Query at position p sees kv rows <= p: its own write plus the
+        # draft rows before it (which ARE the greedy path up to the
+        # first mismatch — past it, everything is masked off by the
+        # final lengths and rewritten).
+        valid = kv_pos[None, None, :] <= positions[:, :, None]  # [B,T,M]
+        # Rows past capacity (and every row of an inactive slot) must
+        # not land anywhere real: give them an out-of-range row index
+        # and let the scatter's mode='drop' discard them. Clamping
+        # instead would collapse several draft rows onto one physical
+        # row — duplicate scatter indices with differing values are
+        # nondeterministic, which would break bit-identity at the
+        # capacity edge.
+        ok = state.active[:, None] & (positions <= self.max_len - 1)
+        wp = jnp.minimum(positions, self.max_len - 1)  # in-bounds lookup
+        kv_heads = jnp.arange(c.num_kv_heads)
+        if self.paged:
+            blk = jnp.take_along_axis(state.block_tables,
+                                      wp // self.kv_block, axis=1)  # [B,T]
+            row = jnp.where(ok, wp % self.kv_block, self.kv_block)
+        else:
+            rows_b = jnp.arange(b)
+            row_idx = jnp.where(ok, wp, self.m_pad)
+
+        model = self.model
+
+        def layer(carry, inputs_l):
+            x, cache_k, cache_v = carry
+            lp, i = inputs_l
+            q, k, v = model._qkv(lp, x, cos, sin, positions, constrain=False)
+            if self.paged:
+                # [B, T, kvh, d] rows scattered through the tables;
+                # out-of-range row sentinels drop.
+                cache_k = cache_k.at[i, blk[:, :, None],
+                                     kv_heads[None, None, :],
+                                     row[:, :, None]].set(
+                    k.astype(cache_k.dtype), mode='drop')
+                cache_v = cache_v.at[i, blk[:, :, None],
+                                     kv_heads[None, None, :],
+                                     row[:, :, None]].set(
+                    v.astype(cache_v.dtype), mode='drop')
+                k_layer = self._gather_batch(cache_k[i],
+                                             state.block_tables)
+                v_layer = self._gather_batch(cache_v[i],
+                                             state.block_tables)
+            else:
+                cache_k = cache_k.at[i, rows_b[:, None, None],
+                                     kv_heads[None, None, :],
+                                     row_idx[:, :, None]].set(
+                    k.astype(cache_k.dtype), mode='drop')
+                cache_v = cache_v.at[i, rows_b[:, None, None],
+                                     kv_heads[None, None, :],
+                                     row_idx[:, :, None]].set(
+                    v.astype(cache_v.dtype), mode='drop')
+                k_layer = cache_k[i]  # [B, kvh, M, d]
+                v_layer = cache_v[i]
+            # Grouped-query attention, T queries per slot over the
+            # slot's cache rows (same layout as the 1-query step).
+            qg = q.reshape(b, t, c.num_kv_heads, grp, c.head_dim)
+            s = jnp.einsum('btkgd,bkmd->btkgm', qg, k_layer,
+                           preferred_element_type=jnp.float32)
+            s = s * (c.head_dim**-0.5)
+            s = jnp.where(valid[:, :, None, None, :], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            attn = jnp.einsum('btkgm,bkmd->btkgd', p.astype(c.dtype),
+                              v_layer,
+                              preferred_element_type=jnp.float32)
+            attn = attn.reshape(b, t, c.num_heads,
+                                c.head_dim).astype(c.dtype)
+            x = x + jnp.einsum('bshd,hde->bse', attn, lp['wo'])
+            x = x + model._mlp_delta(lp, x, constrain=False)[0]
+            return (x, cache_k, cache_v), None
+
+        (x, new_k, new_v), _ = lax.scan(
+            layer, (x, state.k, state.v),
+            (params['layers'], jnp.arange(c.num_layers)))
+
+        x = rms_norm(x, params['final_norm'], c.norm_eps)
+        head = (params['embed'].T if c.tie_embeddings else params['lm_head'])
+        logits = jnp.einsum('bte,ev->btv', x.astype(jnp.float32),
+                            head.astype(jnp.float32))  # [B, 1+K, V]
+        # Row 0 through the full sampler: for temperature 0 it is the
+        # greedy argmax; for sampling slots it reproduces the plain
+        # step's categorical draw (same split discipline, same rng).
+        out0 = _sample(logits[:, 0], sample_rng, temperature, top_k)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, T]
+        # accept = longest prefix of the draft matching the greedy
+        # continuation; sampling slots accept nothing (their draft
+        # rows were scored under greedy context, not their draws).
+        match = (draft == greedy[:, :k_spec]).astype(jnp.int32)
+        accept = jnp.cumprod(match, axis=1).sum(axis=1)         # [B]
+        accept = jnp.where(temperature > 0.0, 0, accept)
+        out = jnp.concatenate([out0[:, None], greedy[:, 1:]], axis=1)
+        # The corrected token out[accept] becomes the slot's pending
+        # input; lengths advance by accept + 1 (the emitted count).
+        new_last = jnp.take_along_axis(out, accept[:, None],
+                                       axis=1)[:, 0]
+        active_i = state.active.astype(jnp.int32)
+        return DecodeState(
+            k=new_k, v=new_v,
+            lengths=jnp.minimum(state.lengths + (accept + 1) * active_i,
+                                self.max_len - 1),
+            last_tokens=jnp.where(state.active, new_last,
+                                  state.last_tokens),
+            active=state.active,
+            block_tables=state.block_tables,
+        ), out, accept, rng
+
+
+def draft_tokens(history: List[int], k: int, ngram: int = 3) -> List[int]:
+    """Model-free prompt-lookup draft: ``k`` proposed continuation
+    tokens from ``history`` (the request's prompt + emitted tokens).
+
+    Longest-match-first n-gram backoff (Prompt Lookup Decoding): find
+    the most recent EARLIER occurrence of the trailing ``n``-gram for
+    n = ngram .. 1 and propose the ``k`` tokens that followed it.
+    Correctness never depends on this — ``step_verify`` accepts only
+    the exact greedy continuation — so a cold or stale drafter merely
+    lowers the accept rate. Short proposals pad by repeating the last
+    proposed (or last history) token; an empty history drafts zeros.
+    """
+    if k <= 0:
+        return []
+    h = history
+    n_h = len(h)
+    out: List[int] = []
+    for n in range(min(ngram, n_h - 1), 0, -1):
+        tail = h[n_h - n:]
+        # Most recent earlier occurrence: scan right-to-left over
+        # window starts whose match leaves at least one follower.
+        for start in range(n_h - n - 1, -1, -1):
+            if h[start:start + n] == tail:
+                follow = h[start + n:start + n + k]
+                out = list(follow)
+                break
+        if out:
+            break
+    pad = out[-1] if out else (h[-1] if h else 0)
+    while len(out) < k:
+        out.append(pad)
+    return out[:k]
 
 
 def _sample(logits: jax.Array, rng: jax.Array, temperature,

@@ -42,7 +42,7 @@ from typing import Any, Dict, List, Optional
 from skypilot_tpu import env_vars
 from skypilot_tpu.models import paged_kv
 from skypilot_tpu.models.decode import (DecodeEngine, chunk_spans,
-                                        prefill_bucket)
+                                        draft_tokens, prefill_bucket)
 from skypilot_tpu.models.llama import PRESETS, LlamaConfig, LlamaModel
 from skypilot_tpu.utils import metrics as metrics_lib
 from skypilot_tpu.utils import timeline
@@ -118,7 +118,8 @@ class _Request:
                  'out_queue', 'submitted_at', 'first_token_at', 'done',
                  'error', 'prompt_len', 'emitted', 'admit_started_at',
                  'prefill_settled', 'request_id', 'est_ttft_ms',
-                 'last_token_at', 'prefill_cost', 'block_hashes')
+                 'last_token_at', 'prefill_cost', 'block_hashes',
+                 'history')
 
     def __init__(self, tokens, max_tokens, temperature, top_k, eos_id,
                  request_id: Optional[str] = None):
@@ -154,6 +155,11 @@ class _Request:
         # prefix-cache commit — hashing a 2500-token prompt three times
         # per admission was measurable scheduler-thread work.
         self.block_hashes: Optional[List[bytes]] = None
+        # Prompt + every emitted token, the prompt-lookup drafter's
+        # input (emitter appends; the scheduler reads it when building
+        # a draft — it may lag the device by the in-flight window,
+        # which only lowers the accept rate, never correctness).
+        self.history: List[int] = list(tokens)
 
     def fail(self, msg: str) -> None:
         self.error = msg
@@ -227,7 +233,8 @@ class GenerationScheduler:
                  ttft_slo_ms: Optional[float] = None,
                  kv_block: Optional[int] = None,
                  kv_blocks: Optional[int] = None,
-                 inflight_steps: Optional[int] = None):
+                 inflight_steps: Optional[int] = None,
+                 spec_tokens: Optional[int] = None):
         """``model`` serves a non-Llama family through the same engine
         (e.g. a MixtralModel for MoE decode via its _mlp_delta).
 
@@ -261,13 +268,24 @@ class GenerationScheduler:
         device's dispatch queue fed while host work runs. 1 = the
         synchronous one-step-per-tick schedule (the equivalence
         oracle).
+
+        ``spec_tokens`` ($SKYTPU_SPEC_TOKENS, default 4; 0 = plain
+        one-token steps, the bit-identity oracle): with K > 0 every
+        decode dispatch is a ``step_verify`` over K host-drafted tokens
+        (prompt-lookup from each request's own history,
+        $SKYTPU_SPEC_NGRAM), emitting 1..K+1 tokens per request per
+        step. Greedy streams are bit-identical to K = 0; sampling
+        requests fall back to one token per step inside the same
+        batched dispatch.
         """
         import jax
         self.config = config
         self.params = params
         self.engine = DecodeEngine(config, batch_slots=batch_slots,
                                    max_len=max_len, model=model,
-                                   kv_block=kv_block, kv_blocks=kv_blocks)
+                                   kv_block=kv_block, kv_blocks=kv_blocks,
+                                   spec_tokens=spec_tokens)
+        self.spec_ngram = max(1, env_vars.get_int('SKYTPU_SPEC_NGRAM'))
         self.state = self.engine.init_state()
         # Paged-KV scheduler state: explicit per-slot block assignments
         # (slot -> block ids to deref when the slot vacates) and the
@@ -317,6 +335,10 @@ class GenerationScheduler:
         # Decode steps dispatched since each slot's insert (scheduler-owned;
         # +1 prefill token = total tokens requested from the device).
         self._dispatched: List[int] = [0] * batch_slots
+        # KV rows those dispatches wrote (1 per plain step, 1+K per
+        # verify step): the release-time used-rows bound. Steps == rows
+        # only at K = 0, so the two counters are tracked separately.
+        self._rows_dispatched: List[int] = [0] * batch_slots
         # Cached device-resident per-slot sampling settings: rebuilt only
         # when slot composition changes, so the steady-state decode step is
         # a single device dispatch with no host->device transfers.
@@ -650,6 +672,16 @@ class GenerationScheduler:
         self.state, sampled, self._rng = eng.step(self.params, self.state,
                                                   self._rng)
         int(sampled[0])  # scalar fetch: the one reliable sync everywhere
+        if eng.spec_tokens > 0:
+            # Compile the verify variant at the configured K now: left
+            # to traffic, its multi-second XLA compile would land
+            # inside the first request's latency (and read as a
+            # mid-traffic recompile).
+            draft = jnp.zeros((eng.batch_slots, eng.spec_tokens),
+                              jnp.int32)
+            self.state, _, acc, self._rng = eng.step_verify(
+                self.params, self.state, self._rng, draft)
+            int(acc[0])
         # Warmup drove the engine through its legacy auto-assignment;
         # hand the blocks back — admissions below reserve explicitly.
         eng.free_auto_tables()
@@ -915,6 +947,7 @@ class GenerationScheduler:
                     self._commit_prefix(prep)
                 self._slots[slot] = req
                 self._dispatched[slot] = 0
+                self._rows_dispatched[slot] = 0
                 self._queue_emission(('first', first, req, slot))
         return spent
 
@@ -1049,6 +1082,7 @@ class GenerationScheduler:
                     for (req, _, prep), slot in zip(group, slots):
                         self._slots[slot] = req
                         self._dispatched[slot] = 0
+                        self._rows_dispatched[slot] = 0
                         if prep['blocks']:
                             self._slot_kv[slot] = prep['blocks']
                             self._commit_prefix(prep)
@@ -1080,6 +1114,7 @@ class GenerationScheduler:
                     continue
                 self._slots[slot] = req
                 self._dispatched[slot] = 0
+                self._rows_dispatched[slot] = 0
                 if prep['blocks']:
                     self._slot_kv[slot] = prep['blocks']
                     self._commit_prefix(prep)
@@ -1111,6 +1146,7 @@ class GenerationScheduler:
                     continue
                 self._slots[slot] = req
                 self._dispatched[slot] = 0
+                self._rows_dispatched[slot] = 0
                 self._slot_kv[slot] = prep['blocks']
                 self._commit_prefix(prep)
                 self._queue_emission(('first', first_tok, req, slot))
@@ -1118,7 +1154,7 @@ class GenerationScheduler:
     def _queue_emission(self, item: tuple) -> None:
         with self._emit_lock:
             self._emit_q.append(item)
-            if item[0] == 'step':
+            if item[0] in ('step', 'verify'):
                 self._inflight_now += 1
                 prof = self.engine.profiler
                 if prof is not None:
@@ -1132,11 +1168,14 @@ class GenerationScheduler:
         req = self._slots[slot]
         self.state = self.engine.release(self.state, slot)
         self._slots[slot] = None
-        # Rows actually written: the prompt's prefill plus one KV row
-        # per dispatched decode step (post-EOS in-flight steps
-        # included — the device wrote those rows even though the
-        # emitter discards their tokens).
-        used_rows = min(req.prompt_len + self._dispatched[slot],
+        # Rows actually written: the prompt's prefill plus the KV rows
+        # of every dispatched step (1 plain, 1+K verify; post-EOS
+        # in-flight steps included — the device wrote those rows even
+        # though the emitter discards their tokens, and a verify
+        # step's REJECTED rows were written too, just never advanced
+        # past — a block is reclaimable only if no write ever touched
+        # it).
+        used_rows = min(req.prompt_len + self._rows_dispatched[slot],
                         self.engine.max_len)
         self._free_slot_kv(slot, used_rows=used_rows)
         self._note_release()
@@ -1185,8 +1224,16 @@ class GenerationScheduler:
                         prof.note_inflight(0)
                     self._backlog_cv.notify_all()
                 for item in dropped:
-                    reqs = ([item[2]] if item[0] == 'first'
-                            else [r for r in item[2] if r is not None])
+                    # 'first' carries one request; 'verify' keeps its
+                    # slot snapshot at item[3] (item[2] is the accept
+                    # count device array); 'firsts'/'step' snapshot at
+                    # item[2].
+                    if item[0] == 'first':
+                        reqs = [item[2]]
+                    elif item[0] == 'verify':
+                        reqs = [r for r in item[3] if r is not None]
+                    else:
+                        reqs = [r for r in item[2] if r is not None]
                     for req in reqs:
                         if not req.done:
                             self._settle_prefill(req)
@@ -1293,18 +1340,42 @@ class GenerationScheduler:
                 self._topks_dev = jnp.asarray(
                     [r.top_k if r is not None else 0
                      for r in self._slots], jnp.int32)
-            self.state, sampled, self._rng = self.engine.step(
-                self.params, self.state, self._rng,
-                temperature=self._temps_dev, top_k=self._topks_dev)
+            k_spec = self.engine.spec_tokens
+            if k_spec > 0:
+                # Speculative round: draft K tokens per occupied slot
+                # from the request's own history (host work — with
+                # >= 2 steps in flight the device rides through it),
+                # verify them all in ONE [B, 1+K] dispatch. Inactive
+                # slots get a zero draft; their writes drop in-jit.
+                draft = [draft_tokens(r.history, k_spec, self.spec_ngram)
+                         if r is not None else [0] * k_spec
+                         for r in self._slots]
+                self.state, sampled, accepts, self._rng = (
+                    self.engine.step_verify(
+                        self.params, self.state, self._rng, draft,
+                        temperature=self._temps_dev,
+                        top_k=self._topks_dev))
+            else:
+                self.state, sampled, self._rng = self.engine.step(
+                    self.params, self.state, self._rng,
+                    temperature=self._temps_dev, top_k=self._topks_dev)
             prof = self.engine.profiler
             if prof is not None:
-                prof.note_occupancy(
-                    sum(1 for r in self._slots if r is not None),
-                    self.engine.batch_slots)
+                n_active = sum(1 for r in self._slots if r is not None)
+                prof.note_occupancy(n_active, self.engine.batch_slots)
+                if k_spec > 0:
+                    # note_occupancy counted 1 decode token per active
+                    # slot; a verify dispatch runs K more positions.
+                    prof.decode_tokens.inc(n_active * k_spec)
             for s, r in enumerate(self._slots):
                 if r is not None:
                     self._dispatched[s] += 1
-            self._queue_emission(('step', sampled, list(self._slots)))
+                    self._rows_dispatched[s] += 1 + k_spec
+            if k_spec > 0:
+                self._queue_emission(('verify', sampled, accepts,
+                                      list(self._slots)))
+            else:
+                self._queue_emission(('step', sampled, list(self._slots)))
             # Eager slot turnover: once a request's FINAL token has been
             # dispatched (prefill token + max_tokens-1 steps), its KV is
             # dead weight — release the slot NOW so the next _admit
@@ -1340,7 +1411,8 @@ class GenerationScheduler:
                     self._backlog_cv.notify_all()
             if not batch:
                 continue
-            n_steps = sum(1 for item in batch if item[0] == 'step')
+            n_steps = sum(1 for item in batch
+                          if item[0] in ('step', 'verify'))
             try:
                 self._emit_batch(batch)
             except Exception:  # noqa: BLE001 — emitter must survive too
@@ -1369,6 +1441,11 @@ class GenerationScheduler:
                 failed.append((item[2], item[3]))
             elif item[0] == 'firsts':
                 failed.extend(zip(item[2], item[3]))
+            elif item[0] == 'verify':
+                failed.extend(
+                    (req, slot)
+                    for slot, req in enumerate(item[3])
+                    if req is not None)
             else:
                 failed.extend(
                     (req, slot)
@@ -1387,11 +1464,19 @@ class GenerationScheduler:
         route values + make EOS/max_tokens/full decisions in order.
         Hot-path covered via its root caller ``_emit_loop``."""
         import jax.numpy as jnp
-        arrays = [item[1].reshape(-1) if item[0] in ('step', 'firsts')
-                  else item[1].reshape(1) for item in batch]
+        arrays = []
+        for item in batch:
+            if item[0] in ('step', 'firsts'):
+                arrays.append(item[1].reshape(-1))
+            elif item[0] == 'verify':
+                arrays.append(item[1].reshape(-1))  # [B * (1+K)] tokens
+                arrays.append(item[2].reshape(-1))  # [B] accept counts
+            else:
+                arrays.append(item[1].reshape(1))
         flat = (jnp.concatenate(arrays) if len(arrays) > 1
                 else arrays[0]).tolist()
         now = time.perf_counter()
+        prof = self.engine.profiler
         off = 0
         for item in batch:
             if item[0] == 'first':
@@ -1409,6 +1494,29 @@ class GenerationScheduler:
                     if req.done:
                         continue
                     self._emit_token(req, int(tok), slot, now)
+            elif item[0] == 'verify':
+                _, out_dev, _, snapshot = item
+                b, tper = out_dev.shape
+                toks = flat[off:off + b * tper]
+                off += b * tper
+                accs = flat[off:off + b]
+                off += b
+                for slot, req in enumerate(snapshot):
+                    if req is None or req.done:
+                        continue
+                    n_acc = int(accs[slot])
+                    if prof is not None:
+                        prof.note_spec_accept(n_acc, tper - 1)
+                    base = slot * tper
+                    # Emit the accepted prefix + the corrected token,
+                    # stopping the moment the request terminates (EOS /
+                    # max_tokens / full): accepted tokens past the
+                    # terminal one were never part of the K = 0 stream.
+                    for j in range(n_acc + 1):
+                        if req.done:
+                            break
+                        self._emit_token(req, int(toks[base + j]), slot,
+                                         now)
             else:
                 _, sampled, snapshot = item
                 b = len(snapshot)
@@ -1470,6 +1578,7 @@ class GenerationScheduler:
                                           + alpha * sample)
         req.out_queue.put(tok)
         req.emitted += 1
+        req.history.append(tok)  # drafter input: prompt + emitted
         req.last_token_at = now
         self._count('tokens_out')
         if self._m is not None:
@@ -1728,6 +1837,10 @@ def main() -> None:
     parser.add_argument('--kv-blocks', type=int, default=None,
                         help='KV pool size in blocks ($SKYTPU_KV_BLOCKS'
                              ', default = contiguous HBM budget)')
+    parser.add_argument('--spec-tokens', type=int, default=None,
+                        help='speculative draft tokens per decode step '
+                             '($SKYTPU_SPEC_TOKENS, default 4; 0 = '
+                             'plain one-token steps)')
     parser.add_argument('--ckpt-dir', default=None,
                         help='orbax checkpoint dir (train/checkpoint '
                              'layout) to serve trained weights from; '
@@ -1779,7 +1892,8 @@ def main() -> None:
                                     max_len=args.max_len,
                                     model=model,
                                     kv_block=args.kv_block,
-                                    kv_blocks=args.kv_blocks)
+                                    kv_blocks=args.kv_blocks,
+                                    spec_tokens=args.spec_tokens)
     scheduler.start()
     server = GenerationServer(scheduler, port=args.port)
     print(f'generation server on :{server.port} '

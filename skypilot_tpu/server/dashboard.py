@@ -94,6 +94,13 @@ def _service_metrics_row(name: str, controller_port: int) -> List[Any]:
         v = metrics_lib.histogram_quantile(cum, q)
         return '-' if v is None else f'{v:.2f}'
 
+    def hist_mean(metric):
+        total = metrics_lib.sample_value(samples, f'{metric}_sum')
+        count = metrics_lib.sample_value(samples, f'{metric}_count')
+        if not count:
+            return '-'
+        return f'{total / count:.2f}'
+
     return [
         _esc(name),
         _esc(val('skytpu_serve_requests_total')),
@@ -106,6 +113,11 @@ def _service_metrics_row(name: str, controller_port: int) -> List[Any]:
         # overlapped; gap approaching tpot p50 = device waiting on host.
         _esc(quantile_fine('skytpu_engine_step_gap_ms', 0.5)),
         _esc(val('skytpu_engine_inflight_steps_count')),
+        # Spec-decode yield: the accept histogram observes tokens emitted
+        # per slot per verify step (accept + 1), so its mean IS
+        # accepted_tokens_per_step. 1.00 = drafts never land; '-' = spec
+        # path off (SKYTPU_SPEC_TOKENS=0).
+        _esc(hist_mean('skytpu_engine_spec_accept_tokens')),
         _esc(val('skytpu_engine_recompiles_total')),
     ]
 
@@ -204,7 +216,8 @@ def render() -> str:
         serve_metrics=_table(
             ['service', 'requests', '429s', 'queue depth',
              'ttft p50 (ms)', 'ttft p99 (ms)', 'tpot p50 (ms)',
-             'step gap p50 (ms)', 'in-flight', 'recompiles'],
+             'step gap p50 (ms)', 'in-flight', 'accept/step',
+             'recompiles'],
             serve_metric_rows),
         requests=_table(['id', 'op', 'user', 'status', 'created'],
                         request_rows),

@@ -22,6 +22,12 @@ if '--xla_force_host_platform_device_count' not in flags:
 # either; a blank value stops the axon sitecustomize from registering the
 # backend while the runtime's stash/restore logic treats it as absent.
 os.environ['PALLAS_AXON_POOL_IPS'] = ''
+# Speculative decoding defaults ON in production (SKYTPU_SPEC_TOKENS=4)
+# but OFF for the suite: every scheduler a test builds would otherwise
+# pay the step_verify compile and shift pinned step/reclaim counters.
+# Spec-path tests opt in explicitly (spec_tokens= ctor arg, or setenv for
+# replica subprocesses) — setdefault so a deliberate export still wins.
+os.environ.setdefault('SKYTPU_SPEC_TOKENS', '0')
 
 import pytest  # noqa: E402
 

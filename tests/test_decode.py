@@ -99,16 +99,21 @@ def _shared_engine(**geometry):
 
 
 def _make_async_sched(params, *, batch_slots=2, max_len=64, kv_block=None,
-                      kv_blocks=None, **sched_kwargs):
+                      kv_blocks=None, spec_tokens=0, **sched_kwargs):
     from skypilot_tpu.serve.generation_server import GenerationScheduler
     sched = GenerationScheduler(CFG, params, batch_slots=batch_slots,
                                 max_len=max_len, kv_block=kv_block,
-                                kv_blocks=kv_blocks, **sched_kwargs)
+                                kv_blocks=kv_blocks,
+                                spec_tokens=spec_tokens, **sched_kwargs)
     # The scheduler reads engine/state dynamically, so swapping in the
     # shared warmed engine (same geometry) right after construction is
     # equivalent to the one it built — minus the per-test recompiles.
     sched.engine = _shared_engine(batch_slots=batch_slots, max_len=max_len,
                                   kv_block=kv_block, kv_blocks=kv_blocks)
+    # spec_tokens only gates the scheduler's dispatch choice; force it on
+    # the shared instance every checkout (a prior spec test may have
+    # flipped it — the cache would otherwise leak that state).
+    sched.engine.spec_tokens = spec_tokens
     sched.state = sched.engine.init_state()
     return sched
 
@@ -1101,3 +1106,186 @@ def test_early_eos_reclaims_never_written_tail_blocks(model_and_params):
         if ok.done:
             break
     assert _drain_out_queue(ok) == naive_greedy(model, params, [1, 2, 3], 2)
+
+
+# ---- speculative decoding (prompt-lookup drafting + step_verify) -----------
+
+def test_draft_tokens_prompt_lookup():
+    from skypilot_tpu.models.decode import draft_tokens
+    # Trailing 3-gram [7, 8, 9] recurs at the start: propose the tokens
+    # that followed it there.
+    assert draft_tokens([1, 7, 8, 9, 4, 5, 2, 7, 8, 9], 3) == [4, 5, 2]
+    # No recurrence at any n: pad by repeating the last history token.
+    assert draft_tokens([1, 2, 3], 4) == [3, 3, 3, 3]
+    # MOST RECENT earlier occurrence wins when the n-gram recurs twice.
+    assert draft_tokens([7, 8, 1, 7, 8, 2, 7, 8], 1) == [2]
+    assert draft_tokens([], 2) == [0, 0]
+    assert draft_tokens([5, 6], 0) == []
+
+
+def test_step_verify_accepts_exactly_the_greedy_prefix(model_and_params):
+    """The verify-step contract at the engine level: a perfect draft is
+    fully accepted (one step emits K+1 oracle tokens); a draft wrong at
+    position j is accepted up to j with out[j] the corrected token —
+    exactly what j+1 plain steps would have emitted."""
+    model, params = model_and_params
+    engine = _shared_engine(batch_slots=2, max_len=64)
+    prompt = [1, 9, 77, 123]
+    want = naive_greedy(model, params, prompt, 9)
+    bucket = prefill_bucket(len(prompt), 64)
+    padded = jnp.asarray(prompt + [0] * (bucket - len(prompt)), jnp.int32)
+
+    state = engine.init_state()
+    rng = jax.random.key(0)
+    state, first, rng = engine.admit(params, state, padded, len(prompt),
+                                     0, rng)
+    assert int(first) == want[0]
+    # Perfect draft: all K accepted, K+1 tokens out in ONE dispatch.
+    draft = jnp.asarray([want[1:5], [0] * 4], jnp.int32)
+    state, out, accept, rng = engine.step_verify(params, state, rng,
+                                                 draft)
+    assert int(accept[0]) == 4
+    assert [int(tok) for tok in out[0]] == want[1:6]
+    assert int(state.lengths[0]) == len(prompt) + 5
+
+    # The slot's pending token is now want[5], so the true continuation
+    # resumes at want[6]. Mismatch at draft position 1: accept stops
+    # there, out[1] is the corrected token, and the stream continues on
+    # the oracle.
+    wrong = (want[7] + 1) % CFG.vocab_size
+    draft = jnp.asarray([[want[6], wrong, want[8], want[8]], [0] * 4],
+                        jnp.int32)
+    state, out, accept, rng = engine.step_verify(params, state, rng,
+                                                 draft)
+    assert int(accept[0]) == 1
+    assert [int(tok) for tok in out[0][:2]] == want[6:8]
+    state, sampled, rng = engine.step(params, state, rng)
+    assert int(sampled[0]) == want[8]
+    engine.free_auto_tables()
+
+
+def test_spec_all_reject_rolls_back_and_leaks_no_blocks(model_and_params):
+    """Forced all-reject on the paged engine: accept 0, exactly the
+    plain step's token emitted, lengths advance by 1, and the rejected
+    KV writes are never committed — block accounting is untouched by
+    the verify step, the stream continues on the oracle over the very
+    rows the rejected draft wrote, and the pool drains to zero."""
+    model, params = model_and_params
+    engine = _shared_engine(batch_slots=2, max_len=64, kv_block=8,
+                            kv_blocks=9)
+    alloc = engine.allocator
+    base_avail = alloc.available()
+    prompt = [5, 17, 200, 9]
+    want = naive_greedy(model, params, prompt, 5)
+    bucket = prefill_bucket(len(prompt), 64)
+    padded = jnp.asarray(prompt + [0] * (bucket - len(prompt)), jnp.int32)
+
+    state = engine.init_state()
+    rng = jax.random.key(0)
+    state, first, rng = engine.admit(params, state, padded, len(prompt),
+                                     0, rng)
+    assert int(first) == want[0]
+    used_after_admit = alloc.used()
+    # Every draft position wrong (position 0 guarantees all-reject).
+    wrong = [(tok + 1) % CFG.vocab_size for tok in want[1:5]]
+    state, out, accept, rng = engine.step_verify(
+        params, state, rng, jnp.asarray([wrong, [0] * 4], jnp.int32))
+    assert int(accept[0]) == 0
+    assert int(out[0, 0]) == want[1]  # the corrected (plain) token
+    assert int(state.lengths[0]) == len(prompt) + 1
+    # Rollback is length masking, not allocator traffic: the verify
+    # step committed nothing.
+    assert alloc.used() == used_after_admit
+    got = [int(out[0, 0])]
+    for _ in range(3):
+        state, sampled, rng = engine.step(params, state, rng)
+        got.append(int(sampled[0]))
+    assert got == want[1:5]
+    engine.free_auto_tables()
+    assert alloc.used() == 0
+    assert alloc.available() == base_avail
+
+
+def test_spec_streams_identical_with_early_eos_and_turnover(
+        model_and_params):
+    """THE spec bit-identity receipt, scheduler level: drafting on
+    (K=4) vs off over the early-EOS + eager-slot-turnover workload, at
+    in-flight depth 1 AND 2 — every run emits identical greedy streams,
+    all equal to the naive oracle."""
+    model, params = model_and_params
+    p1, p2, p3 = [1, 9, 77, 123], [5, 17, 200], [4, 8]
+    want2 = naive_greedy(model, params, p2, 3)
+    specs = [(p1, 17, None), (p2, 16, want2[2]), (p3, 9, None)]
+    plain, _ = _run_async_schedule(params, 1, specs)
+    spec1, _ = _run_async_schedule(params, 1, specs, spec_tokens=4)
+    spec2, _ = _run_async_schedule(params, 2, specs, spec_tokens=4)
+    assert spec1 == plain
+    assert spec2 == plain
+    assert plain[0] == naive_greedy(model, params, p1, 17)
+    assert plain[1] == want2  # truncated AT the eos token
+    assert plain[2] == naive_greedy(model, params, p3, 9)
+
+
+def test_spec_chunked_prefill_streams_identical(model_and_params):
+    """Bit-identity under chunked prefill: a multi-chunk prompt
+    interleaving with an active decode slot emits the same greedy
+    streams with drafting on (K=4, depth 2) as plain."""
+    model, params = model_and_params
+    short, long = [5, 17, 200], [(i * 3 + 1) % CFG.vocab_size
+                                 for i in range(25)]
+    specs = [(short, 12, None), (long, 4, None)]
+    kwargs = dict(prefill_chunk=8, prefill_budget=8)
+    plain, _ = _run_async_schedule(params, 1, specs, **kwargs)
+    spec, _ = _run_async_schedule(params, 2, specs, spec_tokens=4,
+                                  **kwargs)
+    assert spec == plain
+    assert plain[0] == naive_greedy(model, params, short, 12)
+    assert plain[1] == naive_greedy(model, params, long, 4)
+
+
+def test_spec_oracle_drafter_multitoken_emission_and_metrics(
+        model_and_params, monkeypatch):
+    """Force full accepts with an oracle drafter (the true greedy
+    continuation): every verify step banks K+1 tokens, so the emitter's
+    multi-token drain, the accept histogram (mean accepted-per-step
+    well above 1.8), and steady-state recompile freedom are all
+    exercised — and the stream still equals the naive oracle."""
+    from skypilot_tpu.serve import generation_server as gs
+    model, params = model_and_params
+    prompt = [1, 9, 77, 123]
+    want = naive_greedy(model, params, prompt, 16)
+
+    def oracle_drafter(history, k, ngram=3):
+        nxt = want[len(history) - len(prompt):][:k]
+        return list(nxt) + [0] * (k - len(nxt))
+
+    monkeypatch.setattr(gs, 'draft_tokens', oracle_drafter)
+    sched = _make_async_sched(params, spec_tokens=4)
+    prof = sched.engine.profiler
+    # Metric objects are process-global; assert on deltas.
+    count0, sum0 = prof.spec_accept.count, prof.spec_accept.sum
+    hits0 = prof.spec_draft_hits.value
+
+    req = gs._Request(prompt, max_tokens=16, temperature=0.0, top_k=0,
+                      eos_id=None)
+    sched.submit(req)
+    recompiles_mid = None
+    for i in range(50):
+        sched._tick()
+        if i == 1:  # first verify variant compiled by now
+            recompiles_mid = prof.recompiles.value
+        with sched._emit_lock:
+            batch, sched._emit_q = sched._emit_q, []
+        if batch:
+            sched._emit_batch(batch)
+        if req.done:
+            break
+    sched._apply_releases()
+    assert _drain_out_queue(req) == want
+    d_count = prof.spec_accept.count - count0
+    d_sum = prof.spec_accept.sum - sum0
+    assert d_count > 0
+    assert d_sum / d_count > 1.8  # accepted tokens per verify step
+    assert prof.spec_draft_hits.value > hits0
+    # Steady state is recompile-free: K is one traced-shape bucket.
+    assert prof.recompiles.value == recompiles_mid

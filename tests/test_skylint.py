@@ -819,6 +819,7 @@ class TestShapecheckTree:
         ck = next(c for c in run.checkers if c.name == 'shapecheck')
         needed = {
             'skypilot_tpu.models.decode:DecodeEngine._step_impl',
+            'skypilot_tpu.models.decode:DecodeEngine._step_verify_impl',
             'skypilot_tpu.models.decode:DecodeEngine._prefill_impl',
             'skypilot_tpu.models.llama:LlamaModel._qkv',
             'skypilot_tpu.models.llama:LlamaModel._attend',
@@ -883,6 +884,22 @@ class TestShapecheckSeededBugs:
         assert any('changes the element count' in m
                    and 'decode' in f.path
                    for f, m in zip(run.findings, msgs)), msgs
+
+    def test_transposed_verify_gather_in_spec_decode_fails(self, tmp_path):
+        """Transposing the verify step's KV gather spec (reading the
+        cache [B, kvh, M, d] as [B, M, kvh, d]) must be caught inside
+        the [B, 1+K] speculative forward — the step_verify closure is
+        seeded (draft [B, K]) and its gqa einsum shapes are live."""
+        run = _seeded_tree(
+            tmp_path, 'models/decode.py',
+            "s = jnp.einsum('btkgd,bkmd->btkgm', qg, k_layer,",
+            "s = jnp.einsum('btkgd,bmkd->btkgm', qg, k_layer,")
+        hits = [f for f in run.findings
+                if "in spec 'btkgd,bmkd->btkgm'" in f.message
+                and f.path.endswith('models/decode.py')]
+        assert hits, [f.render() for f in run.findings]
+        assert any("einsum index 'k' binds dim" in f.message
+                   for f in hits), [f.render() for f in hits]
 
     def test_dtype_promoting_accumulate_in_decode_fails(self, tmp_path):
         """Dropping the attn astype silently promotes the residual

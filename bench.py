@@ -494,6 +494,44 @@ def phase_serve(out_path: str, on_tpu: bool, chip_kind: str) -> None:
         _write_record(out_path,
                       {'serve_error': f'{type(e).__name__}: {e}'})
         return
+    # int8 quantized-KV arm at DOUBLED kv_blocks: int8 codes + f32
+    # scales roughly halve KV bytes/block, so twice the blocks fit the
+    # SAME HBM budget the bf16 arm ran under — the record captures
+    # bf16-vs-int8 in one sweep (the r06 acceptance claim: admitted
+    # concurrency >= 2x the bf16 block budget, tpot_p50 no worse at
+    # matched concurrency). Failure here must not void the headline
+    # arm's record.
+    try:
+        if on_tpu:
+            i8 = serve_bench.run(
+                preset='llama-1b', batch_slots=64, max_len=4096,
+                prompt_len=2500, output_len=150,
+                concurrencies=(24, 48, 72, 96),
+                window_s=45.0, warmup_requests=2,
+                ready_timeout_s=150 * _SCALE,
+                warmup_deadline_s=90 * _SCALE,
+                prefill_chunk=256, ttft_slo_ms=4500.0,
+                prefix_share_len=2048, kv_block=64, kv_blocks=4097,
+                spec_tokens=4, kv_dtype='int8',
+                service_name='bench-serve-int8')
+        else:
+            i8 = serve_bench.run(
+                preset='test-tiny', batch_slots=2, max_len=128,
+                prompt_len=24, output_len=8, concurrencies=(2,),
+                window_s=4.0, warmup_requests=1,
+                ready_timeout_s=120 * _SCALE,
+                warmup_deadline_s=60 * _SCALE,
+                prefill_chunk=8, kv_block=8,
+                spec_tokens=4, kv_dtype='int8',
+                service_name='bench-serve-int8')
+        out['serve_sweep_int8'] = i8.get('serve_sweep')
+        for fld in ('serve_kv_dtype', 'serve_kv_blocks',
+                    'serve_req_per_s', 'serve_ttft_p99_ms',
+                    'serve_tpot_p50_ms'):
+            if fld in i8:
+                out['serve_int8_' + fld[len('serve_'):]] = i8[fld]
+    except Exception as e:  # noqa: BLE001
+        out['serve_int8_error'] = f'{type(e).__name__}: {e}'
     if out.get('serve_req_per_s'):
         out.update(serve_bench.equivalence_estimate(
             out['serve_req_per_s'],

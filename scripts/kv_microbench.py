@@ -8,9 +8,12 @@ the SAME fixed-batch decode loop twice — contiguous per-slot KV
 and reports per-step wall time plus the exact KV state bytes, so a
 paged-path regression (gather/scatter overhead creeping up, pool
 mis-sizing) shows up in CI-adjacent tooling without a serve run.
-Two further arms ride along: sync-vs-async dispatch (``--async-depths``)
-and speculative decode (``--spec-ks``: accepted-tokens-per-step +
-effective tok/s per draft length on a repetitive prompt)::
+Three further arms ride along: sync-vs-async dispatch
+(``--async-depths``), speculative decode (``--spec-ks``:
+accepted-tokens-per-step + effective tok/s per draft length on a
+repetitive prompt) and quantized KV (``--quant-ks``: int8-vs-bf16
+bytes/token, step-time ratio, round-trip error, and greedy-stream
+agreement with spec decode off and on)::
 
     python scripts/kv_microbench.py                      # CPU tiny
     python scripts/kv_microbench.py --preset llama-1b \
@@ -31,12 +34,14 @@ sys.path.insert(0, _REPO_ROOT)
 
 
 def _state_kv_bytes(state) -> int:
-    return int(state.k.nbytes) + int(state.v.nbytes)
+    """KV state footprint: pool halves plus (int8 mode) their scales."""
+    return (int(state.k.nbytes) + int(state.v.nbytes)
+            + int(state.k_scale.nbytes) + int(state.v_scale.nbytes))
 
 
 def bench_engine(config, params, *, slots: int, max_len: int,
                  prompt_len: int, steps: int, kv_block: int,
-                 kv_blocks=None) -> dict:
+                 kv_blocks=None, kv_dtype=None) -> dict:
     """Decode-step timing at full occupancy for one engine mode."""
     import jax
     import jax.numpy as jnp
@@ -44,7 +49,8 @@ def bench_engine(config, params, *, slots: int, max_len: int,
     from skypilot_tpu.models.decode import DecodeEngine, prefill_bucket
 
     engine = DecodeEngine(config, batch_slots=slots, max_len=max_len,
-                          kv_block=kv_block, kv_blocks=kv_blocks)
+                          kv_block=kv_block, kv_blocks=kv_blocks,
+                          kv_dtype=kv_dtype)
     state = engine.init_state()
     prompt = jax.random.randint(jax.random.key(7), (prompt_len,), 0,
                                 config.vocab_size)
@@ -66,6 +72,8 @@ def bench_engine(config, params, *, slots: int, max_len: int,
         'mode': 'paged' if kv_block > 0 else 'contiguous',
         'kv_block': kv_block,
         'kv_blocks': engine.kv_blocks,
+        'kv_dtype': engine.kv_dtype,
+        'kv_bytes_per_token': engine.kv_bytes_per_token(),
         'step_ms': round(dt / steps * 1e3, 3),
         'decode_tokens_per_s': round(slots * steps / dt, 1),
         'kv_state_bytes': _state_kv_bytes(state),
@@ -214,6 +222,97 @@ def bench_spec(config, params, *, max_len: int, prompt_len: int,
     }
 
 
+def _greedy_stream(config, params, engine, prompt, n_tokens: int,
+                   k: int, ngram: int = 3) -> list:
+    """One slot's greedy stream under a given spec draft length (k=0 =
+    plain steps) — the int8-vs-bf16 agreement probe's driver."""
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.models.decode import draft_tokens, prefill_bucket
+
+    state = engine.init_state()
+    rng = jax.random.key(11)
+    bucket = prefill_bucket(len(prompt), engine.max_len)
+    padded = jnp.asarray(list(prompt) + [0] * (bucket - len(prompt)),
+                         jnp.int32)
+    state, first, rng = engine.admit(params, state, padded, len(prompt),
+                                     0, rng)
+    hist = list(prompt) + [int(first)]
+    out = [int(first)]
+    while len(out) < n_tokens:
+        if k > 0:
+            draft = jnp.asarray([draft_tokens(hist, k, ngram), [0] * k],
+                                jnp.int32)
+            state, toks, acc, rng = engine.step_verify(params, state,
+                                                       rng, draft)
+            take = [int(t) for t in toks[0][:int(acc[0]) + 1]]
+        else:
+            state, sampled, rng = engine.step(params, state, rng)
+            take = [int(sampled[0])]
+        out.extend(take)
+        hist.extend(take)
+    return out[:n_tokens]
+
+
+def bench_quant(config, params, *, slots: int, max_len: int,
+                prompt_len: int, steps: int, kv_block: int,
+                kv_blocks=None, spec_ks=(0, 4), agree_tokens: int = 128
+                ) -> dict:
+    """Quantized-KV arm: int8-vs-bf16 bytes/token and step time on the
+    SAME paged workload, greedy-stream agreement per spec draft length,
+    and the raw quantize->dequantize round-trip error. The headline
+    claims: bytes reduction >= 1.9x, step time <= 1.1x bf16."""
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.models.decode import (DecodeEngine,
+                                            dequantize_kv_rows,
+                                            quantize_kv_rows)
+
+    common = dict(slots=slots, max_len=max_len, prompt_len=prompt_len,
+                  steps=steps, kv_block=kv_block, kv_blocks=kv_blocks)
+    bf = bench_engine(config, params, **common)
+    i8 = bench_engine(config, params, kv_dtype='int8', **common)
+
+    # Round-trip error on KV-shaped data: per-row absmax symmetric
+    # quantization bounds the error at scale/2 = absmax/254 per element.
+    x = jax.random.normal(jax.random.key(3),
+                          (config.num_kv_heads, kv_block,
+                           config.head_dim), jnp.float32)
+    q, s = quantize_kv_rows(x)
+    err = jnp.abs(dequantize_kv_rows(q, s) - x)
+    rel = float(jnp.max(err) / jnp.max(jnp.abs(x)))
+
+    agree_len = min(agree_tokens, max_len - prompt_len - 8)
+    agreement = {}
+    pattern = (5, 9, 2, 7, 11, 3, 13, 4)
+    prompt = [pattern[i % len(pattern)] % config.vocab_size
+              for i in range(prompt_len)]
+    for k in spec_ks:
+        e_bf = DecodeEngine(config, batch_slots=2, max_len=max_len,
+                            kv_block=kv_block, spec_tokens=k)
+        e_i8 = DecodeEngine(config, batch_slots=2, max_len=max_len,
+                            kv_block=kv_block, spec_tokens=k,
+                            kv_dtype='int8')
+        s_bf = _greedy_stream(config, params, e_bf, prompt, agree_len, k)
+        s_i8 = _greedy_stream(config, params, e_i8, prompt, agree_len, k)
+        agreement[f'k{k}'] = round(
+            sum(a == b for a, b in zip(s_bf, s_i8)) / agree_len, 4)
+
+    return {
+        'bf16': bf,
+        'int8': i8,
+        'kv_bytes_reduction': round(
+            bf['kv_bytes_per_token'] / i8['kv_bytes_per_token'], 2),
+        'step_time_ratio': round(i8['step_ms'] / bf['step_ms'], 3)
+        if bf['step_ms'] else None,
+        'roundtrip_rel_err': round(rel, 5),
+        'greedy_agreement': agreement,
+        'agree_tokens': agree_len,
+    }
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.split('\n')[0])
     parser.add_argument('--preset', default='test-tiny')
@@ -239,6 +338,11 @@ def main(argv=None) -> int:
                              '(0 = plain-step baseline; empty = skip)')
     parser.add_argument('--spec-ngram', type=int, default=3,
                         help='drafter n-gram length in the spec arm')
+    parser.add_argument('--quant-ks', type=int, nargs='*',
+                        default=(0, 4),
+                        help='spec draft lengths for the int8-vs-bf16 '
+                             'agreement probe in the quant arm '
+                             '(empty = skip the quant arm)')
     args = parser.parse_args(argv)
 
     import jax
@@ -277,6 +381,14 @@ def main(argv=None) -> int:
                               host_work_ms=args.host_work_ms, **common)
                   for d in (args.async_depths or ())],
     }
+    if args.quant_ks:
+        # Quant arm needs room for the agreement stream; reuse the spec
+        # arm's length floor.
+        quant_max_len = max(args.max_len, 256)
+        record['quant'] = bench_quant(
+            config, params, slots=args.slots, max_len=quant_max_len,
+            prompt_len=common['prompt_len'], steps=args.steps,
+            kv_block=args.kv_block, spec_ks=tuple(args.quant_ks))
     if args.spec_ks:
         # Own max_len: the stream needs room to settle into a cycle the
         # drafter can lock onto before the length budget runs out. Pool

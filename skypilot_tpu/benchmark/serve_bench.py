@@ -423,7 +423,8 @@ def run(*, preset: str = 'llama-1b', batch_slots: int = 16,
         ab_monolithic: bool = False, prefix_share_len: int = 0,
         kv_block: Optional[int] = None,
         kv_blocks: Optional[int] = None,
-        spec_tokens: Optional[int] = None) -> Dict[str, Any]:
+        spec_tokens: Optional[int] = None,
+        kv_dtype: Optional[str] = None) -> Dict[str, Any]:
     """Serve-path sweep, optionally A/B'd chunked-vs-monolithic.
 
     The headline service runs with ``prefill_chunk``/``ttft_slo_ms``
@@ -445,7 +446,10 @@ def run(*, preset: str = 'llama-1b', batch_slots: int = 16,
     (replica $SKYTPU_SPEC_TOKENS) pins the speculative draft length;
     pass 0 for the plain-step oracle arm, and read the resulting
     accept yield from ``skytpu_engine_spec_accept_tokens`` (mean =
-    accepted tokens per verify step) in the replica metrics summary."""
+    accepted tokens per verify step) in the replica metrics summary.
+    ``kv_dtype`` (replica $SKYTPU_KV_DTYPE) selects the KV storage
+    dtype — run an ``int8`` arm at doubled ``kv_blocks`` to capture
+    bf16-vs-int8 under the same HBM budget in one sweep."""
     import skypilot_tpu as sky
     from skypilot_tpu.models.llama import PRESETS
     from skypilot_tpu.serve import service_spec as spec_lib
@@ -469,6 +473,8 @@ def run(*, preset: str = 'llama-1b', batch_slots: int = 16,
             envs['SKYTPU_KV_BLOCKS'] = str(int(kv_blocks))
         if spec_tokens is not None:
             envs['SKYTPU_SPEC_TOKENS'] = str(int(spec_tokens))
+        if kv_dtype is not None:
+            envs['SKYTPU_KV_DTYPE'] = str(kv_dtype)
         task = sky.Task(
             run=(f'{sys.executable} -m '
                  'skypilot_tpu.serve.generation_server '
@@ -501,6 +507,8 @@ def run(*, preset: str = 'llama-1b', batch_slots: int = 16,
         out['serve_kv_blocks'] = kv_blocks
     if spec_tokens is not None:
         out['serve_spec_tokens'] = spec_tokens
+    if kv_dtype is not None:
+        out['serve_kv_dtype'] = kv_dtype
 
     def sub_progress(field: str):
         if progress is None:

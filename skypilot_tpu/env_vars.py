@@ -138,6 +138,9 @@ _v('SKYTPU_KV_BLOCK', '64', 'engine',
    'oracle)')
 _v('SKYTPU_KV_BLOCKS', '0', 'engine',
    'KV pool size in blocks (0 = the contiguous layout\'s HBM budget)')
+_v('SKYTPU_KV_DTYPE', 'bf16', 'engine',
+   'paged-KV storage dtype: bf16 (bit-identity oracle) or int8 '
+   '(absmax-quantized pool + f32 per-row scales; paged mode only)')
 _v('SKYTPU_SPEC_TOKENS', '4', 'engine',
    'speculative draft tokens per decode step (0 = plain one-token '
    'steps, the bit-identity oracle)')

@@ -33,6 +33,11 @@ EXPECTED_FAMILIES = (
     # Speculative-decode series (accept histogram feeds the dashboard
     # accept/step column and the serve_bench spec arm).
     'skytpu_engine_spec_',                # drafter + verify-step series
+    # Quantized-KV series (dashboard "KV bytes/tok" column, r06 bench
+    # bf16-vs-int8 sweep, observability.md quant guide).
+    'skytpu_engine_kv_dtype_',            # storage-dtype info gauge
+    'skytpu_engine_kv_bytes_',            # per-token KV footprint
+    'skytpu_engine_kv_quant_',            # absmax-scale canary histogram
 )
 
 _CONSTRUCTORS = {'counter', 'gauge', 'histogram'}

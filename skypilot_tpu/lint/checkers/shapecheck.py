@@ -818,6 +818,14 @@ class Interp:
                 f'matmul mixes strong {mix.half} and {mix.wide} '
                 f'operands: the {mix.half} side is silently promoted '
                 f'to {mix.wide}'))
+        qmix = sh.quantized_mix([(av.dtype, av.weak),
+                                 (bv.dtype, bv.weak)])
+        if qmix is not None:
+            problems.append(sh.Problem(
+                'dtype',
+                f'matmul contracts {qmix[0]} codes against {qmix[1]}: '
+                f'quantized storage must be dequantized '
+                f'(astype(float32) * scale) before the contraction'))
         shape = None
         if av.shape is not None and bv.shape is not None \
                 and av.rank >= 1 and bv.rank >= 1:
@@ -3255,6 +3263,36 @@ class ShapeChecker(Checker):
                         f'({k_pool.render()}: {what} '
                         f'{want.value}) — block-table entries can '
                         f'index out of the pool (or strand blocks)')
+            # Quantization-scale layout: int8 mode stores one f32 scale
+            # per pool row, so the scale arrays must be exactly the
+            # pool layout minus head_dim — [L, NB, kvh, BS]. (bf16 mode
+            # carries zero-size rank-1 placeholders; those are skipped.)
+            for sname in ('k_scale', 'v_scale'):
+                scale = fields.get(sname)
+                if not (isinstance(scale, AVal) and scale.shape):
+                    continue
+                if any(d.known and d.value == 0 for d in scale.shape):
+                    continue  # bf16 placeholder
+                if len(scale.shape) != 4:
+                    self.add_finding(
+                        ctx, node,
+                        f'init_state {sname} is {scale.render()} but '
+                        f'the quantized pool {k_pool.render()} needs '
+                        f'per-row scales [L, NB, kvh, block] (rank 4): '
+                        f'the scale scatter/gather indices mirror the '
+                        f'pool indices minus head_dim')
+                    continue
+                for axis in range(4):
+                    want, got = k_pool.shape[axis], scale.shape[axis]
+                    if want.known and got.known \
+                            and want.value != got.value:
+                        self.add_finding(
+                            ctx, node,
+                            f'init_state {sname} dim {axis} is '
+                            f'{got.value} but the KV pool '
+                            f'{k_pool.render()} has {want.value}: '
+                            f'scale rows would decouple from the pool '
+                            f'rows they scale')
 
     def _state_for(self, cls_key):
         interp = self._interp

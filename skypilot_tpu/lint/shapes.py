@@ -136,6 +136,24 @@ HALF_FLOATS = ('bfloat16', 'float16')
 WIDE_FLOATS = ('float32', 'float64')
 FLOATS = HALF_FLOATS + WIDE_FLOATS
 INTS = ('int8', 'int16', 'int32', 'int64', 'uint8', 'uint32')
+# Quantized-storage codes: contracting these against floats is almost
+# always a missing dequantize (the int8-KV engine dequantizes with an
+# explicit astype(float32) * scale BEFORE any matmul/einsum).
+NARROW_INTS = ('int8', 'uint8')
+
+
+def quantized_mix(operands: Sequence[Tuple[Optional[str], bool]]
+                  ) -> Optional[Tuple[str, str]]:
+    """(narrow_int, float) when strong operands provably mix a narrow
+    quantized-int code array with a float — flagged in contractions
+    regardless of preferred_element_type (widening the ACCUMULATOR does
+    not make contracting raw int8 codes against floats meaningful)."""
+    strong = [dt for dt, weak in operands if dt is not None and not weak]
+    narrows = [d for d in strong if d in NARROW_INTS]
+    floats = [d for d in strong if d in FLOATS]
+    if narrows and floats:
+        return narrows[0], floats[0]
+    return None
 
 
 def canon_dtype(name: str) -> Optional[str]:
@@ -373,6 +391,16 @@ def einsum_apply(spec: str, operands: Sequence[AVal],
     # dtype
     dtypes = [(op.dtype, op.weak) for op in operands]
     result_dt, mix = promote_dtypes(dtypes)
+    qmix = quantized_mix(dtypes)
+    if qmix is not None:
+        # Unlike the half/wide mix, preferred_element_type does NOT
+        # sanction this: int8 codes are meaningless in a float
+        # contraction until dequantized (astype + scale multiply).
+        problems.append(Problem(
+            'dtype',
+            f'einsum contracts {qmix[0]} codes against {qmix[1]}: '
+            f'quantized storage must be dequantized '
+            f'(astype(float32) * scale) before the contraction'))
     if mix is not None and preferred is None:
         # An explicit preferred_element_type is the sanctioned way to
         # say "accumulate wide on purpose" — only the IMPLICIT mix is

@@ -24,6 +24,16 @@ Design (vs the correctness-oracle ``LlamaModel.decode_step``):
     style reuse). All three admission paths and the decode-step scatter
     are block-indexed; the contiguous layout remains as the
     equivalence oracle and for A/B microbenches.
+  - **int8 KV** (``SKYTPU_KV_DTYPE=int8``, paged mode only): the pool
+    stores symmetric-absmax-quantized int8 rows plus f32 per-(layer,
+    block, kv-head, row) scales; every write path quantizes and the
+    attention gather dequantizes (int8 -> f32 x scale) before QK^T with
+    f32 score accumulation. Scales travel with blocks, so prefix
+    sharing, tail reclaim and spec-decode rollback-by-length-masking
+    need no extra invalidation. Halves KV bytes/token -> double the
+    block capacity under one HBM budget. ``bf16`` (default) traces the
+    exact pre-quantization program: bit-identical streams, zero new
+    compiles.
 """
 from __future__ import annotations
 
@@ -111,6 +121,21 @@ class StepProfiler:
             'verify step dispatch wall time',
             buckets=(0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 250,
                      1000, 10000, 60000))
+        # Quantized-KV series. kv_bytes_per_token is what int8 storage
+        # halves; the scale histogram is the accuracy canary — scales
+        # drifting toward the top buckets mean coarser quantization
+        # steps (absmax/127), which is where greedy agreement degrades
+        # first. Log-spaced buckets: bf16 activations put typical
+        # per-row absmax around 1e-2..1, so the edges bracket that by
+        # two decades each way.
+        self.kv_bytes_per_token = metrics_lib.gauge(
+            'skytpu_engine_kv_bytes_per_token',
+            'KV cache bytes stored per token across all layers/heads')
+        self.kv_quant_scale = metrics_lib.histogram(
+            'skytpu_engine_kv_quant_scale_ratio',
+            'per-row absmax quantization scales sampled at scrape time',
+            buckets=(1e-4, 3e-4, 1e-3, 3e-3, 0.01, 0.03, 0.1, 0.3,
+                     1.0, 3.0, 10.0))
         self._seen_variants: set = set()
         # Last-N raw gap samples, per-PROFILER (one profiler per
         # engine): the registry histogram above is process-global, so a
@@ -150,6 +175,16 @@ class StepProfiler:
         if accept:
             self.spec_draft_hits.inc(accept)
 
+    def note_kv_config(self, kv_dtype: str, bytes_per_token: int) -> None:
+        """Engine-construction facts: the storage dtype as a Prometheus
+        info gauge (constant 1, dtype label) and the per-token KV
+        footprint the dtype implies."""
+        metrics_lib.gauge(
+            'skytpu_engine_kv_dtype_info',
+            'KV cache storage dtype (constant 1; dtype label)',
+            labels={'dtype': kv_dtype}).set(1)
+        self.kv_bytes_per_token.set(bytes_per_token)
+
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
@@ -172,6 +207,15 @@ class DecodeState:
     rows are never read unmasked. Slots sharing a prompt prefix map the
     SAME physical blocks (refcounted on the host), which is what makes
     the shared-system-prompt workload prefill its prefix once.
+
+    Int8 mode (``kv_dtype='int8'``, paged only): k/v hold int8
+    quantized rows and ``k_scale``/``v_scale`` hold the f32 absmax
+    scales per (layer, block, kv-head, row) — [L, NB, kvh, BS], the
+    pool layout minus the head_dim axis. A scale row is written by
+    exactly the scatter that writes its KV row, so block sharing and
+    rollback semantics are inherited unchanged. In bf16 mode the scale
+    fields are zero-size placeholders (the ``block_tables`` [B, 0]
+    pattern): they cost nothing and never enter traced math.
     """
     k: jax.Array            # [L, B, kvh, M, d] or [L, NB, kvh, BS, d]
     v: jax.Array            # same layout as k
@@ -179,6 +223,35 @@ class DecodeState:
     last_tokens: jax.Array  # [B] int32: next token to feed per slot
     active: jax.Array       # [B] bool: slot occupied
     block_tables: jax.Array  # [B, max_blocks] int32 (paged), [B, 0] else
+    k_scale: jax.Array      # [L, NB, kvh, BS] f32 (int8 mode), [0] else
+    v_scale: jax.Array      # same layout as k_scale
+
+
+KV_DTYPES = ('bf16', 'int8')
+
+
+def quantize_kv_rows(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric absmax int8 quantization over the trailing head_dim.
+
+    ``x`` [..., d] float -> (int8 codes [..., d], f32 scales [...]).
+    Scale = absmax / 127 per row; an all-zero row gets scale 0 and
+    codes 0, so zero rows round-trip exactly (the null block stays
+    null). Round-to-nearest keeps the worst-case row error at
+    scale / 2 per element.
+    """
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)        # [...]
+    scale = amax / 127.0
+    safe = jnp.where(scale > 0.0, scale, 1.0)
+    q = jnp.clip(jnp.round(xf / safe[..., None]), -127.0, 127.0)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_kv_rows(q: jax.Array, scale: jax.Array) -> jax.Array:
+    """Inverse of :func:`quantize_kv_rows`: int8 codes [..., d] x f32
+    scales [...] -> f32 rows (the attention-gather dequant — scores
+    then accumulate in f32 via preferred_element_type)."""
+    return q.astype(jnp.float32) * scale[..., None]
 
 
 class DecodeEngine:
@@ -194,7 +267,8 @@ class DecodeEngine:
                  model: Optional[LlamaModel] = None,
                  kv_block: Optional[int] = None,
                  kv_blocks: Optional[int] = None,
-                 spec_tokens: Optional[int] = None):
+                 spec_tokens: Optional[int] = None,
+                 kv_dtype: Optional[str] = None):
         """``kv_block`` ($SKYTPU_KV_BLOCK, default 64; 0 = contiguous):
         rows per KV block. Paged mode replaces the per-slot contiguous
         [max_len] KV region with a global pool of ``kv_blocks`` blocks
@@ -210,6 +284,12 @@ class DecodeEngine:
         It only gates the CALLER (the scheduler reads it to decide
         whether to draft); ``step_verify`` itself accepts any [B, K]
         draft, one compiled variant per K.
+
+        ``kv_dtype`` ($SKYTPU_KV_DTYPE, default 'bf16'): KV storage
+        dtype. 'int8' stores absmax-quantized rows + f32 per-row
+        scales — half the KV bytes per token, so the same HBM budget
+        holds twice the blocks. Requires paged mode: the contiguous
+        layout is the bit-identity oracle and is rejected with int8.
         """
         self.config = config
         # Engine reuses the model's block methods (_qkv/_mlp_delta) so the
@@ -221,6 +301,23 @@ class DecodeEngine:
             kv_block = env_vars.get_int('SKYTPU_KV_BLOCK')
         self.kv_block = max(0, int(kv_block))
         self.paged = self.kv_block > 0
+        if kv_dtype is None:
+            kv_dtype = env_vars.get('SKYTPU_KV_DTYPE') or 'bf16'
+        if kv_dtype not in KV_DTYPES:
+            raise ValueError(f'SKYTPU_KV_DTYPE must be one of '
+                             f'{KV_DTYPES}, got {kv_dtype!r}')
+        if kv_dtype == 'int8' and not self.paged:
+            raise ValueError(
+                'SKYTPU_KV_DTYPE=int8 requires the paged KV layout '
+                '(SKYTPU_KV_BLOCK > 0): the contiguous kv_block=0 path '
+                'is the bit-identity equivalence oracle and stays '
+                'bf16')
+        self.kv_dtype = kv_dtype
+        # Host-side Python flag: bf16 mode traces EXACTLY the
+        # pre-quantization program (bit-identical streams, no new
+        # compiles); int8 mode swaps in quantized writes + dequantizing
+        # gathers at trace time.
+        self.quantized = kv_dtype == 'int8'
         if self.paged:
             self.max_blocks = -(-self.max_len // self.kv_block)
             # Gathered per-slot view length; >= max_len when max_len is
@@ -276,6 +373,9 @@ class DecodeEngine:
         # disabled: every instrumentation site below is ONE branch.
         self.profiler = (StepProfiler()
                          if metrics_lib.enabled() else None)
+        if self.profiler is not None:
+            self.profiler.note_kv_config(self.kv_dtype,
+                                         self.kv_bytes_per_token())
         # End timestamp of the last step dispatch — the step-gap
         # histogram's anchor. None across idle periods (see
         # note_dispatch_break) so the first step after a lull measures
@@ -299,14 +399,54 @@ class DecodeEngine:
             shape = (c.num_layers, b, c.num_kv_heads, self.max_len,
                      c.head_dim)
             tables = jnp.zeros((b, 0), jnp.int32)
+        if self.quantized:
+            pool_dtype = jnp.int8
+            # One f32 scale per (layer, block, kv-head, row): the pool
+            # layout minus head_dim.
+            scale_shape = (c.num_layers, self.kv_blocks,
+                           c.num_kv_heads, self.kv_block)
+        else:
+            pool_dtype = c.dtype
+            scale_shape = (0,)  # placeholder, never read (cf. [B, 0])
         return DecodeState(
-            k=jnp.zeros(shape, c.dtype),
-            v=jnp.zeros(shape, c.dtype),
+            k=jnp.zeros(shape, pool_dtype),
+            v=jnp.zeros(shape, pool_dtype),
             lengths=jnp.zeros((b,), jnp.int32),
             last_tokens=jnp.zeros((b,), jnp.int32),
             active=jnp.zeros((b,), bool),
             block_tables=tables,
+            k_scale=jnp.zeros(scale_shape, jnp.float32),
+            v_scale=jnp.zeros(scale_shape, jnp.float32),
         )
+
+    def kv_bytes_per_token(self) -> int:
+        """HBM bytes one cached token row costs across all layers (K
+        and V, scales included) — the capacity denominator: pool bytes
+        / this = token capacity, and the dashboard's "KV bytes/tok"."""
+        c = self.config
+        if self.quantized:
+            per_head = c.head_dim * 1 + 4  # int8 codes + one f32 scale
+        else:
+            per_head = c.head_dim * jnp.dtype(c.dtype).itemsize
+        return 2 * c.num_layers * c.num_kv_heads * per_head
+
+    def observe_kv_scales(self, state: DecodeState, cap: int = 512) -> None:
+        """Sample current k-scales into the quant-scale histogram
+        (scrape-time, int8 mode only). Layer 0 only and capped: this is
+        an accuracy canary, not an exhaustive dump. Best-effort — the
+        async runtime may have donated ``state``'s buffers to an
+        in-flight step, in which case reading them raises and the
+        scrape simply skips this sample."""
+        if not self.quantized or self.profiler is None:
+            return
+        try:
+            scales = jax.device_get(state.k_scale[0])
+        except (RuntimeError, ValueError):
+            return
+        flat = scales.reshape(-1)
+        nz = flat[flat > 0.0][:cap]
+        for s in nz:
+            self.profiler.kv_quant_scale.observe(float(s))
 
     # -- paged-KV host-side helpers -----------------------------------------
     def _table_arg(self, slot: Optional[int],
@@ -358,19 +498,34 @@ class DecodeEngine:
             self._auto_tables.clear()
 
     def _gather_slot(self, pool_layer: jax.Array,
-                     table_row: jax.Array) -> jax.Array:
-        """[NB, kvh, BS, d] pool gathered through [nb] -> [kvh, M, d]."""
+                     table_row: jax.Array,
+                     scale_layer: Optional[jax.Array] = None
+                     ) -> jax.Array:
+        """[NB, kvh, BS, d] pool gathered through [nb] -> [kvh, M, d].
+
+        With ``scale_layer`` ([NB, kvh, BS] f32, int8 mode) the rows
+        dequantize in the gather: int8 -> f32 x per-row scale, so
+        attention sees f32 values and accumulates scores in f32."""
         g = pool_layer[table_row]           # [nb, kvh, BS, d]
+        if scale_layer is not None:
+            s = scale_layer[table_row]      # [nb, kvh, BS]
+            g = dequantize_kv_rows(g, s)
         g = g.transpose(1, 0, 2, 3)         # [kvh, nb, BS, d]
         return g.reshape(g.shape[0], -1, g.shape[3])
 
     def _gather_batch(self, pool_layer: jax.Array,
-                      tables: jax.Array) -> jax.Array:
+                      tables: jax.Array,
+                      scale_layer: Optional[jax.Array] = None
+                      ) -> jax.Array:
         """[NB, kvh, BS, d] pool gathered through [B, nb] ->
         [B, kvh, M, d] — the paged decode read: per (slot, kv-head) the
         rows land in table order, so downstream attention is identical
-        to the contiguous layout's."""
+        to the contiguous layout's. ``scale_layer`` dequantizes as in
+        :meth:`_gather_slot`."""
         g = pool_layer[tables]              # [B, nb, kvh, BS, d]
+        if scale_layer is not None:
+            s = scale_layer[tables]         # [B, nb, kvh, BS]
+            g = dequantize_kv_rows(g, s)
         g = g.transpose(0, 2, 1, 3, 4)      # [B, kvh, nb, BS, d]
         return g.reshape(g.shape[0], g.shape[1], -1, g.shape[4])
 
@@ -489,10 +644,32 @@ class DecodeEngine:
             kv_heads = jnp.arange(c.num_kv_heads)
 
         def layer(carry, inputs):
-            x, cache_k, cache_v = carry
+            if self.quantized:
+                x, cache_k, cache_v, scale_k, scale_v = carry
+            else:
+                x, cache_k, cache_v = carry
+                scale_k = scale_v = None
             lp, i = inputs
             q, k, v = model._qkv(lp, x, cos, sin, positions, constrain=False)
-            if self.paged:
+            if self.quantized:
+                # Quantize the chunk's [C, kvh, d] rows and scatter the
+                # int8 codes + [C, kvh] scales through the SAME block-
+                # table addresses (in-place on the donated carry).
+                qk, sk = quantize_kv_rows(k[0])
+                qv, sv = quantize_kv_rows(v[0])
+                cache_k = cache_k.at[i, blk[:, None], kv_heads[None, :],
+                                     row[:, None]].set(qk)
+                cache_v = cache_v.at[i, blk[:, None], kv_heads[None, :],
+                                     row[:, None]].set(qv)
+                scale_k = scale_k.at[i, blk[:, None], kv_heads[None, :],
+                                     row[:, None]].set(sk)
+                scale_v = scale_v.at[i, blk[:, None], kv_heads[None, :],
+                                     row[:, None]].set(sv)
+                k_slot = self._gather_slot(cache_k[i], table,
+                                           scale_k[i])  # [kvh, M, d] f32
+                v_slot = self._gather_slot(cache_v[i], table,
+                                           scale_v[i])
+            elif self.paged:
                 # Scatter the chunk's [C, kvh, d] rows through the block
                 # table (in-place on the donated carry).
                 cache_k = cache_k.at[i, blk[:, None], kv_heads[None, :],
@@ -530,12 +707,21 @@ class DecodeEngine:
                                 c.head_dim).astype(c.dtype)
             x = x + jnp.einsum('bshd,hde->bse', attn, lp['wo'])
             x = x + model._mlp_delta(lp, x, constrain=False)[0]
+            if self.quantized:
+                return (x, cache_k, cache_v, scale_k, scale_v), None
             return (x, cache_k, cache_v), None
 
-        (x, new_k, new_v), _ = lax.scan(
-            layer, (x, state.k, state.v),
-            (params['layers'], jnp.arange(c.num_layers)))
-        return x, new_k, new_v
+        if self.quantized:
+            (x, new_k, new_v, new_sk, new_sv), _ = lax.scan(
+                layer, (x, state.k, state.v, state.k_scale,
+                        state.v_scale),
+                (params['layers'], jnp.arange(c.num_layers)))
+        else:
+            (x, new_k, new_v), _ = lax.scan(
+                layer, (x, state.k, state.v),
+                (params['layers'], jnp.arange(c.num_layers)))
+            new_sk, new_sv = state.k_scale, state.v_scale
+        return x, new_k, new_v, new_sk, new_sv
 
     def _tables_with(self, state, slot, table) -> jax.Array:
         """state.block_tables with ``slot``'s row set (paged only)."""
@@ -545,20 +731,21 @@ class DecodeEngine:
 
     def _prefill_chunk_impl(self, state, params, tokens, offset, slot,
                             table):
-        _, new_k, new_v = self._chunk_forward(state, params, tokens,
-                                              offset, slot, table)
+        _, new_k, new_v, new_sk, new_sv = self._chunk_forward(
+            state, params, tokens, offset, slot, table)
         return DecodeState(k=new_k, v=new_v, lengths=state.lengths,
                            last_tokens=state.last_tokens,
                            active=state.active,
                            block_tables=self._tables_with(state, slot,
-                                                          table))
+                                                          table),
+                           k_scale=new_sk, v_scale=new_sv)
 
     def _prefill_chunk_final_impl(self, state, params, tokens, offset,
                                   slot, true_len, rng, temperature, top_k,
                                   table):
         c = self.config
-        x, new_k, new_v = self._chunk_forward(state, params, tokens,
-                                              offset, slot, table)
+        x, new_k, new_v, new_sk, new_sv = self._chunk_forward(
+            state, params, tokens, offset, slot, table)
         x = rms_norm(x, params['final_norm'], c.norm_eps)
         head = (params['embed'].T if c.tie_embeddings else params['lm_head'])
         # Logits only for the prompt's last REAL token (chunk-relative).
@@ -572,6 +759,7 @@ class DecodeEngine:
             last_tokens=state.last_tokens.at[slot].set(first),
             active=state.active.at[slot].set(True),
             block_tables=self._tables_with(state, slot, table),
+            k_scale=new_sk, v_scale=new_sv,
         ), first, rng
 
     # -- insert -------------------------------------------------------------
@@ -591,6 +779,7 @@ class DecodeEngine:
         if pad_m < 0:
             raise ValueError(f'prefill length {t} exceeds max_len '
                              f'{self.max_len}')
+        new_sk, new_sv = state.k_scale, state.v_scale
         if self.paged:
             # Scatter the T rows through the block table. Rows past the
             # table's assignment hit the null block (index 0) — garbage
@@ -601,6 +790,17 @@ class DecodeEngine:
             kv_heads = jnp.arange(self.config.num_kv_heads)
             vals_k = k.transpose(0, 2, 1, 3)  # [L, T, kvh, d]
             vals_v = v.transpose(0, 2, 1, 3)
+            if self.quantized:
+                # Codes + [L, T, kvh] scales land through the same
+                # addresses the row scatter uses.
+                vals_k, sk = quantize_kv_rows(vals_k)
+                vals_v, sv = quantize_kv_rows(vals_v)
+                new_sk = state.k_scale.at[:, blk[:, None],
+                                          kv_heads[None, :],
+                                          row[:, None]].set(sk)
+                new_sv = state.v_scale.at[:, blk[:, None],
+                                          kv_heads[None, :],
+                                          row[:, None]].set(sv)
             new_k = state.k.at[:, blk[:, None], kv_heads[None, :],
                                row[:, None]].set(
                 vals_k.astype(state.k.dtype))
@@ -623,6 +823,7 @@ class DecodeEngine:
             last_tokens=state.last_tokens.at[slot].set(last_token),
             active=state.active.at[slot].set(True),
             block_tables=self._tables_with(state, slot, table),
+            k_scale=new_sk, v_scale=new_sv,
         )
 
     def admit(self, params: Params, state: DecodeState, tokens: jax.Array,
@@ -715,6 +916,7 @@ class DecodeEngine:
         logits = last @ head.astype(jnp.float32)            # [N, V]
         rng, sub = jax.random.split(rng)
         firsts = _sample(logits, sub, temperatures, top_ks)  # [N]
+        new_sk, new_sv = state.k_scale, state.v_scale
         if self.paged:
             # Scatter all N prompts' [T] rows through their tables in
             # one update per cache half (in-place: donated state).
@@ -724,6 +926,15 @@ class DecodeEngine:
             kv_heads = jnp.arange(c.num_kv_heads)
             vals_k = ks.transpose(0, 1, 3, 2, 4)  # [L, N, T, kvh, d]
             vals_v = vs.transpose(0, 1, 3, 2, 4)
+            if self.quantized:
+                vals_k, sk = quantize_kv_rows(vals_k)  # [L, N, T, kvh]
+                vals_v, sv = quantize_kv_rows(vals_v)
+                new_sk = state.k_scale.at[:, blk[:, :, None],
+                                          kv_heads[None, None, :],
+                                          row[None, :, None]].set(sk)
+                new_sv = state.v_scale.at[:, blk[:, :, None],
+                                          kv_heads[None, None, :],
+                                          row[None, :, None]].set(sv)
             new_k = state.k.at[:, blk[:, :, None],
                                kv_heads[None, None, :],
                                row[None, :, None]].set(
@@ -748,6 +959,7 @@ class DecodeEngine:
             last_tokens=state.last_tokens.at[slots].set(firsts),
             active=state.active.at[slots].set(True),
             block_tables=new_tables,
+            k_scale=new_sk, v_scale=new_sv,
         ), firsts, rng
 
     def release(self, state: DecodeState, slot: int) -> DecodeState:
@@ -769,7 +981,9 @@ class DecodeEngine:
                            lengths=state.lengths.at[slot].set(0),
                            last_tokens=state.last_tokens,
                            active=state.active.at[slot].set(False),
-                           block_tables=tables)
+                           block_tables=tables,
+                           k_scale=state.k_scale,
+                           v_scale=state.v_scale)
 
     def sample_first(self, logits: jax.Array, rng: jax.Array,
                      temperature: float, top_k: int
@@ -902,10 +1116,34 @@ class DecodeEngine:
         kv_heads = jnp.arange(c.num_kv_heads)
 
         def layer(carry, inputs):
-            x, cache_k, cache_v = carry
+            if self.quantized:
+                x, cache_k, cache_v, scale_k, scale_v = carry
+            else:
+                x, cache_k, cache_v = carry
+                scale_k = scale_v = None
             lp, i = inputs
             q, k, v = model._qkv(lp, x, cos, sin, positions, constrain=False)
-            if self.paged:
+            if self.quantized:
+                # Quantized row scatter: int8 codes + [B, kvh] scales
+                # through the same table-resolved addresses; the gather
+                # dequantizes to f32 before QK^T.
+                qk, sk = quantize_kv_rows(k[:, 0])
+                qv, sv = quantize_kv_rows(v[:, 0])
+                cache_k = cache_k.at[i, phys_blk[:, None],
+                                     kv_heads[None, :], phys_row].set(qk)
+                cache_v = cache_v.at[i, phys_blk[:, None],
+                                     kv_heads[None, :], phys_row].set(qv)
+                scale_k = scale_k.at[i, phys_blk[:, None],
+                                     kv_heads[None, :], phys_row].set(sk)
+                scale_v = scale_v.at[i, phys_blk[:, None],
+                                     kv_heads[None, :], phys_row].set(sv)
+                k_layer = self._gather_batch(cache_k[i],
+                                             state.block_tables,
+                                             scale_k[i])
+                v_layer = self._gather_batch(cache_v[i],
+                                             state.block_tables,
+                                             scale_v[i])
+            elif self.paged:
                 # Block-indexed row scatter + gather of each slot's view
                 # through its table (indices broadcast to [B, kvh]).
                 cache_k = cache_k.at[i, phys_blk[:, None],
@@ -946,12 +1184,21 @@ class DecodeEngine:
             attn = attn.reshape(b, 1, c.num_heads, c.head_dim).astype(c.dtype)
             x = x + jnp.einsum('bshd,hde->bse', attn, lp['wo'])
             x = x + model._mlp_delta(lp, x, constrain=False)[0]
+            if self.quantized:
+                return (x, cache_k, cache_v, scale_k, scale_v), None
             return (x, cache_k, cache_v), None
 
         n_layers = c.num_layers
-        (x, new_k, new_v), _ = lax.scan(
-            layer, (x, state.k, state.v),
-            (params['layers'], jnp.arange(n_layers)))
+        if self.quantized:
+            (x, new_k, new_v, new_sk, new_sv), _ = lax.scan(
+                layer, (x, state.k, state.v, state.k_scale,
+                        state.v_scale),
+                (params['layers'], jnp.arange(n_layers)))
+        else:
+            (x, new_k, new_v), _ = lax.scan(
+                layer, (x, state.k, state.v),
+                (params['layers'], jnp.arange(n_layers)))
+            new_sk, new_sv = state.k_scale, state.v_scale
 
         x = rms_norm(x, params['final_norm'], c.norm_eps)
         head = (params['embed'].T if c.tie_embeddings else params['lm_head'])
@@ -970,6 +1217,7 @@ class DecodeEngine:
             last_tokens=jnp.where(state.active, sampled, state.last_tokens),
             active=state.active,
             block_tables=state.block_tables,
+            k_scale=new_sk, v_scale=new_sv,
         ), sampled, rng
 
 
@@ -1078,10 +1326,43 @@ class DecodeEngine:
         model = self.model
 
         def layer(carry, inputs_l):
-            x, cache_k, cache_v = carry
+            if self.quantized:
+                x, cache_k, cache_v, scale_k, scale_v = carry
+            else:
+                x, cache_k, cache_v = carry
+                scale_k = scale_v = None
             lp, i = inputs_l
             q, k, v = model._qkv(lp, x, cos, sin, positions, constrain=False)
-            if self.paged:
+            if self.quantized:
+                # Quantized [B, T, kvh, d] append: codes + [B, T, kvh]
+                # scales through the same addresses, with the SAME
+                # out-of-range row sentinel dropping both — a rejected
+                # draft row leaves code and scale untouched together.
+                qk, sk = quantize_kv_rows(k)
+                qv, sv = quantize_kv_rows(v)
+                cache_k = cache_k.at[i, blk[:, :, None],
+                                     kv_heads[None, None, :],
+                                     row[:, :, None]].set(
+                    qk, mode='drop')
+                cache_v = cache_v.at[i, blk[:, :, None],
+                                     kv_heads[None, None, :],
+                                     row[:, :, None]].set(
+                    qv, mode='drop')
+                scale_k = scale_k.at[i, blk[:, :, None],
+                                     kv_heads[None, None, :],
+                                     row[:, :, None]].set(
+                    sk, mode='drop')
+                scale_v = scale_v.at[i, blk[:, :, None],
+                                     kv_heads[None, None, :],
+                                     row[:, :, None]].set(
+                    sv, mode='drop')
+                k_layer = self._gather_batch(cache_k[i],
+                                             state.block_tables,
+                                             scale_k[i])
+                v_layer = self._gather_batch(cache_v[i],
+                                             state.block_tables,
+                                             scale_v[i])
+            elif self.paged:
                 # [B, T, kvh, d] rows scattered through the tables;
                 # out-of-range row sentinels drop.
                 cache_k = cache_k.at[i, blk[:, :, None],
@@ -1122,11 +1403,19 @@ class DecodeEngine:
                                 c.head_dim).astype(c.dtype)
             x = x + jnp.einsum('bshd,hde->bse', attn, lp['wo'])
             x = x + model._mlp_delta(lp, x, constrain=False)[0]
+            if self.quantized:
+                return (x, cache_k, cache_v, scale_k, scale_v), None
             return (x, cache_k, cache_v), None
 
-        (x, new_k, new_v), _ = lax.scan(
-            layer, (x, state.k, state.v),
-            (params['layers'], jnp.arange(c.num_layers)))
+        if self.quantized:
+            (x, new_k, new_v, new_sk, new_sv), _ = lax.scan(
+                layer, (x, state.k, state.v, state.k_scale, state.v_scale),
+                (params['layers'], jnp.arange(c.num_layers)))
+        else:
+            (x, new_k, new_v), _ = lax.scan(
+                layer, (x, state.k, state.v),
+                (params['layers'], jnp.arange(c.num_layers)))
+            new_sk, new_sv = state.k_scale, state.v_scale
 
         x = rms_norm(x, params['final_norm'], c.norm_eps)
         head = (params['embed'].T if c.tie_embeddings else params['lm_head'])
@@ -1151,6 +1440,7 @@ class DecodeEngine:
         active_i = state.active.astype(jnp.int32)
         return DecodeState(
             k=new_k, v=new_v,
+            k_scale=new_sk, v_scale=new_sv,
             lengths=jnp.minimum(state.lengths + (accept + 1) * active_i,
                                 self.max_len - 1),
             last_tokens=jnp.where(state.active, new_last,

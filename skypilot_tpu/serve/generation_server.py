@@ -234,7 +234,8 @@ class GenerationScheduler:
                  kv_block: Optional[int] = None,
                  kv_blocks: Optional[int] = None,
                  inflight_steps: Optional[int] = None,
-                 spec_tokens: Optional[int] = None):
+                 spec_tokens: Optional[int] = None,
+                 kv_dtype: Optional[str] = None):
         """``model`` serves a non-Llama family through the same engine
         (e.g. a MixtralModel for MoE decode via its _mlp_delta).
 
@@ -277,6 +278,11 @@ class GenerationScheduler:
         step. Greedy streams are bit-identical to K = 0; sampling
         requests fall back to one token per step inside the same
         batched dispatch.
+
+        ``kv_dtype`` ($SKYTPU_KV_DTYPE, default 'bf16'): paged-KV
+        storage dtype. 'int8' halves KV bytes per token (quantized pool
+        + f32 per-row scales) so the same HBM budget admits ~2x the
+        blocks; requires paged mode.
         """
         import jax
         self.config = config
@@ -284,7 +290,8 @@ class GenerationScheduler:
         self.engine = DecodeEngine(config, batch_slots=batch_slots,
                                    max_len=max_len, model=model,
                                    kv_block=kv_block, kv_blocks=kv_blocks,
-                                   spec_tokens=spec_tokens)
+                                   spec_tokens=spec_tokens,
+                                   kv_dtype=kv_dtype)
         self.spec_ngram = max(1, env_vars.get_int('SKYTPU_SPEC_NGRAM'))
         self.state = self.engine.init_state()
         # Paged-KV scheduler state: explicit per-slot block assignments
@@ -562,6 +569,8 @@ class GenerationScheduler:
             'prefill_chunk': self.prefill_chunk,
             'ttft_slo_ms': self.ttft_slo_ms,
             'prefill_tokens_per_s': round(rate, 1) if rate else None,
+            'kv_dtype': self.engine.kv_dtype,
+            'kv_bytes_per_token': self.engine.kv_bytes_per_token(),
             **counters,
         }
         if self.engine.paged:
@@ -628,6 +637,9 @@ class GenerationScheduler:
         self._m.queue_depth.set(s['queue_depth'])
         self._m.pending_prefill.set(s['pending_prefill_tokens'])
         self._m.slots_active.set(s['slots_active'])
+        # Quant-scale canary (int8 KV only): sample current scales into
+        # the histogram at scrape cadence, not on the decode hot path.
+        self.engine.observe_kv_scales(self.state)
 
     # -- internals ----------------------------------------------------------
     def _warmup(self) -> None:
@@ -1841,6 +1853,11 @@ def main() -> None:
                         help='speculative draft tokens per decode step '
                              '($SKYTPU_SPEC_TOKENS, default 4; 0 = '
                              'plain one-token steps)')
+    parser.add_argument('--kv-dtype', default=None,
+                        choices=['bf16', 'int8'],
+                        help='KV storage dtype ($SKYTPU_KV_DTYPE, '
+                             'default bf16; int8 = absmax-quantized '
+                             'pool, paged mode only)')
     parser.add_argument('--ckpt-dir', default=None,
                         help='orbax checkpoint dir (train/checkpoint '
                              'layout) to serve trained weights from; '
@@ -1893,7 +1910,8 @@ def main() -> None:
                                     model=model,
                                     kv_block=args.kv_block,
                                     kv_blocks=args.kv_blocks,
-                                    spec_tokens=args.spec_tokens)
+                                    spec_tokens=args.spec_tokens,
+                                    kv_dtype=args.kv_dtype)
     scheduler.start()
     server = GenerationServer(scheduler, port=args.port)
     print(f'generation server on :{server.port} '

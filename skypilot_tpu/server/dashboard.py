@@ -118,6 +118,9 @@ def _service_metrics_row(name: str, controller_port: int) -> List[Any]:
         # accepted_tokens_per_step. 1.00 = drafts never land; '-' = spec
         # path off (SKYTPU_SPEC_TOKENS=0).
         _esc(hist_mean('skytpu_engine_spec_accept_tokens')),
+        # KV footprint: bytes stored per cached token (int8 quantized
+        # KV roughly halves this vs bf16 — more blocks per HBM byte).
+        _esc(val('skytpu_engine_kv_bytes_per_token')),
         _esc(val('skytpu_engine_recompiles_total')),
     ]
 
@@ -217,7 +220,7 @@ def render() -> str:
             ['service', 'requests', '429s', 'queue depth',
              'ttft p50 (ms)', 'ttft p99 (ms)', 'tpot p50 (ms)',
              'step gap p50 (ms)', 'in-flight', 'accept/step',
-             'recompiles'],
+             'KV bytes/tok', 'recompiles'],
             serve_metric_rows),
         requests=_table(['id', 'op', 'user', 'status', 'created'],
                         request_rows),

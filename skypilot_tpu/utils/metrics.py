@@ -36,10 +36,12 @@ DEFAULT_BUCKETS_MS: Tuple[float, ...] = (
     1000, 2500, 5000, 10000, 30000)
 
 # Allowed trailing unit tokens for skytpu_* metric names. 'total' is the
-# Prometheus counter suffix; the rest are the units this codebase
-# actually measures in.
+# Prometheus counter suffix; 'info' is the Prometheus info-metric idiom
+# (constant 1 with identifying labels); 'token' denotes a per-token
+# denominator (e.g. bytes_per_token); the rest are the units this
+# codebase actually measures in.
 UNITS = ('total', 'ms', 'seconds', 'tokens', 'requests', 'slots',
-         'bytes', 'ratio', 'count', 'rps')
+         'bytes', 'ratio', 'count', 'rps', 'info', 'token')
 
 _NAME_RE = re.compile(r'^skytpu_[a-z0-9]+(_[a-z0-9]+)+$')
 

@@ -565,3 +565,150 @@ class TestReclaimTail:
         with pytest.raises(ValueError):
             a.reclaim_tail(ids[:1])
         assert a.reclaim_tail([]) == 0
+
+
+# ---- int8 quantized pool: the paged invariants survive quantization --------
+# Block sharing, spec rollback, and tail reclaim are all table/refcount
+# mechanics — they must hold unchanged when the pool stores int8 codes
+# plus per-row scales, and the scales must travel with the blocks.
+
+@compute
+def test_int8_prefix_sharing_shares_quantized_blocks_and_scales(tiny):
+    """Prefix-cache hit under int8: the second sequence maps the SAME
+    quantized block ids copy-free, the codes AND per-row scales the
+    first prefill committed are bit-untouched by the suffix prefill,
+    every written row saturates the code range (absmax scaling puts the
+    row max at exactly +/-127), and both streams still decode on the
+    oracle within the int8 accuracy bar."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from skypilot_tpu.models.decode import DecodeEngine
+    cfg, model, params = tiny
+    eng = DecodeEngine(cfg, batch_slots=2, max_len=64, kv_block=8,
+                       kv_dtype='int8')
+    alloc = eng.allocator
+    state = eng.init_state()
+    rng = jax.random.key(0)
+    assert state.k.dtype == jnp.int8
+    assert state.k_scale.shape == (cfg.num_layers, eng.kv_blocks,
+                                   cfg.num_kv_heads, eng.kv_block)
+
+    prefix = [(i * 3 + 1) % cfg.vocab_size for i in range(16)]  # 2 blocks
+    pa = prefix + [7, 8, 9]
+    pb = prefix + [11, 12]
+
+    ids_a = alloc.alloc(3)
+    table_a = ids_a + [0] * (eng.max_blocks - 3)
+    pad_a = jnp.asarray(pa + [0] * (32 - len(pa)), jnp.int32)
+    state, first_a, rng = eng.prefill_chunk_final(
+        params, state, pad_a, 0, 0, len(pa), rng, table_row=table_a)
+    alloc.commit(hash_token_blocks(pa, 8), ids_a[:2])
+    shared = jnp.asarray(ids_a[:2])
+    scales_a = jax.device_get(state.k_scale[:, shared])
+    codes_a = jax.device_get(state.k[:, shared])
+    assert (scales_a > 0).all()  # every row of both full blocks written
+    assert (np.abs(codes_a).max(axis=-1) == 127).all()
+
+    hit = alloc.match_and_ref(hash_token_blocks(pb, 8))
+    assert hit == ids_a[:2]  # copy-free: the same physical blocks
+    used_before = alloc.used()
+    new_b = alloc.alloc(1)
+    table_b = hit + new_b + [0] * (eng.max_blocks - 3)
+    suffix = pb[16:]
+    pad_b = jnp.asarray(suffix + [0] * (8 - len(suffix)), jnp.int32)
+    state, first_b, rng = eng.prefill_chunk_final(
+        params, state, pad_b, 16, 1, len(pb), rng, table_row=table_b)
+    assert alloc.used() == used_before + 1  # only B's suffix block
+    # The suffix prefill wrote its own block only: shared codes and
+    # scales are bit-identical to what A committed.
+    assert (jax.device_get(state.k[:, shared]) == codes_a).all()
+    assert (jax.device_get(state.k_scale[:, shared]) == scales_a).all()
+
+    out_a, out_b = [int(first_a)], [int(first_b)]
+    for _ in range(3):
+        state, s, rng = eng.step(params, state, rng)
+        out_a.append(int(s[0]))
+        out_b.append(int(s[1]))
+    # int8 is held to an accuracy bar, not bit-identity (that is bf16's
+    # job): first token exact, >= 3 of 4 greedy tokens on the oracle.
+    want_a = _naive_greedy(model, params, pa, 4)
+    want_b = _naive_greedy(model, params, pb, 4)
+    assert out_a[0] == want_a[0]
+    assert out_b[0] == want_b[0]
+    assert sum(x == y for x, y in zip(out_a, want_a)) >= 3
+    assert sum(x == y for x, y in zip(out_b, want_b)) >= 3
+
+
+@compute
+def test_int8_spec_all_reject_leaks_no_blocks(tiny):
+    """Forced all-reject verify on the int8 pool: accept 0, lengths
+    advance by exactly 1, the verify step moves no blocks (rollback is
+    length masking — rejected quantized rows are simply overwritten
+    later), and the pool drains to zero on release."""
+    import jax
+    import jax.numpy as jnp
+    from skypilot_tpu.models.decode import DecodeEngine, prefill_bucket
+    cfg, model, params = tiny
+    eng = DecodeEngine(cfg, batch_slots=2, max_len=64, kv_block=8,
+                       kv_blocks=9, kv_dtype='int8')
+    alloc = eng.allocator
+    base_avail = alloc.available()
+    prompt = [5, 17, 200, 9]
+    want = _naive_greedy(model, params, prompt, 2)
+    bucket = prefill_bucket(len(prompt), 64)
+    padded = jnp.asarray(prompt + [0] * (bucket - len(prompt)), jnp.int32)
+    state = eng.init_state()
+    rng = jax.random.key(0)
+    state, first, rng = eng.admit(params, state, padded, len(prompt),
+                                  0, rng)
+    assert int(first) == want[0]  # admit logits never see quantized KV
+    used_after_admit = alloc.used()
+    # Drafting want[i]+1 at every position cannot match any greedy
+    # token (quantized or not): position 0 guarantees all-reject.
+    wrong = [(tok + 1) % cfg.vocab_size for tok in
+             _naive_greedy(model, params, prompt, 5)[1:5]]
+    state, out, accept, rng = eng.step_verify(
+        params, state, rng, jnp.asarray([wrong, [0] * 4], jnp.int32))
+    assert int(accept[0]) == 0
+    assert int(out[0, 0]) == want[1]  # the corrected (plain) token
+    assert int(state.lengths[0]) == len(prompt) + 1
+    assert alloc.used() == used_after_admit  # no allocator traffic
+    eng.free_auto_tables()
+    assert alloc.used() == 0
+    assert alloc.available() == base_avail
+
+
+@compute
+def test_int8_reclaim_tail_returns_never_written_blocks(tiny):
+    """Early-EOS tail return under int8: blocks reserved for max_tokens
+    but never scattered into hold all-zero codes AND all-zero scales,
+    reclaim_tail returns exactly them, and the pool drains to zero."""
+    import jax
+    import jax.numpy as jnp
+    from skypilot_tpu.models.decode import DecodeEngine
+    cfg, model, params = tiny
+    eng = DecodeEngine(cfg, batch_slots=1, max_len=64, kv_block=8,
+                       kv_blocks=9, kv_dtype='int8')
+    alloc = eng.allocator
+    prompt = [5, 17, 200, 9]
+    need = blocks_for(len(prompt) + 28, 8)  # reserve for 28 tokens
+    ids = alloc.alloc(need)
+    table = ids + [0] * (eng.max_blocks - need)
+    state = eng.init_state()
+    rng = jax.random.key(0)
+    pad = jnp.asarray(prompt + [0] * (8 - len(prompt)), jnp.int32)
+    state, first, rng = eng.prefill_chunk_final(
+        params, state, pad, 0, 0, len(prompt), rng, table_row=table)
+    state, s, rng = eng.step(params, state, rng)
+    # 4 prompt rows + 2 decode rows -> only block 0 ever written.
+    written = blocks_for(int(state.lengths[0]), 8)
+    assert written == 1
+    tail = jnp.asarray(ids[written:])
+    assert not jax.device_get(state.k[:, tail]).any()
+    assert not jax.device_get(state.k_scale[:, tail]).any()
+    n = alloc.reclaim_tail(ids[written:])
+    assert n == need - written
+    assert alloc.counters['reclaimed'] == n
+    alloc.deref(ids[:written])
+    assert alloc.used() == 0

@@ -851,20 +851,30 @@ class TestShapecheckTree:
 
 
 # ---- shapecheck: seeded shape bugs must fail tier-1 -------------------------
-def _seeded_tree(tmp_path, patch_file, old, new):
+def _seeded_tree(tmp_path, patch_file, old, new, extra=()):
     """Copy the whole package (package layout preserved so the
-    ProjectIndex resolves cross-module), apply one seeded bug, lint."""
+    ProjectIndex resolves cross-module), apply one seeded bug (plus any
+    ``extra`` (file, old, new) patches — e.g. flipping an env default so
+    a guarded branch becomes the traced one), lint."""
     import shutil
     dst = tmp_path / 'skypilot_tpu'
     shutil.copytree(os.path.join(REPO_ROOT, 'skypilot_tpu'), dst,
                     ignore=shutil.ignore_patterns('__pycache__'))
-    p = dst / patch_file
-    source = p.read_text()
-    assert old in source, f'seed anchor missing in {patch_file}'
-    p.write_text(source.replace(old, new, 1))
+    for pf, po, pn in ((patch_file, old, new),) + tuple(extra):
+        p = dst / pf
+        source = p.read_text()
+        assert po in source, f'seed anchor missing in {pf}'
+        p.write_text(source.replace(po, pn, 1))
     run = core.LintRun([str(dst)], checks=['shapecheck'])
     run.run()
     return run
+
+
+# Flipping the registry default makes SKYTPU_KV_DTYPE resolve to 'int8'
+# under abstract interpretation, so the quantized branches (int8 pool +
+# per-row scale arrays) become the traced ones tree-wide.
+_INT8_DEFAULT = (('env_vars.py', "_v('SKYTPU_KV_DTYPE', 'bf16', 'engine',",
+                  "_v('SKYTPU_KV_DTYPE', 'int8', 'engine',"),)
 
 
 class TestShapecheckSeededBugs:
@@ -925,6 +935,51 @@ class TestShapecheckSeededBugs:
         assert hits, [f.render() for f in run.findings]
         assert any("'mlp'" in f.message and "preset 'test-tiny'" in
                    f.message for f in hits)
+
+    def test_int8_default_tree_is_clean(self, tmp_path):
+        """Flipping SKYTPU_KV_DTYPE's registry default to int8 (no
+        other seed) traces the quantized pool/scale branches tree-wide
+        — they must lint clean, or the three seeded bugs below would
+        drown in background noise."""
+        run = _seeded_tree(tmp_path, *_INT8_DEFAULT[0])
+        assert not run.findings, [f.render() for f in run.findings]
+
+    def test_int8_scale_missing_head_dim_fails(self, tmp_path):
+        """Dropping the kv-head dim from init_state's scale allocation
+        must be caught by the allocator-vs-init_state consistency check
+        (rank-4 per-row scale contract) — scale rows would silently
+        decouple from the pool rows they scale."""
+        run = _seeded_tree(
+            tmp_path, 'models/decode.py',
+            'scale_shape = (c.num_layers, self.kv_blocks,\n'
+            '                           c.num_kv_heads, self.kv_block)',
+            'scale_shape = (c.num_layers, self.kv_blocks,\n'
+            '                           self.kv_block)',
+            extra=_INT8_DEFAULT)
+        hits = [f for f in run.findings
+                if 'per-row scales [L, NB, kvh, block]' in f.message
+                and f.path.endswith('models/decode.py')]
+        assert hits, [f.render() for f in run.findings]
+        assert any('k_scale' in f.message for f in hits)
+        assert any('v_scale' in f.message for f in hits)
+
+    def test_int8_missing_dequant_fails(self, tmp_path):
+        """Deleting the dequant step in the paged gather feeds raw int8
+        codes into the attention einsum: the narrow-int x float
+        contraction check must fire at every attention site."""
+        run = _seeded_tree(
+            tmp_path, 'models/decode.py',
+            'g = pool_layer[tables]              # [B, nb, kvh, BS, d]\n'
+            '        if scale_layer is not None:\n'
+            '            s = scale_layer[tables]         # [B, nb, kvh, BS]\n'
+            '            g = dequantize_kv_rows(g, s)',
+            'g = pool_layer[tables]              # [B, nb, kvh, BS, d]',
+            extra=_INT8_DEFAULT)
+        hits = [f for f in run.findings
+                if 'contracts int8 codes against' in f.message
+                and f.path.endswith('models/decode.py')]
+        assert hits, [f.render() for f in run.findings]
+        assert any('dequantized' in f.message for f in hits)
 
 
 # ---- baseline staleness -----------------------------------------------------

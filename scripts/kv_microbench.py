@@ -11,9 +11,11 @@ mis-sizing) shows up in CI-adjacent tooling without a serve run.
 Three further arms ride along: sync-vs-async dispatch
 (``--async-depths``), speculative decode (``--spec-ks``:
 accepted-tokens-per-step + effective tok/s per draft length on a
-repetitive prompt) and quantized KV (``--quant-ks``: int8-vs-bf16
+repetitive prompt), quantized KV (``--quant-ks``: int8-vs-bf16
 bytes/token, step-time ratio, round-trip error, and greedy-stream
-agreement with spec decode off and on)::
+agreement with spec decode off and on) and span tracing
+(``--trace-overhead``: traced-vs-plain step time for the request-
+lifecycle tracer's hot-path recording; pinned < 5% in tier-1)::
 
     python scripts/kv_microbench.py                      # CPU tiny
     python scripts/kv_microbench.py --preset llama-1b \
@@ -313,6 +315,85 @@ def bench_quant(config, params, *, slots: int, max_len: int,
     }
 
 
+def bench_trace_overhead(config, params, *, slots: int, max_len: int,
+                         prompt_len: int, steps: int, kv_block: int,
+                         kv_blocks=None, rounds: int = 3) -> dict:
+    """Span-tracing overhead arm: the SAME paged decode loop with and
+    without the scheduler's per-step trace-ring recording (one decode
+    span per slot per step, a verify point, an exemplar'd histogram
+    observe — the instrumentation the request-lifecycle tracer adds to
+    the hot path). Interleaved A/B rounds with min-per-arm timing keep
+    thermal/GC drift out of the ratio; the tier-1 pin asserts the
+    traced arm stays within 5% of plain."""
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.models.decode import DecodeEngine, prefill_bucket
+    from skypilot_tpu.utils import metrics as metrics_lib
+    from skypilot_tpu.utils import timeline
+
+    engine = DecodeEngine(config, batch_slots=slots, max_len=max_len,
+                          kv_block=kv_block, kv_blocks=kv_blocks)
+    state = engine.init_state()
+    prompt = jax.random.randint(jax.random.key(7), (prompt_len,), 0,
+                                config.vocab_size)
+    bucket = prefill_bucket(prompt_len, engine.max_len)
+    padded = jnp.pad(prompt, (0, bucket - prompt_len))
+    rng = jax.random.key(11)
+    for s in range(slots):
+        state, _, rng = engine.admit(params, state, padded, prompt_len,
+                                     s, rng)
+    for _ in range(4):  # compile + warm
+        state, sampled, rng = engine.step(params, state, rng)
+    int(sampled[0])
+    hist = metrics_lib.histogram('skytpu_bench_trace_overhead_ms',
+                                 'trace-overhead arm probe histogram')
+
+    def run(traced: bool, tag: int) -> float:
+        nonlocal state, rng
+        rids = [f'bench-{tag}-{s}' for s in range(slots)]
+        t0 = time.perf_counter()
+        for i in range(steps):
+            t_step = time.perf_counter()
+            state, sampled, rng = engine.step(params, state, rng)
+            if traced:
+                end = time.time()
+                dur = time.perf_counter() - t_step
+                for rid in rids:
+                    timeline.trace_span(rid, 'decode', end - dur, end,
+                                        steps=1, spec=False)
+                timeline.trace_point(rids[i % slots], 'verify', end,
+                                     k=0, accepted=1)
+                hist.observe(dur * 1e3, exemplar=rids[i % slots])
+            if (i + 1) % 16 == 0:  # exercise ring sealing too
+                if traced:
+                    for rid in rids:
+                        timeline.trace_finish(rid, status='ok')
+                    rids = [f'bench-{tag}-{s}-{i}' for s in range(slots)]
+        int(sampled[0])  # sync
+        dt = time.perf_counter() - t0
+        if traced:
+            for rid in rids:
+                timeline.trace_finish(rid, status='ok')
+        return dt
+
+    run(True, -1)  # warm the trace path (ring allocation, atexit hook)
+    best = {False: float('inf'), True: float('inf')}
+    for r in range(rounds):
+        for traced in (False, True) if r % 2 == 0 else (True, False):
+            best[traced] = min(best[traced], run(traced, r))
+    plain_ms = best[False] / steps * 1e3
+    traced_ms = best[True] / steps * 1e3
+    return {
+        'step_ms_plain': round(plain_ms, 4),
+        'step_ms_traced': round(traced_ms, 4),
+        'overhead_pct': round((traced_ms / plain_ms - 1) * 100, 2)
+        if plain_ms else None,
+        'spans_per_step': slots + 1,
+        'rounds': rounds,
+    }
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.split('\n')[0])
     parser.add_argument('--preset', default='test-tiny')
@@ -343,6 +424,9 @@ def main(argv=None) -> int:
                         help='spec draft lengths for the int8-vs-bf16 '
                              'agreement probe in the quant arm '
                              '(empty = skip the quant arm)')
+    parser.add_argument('--trace-overhead', action='store_true',
+                        help='add the span-tracing overhead arm '
+                             '(traced-vs-plain decode step time)')
     args = parser.parse_args(argv)
 
     import jax
@@ -403,6 +487,10 @@ def main(argv=None) -> int:
                        ngram=args.spec_ngram, out_tokens=spec_out,
                        kv_block=args.kv_block)
             for k in args.spec_ks]
+    if args.trace_overhead:
+        record['trace_overhead'] = bench_trace_overhead(
+            config, params, kv_block=args.kv_block,
+            kv_blocks=args.kv_blocks, **common)
     print(json.dumps(record))
     return 0
 

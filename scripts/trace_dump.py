@@ -1,0 +1,107 @@
+#!/usr/bin/env python3
+"""Convert a request-lifecycle trace to a Perfetto/Chrome trace file.
+
+The serving plane records a structured span tree per request
+(utils/timeline.py trace ring) and serves it as JSON at
+``/trace/<request-id>`` on both the replica and the load balancer (the
+LB merges its own ``lb.proxy`` span with the replica tree). This tool
+turns that JSON into Chrome trace-event format, loadable in Perfetto
+(https://ui.perfetto.dev) or ``chrome://tracing``::
+
+    python scripts/trace_dump.py http://127.0.0.1:PORT/trace/REQ_ID
+    python scripts/trace_dump.py trace.json -o req.trace.json
+
+The source is a URL (fetched) or a local file holding the ``/trace``
+payload. Spans become complete ('X') events on one row per span name;
+zero-duration spans (verify, first_token) become instant ('i') events
+so they stay visible at any zoom. Span attrs ride along as event args.
+Output defaults to ``<request_id>.trace.json`` in the cwd.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+from typing import Any, Dict, List
+
+
+def load_trace(source: str) -> Dict[str, Any]:
+    if source.startswith(('http://', 'https://')):
+        with urllib.request.urlopen(source, timeout=5.0) as resp:
+            return json.loads(resp.read())
+    with open(source, encoding='utf-8') as f:
+        return json.load(f)
+
+
+def to_chrome_events(trace: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Chrome trace events for one /trace payload. One pid for the
+    whole request; tid = span name so each lifecycle stage gets its own
+    swim lane and repeated spans (prefill chunks, decode bursts) line
+    up on one row."""
+    rid = trace.get('request_id', '?')
+    pid = trace.get('pid', 0)
+    lanes: Dict[str, int] = {}
+    events: List[Dict[str, Any]] = [{
+        'name': 'process_name', 'ph': 'M', 'pid': pid,
+        'args': {'name': f'request {rid}'},
+    }]
+    for span in trace.get('spans', ()):
+        name = span.get('name', '?')
+        tid = lanes.setdefault(name, len(lanes) + 1)
+        start = span.get('start_us', 0)
+        dur = max(0, span.get('end_us', start) - start)
+        evt: Dict[str, Any] = {
+            'name': name, 'pid': pid, 'tid': tid, 'ts': start,
+            'cat': 'request',
+        }
+        if dur == 0:
+            evt.update(ph='i', s='t')  # thread-scoped instant
+        else:
+            evt.update(ph='X', dur=dur)
+        attrs = span.get('attrs')
+        if attrs:
+            evt['args'] = attrs
+        events.append(evt)
+    for name, tid in lanes.items():
+        events.append({'name': 'thread_name', 'ph': 'M', 'pid': pid,
+                       'tid': tid, 'args': {'name': name}})
+    return events
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description='request trace JSON -> Perfetto/Chrome trace file')
+    parser.add_argument('source',
+                        help='/trace/<request-id> URL or a local JSON '
+                        'file holding its payload')
+    parser.add_argument('-o', '--output', default=None,
+                        help='output path (default '
+                        '<request_id>.trace.json)')
+    args = parser.parse_args(argv)
+
+    try:
+        trace = load_trace(args.source)
+    except (OSError, ValueError) as e:
+        print(f'error: cannot load trace from {args.source!r}: {e}',
+              file=sys.stderr)
+        return 1
+    if not isinstance(trace, dict) or 'spans' not in trace:
+        print(f'error: {args.source!r} is not a /trace payload '
+              "(missing 'spans')", file=sys.stderr)
+        return 1
+
+    events = to_chrome_events(trace)
+    out = args.output or f"{trace.get('request_id', 'trace')}.trace.json"
+    with open(out, 'w', encoding='utf-8') as f:
+        json.dump({'traceEvents': events, 'displayTimeUnit': 'ms'}, f)
+    n_spans = len(trace.get('spans', ()))
+    state = 'complete' if trace.get('complete') else 'in-flight'
+    print(f'{out}: {n_spans} spans ({state}, '
+          f"dropped={trace.get('dropped_spans', 0)}) — open in "
+          'https://ui.perfetto.dev')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

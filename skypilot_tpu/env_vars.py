@@ -155,6 +155,19 @@ _v('SKYTPU_TIMELINE', None, 'observability',
    'trace output path; enables the Perfetto timeline when set')
 _v('SKYTPU_TIMELINE_EVENTS', None, 'observability',
    'timeline ring-buffer capacity (default 100000)')
+_v('SKYTPU_TRACE_RING', None, 'observability',
+   'completed request-trace ring capacity served at /trace/<request-id> '
+   '(default 256)')
+_v('SKYTPU_SLO_TTFT_MS', None, 'observability',
+   'TTFT threshold for the controller burn-rate engine (default: the '
+   'admission SLO SKYTPU_TTFT_SLO_MS; 0/unset with no admission SLO '
+   'disables the TTFT burn signal)')
+_v('SKYTPU_SLO_TPOT_MS', '0', 'observability',
+   'TPOT threshold in ms for the controller burn-rate engine '
+   '(0 = TPOT burn signal off)')
+_v('SKYTPU_SLO_TARGET', '0.99', 'observability',
+   'SLO attainment target; the error budget is 1 - target and burn '
+   'rate 1.0 drains it exactly at the refill rate')
 
 # -- managed jobs -------------------------------------------------------------
 _v('SKYTPU_JOBS_POLL_INTERVAL', '15', 'jobs',

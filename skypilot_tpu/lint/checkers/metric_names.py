@@ -38,6 +38,11 @@ EXPECTED_FAMILIES = (
     'skytpu_engine_kv_dtype_',            # storage-dtype info gauge
     'skytpu_engine_kv_bytes_',            # per-token KV footprint
     'skytpu_engine_kv_quant_',            # absmax-scale canary histogram
+    # Observability plane (dashboard slo-burn column + trace links,
+    # docs/observability.md HBM ledger + burn-rate guides).
+    'skytpu_engine_hbm_',                 # device-memory ledger gauges
+    'skytpu_controller_slo_burn_',        # error-budget burn rates
+    'skytpu_serve_trace_',                # request-trace ring occupancy
 )
 
 _CONSTRUCTORS = {'counter', 'gauge', 'histogram'}

@@ -185,6 +185,33 @@ class StepProfiler:
             labels={'dtype': kv_dtype}).set(1)
         self.kv_bytes_per_token.set(bytes_per_token)
 
+    def note_hbm(self, ledger: Dict[str, float],
+                 block_stats: Optional[Dict[str, float]] = None) -> None:
+        """Publish the HBM ledger (component -> bytes) as the labeled
+        ``skytpu_engine_hbm_bytes`` gauge family, plus the block-pool
+        utilization/fragmentation ratios when ``hbm_block_stats()``
+        output is passed (scrape-time refresh; registration is
+        idempotent, so repeat scrapes just .set())."""
+        def comp(component: str, nbytes: float) -> None:
+            metrics_lib.gauge(
+                'skytpu_engine_hbm_bytes',
+                'device-memory accounting by component',
+                labels={'component': component}).set(nbytes)
+
+        for component, nbytes in ledger.items():
+            comp(component, nbytes)
+        if block_stats:
+            comp('kv_used', block_stats.get('kv_used_bytes', 0))
+            comp('kv_free', block_stats.get('kv_free_bytes', 0))
+            metrics_lib.gauge(
+                'skytpu_engine_hbm_kv_utilization_ratio',
+                'used fraction of the KV block pool').set(
+                    block_stats.get('kv_block_utilization', 0.0))
+            metrics_lib.gauge(
+                'skytpu_engine_hbm_fragmentation_ratio',
+                'share of pool bytes in free-but-resident blocks').set(
+                    block_stats.get('kv_fragmentation_ratio', 0.0))
+
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
@@ -429,6 +456,51 @@ class DecodeEngine:
         else:
             per_head = c.head_dim * jnp.dtype(c.dtype).itemsize
         return 2 * c.num_layers * c.num_kv_heads * per_head
+
+    def hbm_ledger(self, state: DecodeState,
+                   params: Optional[Params] = None) -> Dict[str, int]:
+        """Device-memory accounting table (component -> bytes).
+
+        Every entry is computed from shape metadata (``.nbytes`` reads
+        the aval, never device buffers), so the ledger is safe to build
+        while the async runtime holds donated state in flight. The KV
+        entries are exact by construction — ``kv_code_pool +
+        kv_scale_pool == kv_bytes_per_token() * kv_block * kv_blocks``
+        in paged mode for both bf16 and int8 (tier-1 pinned) — and
+        ``weights`` sums the param tree when the caller holds one.
+        ``spec_buffers`` is the per-dispatch draft+verify token I/O
+        ([B, 1+K] int32 in and out) — the only persistent spec-path
+        device footprint beyond the KV rows already in the pool.
+        """
+        ledger: Dict[str, int] = {}
+        if params is not None:
+            ledger['weights'] = sum(
+                leaf.nbytes for leaf in jax.tree_util.tree_leaves(params))
+        ledger['kv_code_pool'] = state.k.nbytes + state.v.nbytes
+        ledger['kv_scale_pool'] = (state.k_scale.nbytes
+                                   + state.v_scale.nbytes)
+        ledger['spec_buffers'] = (
+            2 * self.batch_slots * (1 + self.spec_tokens) * 4
+            if self.spec_tokens else 0)
+        return ledger
+
+    def hbm_block_stats(self) -> Dict[str, float]:
+        """Block-pool utilization/fragmentation companion to the byte
+        ledger (paged mode; empty dict otherwise). Fragmentation here
+        is the share of pool bytes parked in free-but-resident blocks
+        (incl. LRU-cached prefix blocks awaiting reuse or eviction)."""
+        if not self.paged:
+            return {}
+        stats = self.allocator.stats()
+        total = max(1, stats['kv_blocks_total'])
+        block_bytes = self.kv_bytes_per_token() * self.kv_block
+        return {
+            'kv_block_bytes': block_bytes,
+            'kv_used_bytes': stats['kv_blocks_used'] * block_bytes,
+            'kv_free_bytes': stats['kv_blocks_free'] * block_bytes,
+            'kv_block_utilization': stats['kv_block_utilization'],
+            'kv_fragmentation_ratio': stats['kv_blocks_free'] / total,
+        }
 
     def observe_kv_scales(self, state: DecodeState, cap: int = 512) -> None:
         """Sample current k-scales into the quant-scale histogram

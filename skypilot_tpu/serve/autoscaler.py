@@ -17,12 +17,14 @@ like the reference's tests/test_serve_autoscaler.py drive.
 """
 from __future__ import annotations
 
+import collections
 import math
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
 from skypilot_tpu.serve import service_spec as spec_lib
+from skypilot_tpu.utils import metrics as metrics_lib
 
 
 class RequestRateAutoscaler:
@@ -146,6 +148,122 @@ class RequestRateAutoscaler:
         if p.dynamic_ondemand_fallback:
             fallback += max(0, target - max(0, num_ready_primary))
         return MixedTarget(primary=target, ondemand_fallback=fallback)
+
+
+class SloBurnEngine:
+    """SRE-style multi-window error-budget burn rates from scraped
+    fleet histograms.
+
+    Burn rate = (fraction of requests violating the SLO over a window)
+    / (1 - SLO target): at burn 1.0 the error budget drains exactly at
+    the rate it refills; sustained burn > 1.0 over the short window is
+    the page-worthy "scale out or degrade" signal (Google SRE workbook
+    multi-window alerting), and the long window filters one-burst
+    noise. Pure logic with an injected clock, like the autoscaler
+    above: the controller feeds it one fleet scrape per tick and
+    publishes the rates as gauges + ``fleet_signals`` entries —
+    ``RequestRateAutoscaler.evaluate()``'s ready-to-consume SLO input.
+
+    Good/total counts come from cumulative histogram buckets with the
+    SLO threshold linearly interpolated inside its containing bucket
+    (the threshold rarely sits on a bucket edge); a threshold past the
+    last finite edge counts the +Inf bucket as violating, which errs
+    toward alerting. Degenerate windows (no scrape delta yet, empty
+    histogram) burn 0.0 — a cold controller must not page."""
+
+    WINDOWS: Tuple[Tuple[str, float], ...] = (('5m', 300.0),
+                                              ('1h', 3600.0))
+
+    def __init__(self, ttft_slo_ms: float = 0.0,
+                 tpot_slo_ms: float = 0.0, target: float = 0.99,
+                 windows: Optional[Sequence[Tuple[str, float]]] = None):
+        # slo name -> (histogram family, threshold ms); a zero/absent
+        # threshold disables that SLO entirely.
+        self.slos: Dict[str, Tuple[str, float]] = {}
+        if ttft_slo_ms and ttft_slo_ms > 0:
+            self.slos['ttft'] = ('skytpu_serve_ttft_ms',
+                                 float(ttft_slo_ms))
+        if tpot_slo_ms and tpot_slo_ms > 0:
+            self.slos['tpot'] = ('skytpu_serve_tpot_ms',
+                                 float(tpot_slo_ms))
+        # Clamp: target 1.0 would zero the error budget and divide by 0.
+        self.target = min(max(float(target), 0.0), 1.0 - 1e-9)
+        self.windows = tuple(windows if windows is not None
+                             else self.WINDOWS)
+        self._max_window = max((w for _, w in self.windows), default=0.0)
+        # Per SLO: cumulative (ts, good, total) snapshots, oldest first.
+        self._series: Dict[str, Deque[Tuple[float, float, float]]] = {
+            name: collections.deque() for name in self.slos}
+
+    @staticmethod
+    def _good_total(cumulative: Sequence[Tuple[float, float]],
+                    threshold_ms: float) -> Tuple[float, float]:
+        """(observations <= threshold, total) from [(le, cumulative)]."""
+        if not cumulative:
+            return 0.0, 0.0
+        total = cumulative[-1][1]
+        prev_le, prev_cum = 0.0, 0.0
+        for le, cum in cumulative:
+            if threshold_ms <= le:
+                if le == float('inf'):
+                    return prev_cum, total  # +Inf bucket counts as bad
+                if le == threshold_ms or cum <= prev_cum:
+                    return cum, total
+                frac = (threshold_ms - prev_le) / (le - prev_le)
+                return prev_cum + (cum - prev_cum) * frac, total
+            prev_le, prev_cum = le, cum
+        return total, total
+
+    def observe(self, samples: Sequence[metrics_lib.Sample],
+                now: Optional[float] = None) -> Dict[str, float]:
+        """Ingest one fleet scrape (parsed samples) and return the
+        current burn rates as flat ``slo_burn_<slo>_<window>`` signal
+        keys — merged into ``fleet_signals`` by the controller."""
+        now = time.time() if now is None else now
+        for name, (metric, threshold) in self.slos.items():
+            cumulative = metrics_lib.histogram_cumulative(samples, metric)
+            good, total = self._good_total(cumulative, threshold)
+            series = self._series[name]
+            series.append((now, good, total))
+            cutoff = now - 2 * self._max_window
+            while len(series) > 1 and series[0][0] < cutoff:
+                series.popleft()
+        return {f'slo_burn_{slo}_{win}': rate
+                for (slo, win), rate in self.burn_rates(now).items()}
+
+    def burn_rates(self, now: Optional[float] = None
+                   ) -> Dict[Tuple[str, str], float]:
+        """{(slo, window): burn rate} over each configured window. The
+        baseline is the newest snapshot at least one window old (a
+        partial history falls back to the oldest snapshot — the honest
+        short-history estimate, not a guess of zero)."""
+        now = time.time() if now is None else now
+        budget = 1.0 - self.target
+        out: Dict[Tuple[str, str], float] = {}
+        for name in self.slos:
+            series = self._series[name]
+            if not series:
+                for win_name, _ in self.windows:
+                    out[(name, win_name)] = 0.0
+                continue
+            cur_ts, cur_good, cur_total = series[-1]
+            for win_name, win_s in self.windows:
+                base = series[0]
+                for snap in series:
+                    if snap[0] <= now - win_s:
+                        base = snap
+                    else:
+                        break
+                _, base_good, base_total = base
+                d_total = cur_total - base_total
+                d_bad = ((cur_total - cur_good)
+                         - (base_total - base_good))
+                if d_total <= 0:
+                    out[(name, win_name)] = 0.0
+                else:
+                    bad_frac = min(1.0, max(0.0, d_bad / d_total))
+                    out[(name, win_name)] = bad_frac / budget
+        return out
 
 
 class MixedTarget:

@@ -106,6 +106,12 @@ class _ControllerMetrics:
             'skytpu_controller_scraped_replicas_count',
             'replicas contributing to the fleet metrics aggregate')
 
+    def slo_burn(self, slo: str, window: str) -> metrics_lib.Gauge:
+        return metrics_lib.gauge(
+            'skytpu_controller_slo_burn_ratio',
+            'error-budget burn rate (1.0 = budget drains at refill rate)',
+            labels={'slo': slo, 'window': window})
+
 
 class ServeController:
 
@@ -124,6 +130,17 @@ class ServeController:
         self._http: ThreadingHTTPServer = None
         self._m = (_ControllerMetrics()
                    if metrics_lib.enabled() else None)
+        # SLO burn-rate engine: the TTFT threshold defaults to the
+        # admission SLO so one knob arms both planes; SKYTPU_SLO_TTFT_MS
+        # overrides it for alert-only thresholds stricter/looser than
+        # the 429 line.
+        ttft_ms = env_vars.get('SKYTPU_SLO_TTFT_MS')
+        if ttft_ms is None:
+            ttft_ms = env_vars.get('SKYTPU_TTFT_SLO_MS')
+        self.burn_engine = autoscaler_lib.SloBurnEngine(
+            ttft_slo_ms=float(ttft_ms or 0),
+            tpot_slo_ms=float(env_vars.get('SKYTPU_SLO_TPOT_MS') or 0),
+            target=float(env_vars.get('SKYTPU_SLO_TARGET') or 0.99))
 
     def _maybe_adopt_update(self, row) -> None:
         """`serve update` bumped the row's version: reload spec/task and
@@ -170,7 +187,11 @@ class ServeController:
             self._m.request_rate.set(self.autoscaler.observed_qps())
             self._m.scraped_replicas.set(self.manager.num_scraped())
         own = metrics_lib.REGISTRY.render()
-        fleet = metrics_lib.render_samples(self.manager.fleet_metrics())
+        # Exemplars ride along so the dashboard can link a fleet p99
+        # bucket back to the request trace that landed in it.
+        fleet = metrics_lib.render_samples(
+            self.manager.fleet_metrics(),
+            exemplars=self.manager.fleet_exemplars())
         return own + fleet
 
     def _serve_http(self) -> None:
@@ -199,6 +220,28 @@ class ServeController:
             status = ServiceStatus.NO_REPLICA
         serve_state.set_status_unless_shutting_down(self.name, status)
 
+    def tick_once(self, row) -> None:
+        """One controller tick: autoscale, reconcile, probe, scrape,
+        burn-rate accounting, status refresh."""
+        self._maybe_adopt_update(row)
+        mixed = self.autoscaler.evaluate_mixed(
+            self.manager.num_ready_primary())
+        self.manager.reconcile(mixed.primary, mixed.ondemand_fallback)
+        self.manager.probe_all()
+        # Fleet observability: scrape replica /metrics and hand the SLO
+        # signal subset (429s, queue depth, pending prefill) plus the
+        # error-budget burn rates to the autoscaler — evaluate()
+        # consumes them in the SLO-scaling follow-up.
+        self.manager.scrape_metrics()
+        signals = self.manager.fleet_signals()
+        burn = self.burn_engine.observe(self.manager.fleet_metrics())
+        if self._m is not None:
+            for (slo, window), rate in \
+                    self.burn_engine.burn_rates().items():
+                self._m.slo_burn(slo, window).set(rate)
+        self.autoscaler.observe_fleet({**signals, **burn})
+        self._refresh_service_status()
+
     def run(self) -> None:
         serve_state.update_service(self.name, controller_pid=os.getpid())
         self._serve_http()
@@ -215,20 +258,7 @@ class ServeController:
                 self._http.shutdown()
                 return
             try:
-                self._maybe_adopt_update(row)
-                mixed = self.autoscaler.evaluate_mixed(
-                    self.manager.num_ready_primary())
-                self.manager.reconcile(mixed.primary,
-                                       mixed.ondemand_fallback)
-                self.manager.probe_all()
-                # Fleet observability: scrape replica /metrics and hand
-                # the SLO signal subset (429s, queue depth, pending
-                # prefill) to the autoscaler — evaluate() consumes them
-                # in the SLO-scaling follow-up.
-                self.manager.scrape_metrics()
-                self.autoscaler.observe_fleet(
-                    self.manager.fleet_signals())
-                self._refresh_service_status()
+                self.tick_once(row)
             except Exception as e:  # noqa: BLE001
                 # A transient failure (sqlite busy, cloud API hiccup) must
                 # not kill the controller: the fleet would run unsupervised.

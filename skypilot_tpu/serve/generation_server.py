@@ -111,6 +111,12 @@ class _SchedulerMetrics:
             'prompt tokens queued or in-flight for prefill')
         self.slots_active = metrics_lib.gauge(
             'skytpu_serve_slots_active_count', 'occupied decode slots')
+        self.trace_completed = metrics_lib.gauge(
+            'skytpu_serve_trace_ring_completed_count',
+            'completed request traces held in the trace ring')
+        self.trace_open = metrics_lib.gauge(
+            'skytpu_serve_trace_open_count',
+            'in-flight request traces not yet sealed')
 
 
 class _Request:
@@ -579,6 +585,12 @@ class GenerationScheduler:
             # fields and the capacity signal block-budget admission
             # exposes to the LB/autoscaler.
             out.update(self.engine.allocator.stats())
+        # HBM ledger: where every device byte went (shape metadata only
+        # — safe while the async runtime holds donated buffers).
+        out['hbm'] = {
+            **self.engine.hbm_ledger(self.state, self.params),
+            **self.engine.hbm_block_stats(),
+        }
         return out
 
     def _ttft_estimate_locked(self, cost: int, rate: float,
@@ -637,6 +649,15 @@ class GenerationScheduler:
         self._m.queue_depth.set(s['queue_depth'])
         self._m.pending_prefill.set(s['pending_prefill_tokens'])
         self._m.slots_active.set(s['slots_active'])
+        ts = timeline.trace_stats()
+        self._m.trace_completed.set(ts['completed'])
+        self._m.trace_open.set(ts['open'])
+        # HBM ledger -> skytpu_engine_hbm_* gauges, same scrape-time
+        # refresh cadence (never on the step path).
+        if self.engine.profiler is not None:
+            self.engine.profiler.note_hbm(
+                self.engine.hbm_ledger(self.state, self.params),
+                self.engine.hbm_block_stats())
         # Quant-scale canary (int8 KV only): sample current scales into
         # the histogram at scrape cadence, not on the decode hot path.
         self.engine.observe_kv_scales(self.state)
@@ -719,6 +740,10 @@ class GenerationScheduler:
         wait_s = req.admit_started_at - req.submitted_at
         if self._m is not None:
             self._m.queue_wait_ms.observe(wait_s * 1e3)
+            if req.request_id:
+                end = time.time()
+                timeline.trace_span(req.request_id, 'queue_wait',
+                                    end - wait_s, end)
         if timeline.enabled():
             timeline.complete('serve.queue_wait', wait_s,
                               request_id=req.request_id)
@@ -767,15 +792,29 @@ class GenerationScheduler:
         when the pool cannot satisfy it right now (the caller stashes
         the request head-of-line and retries after a release); ``False``
         when the request can NEVER fit (failed here). Contiguous mode
-        returns an empty prep (slot = region, nothing to reserve)."""
+        returns an empty prep (slot = region, nothing to reserve).
+
+        Each attempt records an ``admission`` span with the
+        block-reservation outcome on the request's trace (a request that
+        waits head-of-line records one span per retry)."""
         eng = self.engine
+        t0 = (time.time() if self._m is not None and req.request_id
+              else None)
+
+        def trace(outcome: str, **attrs: Any) -> None:
+            if t0 is not None:
+                timeline.trace_span(req.request_id, 'admission', t0,
+                                    time.time(), outcome=outcome, **attrs)
+
         if not eng.paged:
+            trace('admitted')
             return {'table': None, 'blocks': [], 'cached': 0,
                     'commit': ((), ())}
         plen = len(prompt)
         rows = min(plen + max(req.max_tokens, 1), eng.max_len)
         total_blocks = paged_kv.blocks_for(rows, eng.kv_block)
         if total_blocks > eng.allocator.capacity:
+            trace('rejected', blocks_needed=total_blocks)
             self._settle_prefill(req)
             req.fail(f'request needs {total_blocks} KV blocks; pool '
                      f'holds {eng.allocator.capacity}')
@@ -784,9 +823,11 @@ class GenerationScheduler:
         reservation = eng.allocator.reserve(
             full_chain[:self._match_cap(plen)], total_blocks)
         if reservation is None:
+            trace('wait_blocks', blocks_needed=total_blocks)
             return None
         cached_ids, new_ids = reservation
         ids = cached_ids + new_ids
+        trace('reserved', blocks=len(ids), cached_blocks=len(cached_ids))
         table = ids + [0] * (eng.max_blocks - len(ids))
         # Commit candidates: every FULL prompt block (decode rows are
         # not cached). Registered only after the prefill that fills
@@ -924,7 +965,9 @@ class GenerationScheduler:
             piece = prompt[off:off + bucket]
             padded = jnp.asarray(piece + [0] * (bucket - len(piece)),
                                  jnp.int32)
-            chunk_t0 = time.perf_counter() if timeline.enabled() else None
+            trace_on = (timeline.enabled()
+                        or (self._m is not None and req.request_id))
+            chunk_t0 = time.perf_counter() if trace_on else None
             try:
                 if final:
                     self.state, first, self._rng = eng.prefill_chunk_final(
@@ -942,11 +985,17 @@ class GenerationScheduler:
             if chunk_t0 is not None:
                 # Dispatch time, not device time (chunks are async): the
                 # span still localizes which chunk a stall landed in.
+                dur = time.perf_counter() - chunk_t0
                 timeline.complete(
-                    'serve.prefill_chunk',
-                    time.perf_counter() - chunk_t0,
+                    'serve.prefill_chunk', dur,
                     request_id=req.request_id, offset=off,
                     bucket=bucket, final=final)
+                if self._m is not None and req.request_id:
+                    end = time.time()
+                    timeline.trace_span(
+                        req.request_id, 'prefill_chunk', end - dur, end,
+                        offset=off, bucket=bucket, final=final,
+                        cached=prep['cached'] if prep else 0)
             spent += bucket
             prog['next'] += 1
             if final:
@@ -1075,6 +1124,7 @@ class GenerationScheduler:
                     and len(group) == self.ADMIT_BATCH_MAX):
                 slots = free[:len(group)]
                 free = free[len(group):]
+                t0 = time.time() if self._m is not None else None
                 try:
                     toks = jnp.asarray(
                         [p + [0] * (group_bucket - len(p))
@@ -1098,6 +1148,11 @@ class GenerationScheduler:
                         if prep['blocks']:
                             self._slot_kv[slot] = prep['blocks']
                             self._commit_prefix(prep)
+                        if t0 is not None and req.request_id:
+                            timeline.trace_span(
+                                req.request_id, 'prefill', t0,
+                                time.time(), bucket=group_bucket,
+                                fused=True)
                     self._queue_emission(
                         ('firsts', firsts, [r for r, _, _ in group],
                          list(slots)))
@@ -1111,6 +1166,8 @@ class GenerationScheduler:
                         + solo)
             for req, prompt, prep, bucket in solo:
                 slot = free.pop(0)
+                t0 = (time.time() if self._m is not None
+                      and req.request_id else None)
                 try:
                     padded = jnp.asarray(
                         prompt + [0] * (bucket - len(prompt)), jnp.int32)
@@ -1124,6 +1181,9 @@ class GenerationScheduler:
                     self._settle_prefill(req)
                     req.fail(f'prefill failed: {e!r}')
                     continue
+                if t0 is not None:
+                    timeline.trace_span(req.request_id, 'prefill', t0,
+                                        time.time(), bucket=bucket)
                 self._slots[slot] = req
                 self._dispatched[slot] = 0
                 self._rows_dispatched[slot] = 0
@@ -1141,6 +1201,8 @@ class GenerationScheduler:
                 suffix = prompt[cached:]
                 bucket = min(prefill_bucket(len(suffix), eng.max_len),
                              eng.max_len - cached)
+                t0 = (time.time() if self._m is not None
+                      and req.request_id else None)
                 try:
                     padded = jnp.asarray(
                         suffix + [0] * (bucket - len(suffix)), jnp.int32)
@@ -1156,6 +1218,10 @@ class GenerationScheduler:
                     self._settle_prefill(req)
                     req.fail(f'prefill failed: {e!r}')
                     continue
+                if t0 is not None:
+                    timeline.trace_span(req.request_id, 'prefill', t0,
+                                        time.time(), bucket=bucket,
+                                        cached=cached)
                 self._slots[slot] = req
                 self._dispatched[slot] = 0
                 self._rows_dispatched[slot] = 0
@@ -1326,16 +1392,34 @@ class GenerationScheduler:
         """
         import jax.numpy as jnp
         dispatched = 0
+        k_spec = self.engine.spec_tokens
+        # Burst-grained trace spans: one 'decode' span per request per
+        # dispatch burst (not per step — a 1000-token generation must
+        # not write 1000 spans). Collected here, flushed at every
+        # return so eagerly-released slots keep their last burst.
+        burst_t0 = time.time() if self._m is not None else None
+        burst_steps: Dict[str, int] = {}
+
+        def flush_burst() -> None:
+            if burst_t0 is None or not burst_steps:
+                return
+            end = time.time()
+            for rid, n in burst_steps.items():
+                timeline.trace_span(rid, 'decode', burst_t0, end,
+                                    steps=n, spec=bool(k_spec))
+
         while dispatched < self.inflight_steps and self._needs_step():
             with self._backlog_cv:
                 if len(self._emit_q) >= self.MAX_BACKLOG:
                     self._emit_event.set()
                     if dispatched:
+                        flush_burst()
                         return dispatched
                     # Event-driven wait for the emitter's drain notify;
                     # the timeout only covers a missed signal.
                     self._backlog_cv.wait(timeout=0.05)
                     if len(self._emit_q) >= self.MAX_BACKLOG:
+                        flush_burst()
                         return dispatched
             # Per-slot sampling settings; traced [B] args, so
             # heterogeneous values share one compiled step. Device
@@ -1352,7 +1436,6 @@ class GenerationScheduler:
                 self._topks_dev = jnp.asarray(
                     [r.top_k if r is not None else 0
                      for r in self._slots], jnp.int32)
-            k_spec = self.engine.spec_tokens
             if k_spec > 0:
                 # Speculative round: draft K tokens per occupied slot
                 # from the request's own history (host work — with
@@ -1383,6 +1466,9 @@ class GenerationScheduler:
                 if r is not None:
                     self._dispatched[s] += 1
                     self._rows_dispatched[s] += 1 + k_spec
+                    if burst_t0 is not None and r.request_id:
+                        burst_steps[r.request_id] = (
+                            burst_steps.get(r.request_id, 0) + 1)
             if k_spec > 0:
                 self._queue_emission(('verify', sampled, accepts,
                                       list(self._slots)))
@@ -1405,6 +1491,7 @@ class GenerationScheduler:
                         and 1 + self._dispatched[s] >= r.max_tokens):
                     self._release_slot(s)
             dispatched += 1
+        flush_burst()
         return dispatched
 
     # -- emitter ------------------------------------------------------------
@@ -1519,6 +1606,9 @@ class GenerationScheduler:
                     n_acc = int(accs[slot])
                     if prof is not None:
                         prof.note_spec_accept(n_acc, tper - 1)
+                    if self._m is not None and req.request_id:
+                        timeline.trace_point(req.request_id, 'verify',
+                                             k=tper - 1, accepted=n_acc)
                     base = slot * tper
                     # Emit the accepted prefix + the corrected token,
                     # stopping the moment the request terminates (EOS /
@@ -1545,7 +1635,14 @@ class GenerationScheduler:
             req.first_token_at = now
             ttft_ms = (now - req.submitted_at) * 1e3
             if self._m is not None:
-                self._m.ttft_ms.observe(ttft_ms)
+                # Tail exemplar: the p99 bucket remembers WHICH request
+                # landed there, so the dashboard links straight to its
+                # /trace/<request-id> span tree.
+                self._m.ttft_ms.observe(ttft_ms,
+                                        exemplar=req.request_id)
+                if req.request_id:
+                    timeline.trace_point(req.request_id, 'first_token',
+                                         ttft_ms=round(ttft_ms, 2))
                 if req.est_ttft_ms is not None:
                     self._m.ttft_est_error_ms.observe(
                         abs(req.est_ttft_ms - ttft_ms))
@@ -1610,7 +1707,21 @@ class GenerationScheduler:
                 # so the per-request MEAN is the honest grain.
                 self._m.tpot_ms.observe(
                     (now - req.first_token_at) * 1e3
-                    / (req.emitted - 1))
+                    / (req.emitted - 1),
+                    exemplar=req.request_id)
+            if self._m is not None and req.request_id:
+                # Seal the trace: the emit span covers first-token ->
+                # last-token delivery, then the finished tree moves
+                # into the completed ring /trace/<request-id> serves.
+                end = time.time()
+                first_wall = end - max(0.0, now - (req.first_token_at
+                                                   or now))
+                timeline.trace_span(req.request_id, 'emit', first_wall,
+                                    end, tokens=req.emitted)
+                timeline.trace_finish(
+                    req.request_id,
+                    status='error' if req.error else 'ok',
+                    tokens=req.emitted)
             req.out_queue.put(None)  # sentinel: stream end
             if slot is not None:
                 self._releases.put((slot, req))
@@ -1659,6 +1770,17 @@ class GenerationServer:
                                          'SKYTPU_TIMELINE not set'})
                     else:
                         self._json(200, {'saved': timeline.save()})
+                elif self.path.startswith('/trace/'):
+                    # Structured span tree for one request (completed
+                    # ring, falling back to the in-flight tree for a
+                    # request still streaming).
+                    rid = self.path[len('/trace/'):]
+                    tr = timeline.get_trace(rid)
+                    if tr is None:
+                        self._json(404, {
+                            'error': f'no trace for request {rid!r}'})
+                    else:
+                        self._json(200, tr)
                 else:
                     self._json(404, {'error': 'not found'})
 
@@ -1735,6 +1857,13 @@ class GenerationServer:
                                  request_id=request_id,
                                  est_ttft_ms=reject['est_ttft_ms'],
                                  ttft_slo_ms=reject['ttft_slo_ms'])
+            if self.scheduler._m is not None:
+                # Sealed immediately: a shed request's trace is just
+                # the rejection record.
+                timeline.trace_point(request_id, 'admission',
+                                     outcome='rejected_slo',
+                                     est_ttft_ms=reject['est_ttft_ms'])
+                timeline.trace_finish(request_id, status='rejected')
             # Early reject: the queue-wait estimate already blows the
             # TTFT SLO, so refuse before taking any engine work. 429 +
             # Retry-After is the LB's signal to shed to another replica.

@@ -181,6 +181,42 @@ class LoadBalancer:
         with self._ts_lock:
             self._pending_timestamps.append(time.time())
 
+    def trace_payload(self, rid: str) -> tuple:
+        """(status, body) for ``GET /trace/<rid>``: the LB's own
+        ``lb.proxy`` span merged with the replica-side span tree. The
+        LB doesn't record which replica served a request, so it asks
+        every known replica (the ring lookup is a cheap 404 elsewhere);
+        sub-second timeouts bound the sweep. Not on the proxy hot
+        path — this is a debugging endpoint."""
+        local = timeline.get_trace(rid)
+        merged = None
+        for url in self.policy.urls:
+            try:
+                with urllib.request.urlopen(
+                        url.rstrip('/') + '/trace/' + rid,
+                        timeout=0.8) as resp:
+                    remote = json.loads(resp.read())
+            except (urllib.error.URLError, OSError, ValueError):
+                continue
+            if isinstance(remote, dict) and remote.get('spans'):
+                remote['replica_url'] = url
+                merged = remote
+                break
+        if merged is None and local is None:
+            return 404, {'error': f'no trace for request {rid!r}'}
+        if merged is None:
+            merged = dict(local)
+        elif local is not None and local.get('pid') != merged.get('pid'):
+            # Same pid means the "remote" tree came from this process's
+            # own trace ring (in-process replica in tests / local dev):
+            # merging would duplicate every span.
+            merged = dict(merged)
+            merged['spans'] = sorted(
+                list(local.get('spans', ())) + list(merged['spans']),
+                key=lambda s: (s['start_us'], s['end_us']))
+            merged['lb_pid'] = local.get('pid')
+        return 200, merged
+
     # -- serving --------------------------------------------------------------
     def run(self) -> None:
         lb = self
@@ -209,15 +245,25 @@ class LoadBalancer:
 
                 def account(code: int) -> None:
                     dur_s = time.perf_counter() - t0
+                    end = time.time()
                     if lb._m is not None:
-                        lb._m.proxy_ms.observe(dur_s * 1e3)
+                        # Exemplar: the proxy-latency tail bucket keeps
+                        # the request id, linking to /trace/<id>.
+                        lb._m.proxy_ms.observe(dur_s * 1e3, exemplar=rid)
                         lb._m.response(code)
+                        # LB-side span tree entry: one lb.proxy span
+                        # covering receipt -> response completion,
+                        # sealed immediately (the replica-side tree is
+                        # merged at query time by /trace/<id>).
+                        timeline.trace_span(rid, 'lb.proxy',
+                                            end - dur_s, end,
+                                            status=code, path=self.path)
+                        timeline.trace_finish(rid, status=str(code))
                     if timeline.enabled():
                         # The lb.proxy slice ENCLOSES this request's
                         # flow events (the earlier flow_start and the
                         # flow_end below): Perfetto only renders flow
                         # arrows anchored inside duration slices.
-                        end = time.time()
                         timeline.complete('lb.proxy', dur_s,
                                           end_wall_s=end,
                                           request_id=rid, status=code)
@@ -393,6 +439,18 @@ class LoadBalancer:
                     self.send_response(200)
                     self.send_header('Content-Type',
                                      metrics_lib.CONTENT_TYPE)
+                    self.send_header('Content-Length', str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                if (lb._m is not None
+                        and self.path.startswith('/trace/')):
+                    # One request's merged span tree (LB + replica).
+                    code, payload = lb.trace_payload(
+                        self.path[len('/trace/'):])
+                    data = json.dumps(payload).encode()
+                    self.send_response(code)
+                    self.send_header('Content-Type', 'application/json')
                     self.send_header('Content-Length', str(len(data)))
                     self.end_headers()
                     self.wfile.write(data)

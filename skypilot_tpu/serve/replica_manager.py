@@ -67,6 +67,11 @@ class ReplicaManager:
         # controller's fleet aggregate and the autoscaler's SLO signals.
         self._metrics_lock = threading.Lock()
         self._replica_metrics: Dict[int, List[metrics_lib.Sample]] = {}
+        # Latest histogram-bucket exemplars per replica (same scrape):
+        # the request ids that landed in each latency bucket, re-exported
+        # by the controller so dashboard tail cells can link to traces.
+        self._replica_exemplars: Dict[
+            int, List[metrics_lib.Exemplar]] = {}
 
     def _set_task(self, spec: spec_lib.ServiceSpec, task_yaml: Dict) -> None:
         self.spec = spec
@@ -347,6 +352,9 @@ class ReplicaManager:
             for rid in list(self._replica_metrics):
                 if rid not in live:
                     del self._replica_metrics[rid]
+            for rid in list(self._replica_exemplars):
+                if rid not in live:
+                    del self._replica_exemplars[rid]
         list(self._probe_pool.map(self._scrape_one, live.values()))
 
     def _scrape_one(self, replica: Dict) -> None:
@@ -363,8 +371,10 @@ class ReplicaManager:
         samples = metrics_lib.parse_text(text)
         if not samples:
             return  # 200 + non-exposition body (arbitrary user replica)
+        exemplars = metrics_lib.parse_exemplars(text)
         with self._metrics_lock:
             self._replica_metrics[rid] = samples
+            self._replica_exemplars[rid] = exemplars
 
     def num_scraped(self) -> int:
         with self._metrics_lock:
@@ -376,6 +386,14 @@ class ReplicaManager:
         with self._metrics_lock:
             scrapes = list(self._replica_metrics.values())
         return metrics_lib.aggregate_samples(scrapes)
+
+    def fleet_exemplars(self) -> List[metrics_lib.Exemplar]:
+        """Fleet-level exemplar union (last replica wins per bucket):
+        re-attached to the aggregate's bucket lines by the controller's
+        /metrics so trace links survive the scrape chain."""
+        with self._metrics_lock:
+            scrapes = list(self._replica_exemplars.values())
+        return metrics_lib.merge_exemplars(scrapes)
 
     def fleet_signals(self) -> Dict[str, float]:
         """The SLO-relevant subset of the fleet aggregate, keyed by

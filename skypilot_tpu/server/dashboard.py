@@ -65,7 +65,8 @@ def _esc(v: Any) -> str:
     return html.escape(str(v if v is not None else '-'))
 
 
-def _service_metrics_row(name: str, controller_port: int) -> List[Any]:
+def _service_metrics_row(name: str, controller_port: int,
+                         lb_port: int = 0) -> List[Any]:
     """One fleet-metrics row from the service controller's /metrics
     aggregate (see docs/observability.md, 'reading the dashboard').
     Sub-second timeout: the dashboard renders inside an API request and
@@ -77,8 +78,9 @@ def _service_metrics_row(name: str, controller_port: int) -> List[Any]:
     with urllib.request.urlopen(
             f'http://127.0.0.1:{controller_port}/metrics',
             timeout=0.8) as resp:
-        samples = metrics_lib.parse_text(
-            resp.read().decode('utf-8', 'replace'))
+        text = resp.read().decode('utf-8', 'replace')
+    samples = metrics_lib.parse_text(text)
+    exemplars = metrics_lib.parse_exemplars(text)
 
     def val(metric, default='-'):
         v = metrics_lib.sample_value(samples, metric)
@@ -101,14 +103,49 @@ def _service_metrics_row(name: str, controller_port: int) -> List[Any]:
             return '-'
         return f'{total / count:.2f}'
 
+    def tail_cell(metric, q):
+        """Quantile cell linked to the trace of the slowest exemplar in
+        that histogram: 'the p99 is 900ms' becomes one click to the
+        span tree of a request that actually landed in the tail."""
+        text_val = quantile(metric, q)
+        best = None
+        for fam, _labels, rid, value in exemplars:
+            if fam == f'{metric}_bucket' and rid and (
+                    best is None or value > best[1]):
+                best = (rid, value)
+        if best is None or not lb_port or text_val == '-':
+            return _esc(text_val)
+        url = f'http://127.0.0.1:{lb_port}/trace/{best[0]}'
+        return (f'<a href="{html.escape(url, quote=True)}" '
+                f'title="trace {html.escape(best[0])}">'
+                f'{_esc(text_val)}</a>')
+
+    def burn_cell():
+        """Worst burn rate across SLOs/windows: >1.0 means the error
+        budget is draining faster than it refills (alert-red)."""
+        worst = None
+        for sname, slabels, svalue in samples:
+            if sname != 'skytpu_controller_slo_burn_ratio':
+                continue
+            if worst is None or svalue > worst[1]:
+                worst = (dict(slabels), svalue)
+        if worst is None:
+            return '<span class="muted">-</span>'
+        labels, rate = worst
+        tag = (f"{labels.get('slo', '?')}/{labels.get('window', '?')} "
+               f'{rate:.2f}x')
+        cls = 'bad' if rate > 1.0 else ('warn' if rate > 0.5 else 'ok')
+        return f'<span class="{cls}">{html.escape(tag)}</span>'
+
     return [
         _esc(name),
         _esc(val('skytpu_serve_requests_total')),
         _esc(val('skytpu_serve_rejected_total')),
         _esc(val('skytpu_serve_queue_depth_requests')),
         _esc(quantile('skytpu_serve_ttft_ms', 0.5)),
-        _esc(quantile('skytpu_serve_ttft_ms', 0.99)),
+        tail_cell('skytpu_serve_ttft_ms', 0.99),
         _esc(quantile('skytpu_serve_tpot_ms', 0.5)),
+        burn_cell(),
         # Async-runtime health: sub-ms step-gap p50 = host work fully
         # overlapped; gap approaching tpot p50 = device waiting on host.
         _esc(quantile_fine('skytpu_engine_step_gap_ms', 0.5)),
@@ -170,7 +207,8 @@ def render() -> str:
                 _esc(s['lb_port'] or '-'),
             ])
             if s.get('controller_port'):
-                metric_targets.append((s['name'], s['controller_port']))
+                metric_targets.append((s['name'], s['controller_port'],
+                                       s['lb_port'] or 0))
         if metric_targets:
             # Concurrent scrapes: k services with wedged controllers
             # must cost ONE sub-second timeout, not k in series.
@@ -219,7 +257,7 @@ def render() -> str:
         serve_metrics=_table(
             ['service', 'requests', '429s', 'queue depth',
              'ttft p50 (ms)', 'ttft p99 (ms)', 'tpot p50 (ms)',
-             'step gap p50 (ms)', 'in-flight', 'accept/step',
+             'slo burn', 'step gap p50 (ms)', 'in-flight', 'accept/step',
              'KV bytes/tok', 'recompiles'],
             serve_metric_rows),
         requests=_table(['id', 'op', 'user', 'status', 'created'],

@@ -83,6 +83,13 @@ def _fmt_labels(labels: Tuple[Tuple[str, str], ...]) -> str:
     return '{' + inner + '}'
 
 
+def _fmt_exemplar(exemplar_id: str, value: float) -> str:
+    """OpenMetrics exemplar suffix for a bucket sample line:
+    ``... # {request_id="<id>"} <observed value>``. Our parser strips
+    it; Prometheus pre-OpenMetrics scrapers skip unknown suffixes."""
+    return f' # {{request_id="{exemplar_id}"}} {_fmt(value)}'
+
+
 class Counter:
     """Monotonic counter."""
 
@@ -169,13 +176,35 @@ class Histogram:
         self._counts = [0] * (len(self.buckets) + 1)
         self._sum = 0.0
         self._count = 0
+        # Last exemplar per bucket: (id, observed value) or None. An
+        # exemplar names the request that landed in the bucket, so a
+        # tail-quantile cell can link straight to that request's trace.
+        self._exemplars: List[Optional[Tuple[str, float]]] = (
+            [None] * (len(self.buckets) + 1))
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float,
+                exemplar: Optional[str] = None) -> None:
         i = bisect.bisect_left(self.buckets, value)
         with self._lock:
             self._counts[i] += 1
             self._sum += value
             self._count += 1
+            if exemplar is not None:
+                self._exemplars[i] = (str(exemplar), float(value))
+
+    def exemplars(self) -> Dict[str, Tuple[str, float]]:
+        """{le-label: (exemplar_id, observed value)} for buckets that
+        hold one. Keys use the same le formatting as samples() ('+Inf'
+        for the final bucket), so exposition and lookup agree."""
+        with self._lock:
+            snap = list(self._exemplars)
+        out: Dict[str, Tuple[str, float]] = {}
+        for le, ex in zip(self.buckets, snap):
+            if ex is not None:
+                out[_fmt(le)] = ex
+        if snap[-1] is not None:
+            out['+Inf'] = snap[-1]
+        return out
 
     @property
     def count(self) -> int:
@@ -233,13 +262,19 @@ def histogram_quantile(cumulative: Sequence[Tuple[float, float]],
     """Quantile estimate from [(le, cumulative_count)] pairs (the last
     pair being +Inf). Mirrors PromQL histogram_quantile: linear
     interpolation within the bucket, top (+Inf) bucket clamped to the
-    highest finite edge."""
+    highest finite edge.
+
+    Degenerate inputs are deterministic, never arithmetic errors: an
+    empty list or zero total observations -> None; a single-bucket
+    histogram (only +Inf, no finite edge to interpolate toward) ->
+    0.0; q outside [0, 1] is clamped so a caller typo can never walk
+    off the bucket list and return +Inf."""
     if not cumulative:
         return None
     total = cumulative[-1][1]
     if total <= 0:
         return None
-    rank = q * total
+    rank = min(1.0, max(0.0, q)) * total
     prev_le, prev_cum = 0.0, 0.0
     for le, cum in cumulative:
         if cum >= rank:
@@ -250,7 +285,9 @@ def histogram_quantile(cumulative: Sequence[Tuple[float, float]],
             frac = (rank - prev_cum) / (cum - prev_cum)
             return prev_le + (le - prev_le) * frac
         prev_le, prev_cum = le, cum
-    return prev_le
+    # Unreachable with monotone cumulative input (the +Inf pair holds
+    # the total); a non-monotone scrape still gets a finite answer.
+    return 0.0 if prev_le == float('inf') else prev_le
 
 
 # Rendering an EMPTY registry must not allocate: the no-metrics case is
@@ -329,9 +366,15 @@ class Registry:
                 if m.help:
                     lines.append(f'# HELP {m.name} {m.help}')
                 lines.append(f'# TYPE {m.name} {m.kind}')
+            exemplars = (m.exemplars()
+                         if isinstance(m, Histogram) else {})
             for sample_name, labels, value in m.samples():
-                lines.append(
-                    f'{sample_name}{_fmt_labels(labels)} {_fmt(value)}')
+                line = f'{sample_name}{_fmt_labels(labels)} {_fmt(value)}'
+                if exemplars and sample_name.endswith('_bucket'):
+                    ex = exemplars.get(dict(labels).get('le', ''))
+                    if ex is not None:
+                        line += _fmt_exemplar(*ex)
+                lines.append(line)
         return '\n'.join(lines) + '\n'
 
 
@@ -362,6 +405,7 @@ def histogram(name: str, help_text: str = '',
 _SAMPLE_RE = re.compile(
     r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)\s*$')
 _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_EXEMPLAR_RE = re.compile(r'^\{request_id="([^"]*)"\}\s+(\S+)$')
 
 Sample = Tuple[str, Tuple[Tuple[str, str], ...], float]
 
@@ -375,6 +419,8 @@ def parse_text(text: str) -> List[Sample]:
         line = line.strip()
         if not line or line.startswith('#'):
             continue
+        if ' # ' in line:  # OpenMetrics exemplar suffix on a sample
+            line = line.split(' # ', 1)[0].rstrip()
         m = _SAMPLE_RE.match(line)
         if not m:
             continue
@@ -414,19 +460,84 @@ def aggregate(texts: Iterable[str]) -> List[Sample]:
     return aggregate_samples(parse_text(t) for t in texts)
 
 
-def render_samples(samples: Iterable[Sample]) -> str:
+Exemplar = Tuple[str, Tuple[Tuple[str, str], ...], str, float]
+
+
+def parse_exemplars(text: str) -> List[Exemplar]:
+    """Extract (sample_name, labels, exemplar_id, observed_value) from
+    exemplar-suffixed bucket lines (the inverse of the render-side
+    suffix). Tolerant like parse_text: malformed suffixes are skipped."""
+    out: List[Exemplar] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith('#') or ' # ' not in line:
+            continue
+        sample_part, ex_part = line.split(' # ', 1)
+        m = _SAMPLE_RE.match(sample_part.rstrip())
+        em = _EXEMPLAR_RE.match(ex_part.strip())
+        if not m or not em:
+            continue
+        name, raw_labels, _ = m.groups()
+        labels = tuple((k, v) for k, v in
+                       _LABEL_RE.findall(raw_labels or ''))
+        try:
+            value = float(em.group(2))
+        except ValueError:
+            continue
+        out.append((name, labels, em.group(1), value))
+    return out
+
+
+def merge_exemplars(
+        exemplar_lists: Iterable[Sequence[Exemplar]]
+) -> List[Exemplar]:
+    """Union exemplars across scrapes, last writer per (name, labels)
+    wins — the fleet rollup keeps ONE representative request per
+    bucket, which is all a trace link needs."""
+    acc: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
+              Tuple[str, float]] = {}
+    for exemplars in exemplar_lists:
+        for name, labels, ex_id, value in exemplars:
+            acc[(name, labels)] = (ex_id, value)
+    return [(name, labels, ex_id, value)
+            for (name, labels), (ex_id, value) in acc.items()]
+
+
+def render_samples(samples: Iterable[Sample],
+                   exemplars: Optional[Sequence[Exemplar]] = None
+                   ) -> str:
     """Render raw samples as (untyped) exposition lines — used for the
     controller's fleet aggregate, which re-exports scraped series
-    without their original TYPE metadata."""
-    lines = [f'{name}{_fmt_labels(labels)} {_fmt(value)}'
-             for name, labels, value in samples]
+    without their original TYPE metadata. ``exemplars`` re-attaches
+    scraped exemplar suffixes to their bucket lines so trace links
+    survive the re-export."""
+    by_key: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
+                 Tuple[str, float]] = {}
+    for name, labels, ex_id, value in (exemplars or ()):
+        by_key[(name, labels)] = (ex_id, value)
+    lines = []
+    for name, labels, value in samples:
+        line = f'{name}{_fmt_labels(labels)} {_fmt(value)}'
+        ex = by_key.get((name, labels))
+        if ex is not None:
+            line += _fmt_exemplar(*ex)
+        lines.append(line)
     return '\n'.join(lines) + ('\n' if lines else '')
 
 
-def sample_value(samples: Sequence[Sample], name: str) -> Optional[float]:
-    """First sample value for ``name`` ignoring labels (None if absent)."""
-    for n, _, v in samples:
-        if n == name:
+def sample_value(samples: Sequence[Sample], name: str,
+                 labels: Optional[Dict[str, str]] = None
+                 ) -> Optional[float]:
+    """First sample value for ``name`` (None if absent). Without
+    ``labels`` the first sample of any labeling wins (the historical
+    behavior); with ``labels`` the sample's labels must contain every
+    given pair — how the dashboard picks one (slo, window) burn-rate
+    series out of the labeled family."""
+    want = tuple(sorted((labels or {}).items()))
+    for n, lbl, v in samples:
+        if n != name:
+            continue
+        if not want or set(want) <= set(lbl):
             return v
     return None
 

@@ -200,3 +200,133 @@ def flow_end(name: str, flow_id: str, ts_s: Optional[float] = None,
              **args: Any) -> None:
     """Terminate a flow (ph 'f') — e.g. LB finished streaming."""
     _flow('f', name, flow_id, ts_s, **args)
+
+
+# ---- structured request-trace ring ------------------------------------------
+# A second, independent store: where the Chrome-event ring above is a
+# flat window of EVERY event (for Perfetto), this one keys COMPLETED
+# span trees by request id so `/trace/<request-id>` can answer "where
+# did this request's latency go" long after the flat ring wrapped.
+# It is part of the metrics plane, not the timeline plane: callers gate
+# recording on their metrics containers (None under SKYTPU_METRICS=0),
+# so the disabled path stays one branch and the ring works without
+# SKYTPU_TIMELINE set. Appends take one short lock — nothing here
+# blocks, so hot-path (`# skylint: hot-path`) callers may record.
+
+TRACE_RING_DEFAULT = 256
+# Spans kept per trace; a pathological 100k-token generation must not
+# grow one trace without bound. Further spans count as dropped.
+TRACE_SPANS_MAX = 512
+
+
+def _trace_capacity_from_env() -> int:
+    raw = os.environ.get('SKYTPU_TRACE_RING', '')
+    try:
+        cap = int(raw) if raw else TRACE_RING_DEFAULT
+    except ValueError:
+        cap = TRACE_RING_DEFAULT
+    return max(1, cap)
+
+
+_trace_lock = threading.Lock()
+_trace_capacity = _trace_capacity_from_env()
+# Completed traces, oldest-first (plain dict = insertion-ordered ring).
+_traces: dict = {}
+# In-flight traces: spans accumulate here until trace_finish moves the
+# tree into the completed ring.
+_open_traces: dict = {}
+
+
+def configure_traces(capacity: Optional[int] = None) -> None:
+    """Re-create the completed-trace ring (drops recorded traces)."""
+    global _trace_capacity, _traces, _open_traces
+    with _trace_lock:
+        _trace_capacity = (max(1, capacity) if capacity is not None
+                           else _trace_capacity_from_env())
+        _traces = {}
+        _open_traces = {}
+
+
+def trace_span(request_id: str, name: str, start_s: float,
+               end_s: float, **attrs: Any) -> None:
+    """Append one completed span to ``request_id``'s (open) trace."""
+    span: dict = {'name': name,
+                  'start_us': int(start_s * 1e6),
+                  'end_us': int(end_s * 1e6)}
+    if attrs:
+        span['attrs'] = attrs
+    with _trace_lock:
+        tr = _open_traces.get(request_id)
+        if tr is None:
+            # Bound the open table too: a request that never finishes
+            # (client gone, crash path missed) must not leak forever.
+            while len(_open_traces) >= 2 * _trace_capacity:
+                _open_traces.pop(next(iter(_open_traces)))
+            tr = {'request_id': request_id, 'pid': os.getpid(),
+                  'spans': [], 'dropped_spans': 0}
+            _open_traces[request_id] = tr
+        if len(tr['spans']) >= TRACE_SPANS_MAX:
+            tr['dropped_spans'] += 1
+        else:
+            tr['spans'].append(span)
+
+
+def trace_point(request_id: str, name: str,
+                ts_s: Optional[float] = None, **attrs: Any) -> None:
+    """Zero-duration span (a point event in the tree)."""
+    ts = time.time() if ts_s is None else ts_s
+    trace_span(request_id, name, ts, ts, **attrs)
+
+
+def trace_finish(request_id: str, **attrs: Any) -> None:
+    """Seal ``request_id``'s trace into the completed ring (evicting
+    the oldest completed trace past capacity). No-op for ids that never
+    recorded a span."""
+    with _trace_lock:
+        tr = _open_traces.pop(request_id, None)
+        if tr is None:
+            return
+        if attrs:
+            tr.setdefault('attrs', {}).update(attrs)
+        tr['finished_at_us'] = int(time.time() * 1e6)
+        # Re-finish merges into the already-sealed tree (and moves it to
+        # the ring's newest end): an LB and a replica sharing one
+        # process (tests, single-process local serving) each seal their
+        # own spans for the same request id, and neither may clobber
+        # the other's half of the tree.
+        prev = _traces.pop(request_id, None)
+        if prev is not None:
+            tr['spans'] = list(prev['spans']) + tr['spans']
+            tr['dropped_spans'] += prev.get('dropped_spans', 0)
+            if 'attrs' in prev:
+                merged = dict(prev['attrs'])
+                merged.update(tr.get('attrs', {}))
+                tr['attrs'] = merged
+        tr['spans'].sort(key=lambda s: (s['start_us'], s['end_us']))
+        _traces[request_id] = tr
+        while len(_traces) > _trace_capacity:
+            _traces.pop(next(iter(_traces)))
+
+
+def get_trace(request_id: str) -> Optional[dict]:
+    """Completed trace for ``request_id`` (or the in-flight tree, with
+    ``complete: false``, for a request still streaming). None if the
+    id never traced or its trace aged out of the ring."""
+    with _trace_lock:
+        tr = _traces.get(request_id)
+        if tr is not None:
+            return {**tr, 'complete': True}
+        tr = _open_traces.get(request_id)
+        if tr is not None:
+            snap = {**tr, 'spans': sorted(
+                tr['spans'], key=lambda s: (s['start_us'], s['end_us']))}
+            snap['complete'] = False
+            return snap
+    return None
+
+
+def trace_stats() -> dict:
+    """Ring occupancy for the trace-ring gauges."""
+    with _trace_lock:
+        return {'completed': len(_traces), 'open': len(_open_traces),
+                'capacity': _trace_capacity}

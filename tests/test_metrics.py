@@ -379,3 +379,500 @@ class TestServerEndpoints:
                        for e in dumped['traceEvents'])
         finally:
             timeline.configure()
+
+
+# ---- exemplars: observe -> render -> scrape chain ---------------------------
+class TestExemplars:
+
+    def test_observe_render_parse_roundtrip(self):
+        r = metrics_lib.Registry()
+        h = r.histogram('skytpu_test_exlat_ms', 'lat',
+                        buckets=(1, 10, 100))
+        h.observe(5.0, exemplar='req-a')
+        h.observe(50.0, exemplar='req-b')
+        h.observe(60.0, exemplar='req-c')  # same bucket: last wins
+        h.observe(0.5)  # no exemplar: le="1" stays clean
+        text = r.render()
+        assert '# {request_id="req-c"}' in text
+        # A plain scraper is unaffected: parse_text strips the
+        # OpenMetrics suffix, counts and buckets stay exact.
+        samples = metrics_lib.parse_text(text)
+        assert metrics_lib.sample_value(
+            samples, 'skytpu_test_exlat_ms_count') == 4
+        cum = metrics_lib.histogram_cumulative(samples,
+                                               'skytpu_test_exlat_ms')
+        assert [c for _, c in cum] == [1, 2, 4, 4]
+        by_le = {float(dict(lbl)['le']): (rid, v)
+                 for name, lbl, rid, v
+                 in metrics_lib.parse_exemplars(text)
+                 if name == 'skytpu_test_exlat_ms_bucket'}
+        assert by_le[10.0] == ('req-a', 5.0)
+        assert by_le[100.0] == ('req-c', 60.0)
+        assert 1.0 not in by_le
+
+    def test_merge_last_writer_and_render_reattach(self):
+        bucket = (('le', '10'),)
+        e1 = [('skytpu_test_m_ms_bucket', bucket, 'req-b', 5.0)]
+        e2 = [('skytpu_test_m_ms_bucket', bucket, 'req-c', 7.0)]
+        merged = metrics_lib.merge_exemplars([e1, e2])
+        assert merged == [('skytpu_test_m_ms_bucket', bucket,
+                           'req-c', 7.0)]
+        # Re-attached on render (the replica -> controller -> dashboard
+        # chain) and still parseable on the far side.
+        samples = [('skytpu_test_m_ms_bucket', bucket, 3.0),
+                   ('skytpu_test_m_ms_bucket', (('le', '+Inf'),), 3.0)]
+        out = metrics_lib.render_samples(samples, exemplars=merged)
+        assert '# {request_id="req-c"}' in out
+        back = metrics_lib.parse_exemplars(out)
+        assert [(n, lbl, rid) for n, lbl, rid, _ in back] == \
+            [('skytpu_test_m_ms_bucket', bucket, 'req-c')]
+        # parse_text on the re-render still sees clean values.
+        assert metrics_lib.sample_value(
+            metrics_lib.parse_text(out), 'skytpu_test_m_ms_bucket',
+            {'le': '10'}) == 3.0
+
+    def test_quantile_degenerate_histograms(self):
+        hq = metrics_lib.histogram_quantile
+        inf = float('inf')
+        assert hq([], 0.5) is None
+        assert hq([(inf, 0.0)], 0.5) is None  # zero observations
+        # Single-bucket histogram: only +Inf, nothing to interpolate
+        # toward -> 0.0, never an arithmetic error.
+        assert hq([(inf, 5.0)], 0.99) == 0.0
+        # q outside [0, 1] clamps instead of walking off the list.
+        assert hq([(10.0, 5.0), (inf, 5.0)], 1.5) == 10.0
+        assert hq([(10.0, 5.0), (inf, 5.0)], -2.0) == 0.0
+
+
+# ---- structured request-trace ring ------------------------------------------
+class TestTraceRing:
+
+    def test_spans_sort_and_finish_seals(self):
+        timeline.configure_traces(capacity=8)
+        try:
+            timeline.trace_span('r1', 'b', 2.0, 3.0, n=1)
+            timeline.trace_span('r1', 'a', 1.0, 2.0)
+            timeline.trace_point('r1', 'v', ts_s=2.5, k=4, accepted=2)
+            snap = timeline.get_trace('r1')
+            assert snap['complete'] is False
+            assert [s['name'] for s in snap['spans']] == ['a', 'b', 'v']
+            timeline.trace_finish('r1', status='ok', tokens=7)
+            tr = timeline.get_trace('r1')
+            assert tr['complete'] is True
+            assert tr['attrs'] == {'status': 'ok', 'tokens': 7}
+            assert [s['name'] for s in tr['spans']] == ['a', 'b', 'v']
+            point = tr['spans'][2]
+            assert point['start_us'] == point['end_us'] == 2_500_000
+            assert point['attrs'] == {'k': 4, 'accepted': 2}
+            assert timeline.trace_stats()['completed'] == 1
+            assert timeline.trace_stats()['open'] == 0
+            # Unknown id and finish-without-spans are clean no-ops.
+            assert timeline.get_trace('nope') is None
+            timeline.trace_finish('nope')
+        finally:
+            timeline.configure_traces()
+
+    def test_completed_ring_evicts_oldest(self):
+        timeline.configure_traces(capacity=4)
+        try:
+            for i in range(6):
+                timeline.trace_span(f'r{i}', 's', 0.0, 1.0)
+                timeline.trace_finish(f'r{i}')
+            assert timeline.trace_stats()['completed'] == 4
+            assert timeline.get_trace('r0') is None
+            assert timeline.get_trace('r1') is None
+            assert timeline.get_trace('r5') is not None
+        finally:
+            timeline.configure_traces()
+
+    def test_open_table_bounded(self):
+        timeline.configure_traces(capacity=2)
+        try:
+            # Requests that never finish (client gone) must not leak.
+            for i in range(10):
+                timeline.trace_span(f'o{i}', 's', 0.0, 1.0)
+            assert timeline.trace_stats()['open'] <= 4
+        finally:
+            timeline.configure_traces()
+
+    def test_span_cap_counts_drops(self):
+        timeline.configure_traces(capacity=2)
+        try:
+            for i in range(timeline.TRACE_SPANS_MAX + 5):
+                timeline.trace_span('big', 't', float(i), float(i + 1))
+            timeline.trace_finish('big')
+            tr = timeline.get_trace('big')
+            assert len(tr['spans']) == timeline.TRACE_SPANS_MAX
+            assert tr['dropped_spans'] == 5
+        finally:
+            timeline.configure_traces()
+
+    def test_refinish_merges_split_trees(self):
+        """An LB and a replica sharing one process (tests, local dev)
+        both seal spans for the same request id: the second finish must
+        merge, not clobber the first half of the tree."""
+        timeline.configure_traces(capacity=4)
+        try:
+            timeline.trace_span('rr', 'decode', 1.0, 2.0)
+            timeline.trace_finish('rr', status='ok')
+            timeline.trace_span('rr', 'lb.proxy', 0.5, 2.5)
+            timeline.trace_finish('rr', status='200')
+            tr = timeline.get_trace('rr')
+            assert [s['name'] for s in tr['spans']] == \
+                ['lb.proxy', 'decode']
+            assert tr['attrs']['status'] == '200'
+        finally:
+            timeline.configure_traces()
+
+    def test_ring_capacity_from_env(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_TRACE_RING', '7')
+        timeline.configure_traces()
+        try:
+            assert timeline.trace_stats()['capacity'] == 7
+        finally:
+            monkeypatch.delenv('SKYTPU_TRACE_RING')
+            timeline.configure_traces()
+
+
+# ---- timeline under concurrency ---------------------------------------------
+class TestTimelineConcurrency:
+
+    def test_save_under_concurrent_writers(self, monkeypatch, tmp_path):
+        """save() must produce valid JSON while writer threads hammer
+        the ring (the /trace flush endpoint runs mid-traffic)."""
+        monkeypatch.setenv('SKYTPU_TIMELINE', str(tmp_path / 't.json'))
+        timeline.configure(capacity=512)
+        try:
+            stop = threading.Event()
+
+            def writer(i):
+                n = 0
+                while not stop.is_set():
+                    timeline.instant(f'w{i}', n=n)
+                    n += 1
+
+            threads = [threading.Thread(target=writer, args=(i,),
+                                        daemon=True) for i in range(4)]
+            for t in threads:
+                t.start()
+            try:
+                for k in range(5):
+                    path = timeline.save(str(tmp_path / f'd{k}.json'))
+                    assert path is not None
+                    data = json.loads(open(path).read())
+                    events = data['traceEvents']
+                    assert events and len(events) <= 512
+                    assert all('name' in e and 'ts' in e for e in events)
+            finally:
+                stop.set()
+                for t in threads:
+                    t.join(timeout=5)
+        finally:
+            timeline.configure()
+
+
+# ---- metric-family guard: seeded-bug check ----------------------------------
+class TestMetricFamilyGuard:
+
+    def test_missing_family_fails_full_tree_lint(self):
+        """Seeded bug: drop one expected family from the observed
+        registrations and the checker must flag it (full tree only)."""
+        from skypilot_tpu.lint.checkers import metric_names as mn
+
+        class Run:
+            full_tree = True
+
+        class Partial:
+            full_tree = False
+
+        checker = mn.MetricNameChecker()
+        checker._all_names = [f + 'x_total'
+                              for f in mn.EXPECTED_FAMILIES
+                              if f != 'skytpu_engine_hbm_']
+        findings = checker.finalize(Run())
+        assert findings, 'missing family must produce a finding'
+        assert any('skytpu_engine_hbm_' in f.message for f in findings)
+        # A partial run (changed-files lint) must not false-positive.
+        assert checker.finalize(Partial()) == []
+        # All families present: clean.
+        checker2 = mn.MetricNameChecker()
+        checker2._all_names = [f + 'x_total'
+                               for f in mn.EXPECTED_FAMILIES]
+        assert checker2.finalize(Run()) == []
+
+    def test_new_observability_families_are_expected(self):
+        from skypilot_tpu.lint.checkers import metric_names as mn
+        for family in ('skytpu_engine_hbm_',
+                       'skytpu_controller_slo_burn_',
+                       'skytpu_serve_trace_'):
+            assert family in mn.EXPECTED_FAMILIES, family
+
+
+# ---- HBM ledger: bytes table vs allocator math ------------------------------
+class TestHbmLedger:
+
+    @pytest.mark.parametrize('kv_dtype', ['bf16', 'int8'])
+    def test_ledger_matches_pool_math(self, kv_dtype):
+        import jax
+        from skypilot_tpu.models.decode import DecodeEngine
+        from skypilot_tpu.models.llama import PRESETS, LlamaModel
+
+        cfg = PRESETS['test-tiny']
+        model = LlamaModel(cfg)
+        params = jax.jit(model.init)(jax.random.key(0))
+        eng = DecodeEngine(cfg, batch_slots=2, max_len=64, model=model,
+                           kv_block=16, spec_tokens=4,
+                           kv_dtype=kv_dtype)
+        assert eng.quantized is (kv_dtype == 'int8')
+        state = eng.init_state()
+        ledger = eng.hbm_ledger(state, params)
+        # The exactness invariant the gauges advertise: pool bytes ==
+        # bytes/token x rows/block x blocks, for bf16 AND int8.
+        assert ledger['kv_code_pool'] + ledger['kv_scale_pool'] == \
+            eng.kv_bytes_per_token() * eng.kv_block * eng.kv_blocks
+        assert ledger['weights'] == sum(
+            leaf.nbytes
+            for leaf in jax.tree_util.tree_leaves(params))
+        # Spec I/O buffers: [B, 1+K] int32 in and out.
+        assert ledger['spec_buffers'] == 2 * 2 * (1 + 4) * 4
+        bs = eng.hbm_block_stats()
+        assert bs['kv_block_bytes'] == \
+            eng.kv_bytes_per_token() * eng.kv_block
+        # used + free covers the allocatable pool: total minus the
+        # reserved null block.
+        assert bs['kv_used_bytes'] + bs['kv_free_bytes'] == \
+            (eng.kv_blocks - 1) * bs['kv_block_bytes']
+        assert 0.0 <= bs['kv_block_utilization'] <= 1.0
+        assert 0.0 <= bs['kv_fragmentation_ratio'] <= 1.0
+
+    def test_int8_shrinks_bytes_per_token(self):
+        from skypilot_tpu.models.decode import DecodeEngine
+        from skypilot_tpu.models.llama import PRESETS
+
+        cfg = PRESETS['test-tiny']
+        full = DecodeEngine(cfg, batch_slots=2, max_len=64, kv_block=16)
+        q = DecodeEngine(cfg, batch_slots=2, max_len=64, kv_block=16,
+                         kv_dtype='int8')
+        assert q.kv_bytes_per_token() < full.kv_bytes_per_token()
+
+
+# ---- trace-overhead pin ------------------------------------------------------
+@pytest.mark.e2e
+class TestTraceOverheadPin:
+
+    def test_per_step_tracing_overhead_under_5pct(self):
+        """The --trace-overhead microbench arm, pinned: per-step span +
+        exemplar recording must cost < 5% of step wall time even on the
+        tiny CPU preset (real TPU steps are far longer, so this bounds
+        the worst case)."""
+        import importlib.util
+        import jax
+        from skypilot_tpu.models.llama import PRESETS, LlamaModel
+
+        spec = importlib.util.spec_from_file_location(
+            'kv_microbench',
+            os.path.join(REPO_ROOT, 'scripts', 'kv_microbench.py'))
+        bench = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bench)
+        cfg = PRESETS['test-tiny']
+        params = jax.jit(LlamaModel(cfg).init)(jax.random.key(0))
+        out = bench.bench_trace_overhead(
+            cfg, params, slots=2, max_len=64, prompt_len=8, steps=64,
+            kv_block=16, rounds=3)
+        assert out['step_ms_plain'] > 0
+        assert out['overhead_pct'] < 5.0, out
+
+
+# ---- acceptance: LB -> replica trace tree, exemplars, HBM ledger ------------
+@pytest.mark.e2e
+class TestTraceE2E:
+
+    @pytest.fixture()
+    def lb_stack(self, monkeypatch):
+        """Real LoadBalancer in front of a real generation replica
+        (spec decode ON), with a fake controller answering the LB's
+        /replicas sync and /load reports."""
+        import jax
+        from http.server import (BaseHTTPRequestHandler,
+                                 ThreadingHTTPServer)
+        from skypilot_tpu.models.llama import PRESETS, LlamaModel
+        from skypilot_tpu.serve import load_balancer as lb_lib
+        from skypilot_tpu.serve import serve_state
+        from skypilot_tpu.serve.generation_server import (
+            GenerationScheduler, GenerationServer)
+
+        monkeypatch.setenv('SKYTPU_SERVE_LB_SYNC', '0.2')
+        timeline.configure_traces(capacity=64)
+        cfg = PRESETS['test-tiny']
+        params = jax.jit(LlamaModel(cfg).init)(jax.random.key(0))
+        sched = GenerationScheduler(cfg, params, batch_slots=2,
+                                    max_len=128, prefill_chunk=8,
+                                    spec_tokens=4)
+        sched.start(warmup=False)
+        srv = GenerationServer(sched, host='127.0.0.1', port=0)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        replica_url = f'http://127.0.0.1:{srv.port}'
+
+        class Ctrl(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(200)
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                self._json({'ready_urls': [replica_url]})
+
+            def do_POST(self):
+                self.rfile.read(
+                    int(self.headers.get('Content-Length', 0)))
+                self._json({'ok': True})
+
+        ctrl = ThreadingHTTPServer(('127.0.0.1', 0), Ctrl)
+        threading.Thread(target=ctrl.serve_forever, daemon=True).start()
+        serve_state.add_service(
+            'svc-trace', {'readiness_probe': '/health', 'replicas': 1},
+            {'resources': {'cloud': 'local'}}, 1)
+        serve_state.update_service(
+            'svc-trace', controller_port=ctrl.server_address[1])
+        lb = lb_lib.LoadBalancer('svc-trace')
+        threading.Thread(target=lb.run, daemon=True).start()
+        deadline = time.time() + 60
+        lb_port = 0
+        while time.time() < deadline and not lb_port:
+            row = serve_state.get_service('svc-trace')
+            lb_port = row['lb_port'] if row else 0
+            if not lb_port:
+                time.sleep(0.1)
+        assert lb_port, 'LB never published its port'
+        try:
+            yield f'http://127.0.0.1:{lb_port}', replica_url, sched
+        finally:
+            srv.shutdown()
+            ctrl.shutdown()
+            sched.stop()
+            timeline.configure_traces()
+
+    def test_span_tree_exemplar_and_hbm_ledger(self, lb_stack):
+        lb_url, replica_url, sched = lb_stack
+        rid = 'trace-e2e-01'
+        # TTFT histogram baseline: the registry is process-global, so
+        # earlier tests' requests are already in it — the p99 claim is
+        # checked on the scrape DELTA (exactly our one request).
+        with urllib.request.urlopen(replica_url + '/metrics',
+                                    timeout=30) as r:
+            cum_before = dict(metrics_lib.histogram_cumulative(
+                metrics_lib.parse_text(r.read().decode()),
+                'skytpu_serve_ttft_ms'))
+        # Repetitive prompt: the prompt-lookup drafter finds its tail
+        # n-gram, so verify steps carry real (k, accepted) attrs.
+        prompt = [5, 9, 2, 7, 11, 3] * 4
+        body = json.dumps({'tokens': prompt,
+                           'max_tokens': 24}).encode()
+        # Retry through the LB until its first replica sync lands.
+        deadline = time.time() + 60
+        out = None
+        while time.time() < deadline and out is None:
+            req = urllib.request.Request(
+                lb_url + '/generate', data=body,
+                headers={'Content-Type': 'application/json',
+                         timeline.REQUEST_ID_HEADER: rid})
+            try:
+                with urllib.request.urlopen(req, timeout=120) as resp:
+                    assert resp.headers[
+                        timeline.REQUEST_ID_HEADER] == rid
+                    out = json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                e.read()
+                if e.code not in (502, 503):
+                    raise
+                time.sleep(0.2)
+        assert out is not None and out['num_tokens'] == 24, out
+
+        # account() seals the LB half after the response flushes: poll
+        # /trace/<rid> on the LB until the merged tree is complete.
+        def merged_trace():
+            try:
+                with urllib.request.urlopen(
+                        f'{lb_url}/trace/{rid}', timeout=10) as r:
+                    tr = json.loads(r.read())
+            except (urllib.error.HTTPError, OSError):
+                return None
+            names = {s['name'] for s in tr.get('spans', ())}
+            return tr if {'lb.proxy', 'emit'} <= names else None
+
+        deadline = time.time() + 30
+        tr = None
+        while time.time() < deadline and tr is None:
+            tr = merged_trace()
+            if tr is None:
+                time.sleep(0.1)
+        assert tr is not None, 'merged trace never appeared at the LB'
+
+        # The full request lifecycle, in monotonic start order.
+        names = {s['name'] for s in tr['spans']}
+        for required in ('lb.proxy', 'queue_wait', 'admission',
+                         'prefill_chunk', 'decode', 'verify',
+                         'first_token', 'emit'):
+            assert required in names, (required, sorted(names))
+        starts = [s['start_us'] for s in tr['spans']]
+        assert starts == sorted(starts)
+        assert all(s['end_us'] >= s['start_us'] for s in tr['spans'])
+        adm = [s for s in tr['spans'] if s['name'] == 'admission'][0]
+        assert adm['attrs']['outcome'] in ('admitted', 'reserved')
+        for v in (s for s in tr['spans'] if s['name'] == 'verify'):
+            assert v['attrs']['k'] == 4
+            assert 0 <= v['attrs']['accepted'] <= 5
+        chunks = [s for s in tr['spans']
+                  if s['name'] == 'prefill_chunk']
+        assert chunks and chunks[-1]['attrs']['final'] is True
+        emit = [s for s in tr['spans'] if s['name'] == 'emit'][0]
+        assert emit['attrs']['tokens'] == 24
+
+        # Tail exemplar: the replica's TTFT histogram remembers WHICH
+        # request landed in the tail bucket, and (single request) the
+        # p99 falls inside that exemplar's bucket.
+        with urllib.request.urlopen(replica_url + '/metrics',
+                                    timeout=30) as r:
+            text = r.read().decode()
+        samples = metrics_lib.parse_text(text)
+        ttft_ex = {float('inf') if dict(lbl)['le'] == '+Inf'
+                   else float(dict(lbl)['le']): ex_id
+                   for name, lbl, ex_id, _v
+                   in metrics_lib.parse_exemplars(text)
+                   if name == 'skytpu_serve_ttft_ms_bucket'}
+        assert rid in ttft_ex.values(), ttft_ex
+        cum = metrics_lib.histogram_cumulative(
+            samples, 'skytpu_serve_ttft_ms')
+        delta = [(le, v - cum_before.get(le, 0.0)) for le, v in cum]
+        assert delta and delta[-1][1] == 1.0, delta  # exactly ours
+        p99 = metrics_lib.histogram_quantile(delta, 0.99)
+        le_ex = min(le for le, ex_id in ttft_ex.items()
+                    if ex_id == rid)
+        # The p99 of our request's delta interpolates inside the very
+        # bucket that carries our exemplar: the dashboard's p99 cell
+        # links to this trace.
+        assert p99 is not None and p99 <= le_ex
+        assert all(d == 0.0 for le, d in delta if le < le_ex), delta
+
+        # HBM ledger: the /stats table equals the engine's pool math,
+        # and the scrape carries the gauge family.
+        with urllib.request.urlopen(replica_url + '/stats',
+                                    timeout=30) as r:
+            hbm = json.loads(r.read())['hbm']
+        eng = sched.engine
+        assert hbm['kv_code_pool'] + hbm['kv_scale_pool'] == \
+            eng.kv_bytes_per_token() * eng.kv_block * eng.kv_blocks
+        assert hbm['kv_used_bytes'] + hbm['kv_free_bytes'] == \
+            (eng.kv_blocks - 1) * hbm['kv_block_bytes']
+        assert hbm['weights'] > 0
+        hbm_samples = [(dict(lbl).get('component'), v)
+                       for n, lbl, v in samples
+                       if n == 'skytpu_engine_hbm_bytes']
+        components = dict(hbm_samples)
+        assert components.get('kv_code_pool') == hbm['kv_code_pool']
+        assert 'weights' in components

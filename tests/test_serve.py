@@ -445,7 +445,7 @@ class TestServeE2E:
                         codes.append(e.code)
                     except (urllib.error.URLError, OSError) as e:
                         codes.append(f'conn:{e}')
-                    time.sleep(0.05)
+                    stop.wait(0.05)
 
             t = threading.Thread(target=traffic, daemon=True)
             t.start()
@@ -462,8 +462,12 @@ class TestServeE2E:
                 return ready_v2 and not live_v1
 
             _wait(rolled, 120, 'rollout to v2 complete')
-            # Let traffic observe the post-rollout fleet for a moment.
-            time.sleep(1.0)
+            # Event-driven (not a fixed sleep): wait until the traffic
+            # thread has actually observed a v2 response — under load the
+            # LB may serve a few more v1-synced responses after the
+            # fleet rolls, and a fixed 1s nap flaked both ways.
+            _wait(lambda: 'v2' in markers, 60,
+                  'traffic observes a v2 response')
             stop.set()
             t.join(timeout=10)
 
@@ -865,3 +869,115 @@ class TestAdmissionControl:
             sched._backlog_tokens = 1000
         assert sched.admission_check(10) is not None
         assert sched.stats()['rejected'] == 1
+
+
+# ---- SLO burn-rate engine ---------------------------------------------------
+def _burn_hist(name, le100, total):
+    """Synthetic scraped histogram: ``le100`` observations at/under
+    100ms out of ``total``."""
+    return [(f'{name}_bucket', (('le', '100.0'),), float(le100)),
+            (f'{name}_bucket', (('le', '+Inf'),), float(total)),
+            (f'{name}_count', (), float(total))]
+
+
+class TestSloBurnEngine:
+
+    def test_good_total_interpolates_inside_bucket(self):
+        gt = autoscaler_lib.SloBurnEngine._good_total
+        cum = [(100.0, 8.0), (200.0, 10.0), (float('inf'), 10.0)]
+        # 150ms sits halfway through the 100..200 bucket: 8 + 2*0.5.
+        assert gt(cum, 150.0) == (9.0, 10.0)
+        # On a bucket edge: exact cumulative, no interpolation.
+        assert gt(cum, 100.0) == (8.0, 10.0)
+
+    def test_threshold_past_last_finite_edge_counts_inf_as_bad(self):
+        gt = autoscaler_lib.SloBurnEngine._good_total
+        cum = [(100.0, 8.0), (float('inf'), 10.0)]
+        assert gt(cum, 500.0) == (8.0, 10.0)
+        assert gt([], 100.0) == (0.0, 0.0)
+
+    def test_zero_thresholds_disable_slos(self):
+        eng = autoscaler_lib.SloBurnEngine(ttft_slo_ms=0.0,
+                                           tpot_slo_ms=0.0)
+        assert eng.observe(_burn_hist('skytpu_serve_ttft_ms', 0, 9),
+                           now=10.0) == {}
+        assert eng.burn_rates(now=10.0) == {}
+
+    def test_cold_engine_burns_zero(self):
+        eng = autoscaler_lib.SloBurnEngine(ttft_slo_ms=100.0,
+                                           target=0.9)
+        # No scrape at all, then a single scrape (no delta yet): both
+        # must report 0.0 for every window — a cold controller must
+        # not page.
+        assert eng.burn_rates(now=0.0) == {('ttft', '5m'): 0.0,
+                                           ('ttft', '1h'): 0.0}
+        out = eng.observe(_burn_hist('skytpu_serve_ttft_ms', 10, 10),
+                          now=1.0)
+        assert out == {'slo_burn_ttft_5m': 0.0, 'slo_burn_ttft_1h': 0.0}
+
+    def test_violation_burst_flips_short_window_burn(self):
+        eng = autoscaler_lib.SloBurnEngine(ttft_slo_ms=100.0,
+                                           target=0.9)
+        t0 = 1_000.0
+        # Healthy baseline: 10/10 requests within SLO.
+        eng.observe(_burn_hist('skytpu_serve_ttft_ms', 10, 10), now=t0)
+        # 60s later: 20 new requests, every one of them over 100ms.
+        out = eng.observe(_burn_hist('skytpu_serve_ttft_ms', 10, 30),
+                          now=t0 + 60)
+        # bad_frac 1.0 against a 0.1 error budget: burn 10x.
+        assert out['slo_burn_ttft_5m'] == pytest.approx(10.0)
+        # Partial history: the 1h window falls back to the oldest
+        # snapshot (honest short-history estimate), same delta here.
+        assert out['slo_burn_ttft_1h'] == pytest.approx(10.0)
+        rates = eng.burn_rates(now=t0 + 60)
+        assert rates[('ttft', '5m')] == pytest.approx(10.0)
+
+    def test_window_baseline_separates_old_burst_from_recovery(self):
+        eng = autoscaler_lib.SloBurnEngine(ttft_slo_ms=100.0,
+                                           target=0.9)
+        t0 = 1_000.0
+        eng.observe(_burn_hist('skytpu_serve_ttft_ms', 10, 10), now=t0)
+        # Burst at t0+60, then full recovery: 100 good requests.
+        eng.observe(_burn_hist('skytpu_serve_ttft_ms', 10, 30),
+                    now=t0 + 60)
+        out = eng.observe(_burn_hist('skytpu_serve_ttft_ms', 110, 130),
+                          now=t0 + 600)
+        # 5m baseline is the t0+60 snapshot (the newest one at least
+        # 300s old): only the 100 good requests are in-window.
+        assert out['slo_burn_ttft_5m'] == pytest.approx(0.0)
+        # 1h still sees the burst: 20 bad of 120 = 1/6 over 0.1 budget.
+        assert out['slo_burn_ttft_1h'] == pytest.approx((20 / 120) / 0.1)
+
+    def test_controller_tick_publishes_burn_gauge(self, monkeypatch):
+        """The acceptance path: a synthetic SLO-violation burst in the
+        fleet scrape flips the controller's 5m burn gauge above 1.0."""
+        from skypilot_tpu.serve import controller as controller_lib
+        from skypilot_tpu.utils import metrics as metrics_lib
+
+        monkeypatch.setenv('SKYTPU_SLO_TTFT_MS', '100')
+        monkeypatch.setenv('SKYTPU_SLO_TARGET', '0.9')
+        serve_state.add_service(
+            'svc-burn', {'readiness_probe': '/health', 'replicas': 1},
+            {'resources': {'cloud': 'local'}}, 1)
+        ctrl = controller_lib.ServeController('svc-burn')
+        assert ctrl._m is not None, 'metrics must be on for this test'
+        # Launch-free tick: fleet interactions stubbed out, the scrape
+        # replaced with synthetic histograms.
+        monkeypatch.setattr(ctrl.manager, 'reconcile',
+                            lambda *a, **k: None)
+        monkeypatch.setattr(ctrl.manager, 'probe_all', lambda: None)
+        monkeypatch.setattr(ctrl.manager, 'scrape_metrics',
+                            lambda: None)
+        scrapes = [_burn_hist('skytpu_serve_ttft_ms', 10, 10),
+                   _burn_hist('skytpu_serve_ttft_ms', 10, 40)]
+        monkeypatch.setattr(ctrl.manager, 'fleet_metrics',
+                            lambda: scrapes[0])
+        row = serve_state.get_service('svc-burn')
+        ctrl.tick_once(row)
+        scrapes.pop(0)
+        ctrl.tick_once(row)
+        samples = metrics_lib.parse_text(ctrl.metrics_payload())
+        burn = metrics_lib.sample_value(
+            samples, 'skytpu_controller_slo_burn_ratio',
+            {'slo': 'ttft', 'window': '5m'})
+        assert burn is not None and burn > 1.0, burn

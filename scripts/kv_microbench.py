@@ -13,9 +13,12 @@ Three further arms ride along: sync-vs-async dispatch
 accepted-tokens-per-step + effective tok/s per draft length on a
 repetitive prompt), quantized KV (``--quant-ks``: int8-vs-bf16
 bytes/token, step-time ratio, round-trip error, and greedy-stream
-agreement with spec decode off and on) and span tracing
+agreement with spec decode off and on), span tracing
 (``--trace-overhead``: traced-vs-plain step time for the request-
-lifecycle tracer's hot-path recording; pinned < 5% in tier-1)::
+lifecycle tracer's hot-path recording; pinned < 5% in tier-1) and
+roofline attribution (``--roofline``: per-variant FLOPs/bytes/
+arithmetic-intensity/MFU from cost_analysis, estimator fallback on
+CPU)::
 
     python scripts/kv_microbench.py                      # CPU tiny
     python scripts/kv_microbench.py --preset llama-1b \
@@ -394,6 +397,50 @@ def bench_trace_overhead(config, params, *, slots: int, max_len: int,
     }
 
 
+def bench_roofline(config, params, *, slots: int, max_len: int,
+                   prompt_len: int, steps: int, kv_block: int,
+                   kv_blocks=None) -> dict:
+    """Roofline arm: run the paged decode loop long enough for the
+    profiler to see real step times, then attribute cost_analysis
+    FLOPs/bytes (analytic estimator on backends without it) to each
+    compiled variant. Prints variant -> (FLOPs, bytes, AI, MFU) — the
+    same numbers the ``skytpu_engine_step_{flops,bytes,ai,mfu}`` gauges
+    export on a serving replica."""
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.models import decode
+    from skypilot_tpu.models.decode import DecodeEngine, prefill_bucket
+
+    engine = DecodeEngine(config, batch_slots=slots, max_len=max_len,
+                          kv_block=kv_block, kv_blocks=kv_blocks)
+    state = engine.init_state()
+    prompt = jax.random.randint(jax.random.key(7), (prompt_len,), 0,
+                                config.vocab_size)
+    bucket = prefill_bucket(prompt_len, engine.max_len)
+    padded = jnp.pad(prompt, (0, bucket - prompt_len))
+    rng = jax.random.key(11)
+    for s in range(slots):
+        state, _, rng = engine.admit(params, state, padded, prompt_len,
+                                     s, rng)
+    for _ in range(4):  # compile + warm
+        state, sampled, rng = engine.step(params, state, rng)
+    int(sampled[0])
+    for _ in range(steps):  # measured: feeds the per-variant step EWMA
+        t0 = time.perf_counter()
+        state, sampled, rng = engine.step(params, state, rng)
+        int(sampled[0])
+        engine.profiler.note_step(time.perf_counter() - t0)
+    engine.profiler.note_roofline(engine.roofline_costs(params, state))
+    snap = engine.profiler.roofline_snapshot(decode.peak_flops())
+    for variant in sorted(snap):
+        row = snap[variant]
+        print(f'# roofline {variant}: flops={row["flops"]:.3e} '
+              f'bytes={row["bytes"]:.3e} ai={row["ai"]:.2f} '
+              f'mfu={row["mfu"]:.4f}', file=sys.stderr)
+    return snap
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.split('\n')[0])
     parser.add_argument('--preset', default='test-tiny')
@@ -427,6 +474,9 @@ def main(argv=None) -> int:
     parser.add_argument('--trace-overhead', action='store_true',
                         help='add the span-tracing overhead arm '
                              '(traced-vs-plain decode step time)')
+    parser.add_argument('--roofline', action='store_true',
+                        help='add the roofline-attribution arm '
+                             '(variant -> FLOPs/bytes/AI/MFU)')
     args = parser.parse_args(argv)
 
     import jax
@@ -489,6 +539,10 @@ def main(argv=None) -> int:
             for k in args.spec_ks]
     if args.trace_overhead:
         record['trace_overhead'] = bench_trace_overhead(
+            config, params, kv_block=args.kv_block,
+            kv_blocks=args.kv_blocks, **common)
+    if args.roofline:
+        record['roofline'] = bench_roofline(
             config, params, kv_block=args.kv_block,
             kv_blocks=args.kv_blocks, **common)
     print(json.dumps(record))

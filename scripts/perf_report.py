@@ -1,0 +1,165 @@
+#!/usr/bin/env python3
+"""Compare BENCH records: per-metric deltas with a regression gate.
+
+Two modes over the repo's ``BENCH_r*.json`` perf records (each one run
+of bench.py: ``{"n": .., "cmd", "rc", "tail", "parsed": {metrics}}``):
+
+    python scripts/perf_report.py BENCH_r04.json BENCH_r05.json
+    python scripts/perf_report.py --dir . --threshold 10
+
+The two-file form prints per-metric old/new/delta and exits non-zero
+when any metric regresses by more than ``--threshold`` percent — the
+CI-adjacent "did this PR cost us throughput" gate. The ``--dir`` form
+prints each metric's trajectory across every record (sorted by run
+number) so a slow leak that no single adjacent pair trips on is still
+visible.
+
+Direction is inferred from the metric name: ``*_ms``/``*_s``/latency/
+overhead metrics regress when they go UP, everything else (tok/s,
+req/s, MFU) regresses when it goes DOWN. Only flat numeric metrics are
+compared; nested sweeps, strings and config echoes (``*_len``,
+``*_slots`` ...) are skipped.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Tuple
+
+# Config echoes recorded alongside results: identical-or-intentional
+# between runs, never a perf signal.
+_SKIP_SUFFIXES = ('_len', '_slots', '_params', '_params_b', '_concurrency',
+                  'seq_len', '_status', '_note')
+# Lower-is-better names: latency/duration suffixes plus overhead and
+# error counts; everything else numeric is a rate or utilisation where
+# higher wins. '_per_s' rates also end in '_s', so the rate check runs
+# first; suffix-only matching keeps 'tokens_per_sec_per_chip' a rate.
+_LOWER_BETTER_SUFFIXES = ('_ms', '_s')
+_LOWER_BETTER_FRAGMENTS = ('overhead', 'errors')
+
+
+def lower_is_better(name: str) -> bool:
+    if name.endswith('_per_s'):
+        return False  # a rate that happens to end in '_s'
+    return (any(name.endswith(s) for s in _LOWER_BETTER_SUFFIXES)
+            or any(f in name for f in _LOWER_BETTER_FRAGMENTS))
+
+
+def load_record(path: str) -> dict:
+    with open(path) as f:
+        record = json.load(f)
+    if 'parsed' not in record:
+        raise ValueError(f'{path}: not a BENCH record (no "parsed" key)')
+    return record
+
+
+def numeric_metrics(parsed: dict) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    # A failed bench run records "parsed": null — contributes nothing.
+    for name, value in (parsed or {}).items():
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            continue
+        if any(name.endswith(s) for s in _SKIP_SUFFIXES):
+            continue
+        out[name] = float(value)
+    return out
+
+
+def compare(old: dict, new: dict,
+            threshold_pct: float) -> Tuple[List[list], List[str]]:
+    """Rows of [metric, old, new, delta_pct, verdict] plus the names
+    that regressed past the threshold."""
+    old_m = numeric_metrics(old['parsed'])
+    new_m = numeric_metrics(new['parsed'])
+    rows: List[list] = []
+    regressions: List[str] = []
+    for name in sorted(set(old_m) & set(new_m)):
+        a, b = old_m[name], new_m[name]
+        if a == 0:
+            delta = 0.0 if b == 0 else float('inf')
+        else:
+            delta = (b - a) / abs(a) * 100.0
+        worse = -delta if lower_is_better(name) else delta
+        if worse < -threshold_pct:
+            verdict = 'REGRESSED'
+            regressions.append(name)
+        elif worse > threshold_pct:
+            verdict = 'improved'
+        else:
+            verdict = 'ok'
+        rows.append([name, a, b, delta, verdict])
+    return rows, regressions
+
+
+def find_records(directory: str) -> List[str]:
+    paths = glob.glob(os.path.join(directory, 'BENCH_r*.json'))
+
+    def run_number(path: str) -> int:
+        try:
+            return int(load_record(path).get('n', 0))
+        except Exception:  # noqa: BLE001 — unreadable file sorts first
+            return 0
+    return sorted(paths, key=run_number)
+
+
+def trajectory(paths: List[str]) -> List[list]:
+    """[metric, v_r1, v_r2, ...] across the records, '-' where a run
+    predates the metric."""
+    records = [load_record(p) for p in paths]
+    metrics = [numeric_metrics(r['parsed']) for r in records]
+    names = sorted(set().union(*metrics)) if metrics else []
+    return [[name] + [m.get(name, '-') for m in metrics]
+            for name in names]
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        return f'{v:+.1f}%' if abs(v) < 1e7 else 'inf'
+    return str(v)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split('\n')[0])
+    parser.add_argument('records', nargs='*',
+                        help='two BENCH_r*.json files: old new')
+    parser.add_argument('--dir', default=None,
+                        help='print the metric trajectory across every '
+                             'BENCH_r*.json in this directory instead')
+    parser.add_argument('--threshold', type=float, default=5.0,
+                        help='regression gate in percent (two-file mode)')
+    args = parser.parse_args(argv)
+
+    if args.dir is not None:
+        paths = find_records(args.dir)
+        if len(paths) < 2:
+            print(f'need >=2 BENCH_r*.json under {args.dir}',
+                  file=sys.stderr)
+            return 2
+        labels = [f'r{load_record(p).get("n", "?")}' for p in paths]
+        print('\t'.join(['metric'] + labels))
+        for row in trajectory(paths):
+            print('\t'.join(str(c) for c in row))
+        return 0
+
+    if len(args.records) != 2:
+        parser.error('expected exactly two records (old new) or --dir')
+    old, new = (load_record(p) for p in args.records)
+    rows, regressions = compare(old, new, args.threshold)
+    print(f'# {args.records[0]} (n={old.get("n")}) -> '
+          f'{args.records[1]} (n={new.get("n")}), '
+          f'threshold {args.threshold:.1f}%')
+    print('\t'.join(['metric', 'old', 'new', 'delta', 'verdict']))
+    for name, a, b, delta, verdict in rows:
+        print(f'{name}\t{a}\t{b}\t{_fmt(delta)}\t{verdict}')
+    if regressions:
+        print(f'REGRESSIONS ({len(regressions)}): '
+              + ', '.join(regressions), file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -168,6 +168,24 @@ _v('SKYTPU_SLO_TPOT_MS', '0', 'observability',
 _v('SKYTPU_SLO_TARGET', '0.99', 'observability',
    'SLO attainment target; the error budget is 1 - target and burn '
    'rate 1.0 drains it exactly at the refill rate')
+_v('SKYTPU_TSDB_POINTS', '512', 'observability',
+   'points per tier of the controller ring TSDB (3 tiers: raw tick '
+   'cadence plus two downsampled)')
+_v('SKYTPU_TSDB_DOWNSAMPLE', '8', 'observability',
+   'TSDB downsample factor: each coarser tier stores the mean of this '
+   'many finer-tier points')
+_v('SKYTPU_TSDB_ANOMALY_Z', '4.0', 'observability',
+   'EWMA z-score at/above which a fleet series is flagged anomalous '
+   '(dashboard alert + flight-recorder trigger)')
+_v('SKYTPU_TSDB_FLIGHT_WINDOW', '120', 'observability',
+   'seconds of series history the flight recorder seals into each '
+   'postmortem artifact (also the per-trigger seal throttle)')
+_v('SKYTPU_PROFILE_DIR', None, 'observability',
+   'directory for POST /profile device-profile artifacts (default: '
+   '<state dir>/profiles)')
+_v('SKYTPU_PEAK_TFLOPS', '0', 'observability',
+   'accelerator peak TFLOP/s for the serving-MFU roofline gauges '
+   '(0 = MFU gauges report 0; AI/FLOPs/bytes still export)')
 
 # -- managed jobs -------------------------------------------------------------
 _v('SKYTPU_JOBS_POLL_INTERVAL', '15', 'jobs',

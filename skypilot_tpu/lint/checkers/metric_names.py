@@ -43,6 +43,12 @@ EXPECTED_FAMILIES = (
     'skytpu_engine_hbm_',                 # device-memory ledger gauges
     'skytpu_controller_slo_burn_',        # error-budget burn rates
     'skytpu_serve_trace_',                # request-trace ring occupancy
+    # Roofline attribution (dashboard MFU/AI readings, kv_microbench
+    # --roofline arm, observability.md roofline guide) + the TSDB
+    # anomaly detector feeding the dashboard alert column.
+    'skytpu_engine_step_flops',           # per-variant FLOPs gauge
+    'skytpu_engine_step_mfu_',            # measured model-FLOPs util
+    'skytpu_controller_anomaly_',         # EWMA z-score per series
 )
 
 _CONSTRUCTORS = {'counter', 'gauge', 'histogram'}

@@ -137,6 +137,14 @@ class StepProfiler:
             buckets=(1e-4, 3e-4, 1e-3, 3e-3, 0.01, 0.03, 0.1, 0.3,
                      1.0, 3.0, 10.0))
         self._seen_variants: set = set()
+        # Roofline attribution state: static (FLOPs, bytes) per compiled
+        # step variant (note_roofline, filled at warmup) joined with a
+        # measured per-variant dispatch-time EWMA (note_step attributes
+        # each step to the variant the preceding note_variant named).
+        self._variant_labels: Dict[tuple, str] = {}
+        self._variant_costs: Dict[str, Tuple[float, float]] = {}
+        self._variant_step_s: Dict[str, float] = {}
+        self._current_variant: Optional[str] = None
         # Last-N raw gap samples, per-PROFILER (one profiler per
         # engine): the registry histogram above is process-global, so a
         # same-process A/B (depth-1 vs depth-2 engines in one test or
@@ -145,16 +153,47 @@ class StepProfiler:
             maxlen=self.GAP_SAMPLES_MAX)
 
     GAP_SAMPLES_MAX = 4096
+    # EWMA weight for the per-variant step-time attribution: slow
+    # enough to ride out one compile stall, fast enough that the MFU
+    # gauge tracks a real regime change within ~10 steps.
+    STEP_EWMA_ALPHA = 0.2
+
+    @staticmethod
+    def variant_label(kind: str, *shape) -> str:
+        """Stable label for one jit variant: ``step:8``,
+        ``step_verify:8x4``, ``prefill_chunk_final:64`` — the key the
+        roofline gauge family and the cost table share. Shape entries
+        may themselves be dim tuples (``admit_many`` passes the whole
+        array shape); they flatten into the same ``x``-joined form."""
+        dims = []
+        for s in shape:
+            if isinstance(s, (tuple, list)):
+                dims.extend(int(d) for d in s)
+            else:
+                dims.append(int(s))
+        if not dims:
+            return kind
+        return kind + ':' + 'x'.join(str(d) for d in dims)
 
     def note_variant(self, kind: str, *shape) -> None:
         key = (kind, *shape)
-        if key not in self._seen_variants:
+        label = self._variant_labels.get(key)
+        if label is None:
+            label = self.variant_label(kind, *shape)
+            self._variant_labels[key] = label
             self._seen_variants.add(key)
             self.recompiles.inc()
+        self._current_variant = label
 
     def note_step(self, wall_s: float) -> None:
         self.steps.inc()
         self.step_ms.observe(wall_s * 1e3)
+        variant = self._current_variant
+        if variant is not None:
+            prev = self._variant_step_s.get(variant)
+            self._variant_step_s[variant] = (
+                wall_s if prev is None
+                else prev + self.STEP_EWMA_ALPHA * (wall_s - prev))
 
     def note_gap(self, gap_s: float) -> None:
         ms = gap_s * 1e3
@@ -211,6 +250,66 @@ class StepProfiler:
                 'skytpu_engine_hbm_fragmentation_ratio',
                 'share of pool bytes in free-but-resident blocks').set(
                     block_stats.get('kv_fragmentation_ratio', 0.0))
+
+    def note_roofline(self,
+                      costs: Dict[str, Tuple[float, float]]) -> None:
+        """Record the compiled-cost table (variant -> (FLOPs, bytes
+        accessed) per dispatch, from ``DecodeEngine.roofline_costs`` at
+        warmup) and publish the static halves as labeled gauges. The
+        dynamic halves — MFU and arithmetic intensity joined with the
+        measured step-time EWMA — refresh at scrape time via
+        :meth:`roofline_snapshot` (``note_hbm`` cadence)."""
+        self._variant_costs.update(costs)
+        for variant, (flops, nbytes) in costs.items():
+            metrics_lib.gauge(
+                'skytpu_engine_step_flops',
+                'FLOPs one dispatch of this jit step variant executes',
+                labels={'variant': variant}).set(flops)
+            metrics_lib.gauge(
+                'skytpu_engine_step_bytes',
+                'HBM bytes one dispatch of this jit step variant moves',
+                labels={'variant': variant}).set(nbytes)
+
+    def roofline_snapshot(self, peak_flops: float = 0.0
+                          ) -> Dict[str, Dict[str, float]]:
+        """variant -> {flops, bytes, ai, step_ms, mfu}; refreshes the
+        ``skytpu_engine_step_ai_ratio`` / ``_mfu_ratio`` gauges.
+
+        AI = FLOPs / bytes places the variant on the roofline's x-axis
+        (below the chip's FLOPs:bandwidth ratio = bandwidth-bound);
+        MFU = FLOPs / (step_time * peak) is how much of the machine the
+        variant actually uses. ``peak_flops`` <= 0 (SKYTPU_PEAK_TFLOPS
+        unset) reports MFU 0 — AI and the static gauges still export.
+        """
+        out: Dict[str, Dict[str, float]] = {}
+        for variant, (flops, nbytes) in sorted(
+                self._variant_costs.items()):
+            ai = flops / nbytes if nbytes > 0 else 0.0
+            step_s = self._variant_step_s.get(variant)
+            mfu = 0.0
+            if step_s and peak_flops > 0:
+                mfu = flops / step_s / peak_flops
+            metrics_lib.gauge(
+                'skytpu_engine_step_ai_ratio',
+                'arithmetic intensity (FLOPs per HBM byte) of this '
+                'step variant', labels={'variant': variant}).set(ai)
+            metrics_lib.gauge(
+                'skytpu_engine_step_mfu_ratio',
+                'serving MFU of this step variant: FLOPs / (measured '
+                'dispatch EWMA x SKYTPU_PEAK_TFLOPS)',
+                labels={'variant': variant}).set(mfu)
+            out[variant] = {
+                'flops': flops, 'bytes': nbytes, 'ai': ai,
+                'step_ms': (step_s or 0.0) * 1e3, 'mfu': mfu,
+            }
+        return out
+
+
+def peak_flops() -> float:
+    """$SKYTPU_PEAK_TFLOPS in FLOP/s — the MFU denominator. 0.0 when
+    unset: the roofline MFU gauges report 0 but AI/FLOPs/bytes still
+    export (they need no hardware constant)."""
+    return float(env_vars.get('SKYTPU_PEAK_TFLOPS') or 0.0) * 1e12
 
 
 @jax.tree_util.register_dataclass
@@ -519,6 +618,144 @@ class DecodeEngine:
         nz = flat[flat > 0.0][:cap]
         for s in nz:
             self.profiler.kv_quant_scale.observe(float(s))
+
+    # -- roofline attribution ------------------------------------------------
+    # Variant kinds with a cost model: the forward-pass dispatches whose
+    # FLOPs/bytes place serving on the roofline. Admission/insert/release
+    # scatters are bookkeeping, not modeled.
+    ROOFLINE_KINDS = ('prefill', 'prefill_chunk', 'prefill_chunk_final',
+                      'step', 'step_verify')
+
+    def estimate_step_cost(self, kind: str, *shape) -> Tuple[float, float]:
+        """Analytic (FLOPs, HBM bytes) for ONE dispatch of a jit step
+        variant — the ``cost_analysis`` fallback, from config dims only.
+
+        FLOPs (matmul MACs x 2, the standard accounting):
+          - layer matmuls: ``2 * P_layers * T`` (qkv + o + SwiGLU mlp
+            weights, T = token rows computed, PADDED — what the
+            compiled program runs, active or not);
+          - lm head: ``2 * E * V * T_logits`` (every row in
+            decode/verify; only the last row in prefill kinds);
+          - attention: ``4 * L * Hq * d * T * M`` (QK^T and AV, each
+            ``2 * M * d`` MACs per query row, over the padded context
+            M — decode attends through the full gathered table).
+        Bytes: the whole weight tree once per dispatch, plus KV rows
+        gathered (S sequences x M padded rows) and the T rows written,
+        at the pool's per-token footprint (int8 halves it). Activations
+        are ignored: orders of magnitude below weights+KV at serving
+        shapes.
+        """
+        c = self.config
+        b = self.batch_slots
+        if kind == 'step':
+            t, t_logits, seqs, m = b, b, b, self.m_pad
+        elif kind == 'step_verify':
+            k = int(shape[1]) if len(shape) > 1 else self.spec_tokens
+            t, t_logits, seqs, m = b * (1 + k), b * (1 + k), b, self.m_pad
+        elif kind in ('prefill_chunk', 'prefill_chunk_final'):
+            t, t_logits, seqs, m = int(shape[0]), 1, 1, self.m_pad
+        elif kind == 'prefill':
+            t, t_logits, seqs, m = int(shape[0]), 1, 1, int(shape[0])
+        else:
+            raise ValueError(f'no cost model for variant kind {kind!r}')
+        qkv = c.embed_dim * c.head_dim * (c.num_heads
+                                          + 2 * c.num_kv_heads)
+        proj = c.num_heads * c.head_dim * c.embed_dim
+        mlp = 3 * c.embed_dim * c.mlp_dim
+        p_layers = c.num_layers * (qkv + proj + mlp)
+        flops = (2.0 * p_layers * t
+                 + 2.0 * c.embed_dim * c.vocab_size * t_logits
+                 + 4.0 * c.num_layers * c.num_heads * c.head_dim * t * m)
+        param_bytes = c.num_params * jnp.dtype(c.dtype).itemsize
+        kv_bytes = self.kv_bytes_per_token() * (seqs * m + t)
+        return flops, float(param_bytes + kv_bytes)
+
+    @staticmethod
+    def _xla_cost(lowered) -> Optional[Tuple[float, float]]:
+        """(flops, bytes accessed) from XLA's own cost model, or None
+        when the backend doesn't expose one. The compiled analysis is
+        preferred (it has the real buffer assignment); the pre-compile
+        HLO analysis is the second chance. Both APIs vary by backend
+        and JAX version — dict or [dict] — hence the broad guards."""
+        for get in (lambda: lowered.compile().cost_analysis(),
+                    lambda: lowered.cost_analysis()):
+            try:
+                analysis = get()
+            except Exception:  # noqa: BLE001 — backend-dependent API
+                continue
+            if isinstance(analysis, (list, tuple)):
+                analysis = analysis[0] if analysis else None
+            if not isinstance(analysis, dict):
+                continue
+            flops = float(analysis.get('flops') or 0.0)
+            nbytes = float(analysis.get('bytes accessed') or 0.0)
+            if flops > 0.0:
+                return flops, nbytes
+        return None
+
+    def roofline_costs(self, params: Params, state: DecodeState,
+                       rng: Optional[jax.Array] = None
+                       ) -> Dict[str, Tuple[float, float]]:
+        """(FLOPs, bytes) per compiled step variant, keyed by
+        :meth:`StepProfiler.variant_label` — XLA's cost model when the
+        backend exposes one, the analytic estimator otherwise (bytes
+        fall back independently: some backends report flops but zero
+        bytes). Covers exactly the variants warmup compiled (the
+        profiler's first-seen set); re-lowering them is warmup-time
+        work, never on the step path."""
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        b = self.batch_slots
+        temp = jnp.zeros((b,), jnp.float32)
+        topk = jnp.zeros((b,), jnp.int32)
+        table = jnp.zeros((self.max_blocks,), jnp.int32)
+        zero = jnp.int32(0)
+        variants = []
+        if self.profiler is not None:
+            variants = [key for key in self.profiler._seen_variants
+                        if key[0] in self.ROOFLINE_KINDS]
+        if not variants:
+            # Engine costed before any traffic/warmup: the decode core.
+            variants = [('step', b)]
+            if self.spec_tokens > 0:
+                variants.append(('step_verify', b, self.spec_tokens))
+        costs: Dict[str, Tuple[float, float]] = {}
+        for key in sorted(variants, key=str):
+            kind, shape = key[0], key[1:]
+            try:
+                if kind == 'step':
+                    lowered = self._step.lower(params, state, rng, temp,
+                                               topk)
+                elif kind == 'step_verify':
+                    draft = jnp.zeros((b, int(shape[1])), jnp.int32)
+                    lowered = self._step_verify.lower(
+                        params, state, rng, temp, topk, draft)
+                elif kind == 'prefill':
+                    lowered = self._prefill.lower(
+                        params, jnp.zeros((int(shape[0]),), jnp.int32),
+                        jnp.int32(1))
+                elif kind == 'prefill_chunk':
+                    lowered = self._prefill_chunk.lower(
+                        state, params,
+                        jnp.zeros((int(shape[0]),), jnp.int32),
+                        zero, zero, table)
+                else:  # prefill_chunk_final
+                    lowered = self._prefill_chunk_final.lower(
+                        state, params,
+                        jnp.zeros((int(shape[0]),), jnp.int32),
+                        zero, zero, jnp.int32(1), rng,
+                        jnp.float32(0.0), jnp.int32(0), table)
+                xla = self._xla_cost(lowered)
+            except Exception:  # noqa: BLE001 — lowering is best-effort
+                xla = None
+            flops, nbytes = self.estimate_step_cost(kind, *shape)
+            if xla is not None:
+                flops = xla[0]
+                if xla[1] > 0.0:
+                    nbytes = xla[1]
+            costs[StepProfiler.variant_label(kind, *shape)] = (flops,
+                                                               nbytes)
+        return costs
 
     # -- paged-KV host-side helpers -----------------------------------------
     def _table_arg(self, slot: Optional[int],

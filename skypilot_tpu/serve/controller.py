@@ -25,7 +25,11 @@ import os
 import threading
 import time
 import traceback
+import urllib.error
+import urllib.parse
+import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
 
 from skypilot_tpu import env_vars
 from skypilot_tpu.serve import autoscaler as autoscaler_lib
@@ -33,6 +37,8 @@ from skypilot_tpu.serve import replica_manager as rm_lib
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve import service_spec as spec_lib
 from skypilot_tpu.utils import metrics as metrics_lib
+from skypilot_tpu.utils import timeline
+from skypilot_tpu.utils import tsdb as tsdb_lib
 
 ServiceStatus = serve_state.ServiceStatus
 ReplicaStatus = serve_state.ReplicaStatus
@@ -69,6 +75,17 @@ class _ControlHandler(BaseHTTPRequestHandler):
             self.send_header('Content-Length', str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif self.path.startswith('/timeseries'):
+            query = urllib.parse.parse_qs(
+                urllib.parse.urlparse(self.path).query)
+            names = [s for s in query.get('series', [''])[0].split(',')
+                     if s] or None
+            try:
+                since = float(query.get('since', ['0'])[0] or 0.0)
+            except ValueError:
+                self._json(400, {'error': 'since must be a unix time'})
+                return
+            self._json(200, c.timeseries_payload(names, since))
         else:
             self._json(404, {'error': f'no route {self.path}'})
 
@@ -112,6 +129,13 @@ class _ControllerMetrics:
             'error-budget burn rate (1.0 = budget drains at refill rate)',
             labels={'slo': slo, 'window': window})
 
+    def anomaly(self, series: str) -> metrics_lib.Gauge:
+        return metrics_lib.gauge(
+            'skytpu_controller_anomaly_zscore_ratio',
+            'EWMA z-score of a fleet series (>= SKYTPU_TSDB_ANOMALY_Z '
+            'flags the dashboard alert column)',
+            labels={'series': series})
+
 
 class ServeController:
 
@@ -141,6 +165,19 @@ class ServeController:
             ttft_slo_ms=float(ttft_ms or 0),
             tpot_slo_ms=float(env_vars.get('SKYTPU_SLO_TPOT_MS') or 0),
             target=float(env_vars.get('SKYTPU_SLO_TARGET') or 0.99))
+        # Retrospective plane: per-tick fleet series ring (served at
+        # /timeseries), histogram-delta rate derivation, EWMA z-score
+        # anomaly detection, and the black-box flight recorder sealing
+        # postmortem JSON under <state dir>/postmortems/.
+        self.tsdb = tsdb_lib.TimeSeriesStore()
+        self.rates = tsdb_lib.RateDeriver()
+        self.anomaly = tsdb_lib.EwmaAnomalyDetector()
+        state_dir = os.path.expanduser(
+            env_vars.get('SKYTPU_STATE_DIR') or '~/.skytpu')
+        self.recorder = tsdb_lib.FlightRecorder(
+            self.tsdb, os.path.join(state_dir, 'postmortems',
+                                    service_name))
+        self._prev_replica_status: Dict[int, 'ReplicaStatus'] = {}
 
     def _maybe_adopt_update(self, row) -> None:
         """`serve update` bumped the row's version: reload spec/task and
@@ -240,7 +277,104 @@ class ServeController:
                     self.burn_engine.burn_rates().items():
                 self._m.slo_burn(slo, window).set(rate)
         self.autoscaler.observe_fleet({**signals, **burn})
+        # Retrospective plane: fold this tick into the ring TSDB, score
+        # every series against its EWMA baseline, and let the flight
+        # recorder seal a postmortem if something just went wrong.
+        self._record_timeseries(signals, burn)
         self._refresh_service_status()
+
+    # -- time-series plane ----------------------------------------------------
+    def _record_timeseries(self, signals: Dict[str, float],
+                           burn: Dict[str, float]) -> None:
+        now = time.time()
+        fleet = self.manager.fleet_metrics()
+        series = self.rates.derive(now, fleet)
+        series['queue_depth'] = signals.get(
+            'skytpu_serve_queue_depth_requests', 0.0)
+        series['pending_prefill_tokens'] = signals.get(
+            'skytpu_serve_pending_prefill_tokens', 0.0)
+        series['slots_active'] = signals.get(
+            'skytpu_serve_slots_active_count', 0.0)
+        kv_util = metrics_lib.sample_value(
+            fleet, 'skytpu_engine_hbm_kv_utilization_ratio')
+        if kv_util is not None:
+            series['kv_utilization'] = kv_util
+        series.update(burn)
+        self.tsdb.record(now, series)
+        zscores = self.anomaly.observe_all(series)
+        if self._m is not None:
+            for name, z in zscores.items():
+                self._m.anomaly(name).set(z)
+        self._flight_check(now, zscores)
+
+    def _flight_check(self, now: float,
+                      zscores: Dict[str, float]) -> None:
+        """Trigger the flight recorder on anomalous series (a 5x TTFT
+        spike, a 429 storm surfacing as a rejected_rps z-score) and on
+        replica transitions into failure/preemption/drain."""
+        reasons = [f'anomaly:{name}'
+                   for name in self.anomaly.flagged(zscores)]
+        current = {r['replica_id']: r['status']
+                   for r in self.manager.replicas()}
+        for rid, status in current.items():
+            prev = self._prev_replica_status.get(rid)
+            if prev == status:
+                continue
+            if (status.is_failed()
+                    or status in (ReplicaStatus.PREEMPTED,
+                                  ReplicaStatus.SHUTTING_DOWN,
+                                  ReplicaStatus.NOT_READY)):
+                reasons.append(f'replica:{rid}:{status.value}')
+        self._prev_replica_status = current
+        context = None
+        for reason in reasons:
+            if context is None:  # built once, only when needed
+                context = self._postmortem_context(zscores)
+            path = self.recorder.seal(reason, now, context)
+            if path:
+                self._log(f'flight recorder sealed {path} ({reason})')
+
+    def _postmortem_context(self, zscores: Dict[str, float]) -> Dict:
+        return {
+            'service': self.name,
+            'status': self.status_payload(),
+            'anomaly_zscores': {n: z for n, z in zscores.items()
+                                if z > 0.0},
+            'anomaly_threshold': self.anomaly.z_threshold,
+            'trace_ring': {'stats': timeline.trace_stats(),
+                           'recent': timeline.recent_traces(16)},
+            'replica_stats': self._fetch_replica_stats(),
+        }
+
+    def _fetch_replica_stats(self) -> Dict[str, Dict]:
+        """Best-effort /stats snapshot of every READY replica: the
+        scheduler-side queue/slot/HBM picture at seal time. A replica
+        that just died simply contributes nothing — the seal must never
+        block on it."""
+        out: Dict[str, Dict] = {}
+        for r in self.manager.replicas():
+            if r['status'] != ReplicaStatus.READY or not r['url']:
+                continue
+            try:
+                with urllib.request.urlopen(
+                        r['url'].rstrip('/') + '/stats',
+                        timeout=0.8) as resp:
+                    out[str(r['replica_id'])] = json.loads(resp.read())
+            except (urllib.error.URLError, OSError, ValueError):
+                continue
+        return out
+
+    def timeseries_payload(self, names: Optional[List[str]],
+                           since: float) -> Dict:
+        return {
+            'now': time.time(),
+            'interval_seconds': _tick(),
+            'names': self.tsdb.names(),
+            'series': self.tsdb.query(names, since),
+            'zscores': self.anomaly.latest(),
+            'anomaly_threshold': self.anomaly.z_threshold,
+            'postmortems': list(self.recorder.sealed),
+        }
 
     def run(self) -> None:
         serve_state.update_service(self.name, controller_pid=os.getpid())

@@ -39,7 +39,11 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
+import os
+import urllib.parse
+
 from skypilot_tpu import env_vars
+from skypilot_tpu.models import decode
 from skypilot_tpu.models import paged_kv
 from skypilot_tpu.models.decode import (DecodeEngine, chunk_spans,
                                         draft_tokens, prefill_bucket)
@@ -658,6 +662,9 @@ class GenerationScheduler:
             self.engine.profiler.note_hbm(
                 self.engine.hbm_ledger(self.state, self.params),
                 self.engine.hbm_block_stats())
+            # Roofline MFU/AI: join the warmup cost table with the
+            # measured per-variant step-time EWMA at scrape cadence.
+            self.engine.profiler.roofline_snapshot(decode.peak_flops())
         # Quant-scale canary (int8 KV only): sample current scales into
         # the histogram at scrape cadence, not on the decode hot path.
         self.engine.observe_kv_scales(self.state)
@@ -718,6 +725,17 @@ class GenerationScheduler:
         # Warmup drove the engine through its legacy auto-assignment;
         # hand the blocks back — admissions below reserve explicitly.
         eng.free_auto_tables()
+        # Roofline attribution: cost every variant warmup just compiled
+        # (XLA cost model with analytic fallback) and publish the
+        # skytpu_engine_step_flops/_bytes gauge families. Warmup-time
+        # only — re-lowering here never lands on the step path.
+        if eng.profiler is not None:
+            try:
+                eng.profiler.note_roofline(
+                    eng.roofline_costs(self.params, self.state))
+            except Exception as e:  # noqa: BLE001 — gauges are optional
+                print(f'[serve] roofline cost extraction skipped: '
+                      f'{type(e).__name__}: {e}', flush=True)
         self.warm.set()
 
     def _take_pending(self) -> _Request:
@@ -1785,6 +1803,9 @@ class GenerationServer:
                     self._json(404, {'error': 'not found'})
 
             def do_POST(self):
+                if self.path.startswith('/profile'):
+                    outer._handle_profile(self)
+                    return
                 if self.path != '/generate':
                     self._json(404, {'error': 'not found'})
                     return
@@ -1810,6 +1831,71 @@ class GenerationServer:
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self.httpd.server_address[1]
+        # One profile window at a time: jax.profiler is process-global,
+        # so a second concurrent start_trace would corrupt the first.
+        self._profile_lock = threading.Lock()
+
+    PROFILE_MAX_MS = 60_000.0
+
+    def _handle_profile(self, handler) -> None:
+        """POST /profile?ms=N — wrap ``jax.profiler.start_trace`` /
+        ``stop_trace`` around N ms of LIVE serving (the scheduler keeps
+        stepping on its own threads; this handler only sleeps) and
+        answer with the artifact directory. Backends without a working
+        profiler get a JSON fallback artifact: scheduler /stats before
+        and after the window plus the trace-ring occupancy — enough to
+        see what the window contained, just not per-op device time."""
+        query = urllib.parse.parse_qs(
+            urllib.parse.urlparse(handler.path).query)
+        try:
+            ms = float(query.get('ms', ['1000'])[0])
+        except ValueError:
+            handler._json(400, {'error': 'ms must be a number'})
+            return
+        ms = max(1.0, min(ms, self.PROFILE_MAX_MS))
+        if not self._profile_lock.acquire(blocking=False):
+            handler._json(409, {'error': 'profile already in progress'})
+            return
+        try:
+            base = env_vars.get('SKYTPU_PROFILE_DIR') or os.path.join(
+                os.path.expanduser(
+                    env_vars.get('SKYTPU_STATE_DIR') or '~/.skytpu'),
+                'profiles')
+            run_dir = os.path.join(base,
+                                   f'profile_{int(time.time() * 1000)}')
+            os.makedirs(run_dir, exist_ok=True)
+            import jax
+            started = False
+            try:
+                jax.profiler.start_trace(run_dir)
+                started = True
+            except Exception as e:  # noqa: BLE001 — fallback below
+                print(f'[serve] jax profiler unavailable, JSON '
+                      f'fallback: {e}', flush=True)
+            stats_before = self.scheduler.stats()
+            time.sleep(ms / 1e3)
+            mode = 'jax'
+            if started:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:  # noqa: BLE001
+                    started = False
+            if not started:
+                mode = 'fallback'
+                payload = {
+                    'mode': 'fallback',
+                    'window_ms': ms,
+                    'stats_before': stats_before,
+                    'stats_after': self.scheduler.stats(),
+                    'trace_ring': timeline.trace_stats(),
+                }
+                with open(os.path.join(run_dir, 'profile_fallback.json'),
+                          'w', encoding='utf-8') as f:
+                    json.dump(payload, f, indent=1, default=str)
+            handler._json(200,
+                          {'artifact': run_dir, 'mode': mode, 'ms': ms})
+        finally:
+            self._profile_lock.release()
 
     def _handle_generate(self, handler, body: Dict[str, Any]) -> None:
         if 'tokens' in body:

@@ -231,7 +231,12 @@ class LoadBalancer:
             # a sleep or disk write on this path would stall a client.
             # skylint: hot-path allow=network
             def _proxy(self):
-                lb.record_request()
+                # Control-plane probes proxy like any request but must
+                # not read as user traffic: a /profile capture during an
+                # incident would otherwise nudge the autoscaler's QPS
+                # window exactly when it should stay honest.
+                if not self.path.startswith('/profile'):
+                    lb.record_request()
                 # Trace correlation id: minted here (kept if the client
                 # sent one), propagated to the replica via header and
                 # echoed back to the client on every response path.

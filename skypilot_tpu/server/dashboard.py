@@ -65,6 +65,57 @@ def _esc(v: Any) -> str:
     return html.escape(str(v if v is not None else '-'))
 
 
+# Series drawn as sparklines next to the point-value columns, from the
+# controller's /timeseries ring (name -> column header).
+_SPARK_SERIES = (('req_rps', 'req/s trend'),
+                 ('ttft_p99_ms', 'ttft p99 trend'),
+                 ('queue_depth', 'queue trend'))
+_SPARK_POINTS = 60  # most recent raw-tier points per sparkline
+
+
+def _spark(points: List[List[float]], width: int = 120,
+           height: int = 22) -> str:
+    """Inline SVG sparkline for [(t, v), ...] — no JS, no external
+    assets (the dashboard stays one dependency-free page). Flat or
+    single-point series render as a midline; the latest value is
+    printed after the polyline so the sparkline carries its own
+    scale."""
+    pts = points[-_SPARK_POINTS:]
+    if not pts:
+        return '<span class="muted">-</span>'
+    values = [p[1] for p in pts]
+    lo, hi = min(values), max(values)
+    span = (hi - lo) or 1.0
+    n = len(values)
+    step = width / max(1, n - 1)
+    coords = ' '.join(
+        f'{i * step:.1f},{height - 2 - (v - lo) / span * (height - 4):.1f}'
+        for i, v in enumerate(values))
+    last = values[-1]
+    label = f'{last:.1f}' if abs(last) < 1000 else f'{last:.0f}'
+    return (f'<svg width="{width}" height="{height}" '
+            f'viewBox="0 0 {width} {height}">'
+            f'<polyline fill="none" stroke="#4078c0" stroke-width="1.5" '
+            f'points="{coords}"/></svg> '
+            f'<span class="muted">{html.escape(label)}</span>')
+
+
+def _fetch_timeseries(controller_port: int) -> dict:
+    """Best-effort /timeseries pull (same sub-second budget as the
+    metrics scrape); {} when the controller predates the TSDB or is
+    briefly unreachable — the sparkline cells degrade to '-'."""
+    import json
+    import urllib.request
+    names = ','.join(name for name, _ in _SPARK_SERIES)
+    try:
+        with urllib.request.urlopen(
+                f'http://127.0.0.1:{controller_port}/timeseries'
+                f'?series={names}', timeout=0.8) as resp:
+            return json.loads(resp.read())
+    except Exception:  # noqa: BLE001 — any failure degrades gracefully
+        return {}
+
+
 def _service_metrics_row(name: str, controller_port: int,
                          lb_port: int = 0) -> List[Any]:
     """One fleet-metrics row from the service controller's /metrics
@@ -120,15 +171,29 @@ def _service_metrics_row(name: str, controller_port: int,
                 f'title="trace {html.escape(best[0])}">'
                 f'{_esc(text_val)}</a>')
 
+    ts = _fetch_timeseries(controller_port)
+
     def burn_cell():
-        """Worst burn rate across SLOs/windows: >1.0 means the error
-        budget is draining faster than it refills (alert-red)."""
+        """Worst burn rate across SLOs/windows (>1.0 = error budget
+        draining faster than it refills), escalated by the controller's
+        EWMA anomaly detector: a series z-score at/over the threshold
+        turns the cell alert-red with the offending series named — the
+        alert column sees a TTFT spike even while the burn windows are
+        still averaging it away."""
         worst = None
         for sname, slabels, svalue in samples:
             if sname != 'skytpu_controller_slo_burn_ratio':
                 continue
             if worst is None or svalue > worst[1]:
                 worst = (dict(slabels), svalue)
+        threshold = ts.get('anomaly_threshold') or float('inf')
+        anomalous = sorted(
+            (z, name) for name, z in (ts.get('zscores') or {}).items()
+            if z >= threshold)
+        if anomalous:
+            z, name = anomalous[-1]
+            tag = f'{name} z={z:.1f}'
+            return f'<span class="bad">{html.escape(tag)}</span>'
         if worst is None:
             return '<span class="muted">-</span>'
         labels, rate = worst
@@ -136,6 +201,9 @@ def _service_metrics_row(name: str, controller_port: int,
                f'{rate:.2f}x')
         cls = 'bad' if rate > 1.0 else ('warn' if rate > 0.5 else 'ok')
         return f'<span class="{cls}">{html.escape(tag)}</span>'
+
+    def spark_cell(series):
+        return _spark((ts.get('series') or {}).get(series) or [])
 
     return [
         _esc(name),
@@ -159,7 +227,7 @@ def _service_metrics_row(name: str, controller_port: int,
         # KV roughly halves this vs bf16 — more blocks per HBM byte).
         _esc(val('skytpu_engine_kv_bytes_per_token')),
         _esc(val('skytpu_engine_recompiles_total')),
-    ]
+    ] + [spark_cell(series) for series, _ in _SPARK_SERIES]
 
 
 def render() -> str:
@@ -258,7 +326,8 @@ def render() -> str:
             ['service', 'requests', '429s', 'queue depth',
              'ttft p50 (ms)', 'ttft p99 (ms)', 'tpot p50 (ms)',
              'slo burn', 'step gap p50 (ms)', 'in-flight', 'accept/step',
-             'KV bytes/tok', 'recompiles'],
+             'KV bytes/tok', 'recompiles']
+            + [title for _, title in _SPARK_SERIES],
             serve_metric_rows),
         requests=_table(['id', 'op', 'user', 'status', 'created'],
                         request_rows),

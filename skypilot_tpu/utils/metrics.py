@@ -41,7 +41,7 @@ DEFAULT_BUCKETS_MS: Tuple[float, ...] = (
 # denominator (e.g. bytes_per_token); the rest are the units this
 # codebase actually measures in.
 UNITS = ('total', 'ms', 'seconds', 'tokens', 'requests', 'slots',
-         'bytes', 'ratio', 'count', 'rps', 'info', 'token')
+         'bytes', 'ratio', 'count', 'rps', 'info', 'token', 'flops')
 
 _NAME_RE = re.compile(r'^skytpu_[a-z0-9]+(_[a-z0-9]+)+$')
 

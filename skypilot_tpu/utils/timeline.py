@@ -30,7 +30,7 @@ import json
 import os
 import threading
 import time
-from typing import Any, Callable, Deque, Optional
+from typing import Any, Callable, Deque, List, Optional
 
 DEFAULT_CAPACITY = 100_000
 
@@ -330,3 +330,14 @@ def trace_stats() -> dict:
     with _trace_lock:
         return {'completed': len(_traces), 'open': len(_open_traces),
                 'capacity': _trace_capacity}
+
+
+def recent_traces(limit: int = 16) -> List[dict]:
+    """The ``limit`` most recently completed traces, newest last —
+    what the flight recorder folds into a postmortem artifact so the
+    sealed window carries the actual request trees, not just rates.
+    The ring dict is insertion-ordered (completion order), so the tail
+    IS recency."""
+    with _trace_lock:
+        tail = list(_traces.values())[-max(0, int(limit)):]
+        return [{**tr, 'complete': True} for tr in tail]

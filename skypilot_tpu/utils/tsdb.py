@@ -1,0 +1,344 @@
+"""Dependency-free fixed-interval ring TSDB for the serve controller.
+
+The controller already *sees* everything — it scrapes every replica's
+/metrics each tick and aggregates — but until now it kept only the
+latest sample, so "what was the fleet doing 90 seconds before that p99
+spike" needed an external Prometheus. This module is the retrospective
+half of the observability plane:
+
+- :class:`SeriesRing` / :class:`TimeSeriesStore`: per-series rings of
+  ``(t, value)`` points at the controller tick cadence, with coarser
+  downsampled tiers behind them (tier k+1 stores the mean of every
+  ``$SKYTPU_TSDB_DOWNSAMPLE`` consecutive tier-k points), so recent
+  history is full-resolution and old history degrades gracefully
+  instead of vanishing. Capacity ``$SKYTPU_TSDB_POINTS`` per tier.
+- :class:`RateDeriver`: turns successive CUMULATIVE scrape snapshots
+  (parsed Prometheus samples) into per-second rates and windowed
+  histogram quantiles — the delta of two cumulative bucket vectors is
+  itself a histogram of exactly that window's observations. Counter
+  resets (replica restart mid-window) are detected per series: a value
+  that went *down* means the counter restarted from zero, so the delta
+  since the reset is the current value itself.
+- :class:`EwmaAnomalyDetector`: EWMA mean/variance z-score per series,
+  feeding the dashboard alert column and the flight-recorder trigger.
+- :class:`FlightRecorder`: seals the last ``$SKYTPU_TSDB_FLIGHT_WINDOW``
+  seconds of every series plus caller-supplied context (trace-ring
+  entries, scheduler /stats) into a JSON postmortem artifact when a
+  replica fails/drains or a series goes anomalous — the black box an
+  operator opens *after* the incident.
+
+Everything here is plain stdlib + utils.metrics parsing helpers: the
+controller must run on machines with nothing installed.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from skypilot_tpu import env_vars
+from skypilot_tpu.utils import metrics as metrics_lib
+
+Point = Tuple[float, float]  # (unix seconds, value)
+
+
+def _points_per_tier() -> int:
+    return max(16, env_vars.get_int('SKYTPU_TSDB_POINTS') or 512)
+
+
+def _downsample_factor() -> int:
+    return max(2, env_vars.get_int('SKYTPU_TSDB_DOWNSAMPLE') or 8)
+
+
+class SeriesRing:
+    """Fixed-capacity ring of (t, v) points with downsampled tiers.
+
+    Tier 0 holds raw appends; every ``factor`` tier-k points are folded
+    (mean of t, mean of v) into one tier-(k+1) point, so with 3 tiers
+    and 512 points each, a 20 s tick keeps ~2.8 h full-resolution plus
+    ~23 h at 160 s and ~7.6 days at 21 min per point.
+    """
+
+    TIERS = 3
+
+    def __init__(self, points: Optional[int] = None,
+                 factor: Optional[int] = None):
+        self.points = points or _points_per_tier()
+        self.factor = factor or _downsample_factor()
+        self._tiers: List[deque] = [deque(maxlen=self.points)
+                                    for _ in range(self.TIERS)]
+        self._folding: List[List[Point]] = [[] for _ in range(self.TIERS)]
+
+    def append(self, t: float, v: float) -> None:
+        self._append_tier(0, float(t), float(v))
+
+    def _append_tier(self, k: int, t: float, v: float) -> None:
+        self._tiers[k].append((t, v))
+        if k + 1 >= self.TIERS:
+            return
+        buf = self._folding[k]
+        buf.append((t, v))
+        if len(buf) >= self.factor:
+            n = len(buf)
+            self._folding[k] = []
+            self._append_tier(k + 1, sum(p[0] for p in buf) / n,
+                              sum(p[1] for p in buf) / n)
+
+    def query(self, since: float = 0.0) -> List[Point]:
+        """Points with t >= ``since`` from the finest tier that still
+        reaches back to ``since``; when none does (the raw ring already
+        wrapped past it), the tier with the longest memory answers —
+        coarser, never empty-handed."""
+        populated = [t for t in self._tiers if t]
+        if not populated:
+            return []
+        for tier in self._tiers:
+            if tier and tier[0][0] <= since:
+                return [p for p in tier if p[0] >= since]
+        oldest = min(populated, key=lambda tier: tier[0][0])
+        return [p for p in oldest if p[0] >= since]
+
+
+class TimeSeriesStore:
+    """Named series, created on first record. Thread-safe: the
+    controller tick records while HTTP handler threads query."""
+
+    def __init__(self, points: Optional[int] = None,
+                 factor: Optional[int] = None):
+        self._points = points
+        self._factor = factor
+        self._series: Dict[str, SeriesRing] = {}
+        self._lock = threading.Lock()
+
+    def record(self, now: float, values: Dict[str, float]) -> None:
+        with self._lock:
+            for name, value in values.items():
+                v = float(value)
+                if not math.isfinite(v):
+                    continue
+                ring = self._series.get(name)
+                if ring is None:
+                    ring = SeriesRing(self._points, self._factor)
+                    self._series[name] = ring
+                ring.append(now, v)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._series)
+
+    def query(self, names: Optional[Sequence[str]] = None,
+              since: float = 0.0) -> Dict[str, List[Point]]:
+        with self._lock:
+            wanted = self._series if names is None else {
+                n: self._series[n] for n in names if n in self._series}
+            return {name: [list(p) for p in ring.query(since)]
+                    for name, ring in wanted.items()}
+
+
+class RateDeriver:
+    """Successive cumulative scrape snapshots -> per-tick series.
+
+    ``derive(now, samples)`` diffs the fleet aggregate against the
+    previous call and returns {series_name: value} for this window:
+    counters become per-second rates, histograms become windowed
+    quantiles (delta of cumulative bucket vectors = the window's own
+    histogram), and ``_sum``/``_count`` pairs become windowed means.
+    The first call only primes state and returns {}.
+
+    Counter reset: the fleet aggregate DROPS a restarted replica's old
+    counters (the manager prunes dead scrapes), so a cumulative value
+    can go down without any single counter resetting. Either way the
+    honest window delta is ``max(cur - prev, 0)`` — except a full
+    restart (prev >> cur ~ 0) where ``cur`` itself is the activity
+    since the reset, which ``cur < prev`` selects.
+    """
+
+    # (metric family, series name) — cumulative counters -> rate/s.
+    COUNTERS = (
+        ('skytpu_serve_requests_total', 'req_rps'),
+        ('skytpu_serve_tokens_out_total', 'tok_rps'),
+        ('skytpu_serve_rejected_total', 'rejected_rps'),
+    )
+    # (histogram family, series prefix, quantiles) -> windowed p50/p99.
+    HISTOGRAMS = (
+        ('skytpu_serve_ttft_ms', 'ttft', (0.5, 0.99)),
+        ('skytpu_serve_tpot_ms', 'tpot', (0.5, 0.99)),
+        ('skytpu_engine_step_gap_ms', 'step_gap', (0.5,)),
+    )
+    # (histogram family, series name) -> windowed mean (sum/count).
+    MEANS = (
+        ('skytpu_engine_spec_accept_tokens', 'spec_accept_per_step'),
+    )
+
+    def __init__(self):
+        self._prev_t: Optional[float] = None
+        self._prev_counters: Dict[str, float] = {}
+        self._prev_buckets: Dict[str, Dict[float, float]] = {}
+        self._prev_sums: Dict[str, Tuple[float, float]] = {}
+
+    @staticmethod
+    def _delta(cur: float, prev: Optional[float]) -> float:
+        if prev is None:
+            return 0.0
+        return cur if cur < prev else cur - prev
+
+    def derive(self, now: float,
+               samples: Sequence[metrics_lib.Sample]) -> Dict[str, float]:
+        first = self._prev_t is None
+        dt = 0.0 if first else max(1e-9, now - self._prev_t)
+        out: Dict[str, float] = {}
+        for family, series in self.COUNTERS:
+            cur = metrics_lib.sample_value(samples, family)
+            if cur is None:
+                continue
+            if not first:
+                out[series] = self._delta(
+                    cur, self._prev_counters.get(family)) / dt
+            self._prev_counters[family] = cur
+
+        for family, prefix, quantiles in self.HISTOGRAMS:
+            cum = metrics_lib.histogram_cumulative(samples, family)
+            if not cum:
+                continue
+            cur_b = dict(cum)
+            prev_b = self._prev_buckets.get(family)
+            if not first and prev_b is not None:
+                reset = any(cur_b.get(le, 0.0) < prev
+                            for le, prev in prev_b.items())
+                window = [(le, c if reset
+                           else c - prev_b.get(le, 0.0))
+                          for le, c in sorted(cur_b.items())]
+                if window and window[-1][1] > 0:
+                    for q in quantiles:
+                        val = metrics_lib.histogram_quantile(window, q)
+                        if val is not None:
+                            out[f'{prefix}_p{int(q * 100)}_ms'] = val
+            self._prev_buckets[family] = cur_b
+
+        for family, series in self.MEANS:
+            total = metrics_lib.sample_value(samples, f'{family}_sum')
+            count = metrics_lib.sample_value(samples, f'{family}_count')
+            if total is None or count is None:
+                continue
+            if not first:
+                prev = self._prev_sums.get(family)
+                d_count = self._delta(count, prev and prev[1])
+                d_sum = (total if prev is not None and count < prev[1]
+                         else total - (prev[0] if prev else 0.0))
+                if d_count > 0:
+                    out[series] = d_sum / d_count
+            self._prev_sums[family] = (total, count)
+
+        self._prev_t = now
+        return out
+
+
+class EwmaAnomalyDetector:
+    """Per-series EWMA mean/variance z-score.
+
+    ``observe(name, value)`` scores *before* folding the value in, so a
+    spike is judged against the pre-spike baseline. The first
+    ``min_samples`` observations return 0.0 (warming); a zero-variance
+    baseline (constant series) scores any departure at :data:`Z_CAP` —
+    definitely anomalous, still JSON-serializable.
+    """
+
+    Z_CAP = 100.0
+
+    def __init__(self, alpha: float = 0.3,
+                 z_threshold: Optional[float] = None,
+                 min_samples: int = 5):
+        if z_threshold is None:
+            z_threshold = float(
+                env_vars.get('SKYTPU_TSDB_ANOMALY_Z') or 4.0)
+        self.alpha = alpha
+        self.z_threshold = z_threshold
+        self.min_samples = min_samples
+        # name -> (count, ewma_mean, ewma_var)
+        self._state: Dict[str, Tuple[int, float, float]] = {}
+        self._last_z: Dict[str, float] = {}
+
+    def observe(self, name: str, value: float) -> float:
+        if not math.isfinite(value):
+            return self._last_z.get(name, 0.0)
+        n, mean, var = self._state.get(name, (0, float(value), 0.0))
+        z = 0.0
+        if n >= self.min_samples:
+            sd = math.sqrt(var)
+            if sd > 0.0:
+                z = min(abs(value - mean) / sd, self.Z_CAP)
+            elif value != mean:
+                z = self.Z_CAP
+        diff = value - mean
+        incr = self.alpha * diff
+        self._state[name] = (n + 1, mean + incr,
+                             (1.0 - self.alpha) * (var + diff * incr))
+        self._last_z[name] = z
+        return z
+
+    def observe_all(self, values: Dict[str, float]) -> Dict[str, float]:
+        return {name: self.observe(name, v) for name, v in values.items()}
+
+    def latest(self) -> Dict[str, float]:
+        return dict(self._last_z)
+
+    def flagged(self, zscores: Dict[str, float]) -> List[str]:
+        return sorted(n for n, z in zscores.items()
+                      if z >= self.z_threshold)
+
+
+class FlightRecorder:
+    """Black-box postmortem writer over a :class:`TimeSeriesStore`.
+
+    ``seal(reason, now, context)`` snapshots the last
+    ``$SKYTPU_TSDB_FLIGHT_WINDOW`` seconds of EVERY series (no
+    selection — dropping a series is exactly what you regret during the
+    postmortem) plus the caller's context dict into one JSON artifact
+    under ``out_dir``. Repeat triggers of the same (reason-class,
+    subject) within one window are throttled to a single artifact: an
+    incident storms its trigger every tick, and 60 identical
+    postmortems bury the one that matters.
+    """
+
+    def __init__(self, store: TimeSeriesStore, out_dir: str,
+                 window_s: Optional[float] = None):
+        if window_s is None:
+            window_s = float(
+                env_vars.get('SKYTPU_TSDB_FLIGHT_WINDOW') or 120)
+        self.store = store
+        self.out_dir = out_dir
+        self.window_s = window_s
+        self.sealed: List[str] = []
+        self._last_seal: Dict[str, float] = {}
+
+    @staticmethod
+    def _throttle_key(reason: str) -> str:
+        return ':'.join(reason.split(':')[:2])
+
+    def seal(self, reason: str, now: float,
+             context: Optional[Dict] = None) -> Optional[str]:
+        key = self._throttle_key(reason)
+        last = self._last_seal.get(key)
+        if last is not None and now - last < self.window_s:
+            return None
+        payload = {
+            'reason': reason,
+            'sealed_at': now,
+            'window_seconds': self.window_s,
+            'series': self.store.query(since=now - self.window_s),
+            'context': context or {},
+        }
+        os.makedirs(self.out_dir, exist_ok=True)
+        slug = ''.join(ch if ch.isalnum() else '-' for ch in key)
+        path = os.path.join(
+            self.out_dir, f'postmortem_{int(now * 1000)}_{slug}.json')
+        tmp = path + '.tmp'
+        with open(tmp, 'w', encoding='utf-8') as f:
+            json.dump(payload, f, indent=1, default=str)
+        os.replace(tmp, path)  # readers never see a half-written box
+        self._last_seal[key] = now
+        self.sealed.append(path)
+        return path

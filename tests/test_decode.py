@@ -1369,3 +1369,140 @@ def test_int8_kv_greedy_agreement_spec_on_and_off(model_and_params):
         agree = sum(a == b for sb, si in zip(bf16, int8)
                     for a, b in zip(sb, si))
         assert agree / total >= 0.9
+
+
+# ---- roofline attribution ---------------------------------------------------
+def _hand_step_cost(b, m_pad):
+    """The estimator's documented formula, recomputed independently
+    from the test-tiny dims — a drifting estimator must fail here."""
+    c = CFG
+    qkv = c.embed_dim * c.head_dim * (c.num_heads + 2 * c.num_kv_heads)
+    proj = c.num_heads * c.head_dim * c.embed_dim
+    mlp = 3 * c.embed_dim * c.mlp_dim
+    p_layers = c.num_layers * (qkv + proj + mlp)
+    t = b  # decode: one token row per slot, logits for every row
+    flops = (2.0 * p_layers * t
+             + 2.0 * c.embed_dim * c.vocab_size * t
+             + 4.0 * c.num_layers * c.num_heads * c.head_dim * t * m_pad)
+    return flops
+
+
+class TestRoofline:
+
+    def test_variant_label_flattens_dim_tuples(self):
+        from skypilot_tpu.models.decode import StepProfiler
+        vl = StepProfiler.variant_label
+        assert vl('step', 4) == 'step:4'
+        assert vl('step_verify', 8, 4) == 'step_verify:8x4'
+        # admit_many passes the whole array shape as one tuple — the
+        # label must flatten it, not int() it (regression: prefill
+        # died with a TypeError the first time a batched admit ran).
+        assert vl('admit_many', (3, 64)) == 'admit_many:3x64'
+        assert vl('warmup') == 'warmup'
+
+    def test_estimate_step_cost_pinned_to_hand_formula(self):
+        eng = _shared_engine(batch_slots=4, max_len=64)
+        flops, nbytes = eng.estimate_step_cost('step', 4)
+        assert flops == pytest.approx(_hand_step_cost(4, eng.m_pad))
+        param_bytes = CFG.num_params * jnp.dtype(CFG.dtype).itemsize
+        kv = eng.kv_bytes_per_token() * (4 * eng.m_pad + 4)
+        assert nbytes == pytest.approx(param_bytes + kv)
+        # Verify-step: (1+K) token rows per slot, same padded context.
+        vf, _ = eng.estimate_step_cost('step_verify', 4, 3)
+        assert vf == pytest.approx(_hand_step_cost(4 * 4, eng.m_pad))
+        # Prefill attends only its own T rows (M = T, not m_pad) and
+        # computes logits for one row.
+        pf, _ = eng.estimate_step_cost('prefill', 64)
+        c = CFG
+        assert pf == pytest.approx(
+            _hand_step_cost(64, 64)
+            - 2.0 * c.embed_dim * c.vocab_size * 63)
+        with pytest.raises(ValueError):
+            eng.estimate_step_cost('admit', 4)
+
+    def test_roofline_costs_fallback_estimator(self, model_and_params,
+                                               monkeypatch):
+        """cost_analysis unavailable (the CPU-safe path): the analytic
+        estimator's numbers flow through verbatim."""
+        _, params = model_and_params
+        eng = _shared_engine(batch_slots=4, max_len=64)
+        monkeypatch.setattr(DecodeEngine, '_xla_cost',
+                            staticmethod(lambda lowered: None))
+        state = eng.init_state()
+        costs = eng.roofline_costs(params, state)
+        assert 'step:4' in costs
+        assert costs['step:4'] == \
+            pytest.approx(eng.estimate_step_cost('step', 4))
+
+    def test_roofline_costs_xla_override(self, model_and_params,
+                                         monkeypatch):
+        """cost_analysis available: XLA flops win; zero reported bytes
+        fall back to the estimator's bytes independently."""
+        _, params = model_and_params
+        eng = _shared_engine(batch_slots=4, max_len=64)
+        monkeypatch.setattr(DecodeEngine, '_xla_cost',
+                            staticmethod(lambda lowered: (7e9, 3e9)))
+        state = eng.init_state()
+        costs = eng.roofline_costs(params, state)
+        assert costs['step:4'] == (7e9, 3e9)
+        monkeypatch.setattr(DecodeEngine, '_xla_cost',
+                            staticmethod(lambda lowered: (7e9, 0.0)))
+        costs = eng.roofline_costs(params, state)
+        _, est_bytes = eng.estimate_step_cost('step', 4)
+        assert costs['step:4'] == (7e9, pytest.approx(est_bytes))
+
+    def test_roofline_costs_covers_seen_variants(self, model_and_params):
+        """Real path, no patching: whatever cost source the backend
+        offers, every ROOFLINE_KINDS variant the profiler saw gets a
+        positive-FLOPs entry keyed by its label."""
+        _, params = model_and_params
+        eng = _shared_engine(batch_slots=4, max_len=64)
+        prompt = [3, 1, 4, 1, 5]
+        out, state = engine_greedy(eng, params, prompt, 3)
+        costs = eng.roofline_costs(params, state)
+        seen = {eng.profiler.variant_label(k[0], *k[1:])
+                for k in eng.profiler._seen_variants
+                if k[0] in eng.ROOFLINE_KINDS}
+        assert set(costs) == seen
+        assert 'step:4' in costs
+        assert all(f > 0 and b > 0 for f, b in costs.values())
+
+    def test_snapshot_publishes_mfu_and_ai_gauges(self):
+        from skypilot_tpu.utils import metrics as metrics_lib
+        eng = _shared_engine(batch_slots=4, max_len=64)
+        prof = eng.profiler
+        prof.note_roofline({'step:4': (1e9, 5e8)})
+        prof._variant_step_s['step:4'] = 0.01
+        snap = prof.roofline_snapshot(peak_flops=1e12)
+        row = snap['step:4']
+        # MFU = 1e9 FLOPs / 0.01 s / 1e12 peak; AI = flops/bytes.
+        assert row['mfu'] == pytest.approx(0.1)
+        assert row['ai'] == pytest.approx(2.0)
+        assert row['step_ms'] == pytest.approx(10.0)
+        samples = metrics_lib.parse_text(metrics_lib.REGISTRY.render())
+        for name, want in (('skytpu_engine_step_flops', 1e9),
+                           ('skytpu_engine_step_bytes', 5e8),
+                           ('skytpu_engine_step_ai_ratio', 2.0),
+                           ('skytpu_engine_step_mfu_ratio', 0.1)):
+            assert metrics_lib.sample_value(
+                samples, name, {'variant': 'step:4'}) == \
+                pytest.approx(want), name
+        # Peak unset: MFU reports 0, AI unaffected.
+        snap = prof.roofline_snapshot(peak_flops=0.0)
+        assert snap['step:4']['mfu'] == 0.0
+        assert snap['step:4']['ai'] == pytest.approx(2.0)
+
+    def test_kv_microbench_roofline_arm(self, model_and_params):
+        """The --roofline arm returns the gauge-shaped table."""
+        import scripts.kv_microbench as kb
+        _, params = model_and_params
+        snap = kb.bench_roofline(CFG, params, slots=2, max_len=64,
+                                 prompt_len=8, steps=2, kv_block=0)
+        assert any(v.startswith('step:') for v in snap)
+        for row in snap.values():
+            assert set(row) == {'flops', 'bytes', 'ai', 'step_ms',
+                                'mfu'}
+            assert row['flops'] > 0 and row['bytes'] > 0
+        step = next(v for k, v in snap.items()
+                    if k.startswith('step:'))
+        assert step['step_ms'] > 0.0

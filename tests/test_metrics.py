@@ -876,3 +876,49 @@ class TestTraceE2E:
         components = dict(hbm_samples)
         assert components.get('kv_code_pool') == hbm['kv_code_pool']
         assert 'weights' in components
+
+    def test_profile_endpoint_via_lb(self, lb_stack, tmp_path,
+                                     monkeypatch):
+        """POST /profile proxies through the LB like /trace, wraps a
+        live-serving window, and returns the artifact path. CPU tier-1
+        accepts either a real jax-profiler trace or the JSON fallback
+        artifact (stats before/after + trace-ring occupancy)."""
+        lb_url, replica_url, sched = lb_stack
+        monkeypatch.setenv('SKYTPU_PROFILE_DIR', str(tmp_path))
+
+        def post(url, timeout=60):
+            req = urllib.request.Request(url, data=b'', method='POST')
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, json.loads(resp.read())
+
+        # Retry through the LB until its first replica sync lands.
+        deadline = time.time() + 60
+        out = None
+        while time.time() < deadline and out is None:
+            try:
+                code, out = post(lb_url + '/profile?ms=50')
+            except urllib.error.HTTPError as e:
+                e.read()
+                if e.code not in (502, 503):
+                    raise
+                time.sleep(0.2)
+            except (urllib.error.URLError, OSError):
+                time.sleep(0.2)
+        assert out is not None and code == 200
+        assert out['mode'] in ('jax', 'fallback')
+        assert out['ms'] == 50.0
+        assert out['artifact'].startswith(str(tmp_path))
+        assert os.path.isdir(out['artifact'])
+        if out['mode'] == 'fallback':
+            fb = os.path.join(out['artifact'], 'profile_fallback.json')
+            with open(fb) as f:
+                art = json.load(f)
+            assert art['window_ms'] == 50.0
+            assert 'stats_before' in art and 'stats_after' in art
+        # Bad ms -> 400 straight from the replica, through the proxy.
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            post(replica_url + '/profile?ms=abc')
+        assert exc.value.code == 400
+        # The window clamp: absurd ms never blocks for minutes.
+        code, out = post(replica_url + '/profile?ms=0.001')
+        assert out['ms'] == 1.0

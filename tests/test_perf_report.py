@@ -1,0 +1,106 @@
+"""scripts/perf_report.py: BENCH-record comparison + regression gate."""
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+import pytest  # noqa: E402
+
+from scripts import perf_report  # noqa: E402
+
+
+def _record(tmp_path, n, parsed, name=None):
+    path = os.path.join(str(tmp_path), name or f'BENCH_r{n:02d}.json')
+    with open(path, 'w') as f:
+        json.dump({'n': n, 'cmd': 'bench', 'rc': 0, 'tail': '',
+                   'parsed': parsed}, f)
+    return path
+
+
+BASE = {'serve_output_tokens_per_s': 1000.0, 'serve_ttft_p99_ms': 200.0,
+        'mfu_pct': 50.0, 'launch_overhead_s': 40.0,
+        'serve_prompt_len': 2500, 'chip': 'TPU v5 lite',
+        'serve_sweep': [{'concurrency': 8}]}
+
+
+class TestCompare:
+
+    def test_direction_aware_verdicts(self, tmp_path):
+        old = perf_report.load_record(_record(tmp_path, 1, BASE))
+        new = perf_report.load_record(_record(tmp_path, 2, {
+            **BASE,
+            'serve_output_tokens_per_s': 900.0,   # -10% rate: regression
+            'serve_ttft_p99_ms': 100.0,           # -50% latency: better
+            'mfu_pct': 50.5,                      # +1%: within threshold
+            'launch_overhead_s': 80.0,            # +100% time: regression
+        }))
+        rows, regressions = perf_report.compare(old, new,
+                                                threshold_pct=5.0)
+        verdicts = {r[0]: r[4] for r in rows}
+        assert verdicts['serve_output_tokens_per_s'] == 'REGRESSED'
+        assert verdicts['serve_ttft_p99_ms'] == 'improved'
+        assert verdicts['mfu_pct'] == 'ok'
+        assert verdicts['launch_overhead_s'] == 'REGRESSED'
+        assert regressions == ['launch_overhead_s',
+                               'serve_output_tokens_per_s']
+        # Config echoes and non-numerics never appear as metrics.
+        assert 'serve_prompt_len' not in verdicts
+        assert 'chip' not in verdicts
+        assert 'serve_sweep' not in verdicts
+
+    def test_threshold_is_configurable(self, tmp_path):
+        old = perf_report.load_record(_record(tmp_path, 1, BASE))
+        new = perf_report.load_record(_record(
+            tmp_path, 2, {**BASE, 'serve_output_tokens_per_s': 900.0}))
+        _, regressions = perf_report.compare(old, new,
+                                             threshold_pct=15.0)
+        assert regressions == []
+
+    def test_lower_better_heuristic_suffix_only_for_seconds(self):
+        assert perf_report.lower_is_better('serve_ttft_p99_ms')
+        assert perf_report.lower_is_better('launch_overhead_s')
+        assert perf_report.lower_is_better('errors')
+        # '_s' must match as a suffix, not a substring.
+        assert not perf_report.lower_is_better(
+            'train_tokens_per_sec_per_chip')
+        assert not perf_report.lower_is_better('mfu_pct')
+
+    def test_null_parsed_record_contributes_nothing(self, tmp_path):
+        old = perf_report.load_record(_record(tmp_path, 1, None))
+        new = perf_report.load_record(_record(tmp_path, 2, BASE))
+        rows, regressions = perf_report.compare(old, new, 5.0)
+        assert rows == [] and regressions == []
+
+
+class TestCli:
+
+    def test_two_file_mode_exit_codes(self, tmp_path, capsys):
+        a = _record(tmp_path, 1, BASE)
+        b = _record(tmp_path, 2,
+                    {**BASE, 'serve_output_tokens_per_s': 500.0},
+                    name='BENCH_r02b.json')
+        assert perf_report.main([a, a]) == 0
+        assert perf_report.main([a, b]) == 1
+        err = capsys.readouterr().err
+        assert 'serve_output_tokens_per_s' in err
+
+    def test_dir_mode_prints_trajectory(self, tmp_path, capsys):
+        _record(tmp_path, 1, BASE)
+        _record(tmp_path, 2, {**BASE, 'mfu_pct': 55.0})
+        _record(tmp_path, 3, None)
+        assert perf_report.main(['--dir', str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        header, *rows = [line.split('\t')
+                         for line in out.strip().splitlines()]
+        assert header == ['metric', 'r1', 'r2', 'r3']
+        mfu = next(r for r in rows if r[0] == 'mfu_pct')
+        assert mfu[1:] == ['50.0', '55.0', '-']
+
+    def test_real_repo_records_compare_cleanly(self, capsys):
+        """The repo's own BENCH trajectory stays loadable end-to-end."""
+        rc = perf_report.main(['--dir', REPO_ROOT, '--threshold', '5'])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert out.splitlines()[0].startswith('metric\t')

@@ -380,18 +380,51 @@ class TestServeE2E:
             assert metrics_lib.sample_value(
                 ctrl_samples,
                 'skytpu_controller_ready_replicas_count') >= 1
+            # The ring TSDB answers over HTTP with named series (the
+            # controller has ticked at least twice by READY+probe time;
+            # a few more ticks make the fleet-signal series appear).
+            _wait(lambda: len(json.loads(_get_retry(
+                f'http://127.0.0.1:{ctrl_port}/timeseries')[1])
+                ['names']) >= 3, 60, 'TSDB series recorded')
+            code, ts_body, _ = _get_retry(
+                f'http://127.0.0.1:{ctrl_port}/timeseries'
+                '?series=queue_depth&since=0')
+            assert code == 200
+            ts = json.loads(ts_body)
+            assert list(ts['series']) == ['queue_depth']
+            assert ts['series']['queue_depth']
+            assert ts['interval_seconds'] > 0
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _get(f'http://127.0.0.1:{ctrl_port}/timeseries'
+                     '?since=notafloat')
+            assert exc.value.code == 400
 
-            # Push sustained traffic through the LB -> scale to 2.
-            def push_and_check():
-                for _ in range(8):
+            # Push sustained traffic through the LB -> scale to 2. A
+            # background thread keeps the 2.0s QPS window full no
+            # matter how long any single request stalls; the _wait
+            # predicate itself stays cheap (serial in-predicate GETs
+            # used to empty the window whenever one of them blocked,
+            # resetting upscale hysteresis — the "passes on rerun"
+            # flake).
+            import threading
+            stop_traffic = threading.Event()
+
+            def traffic():
+                while not stop_traffic.is_set():
                     try:
                         _get(endpoint + '/load-gen', timeout=5)
                     except (urllib.error.URLError, OSError):
                         pass
-                return len(_ready_replicas('svc-e2e')) == 2
+                    stop_traffic.wait(0.05)
 
-            _wait(push_and_check, 120, 'scale up to 2 READY replicas',
-                  interval=0.1)
+            traffic_thread = threading.Thread(target=traffic, daemon=True)
+            traffic_thread.start()
+            try:
+                _wait(lambda: len(_ready_replicas('svc-e2e')) == 2, 120,
+                      'scale up to 2 READY replicas', interval=0.1)
+            finally:
+                stop_traffic.set()
+                traffic_thread.join(timeout=10)
 
             # Traffic stops -> scale back down to 1.
             _wait(lambda: len([
@@ -981,3 +1014,151 @@ class TestSloBurnEngine:
             samples, 'skytpu_controller_slo_burn_ratio',
             {'slo': 'ttft', 'window': '5m'})
         assert burn is not None and burn > 1.0, burn
+
+
+# ---- retrospective plane: TSDB + anomaly + flight recorder ------------------
+def _ttft_hist_2b(le100, le1000, total):
+    """Synthetic cumulative TTFT scrape with two finite buckets (the
+    burn helper's single bucket can't express a quantile spike)."""
+    name = 'skytpu_serve_ttft_ms'
+    return [(f'{name}_bucket', (('le', '100.0'),), float(le100)),
+            (f'{name}_bucket', (('le', '1000.0'),), float(le1000)),
+            (f'{name}_bucket', (('le', '+Inf'),), float(total)),
+            (f'{name}_count', (), float(total))]
+
+
+class TestControllerTimeseries:
+
+    def _launch_free_controller(self, monkeypatch, name):
+        """A ticking controller with every fleet interaction stubbed:
+        reconcile/probe/scrape are no-ops, the scrape aggregate and
+        signal dict come from mutable test state."""
+        from skypilot_tpu.serve import controller as controller_lib
+        serve_state.add_service(
+            name, {'readiness_probe': '/health', 'replicas': 1},
+            {'resources': {'cloud': 'local'}}, 1)
+        ctrl = controller_lib.ServeController(name)
+        monkeypatch.setattr(ctrl.manager, 'reconcile',
+                            lambda *a, **k: None)
+        monkeypatch.setattr(ctrl.manager, 'probe_all', lambda: None)
+        monkeypatch.setattr(ctrl.manager, 'scrape_metrics',
+                            lambda: None)
+        return ctrl
+
+    def test_tick_records_timeseries_and_payload_shape(self,
+                                                       monkeypatch):
+        """The /timeseries acceptance: after ticking on synthetic
+        scrapes the store answers with >=3 series, and the derived
+        TTFT quantile matches the hand-computed bucket-delta value."""
+        ctrl = self._launch_free_controller(monkeypatch, 'svc-ts')
+        scrape = [_ttft_hist_2b(10, 10, 10)
+                  + [('skytpu_serve_requests_total', (), 10.0)]]
+        monkeypatch.setattr(ctrl.manager, 'fleet_metrics',
+                            lambda: scrape[0])
+        monkeypatch.setattr(ctrl.manager, 'fleet_signals', lambda: {
+            'skytpu_serve_queue_depth_requests': 3.0,
+            'skytpu_serve_pending_prefill_tokens': 128.0,
+            'skytpu_serve_slots_active_count': 2.0,
+        })
+        row = serve_state.get_service('svc-ts')
+        ctrl.tick_once(row)
+        scrape[0] = (_ttft_hist_2b(20, 20, 20)
+                     + [('skytpu_serve_requests_total', (), 30.0)])
+        ctrl.tick_once(row)
+
+        payload = ctrl.timeseries_payload(None, 0.0)
+        assert set(payload['names']) >= {'queue_depth', 'req_rps',
+                                         'ttft_p50_ms', 'ttft_p99_ms'}
+        assert len(payload['names']) >= 3
+        # Window: +10 observations all <=100ms -> p99 = 99ms exactly
+        # (quantile of the bucket DELTA, independent of tick timing).
+        assert payload['series']['ttft_p99_ms'][-1][1] == \
+            pytest.approx(99.0)
+        assert payload['series']['queue_depth'][-1][1] == 3.0
+        # req_rps is timing-dependent but must be present and positive.
+        assert payload['series']['req_rps'][-1][1] > 0.0
+        # Name filtering + since filtering.
+        only = ctrl.timeseries_payload(['queue_depth'], 0.0)
+        assert list(only['series']) == ['queue_depth']
+        future = ctrl.timeseries_payload(None, time.time() + 3600)
+        assert all(not pts for pts in future['series'].values())
+
+    def test_ttft_spike_flags_anomaly_and_seals_postmortem(
+            self, monkeypatch):
+        """THE flight-recorder acceptance: a 5x TTFT spike after a
+        steady baseline flips the anomaly gauge past the threshold and
+        seals a postmortem JSON whose series include the spike."""
+        from skypilot_tpu.utils import metrics as metrics_lib
+        ctrl = self._launch_free_controller(monkeypatch, 'svc-spike')
+        assert ctrl._m is not None, 'metrics must be on for this test'
+        state = {'ticks': 0}
+
+        def fleet_metrics():
+            n = state['ticks']
+            # Each tick adds 10 observations <=100ms (p99 = 99ms); the
+            # LAST scrape adds them in (100, 1000] instead -> p99 jumps
+            # to 991ms, ~10x the baseline (scored against the pre-spike
+            # EWMA, so the spike must be the final observation).
+            if n < 10:
+                return _ttft_hist_2b(10 * n, 10 * n, 10 * n)
+            return _ttft_hist_2b(90, 10 * n, 10 * n)
+
+        monkeypatch.setattr(ctrl.manager, 'fleet_metrics', fleet_metrics)
+        monkeypatch.setattr(ctrl.manager, 'fleet_signals', lambda: {})
+        row = serve_state.get_service('svc-spike')
+        for tick in range(10):
+            state['ticks'] = tick + 1
+            ctrl.tick_once(row)
+
+        zscores = ctrl.anomaly.latest()
+        assert zscores['ttft_p99_ms'] >= ctrl.anomaly.z_threshold
+        samples = metrics_lib.parse_text(ctrl.metrics_payload())
+        gauge = metrics_lib.sample_value(
+            samples, 'skytpu_controller_anomaly_zscore_ratio',
+            {'series': 'ttft_p99_ms'})
+        assert gauge is not None and gauge >= ctrl.anomaly.z_threshold
+        # The black box: p50 AND p99 both jumped buckets, each sealing
+        # its own artifact (distinct throttle keys). Open the p99 one.
+        assert ctrl.recorder.sealed
+        boxes = []
+        for sealed in ctrl.recorder.sealed:
+            with open(sealed) as f:
+                boxes.append(json.load(f))
+        box = next(b for b in boxes
+                   if b['reason'] == 'anomaly:ttft_p99_ms')
+        spike_pts = [v for _, v in box['series']['ttft_p99_ms']]
+        assert spike_pts[-1] == pytest.approx(991.0)
+        assert any(v == pytest.approx(99.0) for v in spike_pts)
+        assert box['context']['anomaly_zscores']['ttft_p99_ms'] >= \
+            ctrl.anomaly.z_threshold
+        assert box['context']['service'] == 'svc-spike'
+        assert 'trace_ring' in box['context']
+        # /timeseries exposes the artifact path for operators.
+        payload = ctrl.timeseries_payload(None, 0.0)
+        assert payload['postmortems'] == ctrl.recorder.sealed
+
+    def test_replica_failure_transition_seals_postmortem(
+            self, monkeypatch):
+        from skypilot_tpu.serve.replica_manager import ReplicaStatus
+        ctrl = self._launch_free_controller(monkeypatch, 'svc-crash')
+        monkeypatch.setattr(ctrl.manager, 'fleet_metrics', lambda: [])
+        monkeypatch.setattr(ctrl.manager, 'fleet_signals', lambda: {})
+        replicas = [[]]
+        monkeypatch.setattr(ctrl.manager, 'replicas',
+                            lambda: replicas[0])
+        row = serve_state.get_service('svc-crash')
+        replicas[0] = [{'replica_id': 1, 'spot': False, 'url': '',
+                        'cluster_name': 'c1', 'version': 1,
+                        'status': ReplicaStatus.READY}]
+        ctrl.tick_once(row)
+        assert ctrl.recorder.sealed == []
+        # READY -> FAILED transition: the box seals exactly once.
+        replicas[0] = [{'replica_id': 1, 'spot': False, 'url': '',
+                        'cluster_name': 'c1', 'version': 1,
+                        'status': ReplicaStatus.FAILED}]
+        ctrl.tick_once(row)
+        ctrl.tick_once(row)  # still FAILED: no re-trigger
+        assert len(ctrl.recorder.sealed) == 1
+        with open(ctrl.recorder.sealed[0]) as f:
+            box = json.load(f)
+        assert box['reason'].startswith('replica:1:')

@@ -251,6 +251,37 @@ class TestMetricName:
         run = lint_fixture('metric_clean.py', 'metric-name')
         assert run.findings == []
 
+    def test_finalize_flags_family_renamed_away(self):
+        """Seeded bug: a full-tree scan whose registrations are missing
+        ONE expected family (here the controller anomaly series, as if
+        the gauge were renamed away) must produce exactly that
+        finding."""
+        from skypilot_tpu.lint.checkers.metric_names import (
+            EXPECTED_FAMILIES, MetricNameChecker)
+
+        class FullTreeRun:
+            full_tree = True
+
+        checker = MetricNameChecker()
+        checker._all_names = [f + 'x_total' for f in EXPECTED_FAMILIES
+                              if f != 'skytpu_controller_anomaly_']
+        findings = checker.finalize(FullTreeRun())
+        assert len(findings) == 1
+        assert 'skytpu_controller_anomaly_' in findings[0].message
+        # Every family registered: clean.
+        checker = MetricNameChecker()
+        checker._all_names = [f + 'x_total' for f in EXPECTED_FAMILIES]
+        assert checker.finalize(FullTreeRun()) == []
+
+    def test_observability_families_are_expected(self):
+        """The roofline + anomaly gauge families are tier-1
+        guarantees: dashboards and the microbench read them by name."""
+        from skypilot_tpu.lint.checkers import metric_names
+        for family in ('skytpu_engine_step_flops',
+                       'skytpu_engine_step_mfu_',
+                       'skytpu_controller_anomaly_'):
+            assert family in metric_names.EXPECTED_FAMILIES, family
+
 
 # ---- lock-order -------------------------------------------------------------
 class TestLockOrder:

@@ -1,0 +1,244 @@
+"""Ring TSDB + rate derivation + anomaly/flight-recorder edge cases.
+
+Pure host-side tests (no jax, no serve stack): the tier-1 pins for the
+controller's retrospective observability plane — ring wraparound,
+downsample-tier handoff, counter-reset handling, degenerate anomaly
+windows, and the flight recorder sealing every series with none
+dropped.
+"""
+import json
+import math
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+import pytest  # noqa: E402
+
+from skypilot_tpu.utils import tsdb  # noqa: E402
+
+
+# ---- SeriesRing / TimeSeriesStore -------------------------------------------
+class TestSeriesRing:
+
+    def test_query_prefers_raw_tier_when_it_covers(self):
+        ring = tsdb.SeriesRing(points=16, factor=2)
+        for t in range(100):
+            ring.append(float(t), float(t))
+        # Raw tier holds t=84..99; a query inside that span is answered
+        # at full resolution.
+        pts = ring.query(since=90.0)
+        assert [p[0] for p in pts] == [float(t) for t in range(90, 100)]
+        assert all(p[0] == p[1] for p in pts)
+
+    def test_wraparound_hands_off_to_downsampled_tier(self):
+        ring = tsdb.SeriesRing(points=16, factor=2)
+        for t in range(100):
+            ring.append(float(t), float(t))
+        # since=70 predates the raw ring's oldest point (84): tier 1
+        # (pairwise means, 2x the memory) answers instead of returning
+        # a truncated raw window.
+        pts = ring.query(since=70.0)
+        assert pts, 'coarser tier must cover what raw wrapped past'
+        assert pts[0][0] < 84.0
+        assert min(p[0] for p in pts) >= 70.0
+        # Tier-1 points are pairwise means of consecutive raw points:
+        # t values land on x.5 and value == t for this series.
+        assert all(p[0] * 2 % 1 == 0 and p[0] == p[1] for p in pts)
+
+    def test_query_past_all_tiers_answers_from_longest_memory(self):
+        ring = tsdb.SeriesRing(points=16, factor=2)
+        for t in range(100):
+            ring.append(float(t), float(t))
+        pts = ring.query(since=0.0)
+        assert pts, 'never empty-handed once points exist'
+        # Tier 2 (factor^2 = 4-point means) reaches back the furthest.
+        assert pts[0][0] < ring.query(since=70.0)[0][0]
+
+    def test_downsample_fold_is_mean(self):
+        ring = tsdb.SeriesRing(points=8, factor=2)
+        for t, v in [(0, 10.0), (1, 20.0), (2, 2.0), (3, 4.0)]:
+            ring.append(float(t), v)
+        tier1 = list(ring._tiers[1])
+        assert tier1 == [(0.5, 15.0), (2.5, 3.0)]
+
+    def test_store_skips_non_finite_and_is_queryable_by_name(self):
+        store = tsdb.TimeSeriesStore(points=16, factor=2)
+        store.record(1.0, {'a': 1.0, 'b': float('nan'),
+                           'c': float('inf')})
+        store.record(2.0, {'a': 2.0, 'b': 3.0})
+        assert store.names() == ['a', 'b']
+        out = store.query(['a', 'b', 'missing'], since=0.0)
+        assert out['a'] == [[1.0, 1.0], [2.0, 2.0]]
+        assert out['b'] == [[2.0, 3.0]]
+        assert 'missing' not in out
+
+
+# ---- RateDeriver ------------------------------------------------------------
+def _ttft_hist(le100, le1000, total):
+    """Synthetic cumulative scrape of skytpu_serve_ttft_ms."""
+    name = 'skytpu_serve_ttft_ms'
+    return [(f'{name}_bucket', (('le', '100.0'),), float(le100)),
+            (f'{name}_bucket', (('le', '1000.0'),), float(le1000)),
+            (f'{name}_bucket', (('le', '+Inf'),), float(total)),
+            (f'{name}_count', (), float(total))]
+
+
+class TestRateDeriver:
+
+    def test_first_call_primes_and_returns_empty(self):
+        rd = tsdb.RateDeriver()
+        samples = [('skytpu_serve_requests_total', (), 50.0)]
+        assert rd.derive(100.0, samples) == {}
+
+    def test_counter_rate_pinned(self):
+        rd = tsdb.RateDeriver()
+        rd.derive(100.0, [('skytpu_serve_requests_total', (), 50.0)])
+        out = rd.derive(110.0, [('skytpu_serve_requests_total', (),
+                                 100.0)])
+        assert out['req_rps'] == pytest.approx(5.0)
+
+    def test_counter_reset_uses_current_value_as_delta(self):
+        rd = tsdb.RateDeriver()
+        rd.derive(100.0, [('skytpu_serve_requests_total', (), 50.0)])
+        # Replica restarted: cumulative DROPPED 50 -> 30. The honest
+        # window delta is the 30 requests since the reset.
+        out = rd.derive(110.0, [('skytpu_serve_requests_total', (),
+                                 30.0)])
+        assert out['req_rps'] == pytest.approx(3.0)
+
+    def test_histogram_delta_quantiles_pinned(self):
+        """The acceptance pin: windowed p50/p99 from the DELTA of two
+        cumulative bucket snapshots, values hand-computed from the
+        PromQL interpolation rule."""
+        rd = tsdb.RateDeriver()
+        rd.derive(0.0, _ttft_hist(10, 10, 10))
+        # Window: +10 observations, all <= 100ms.
+        out = rd.derive(10.0, _ttft_hist(20, 20, 20))
+        # rank p50 = 5 of 10 in the 0..100 bucket -> 50ms; p99 -> 99ms.
+        assert out['ttft_p50_ms'] == pytest.approx(50.0)
+        assert out['ttft_p99_ms'] == pytest.approx(99.0)
+        # Next window: +10 observations, all in (100, 1000] — the
+        # cumulative le=100 bucket does NOT move.
+        out = rd.derive(20.0, _ttft_hist(20, 30, 30))
+        assert out['ttft_p50_ms'] == pytest.approx(550.0)
+        assert out['ttft_p99_ms'] == pytest.approx(991.0)
+
+    def test_histogram_reset_treats_snapshot_as_window(self):
+        rd = tsdb.RateDeriver()
+        rd.derive(0.0, _ttft_hist(20, 20, 20))
+        # Cumulative went DOWN (restart): the current snapshot IS the
+        # window — all 5 observations <= 100ms.
+        out = rd.derive(10.0, _ttft_hist(5, 5, 5))
+        assert out['ttft_p99_ms'] == pytest.approx(99.0)
+
+    def test_empty_window_emits_no_quantiles(self):
+        rd = tsdb.RateDeriver()
+        rd.derive(0.0, _ttft_hist(10, 10, 10))
+        out = rd.derive(10.0, _ttft_hist(10, 10, 10))
+        assert 'ttft_p50_ms' not in out
+
+    def test_windowed_mean_from_sum_count(self):
+        rd = tsdb.RateDeriver()
+        fam = 'skytpu_engine_spec_accept_tokens'
+        rd.derive(0.0, [(f'{fam}_sum', (), 10.0),
+                        (f'{fam}_count', (), 5.0)])
+        out = rd.derive(10.0, [(f'{fam}_sum', (), 40.0),
+                               (f'{fam}_count', (), 15.0)])
+        assert out['spec_accept_per_step'] == pytest.approx(3.0)
+
+
+# ---- EwmaAnomalyDetector ----------------------------------------------------
+class TestAnomalyDetector:
+
+    def test_warmup_window_scores_zero(self):
+        det = tsdb.EwmaAnomalyDetector(z_threshold=4.0, min_samples=5)
+        zs = [det.observe('x', v) for v in (1.0, 9.0, 1.0, 9.0, 1.0)]
+        assert zs == [0.0] * 5
+
+    def test_constant_baseline_spike_hits_cap(self):
+        det = tsdb.EwmaAnomalyDetector(z_threshold=4.0, min_samples=5)
+        for _ in range(8):
+            assert det.observe('x', 10.0) == 0.0
+        # Zero-variance baseline: ANY departure is definitely
+        # anomalous, capped to stay JSON-sane.
+        assert det.observe('x', 50.0) == det.Z_CAP
+        assert det.flagged(det.latest()) == ['x']
+
+    def test_spike_scored_against_pre_spike_baseline(self):
+        det = tsdb.EwmaAnomalyDetector(z_threshold=4.0, min_samples=5)
+        for v in (10.0, 11.0, 9.0, 10.0, 11.0, 9.0, 10.0, 11.0):
+            det.observe('ttft', v)
+        z = det.observe('ttft', 50.0)  # the injected 5x spike
+        assert z >= 4.0
+        assert det.flagged({'ttft': z}) == ['ttft']
+
+    def test_small_wobble_not_flagged(self):
+        det = tsdb.EwmaAnomalyDetector(z_threshold=4.0, min_samples=5)
+        for v in (10.0, 11.0, 9.0, 10.0, 11.0, 9.0, 10.0, 11.0):
+            det.observe('ttft', v)
+        z = det.observe('ttft', 12.0)
+        assert z < 4.0
+
+    def test_degenerate_inputs(self):
+        det = tsdb.EwmaAnomalyDetector(z_threshold=4.0, min_samples=5)
+        assert det.observe_all({}) == {}
+        for _ in range(8):
+            det.observe('x', 10.0)
+        before = det.latest()['x']
+        # Non-finite observation: no state update, last score stands.
+        assert det.observe('x', float('nan')) == before
+        assert det.observe('x', 10.0) == 0.0
+        assert not math.isnan(det._state['x'][1])
+
+
+# ---- FlightRecorder ---------------------------------------------------------
+class TestFlightRecorder:
+
+    def _store(self):
+        store = tsdb.TimeSeriesStore(points=512, factor=8)
+        for t in range(0, 201, 10):
+            store.record(float(t), {'req_rps': 5.0,
+                                    'ttft_p99_ms': 90.0 + t,
+                                    'queue_depth': 2.0})
+        return store
+
+    def test_seal_writes_every_series_in_window(self, tmp_path):
+        store = self._store()
+        rec = tsdb.FlightRecorder(store, str(tmp_path), window_s=120.0)
+        path = rec.seal('anomaly:ttft_p99_ms', now=200.0,
+                        context={'note': 'spike'})
+        assert path and os.path.exists(path)
+        with open(path) as f:
+            box = json.load(f)
+        assert box['reason'] == 'anomaly:ttft_p99_ms'
+        # ZERO dropped series: everything the store knows is in the box.
+        assert sorted(box['series']) == store.names()
+        # ... restricted to the flight window.
+        times = [p[0] for p in box['series']['ttft_p99_ms']]
+        assert min(times) >= 200.0 - 120.0
+        assert box['context'] == {'note': 'spike'}
+        assert rec.sealed == [path]
+
+    def test_repeat_trigger_throttled_within_window(self, tmp_path):
+        rec = tsdb.FlightRecorder(self._store(), str(tmp_path),
+                                  window_s=120.0)
+        assert rec.seal('anomaly:ttft_p99_ms', now=200.0) is not None
+        # Same reason-class storming every tick: one artifact only.
+        assert rec.seal('anomaly:ttft_p99_ms', now=210.0) is None
+        # Replica transitions share a (class, subject) throttle key.
+        assert rec.seal('replica:3:FAILED', now=210.0) is not None
+        assert rec.seal('replica:3:PREEMPTED', now=215.0) is None
+        assert rec.seal('replica:4:FAILED', now=215.0) is not None
+        # Past the window the same class seals again.
+        assert rec.seal('anomaly:ttft_p99_ms', now=330.0) is not None
+        assert len(rec.sealed) == 4
+
+    def test_seal_on_empty_store_still_produces_artifact(self, tmp_path):
+        store = tsdb.TimeSeriesStore()
+        rec = tsdb.FlightRecorder(store, str(tmp_path), window_s=60.0)
+        path = rec.seal('replica:0:FAILED', now=5.0)
+        with open(path) as f:
+            box = json.load(f)
+        assert box['series'] == {}
